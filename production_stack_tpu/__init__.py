@@ -1,0 +1,22 @@
+"""production-stack-tpu: a TPU-native LLM serving stack.
+
+A ground-up rebuild of the capabilities of vLLM Production Stack
+(reference: /root/reference) designed TPU-first:
+
+- ``engine/``   -- a JAX/XLA/Pallas OpenAI-compatible serving engine with a
+  paged KV cache in TPU HBM, continuous batching, and pjit/shard_map
+  parallelism over a ``jax.sharding.Mesh`` (the part the reference outsources
+  to vLLM container images).
+- ``models/``   -- functional JAX model definitions (Llama, OPT, Mixtral).
+- ``ops/``      -- Pallas TPU kernels (paged attention, flash attention) with
+  pure-XLA fallbacks for CPU test meshes.
+- ``parallel/`` -- mesh construction, sharding rules (dp/tp/pp/sp/ep), ring
+  attention, and the KV transfer fabric (ICI/DCN) replacing NIXL/UCX.
+- ``router/``   -- the OpenAI-compatible request router: service discovery,
+  session/prefix/KV-aware routing, disaggregated prefill two-phase flow,
+  stats, /metrics (mirrors reference src/vllm_router/).
+- ``kv/``       -- KV offload (HBM -> host), standalone cache server and the
+  KV controller used for kv-aware routing (the LMCache-equivalent layer).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,159 @@
+"""Shared small utilities for the router and engine.
+
+Capability parity with reference src/vllm_router/utils.py:36-223 (SingletonMeta,
+ModelType health-probe payloads, URL validation, ulimit bump, static-config
+parsing helpers). Implementations are original.
+"""
+
+import enum
+import resource
+import threading
+from urllib.parse import urlparse
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+class SingletonMeta(type):
+    """Thread-safe singleton metaclass (cf. reference utils.py:36-49)."""
+
+    _instances: dict = {}
+    _lock = threading.Lock()
+
+    def __call__(cls, *args, **kwargs):
+        if cls not in cls._instances:
+            with cls._lock:
+                if cls not in cls._instances:
+                    cls._instances[cls] = super().__call__(*args, **kwargs)
+        return cls._instances[cls]
+
+    @classmethod
+    def _reset_instance(mcs, cls):
+        """Testing hook: drop the cached instance for ``cls``."""
+        with mcs._lock:
+            mcs._instances.pop(cls, None)
+
+
+class SingletonABCMeta(SingletonMeta):
+    """Singleton + ABC combination (used by abstract singletons)."""
+
+
+class ModelType(enum.Enum):
+    """Model capability classes and the dummy request used to health-probe each.
+
+    Mirrors the semantics of reference utils.py:68-107 (chat / completion /
+    embeddings / rerank / score / transcription probes).
+    """
+
+    chat = "/v1/chat/completions"
+    completion = "/v1/completions"
+    embeddings = "/v1/embeddings"
+    rerank = "/v1/rerank"
+    score = "/v1/score"
+    transcription = "/v1/audio/transcriptions"
+
+    @staticmethod
+    def get_test_payload(model_type: str):
+        mt = ModelType[model_type]
+        if mt == ModelType.chat:
+            return {
+                "messages": [{"role": "user", "content": "Hi"}],
+                "temperature": 0.0,
+                "max_tokens": 3,
+            }
+        if mt == ModelType.completion:
+            return {"prompt": "Hi", "temperature": 0.0, "max_tokens": 3}
+        if mt == ModelType.embeddings:
+            return {"input": "Hi"}
+        if mt == ModelType.rerank:
+            return {"query": "q", "documents": ["d"]}
+        if mt == ModelType.score:
+            return {"text_1": "a", "text_2": "b"}
+        if mt == ModelType.transcription:
+            return {"file": _silent_wav()}
+        raise ValueError(f"unknown model type {model_type}")
+
+    @staticmethod
+    def get_all_fields():
+        return [m.name for m in ModelType]
+
+
+def _silent_wav(duration_s: float = 0.1, rate: int = 16000) -> bytes:
+    """Generate a minimal silent RIFF/WAV payload for transcription probes.
+
+    The reference generates one at runtime too (utils.py:188-223); we build
+    the 44-byte PCM header by hand to avoid any audio dependency.
+    """
+    n_samples = int(duration_s * rate)
+    data_size = n_samples * 2  # 16-bit mono
+    header = b"RIFF"
+    header += (36 + data_size).to_bytes(4, "little")
+    header += b"WAVEfmt "
+    header += (16).to_bytes(4, "little")
+    header += (1).to_bytes(2, "little")      # PCM
+    header += (1).to_bytes(2, "little")      # mono
+    header += rate.to_bytes(4, "little")
+    header += (rate * 2).to_bytes(4, "little")
+    header += (2).to_bytes(2, "little")
+    header += (16).to_bytes(2, "little")
+    header += b"data"
+    header += data_size.to_bytes(4, "little")
+    return header + b"\x00" * data_size
+
+
+def validate_url(url: str) -> bool:
+    """True iff ``url`` is an absolute http(s) URL with a hostname."""
+    try:
+        parsed = urlparse(url)
+        return parsed.scheme in ("http", "https") and bool(parsed.netloc)
+    except (ValueError, AttributeError):
+        return False
+
+
+def parse_static_urls(static_backends: str) -> "list[str]":
+    urls = parse_comma_separated_args(static_backends)
+    out = []
+    for url in urls:
+        if validate_url(url):
+            out.append(url)
+        else:
+            logger.warning("Skipping invalid URL: %s", url)
+    return out
+
+
+def parse_static_model_types(static_model_types: str) -> "list[str]":
+    types = parse_comma_separated_args(static_model_types)
+    valid = set(ModelType.get_all_fields())
+    for t in types or []:
+        if t not in valid:
+            raise ValueError(f"Invalid model type {t!r}; expected one of {sorted(valid)}")
+    return types
+
+
+def parse_comma_separated_args(arg: "str | None") -> "list[str] | None":
+    if arg is None:
+        return None
+    return [item.strip() for item in arg.split(",") if item.strip()]
+
+
+def parse_static_aliases(static_aliases: str) -> "dict[str, str]":
+    """Parse ``alias:model,alias2:model2`` into a dict."""
+    aliases: dict = {}
+    for pair in parse_comma_separated_args(static_aliases) or []:
+        if ":" not in pair:
+            raise ValueError(f"Invalid alias spec {pair!r}, expected alias:model")
+        alias, model = pair.split(":", 1)
+        aliases[alias.strip()] = model.strip()
+    return aliases
+
+
+def set_ulimit(target_soft_limit: int = 65535) -> None:
+    """Raise RLIMIT_NOFILE soft limit so many concurrent streams can be open."""
+    res = resource.RLIMIT_NOFILE
+    soft, hard = resource.getrlimit(res)
+    if soft < target_soft_limit:
+        try:
+            resource.setrlimit(res, (min(target_soft_limit, hard), hard))
+        except ValueError as e:
+            logger.warning("Could not raise ulimit -n to %d: %s", target_soft_limit, e)

@@ -1,0 +1,43 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX imports.
+
+All engine/parallel tests run on a CPU-emulated 8-device mesh so that
+tp/dp/sp/ep shardings are exercised hermetically (no TPU needed), mirroring
+how the driver dry-runs `__graft_entry__.dryrun_multichip`.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("TPU_STACK_LOG_LEVEL", "WARNING")
+
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(items):
+    for item in items:
+        if inspect.iscoroutinefunction(getattr(item, "function", None)):
+            item.add_marker(pytest.mark.asyncio)
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    """Minimal asyncio test support (pytest-asyncio may not be installed)."""
+    func = pyfuncitem.function
+    if inspect.iscoroutinefunction(func):
+        sig = inspect.signature(func)
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in sig.parameters
+            if name in pyfuncitem.funcargs
+        }
+        asyncio.run(func(**kwargs))
+        return True
+    return None

@@ -1,0 +1,78 @@
+"""Unit tests for shared utilities (cf. reference src/tests/test_utils.py,
+test_singleton.py)."""
+
+import threading
+
+from production_stack_tpu.utils import (
+    ModelType,
+    SingletonMeta,
+    parse_static_aliases,
+    parse_static_model_types,
+    parse_static_urls,
+    validate_url,
+)
+
+
+class _Single(metaclass=SingletonMeta):
+    def __init__(self):
+        self.value = 0
+
+
+def test_singleton_identity():
+    a = _Single()
+    b = _Single()
+    assert a is b
+    a.value = 42
+    assert b.value == 42
+
+
+def test_singleton_thread_safety():
+    SingletonMeta._reset_instance(_Single)
+    instances = []
+
+    def make():
+        instances.append(_Single())
+
+    threads = [threading.Thread(target=make) for _ in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(i is instances[0] for i in instances)
+
+
+def test_validate_url():
+    assert validate_url("http://localhost:8000")
+    assert validate_url("https://engine.svc.cluster.local:8000/v1")
+    assert not validate_url("localhost:8000")
+    assert not validate_url("ftp://x")
+    assert not validate_url("")
+
+
+def test_parse_static_urls_skips_invalid():
+    urls = parse_static_urls("http://a:1, bad, http://b:2")
+    assert urls == ["http://a:1", "http://b:2"]
+
+
+def test_parse_static_aliases():
+    assert parse_static_aliases("gpt-4:llama-3-8b, x:y") == {
+        "gpt-4": "llama-3-8b",
+        "x": "y",
+    }
+
+
+def test_parse_static_model_types():
+    assert parse_static_model_types("chat,completion") == ["chat", "completion"]
+    try:
+        parse_static_model_types("bogus")
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
+
+
+def test_model_type_payloads():
+    for name in ModelType.get_all_fields():
+        payload = ModelType.get_test_payload(name)
+        assert payload
+    wav = ModelType.get_test_payload("transcription")["file"]
+    assert wav[:4] == b"RIFF"
