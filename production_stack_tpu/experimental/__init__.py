@@ -1,0 +1,1 @@
+"""Experimental, feature-gated router features (reference src/vllm_router/experimental/)."""

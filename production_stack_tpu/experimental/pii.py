@@ -1,0 +1,92 @@
+"""PII detection: block requests containing detected PII.
+
+Rebuild of reference ``src/vllm_router/experimental/pii/`` (~600 LoC):
+``check_pii`` middleware semantics (``pii/middleware.py:101-154``) with a
+regex analyzer (``pii/analyzers/regex.py``). The Presidio analyzer variant is
+not shipped (presidio is not in this image); the analyzer interface mirrors
+it so one can be plugged in.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from prometheus_client import Counter
+
+from production_stack_tpu.router.metrics import REGISTRY
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+pii_requests_blocked = Counter(
+    "vllm_router:pii_requests_blocked_total",
+    "Requests blocked due to detected PII",
+    ["entity_type"],
+    registry=REGISTRY,
+)
+
+PII_PATTERNS = {
+    "EMAIL_ADDRESS": re.compile(
+        r"[a-zA-Z0-9._%+-]+@[a-zA-Z0-9.-]+\.[a-zA-Z]{2,}"
+    ),
+    "US_SSN": re.compile(r"\b\d{3}-\d{2}-\d{4}\b"),
+    "CREDIT_CARD": re.compile(r"\b(?:\d[ -]*?){13,16}\b"),
+    "PHONE_NUMBER": re.compile(
+        r"\b(?:\+?1[-.\s]?)?\(?\d{3}\)?[-.\s]\d{3}[-.\s]\d{4}\b"
+    ),
+    "IP_ADDRESS": re.compile(r"\b(?:\d{1,3}\.){3}\d{1,3}\b"),
+    "API_KEY": re.compile(r"\b(?:sk|pk|api|key)[-_][a-zA-Z0-9]{16,}\b"),
+    "IBAN": re.compile(r"\b[A-Z]{2}\d{2}[A-Z0-9]{11,30}\b"),
+}
+
+
+def _luhn_ok(digits: str) -> bool:
+    total, alt = 0, False
+    for d in reversed(digits):
+        n = int(d)
+        if alt:
+            n *= 2
+            if n > 9:
+                n -= 9
+        total += n
+        alt = not alt
+    return total % 10 == 0
+
+
+class RegexPIIAnalyzer:
+    def analyze(self, text: str) -> List[str]:
+        found = []
+        for entity, pattern in PII_PATTERNS.items():
+            m = pattern.search(text)
+            if not m:
+                continue
+            if entity == "CREDIT_CARD":
+                digits = re.sub(r"\D", "", m.group())
+                if len(digits) < 13 or not _luhn_ok(digits):
+                    continue
+            found.append(entity)
+        return found
+
+
+class PIIDetector:
+    """Checks request prompts/messages for PII before routing."""
+
+    def __init__(self, analyzer=None):
+        self.analyzer = analyzer or RegexPIIAnalyzer()
+
+    async def check_request(self, request_json: dict) -> Optional[str]:
+        texts = []
+        if isinstance(request_json.get("prompt"), str):
+            texts.append(request_json["prompt"])
+        for m in request_json.get("messages", []) or []:
+            if isinstance(m.get("content"), str):
+                texts.append(m["content"])
+        for text in texts:
+            entities = self.analyzer.analyze(text)
+            if entities:
+                for e in entities:
+                    pii_requests_blocked.labels(entity_type=e).inc()
+                logger.warning("Blocked request containing PII: %s", entities)
+                return ",".join(entities)
+        return None

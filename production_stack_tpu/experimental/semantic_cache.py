@@ -1,0 +1,165 @@
+"""Semantic cache: serve chat completions from similar cached requests.
+
+Rebuild of reference ``src/vllm_router/experimental/semantic_cache*`` (~1100
+LoC): embed the chat messages, search a vector store for a similar past
+request, and serve the cached response on a hit; store new responses after
+completion.
+
+The reference uses sentence-transformers + FAISS. FAISS is not in this image
+and model downloads require egress, so the store is a numpy matrix with exact
+cosine search (fine for cache sizes this layer sees) and the embedder is
+pluggable: a deterministic hashed bag-of-ngrams embedder by default (no
+downloads), sentence-transformers if a local model path is supplied.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+class HashedNgramEmbedder:
+    """Deterministic text embedding via hashed character n-grams.
+
+    No model download, no heavy deps; cosine-similar texts share n-grams.
+    """
+
+    def __init__(self, dim: int = 512, ngram: int = 3):
+        self.dim = dim
+        self.ngram = ngram
+
+    def encode(self, texts: List[str]) -> np.ndarray:
+        import xxhash
+
+        out = np.zeros((len(texts), self.dim), dtype=np.float32)
+        for i, text in enumerate(texts):
+            t = text.lower()
+            for j in range(max(len(t) - self.ngram + 1, 1)):
+                h = xxhash.xxh64_intdigest(t[j : j + self.ngram])
+                out[i, h % self.dim] += 1.0
+            norm = np.linalg.norm(out[i])
+            if norm > 0:
+                out[i] /= norm
+        return out
+
+
+class SentenceTransformerEmbedder:
+    def __init__(self, model_path: str):
+        from sentence_transformers import SentenceTransformer
+
+        self.model = SentenceTransformer(model_path)
+
+    def encode(self, texts: List[str]) -> np.ndarray:
+        vecs = self.model.encode(texts, normalize_embeddings=True)
+        return np.asarray(vecs, dtype=np.float32)
+
+
+class VectorStore:
+    """Exact cosine-similarity store (FAISS flat-IP equivalent)."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+        self._vecs = np.zeros((0, dim), dtype=np.float32)
+        self._payloads: List[dict] = []
+        self._lock = threading.Lock()
+
+    def add(self, vec: np.ndarray, payload: dict) -> None:
+        with self._lock:
+            self._vecs = np.vstack([self._vecs, vec.reshape(1, -1)])
+            self._payloads.append(payload)
+
+    def search(self, vec: np.ndarray, threshold: float) -> Optional[dict]:
+        with self._lock:
+            if len(self._payloads) == 0:
+                return None
+            sims = self._vecs @ vec.reshape(-1)
+            best = int(np.argmax(sims))
+            if sims[best] >= threshold:
+                return self._payloads[best]
+            return None
+
+    def __len__(self) -> int:
+        return len(self._payloads)
+
+
+class SemanticCache:
+    """Reference semantic_cache.py:77-150 semantics: search before routing,
+    store after completion; per-model partitions."""
+
+    def __init__(
+        self,
+        model_name: str = "hashed-ngram",
+        cache_dir: Optional[str] = None,
+        threshold: float = 0.95,
+        dim: int = 512,
+    ):
+        if model_name and os.path.isdir(model_name):
+            self.embedder = SentenceTransformerEmbedder(model_name)
+            probe = self.embedder.encode(["probe"])
+            dim = probe.shape[1]
+        else:
+            self.embedder = HashedNgramEmbedder(dim=dim)
+        self.threshold = threshold
+        self._stores: Dict[str, VectorStore] = {}
+        self._dim = dim
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _render(request_json: dict) -> str:
+        parts = []
+        for m in request_json.get("messages", []) or []:
+            c = m.get("content")
+            if isinstance(c, str):
+                parts.append(f"{m.get('role')}: {c}")
+        return "\n".join(parts)
+
+    def _store_for(self, model: str) -> VectorStore:
+        if model not in self._stores:
+            self._stores[model] = VectorStore(self._dim)
+        return self._stores[model]
+
+    async def check(self, request_json: dict) -> Optional[dict]:
+        """Return a cached chat completion response dict on a hit."""
+        if request_json.get("stream"):
+            return None
+        text = self._render(request_json)
+        if not text:
+            return None
+        vec = self.embedder.encode([text])[0]
+        hit = self._store_for(request_json.get("model", "")).search(
+            vec, self.threshold
+        )
+        if hit is not None:
+            self.hits += 1
+            logger.info("Semantic cache hit (%d total)", self.hits)
+            response = dict(hit["response"])
+            response["cached"] = True
+            return response
+        self.misses += 1
+        return None
+
+    async def maybe_store(self, request_json: dict, response_body: bytes) -> None:
+        if request_json.get("stream"):
+            return
+        try:
+            response = json.loads(response_body)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return
+        if "choices" not in response:
+            return
+        text = self._render(request_json)
+        if not text:
+            return
+        vec = self.embedder.encode([text])[0]
+        self._store_for(request_json.get("model", "")).add(
+            vec, {"request": request_json, "response": response}
+        )

@@ -1,0 +1,13 @@
+"""KV cache layer: controller, offload, cache server, transfer.
+
+The LMCache-equivalent subsystem of the stack (reference integrates LMCache
+via env config — ``helm/templates/deployment-vllm-multi.yaml:182-195`` — and
+embeds its controller in the router for KV-aware routing,
+``src/vllm_router/routers/routing_logic.py:238-255``). Here the layer is
+native to the stack:
+
+- :mod:`controller`  -- tracks which engine holds which token-prefix.
+- :mod:`offload`     -- TPU HBM -> host RAM KV block offload.
+- :mod:`cache_server` -- standalone remote KV cache tier.
+- :mod:`transfer`    -- engine-to-engine KV movement (disaggregated prefill).
+"""

@@ -1,0 +1,155 @@
+"""KV controller: tracks which engine holds which token-prefix.
+
+Replaces the LMCache controller the reference embeds in its router for
+KV-aware routing (reference ``src/vllm_router/routers/routing_logic.py:238-344``;
+engine workers register via ``LMCACHE_ENABLE_CONTROLLER`` env,
+``helm/templates/deployment-vllm-multi.yaml:324-339``).
+
+Design: engines report *chunk hashes* of the prefixes they admit to (and
+evict from) their KV caches. The controller keeps a trie of chunk hashes →
+set of instance ids, answering "which live engine holds the longest stored
+prefix of this prompt". Chunk hashing matches the router's prefix trie
+(xxhash64 over fixed-size character chunks) so router and engines agree on
+granularity without sharing a tokenizer.
+
+Runs in-process in the router (as the reference does) and is also exposed
+over HTTP by the router app (``/kv/register``, ``/kv/admit``, ``/kv/evict``,
+``/kv/lookup``) so out-of-process engines can report — the reference's
+controller↔worker TCP channel equivalent.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+import xxhash
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+_global_kv_controller: Optional["KVController"] = None
+
+CHUNK_SIZE = 128  # characters per hash chunk; matches router.hashtrie default
+
+
+def chunk_hashes(text: str, chunk_size: int = CHUNK_SIZE) -> List[int]:
+    return [
+        xxhash.xxh64_intdigest(text[i : i + chunk_size])
+        for i in range(0, len(text), chunk_size)
+    ]
+
+
+class _Node:
+    __slots__ = ("children", "instances")
+
+    def __init__(self):
+        self.children: Dict[int, "_Node"] = {}
+        self.instances: Set[str] = set()
+
+
+class KVController:
+    """In-process KV index. All methods are coroutine-safe via one lock."""
+
+    def __init__(self, chunk_size: int = CHUNK_SIZE):
+        self.chunk_size = chunk_size
+        self._root = _Node()
+        self._instances: Dict[str, dict] = {}  # id -> {url, last_seen}
+        self._lock = asyncio.Lock()
+
+    # -- instance registry (reference QueryInstMsg / instance-id→URL map) --
+    async def register_instance(self, instance_id: str, url: str) -> None:
+        async with self._lock:
+            self._instances[instance_id] = {"url": url, "last_seen": time.time()}
+
+    async def deregister_instance(self, instance_id: str) -> None:
+        async with self._lock:
+            self._instances.pop(instance_id, None)
+            stack = [self._root]
+            while stack:
+                node = stack.pop()
+                node.instances.discard(instance_id)
+                stack.extend(node.children.values())
+
+    async def instance_url(self, instance_id: str) -> Optional[str]:
+        async with self._lock:
+            info = self._instances.get(instance_id)
+            return info["url"] if info else None
+
+    async def instances(self) -> Dict[str, str]:
+        async with self._lock:
+            return {k: v["url"] for k, v in self._instances.items()}
+
+    # -- admission/eviction reports from engines ---------------------------
+    async def admit(self, instance_id: str, hashes: List[int]) -> None:
+        async with self._lock:
+            if instance_id in self._instances:
+                self._instances[instance_id]["last_seen"] = time.time()
+            node = self._root
+            for h in hashes:
+                nxt = node.children.get(h)
+                if nxt is None:
+                    nxt = _Node()
+                    node.children[h] = nxt
+                nxt.instances.add(instance_id)
+                node = nxt
+
+    async def admit_text(self, instance_id: str, text: str) -> None:
+        await self.admit(instance_id, chunk_hashes(text, self.chunk_size))
+
+    async def evict(self, instance_id: str, hashes: List[int]) -> None:
+        """Evict a prefix: the instance no longer holds `hashes` nor anything
+        below it."""
+        async with self._lock:
+            node = self._root
+            path = []
+            for h in hashes:
+                nxt = node.children.get(h)
+                if nxt is None:
+                    return
+                path.append(nxt)
+                node = nxt
+            stack = [node]
+            while stack:
+                n = stack.pop()
+                n.instances.discard(instance_id)
+                stack.extend(n.children.values())
+
+    # -- lookup (reference LookupMsg) --------------------------------------
+    async def lookup(self, text: str) -> Optional[Tuple[int, str]]:
+        """Longest stored prefix of ``text`` → (matched_chars, instance_id)."""
+        hashes = chunk_hashes(text, self.chunk_size)
+        async with self._lock:
+            node = self._root
+            matched = 0
+            best: Optional[Set[str]] = None
+            for h in hashes:
+                nxt = node.children.get(h)
+                if nxt is None or not nxt.instances:
+                    break
+                live = nxt.instances & set(self._instances)
+                if not live:
+                    break
+                matched += 1
+                best = live
+                node = nxt
+            if not best:
+                return None
+            matched_chars = min(matched * self.chunk_size, len(text))
+            # Deterministic tiebreak: most-recently-seen instance.
+            inst = max(
+                best, key=lambda i: self._instances.get(i, {}).get("last_seen", 0)
+            )
+            return matched_chars, inst
+
+
+def initialize_kv_controller(chunk_size: int = CHUNK_SIZE) -> KVController:
+    global _global_kv_controller
+    _global_kv_controller = KVController(chunk_size)
+    return _global_kv_controller
+
+
+def get_kv_controller() -> Optional[KVController]:
+    return _global_kv_controller

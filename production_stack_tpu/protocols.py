@@ -1,0 +1,179 @@
+"""OpenAI-compatible protocol models shared by router and engine.
+
+Mirrors the surface of the reference's ``src/vllm_router/protocols.py:11-57``
+(ModelCard/ModelList/ErrorResponse with extra-field tolerance), extended with
+the request/response models the TPU engine needs to implement the OpenAI API
+natively (the reference outsources those to vLLM).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Literal, Optional, Union
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+class OpenAIBaseModel(BaseModel):
+    """Base model that tolerates (and logs once) extra fields.
+
+    cf. reference src/vllm_router/protocols.py:11-33.
+    """
+
+    model_config = ConfigDict(extra="allow")
+
+    def __init__(self, **data: Any):
+        super().__init__(**data)
+        declared = set(self.__class__.model_fields)
+        extras = set(data) - declared
+        if extras:
+            logger.debug(
+                "Extra fields on %s: %s", self.__class__.__name__, sorted(extras)
+            )
+
+
+class ModelCard(OpenAIBaseModel):
+    id: str
+    object: str = "model"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    owned_by: str = "production-stack-tpu"
+    root: Optional[str] = None
+    parent: Optional[str] = None
+
+
+class ModelList(OpenAIBaseModel):
+    object: str = "list"
+    data: List[ModelCard] = Field(default_factory=list)
+
+
+class ErrorResponse(OpenAIBaseModel):
+    object: str = "error"
+    message: str
+    type: str = "invalid_request_error"
+    param: Optional[str] = None
+    code: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# Engine-side request/response models (OpenAI API implemented natively).
+# ---------------------------------------------------------------------------
+
+
+class ChatMessage(OpenAIBaseModel):
+    role: str
+    content: Union[str, List[Dict[str, Any]], None] = None
+    name: Optional[str] = None
+
+
+class SamplingParamsMixin(BaseModel):
+    model_config = ConfigDict(extra="allow")
+
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    max_tokens: Optional[int] = None
+    max_completion_tokens: Optional[int] = None
+    n: int = 1
+    stop: Optional[Union[str, List[str]]] = None
+    seed: Optional[int] = None
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+    ignore_eos: bool = False
+    stream: bool = False
+    stream_options: Optional[Dict[str, Any]] = None
+    logprobs: Optional[Union[bool, int]] = None
+    top_logprobs: Optional[int] = None
+
+
+class ChatCompletionRequest(SamplingParamsMixin, OpenAIBaseModel):
+    model: str
+    messages: List[ChatMessage]
+    user: Optional[str] = None
+
+
+class CompletionRequest(SamplingParamsMixin, OpenAIBaseModel):
+    model: str
+    prompt: Union[str, List[str], List[int], List[List[int]]]
+    echo: bool = False
+    user: Optional[str] = None
+
+
+class EmbeddingRequest(OpenAIBaseModel):
+    model: str
+    input: Union[str, List[str], List[int], List[List[int]]]
+    encoding_format: str = "float"
+    user: Optional[str] = None
+
+
+class TokenizeRequest(OpenAIBaseModel):
+    model: Optional[str] = None
+    prompt: Optional[str] = None
+    messages: Optional[List[ChatMessage]] = None
+    add_special_tokens: bool = True
+
+
+class DetokenizeRequest(OpenAIBaseModel):
+    model: Optional[str] = None
+    tokens: List[int] = Field(default_factory=list)
+
+
+class RerankRequest(OpenAIBaseModel):
+    model: str
+    query: str
+    documents: List[str] = Field(default_factory=list)
+    top_n: Optional[int] = None
+
+
+class ScoreRequest(OpenAIBaseModel):
+    model: str
+    text_1: Union[str, List[str]]
+    text_2: Union[str, List[str]]
+
+
+class UsageInfo(OpenAIBaseModel):
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+
+
+class ChatCompletionChoice(OpenAIBaseModel):
+    index: int = 0
+    message: Optional[ChatMessage] = None
+    delta: Optional[Dict[str, Any]] = None
+    finish_reason: Optional[str] = None
+    logprobs: Optional[Dict[str, Any]] = None
+
+
+class ChatCompletionResponse(OpenAIBaseModel):
+    id: str = Field(default_factory=lambda: f"chatcmpl-{uuid.uuid4().hex}")
+    object: Literal["chat.completion", "chat.completion.chunk"] = "chat.completion"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    model: str = ""
+    choices: List[ChatCompletionChoice] = Field(default_factory=list)
+    usage: Optional[UsageInfo] = None
+
+
+class CompletionChoice(OpenAIBaseModel):
+    index: int = 0
+    text: str = ""
+    finish_reason: Optional[str] = None
+    logprobs: Optional[Dict[str, Any]] = None
+
+
+class CompletionResponse(OpenAIBaseModel):
+    id: str = Field(default_factory=lambda: f"cmpl-{uuid.uuid4().hex}")
+    object: str = "text_completion"
+    created: int = Field(default_factory=lambda: int(time.time()))
+    model: str = ""
+    choices: List[CompletionChoice] = Field(default_factory=list)
+    usage: Optional[UsageInfo] = None
+
+
+def request_id(prefix: str = "req") -> str:
+    return f"{prefix}-{uuid.uuid4().hex}"

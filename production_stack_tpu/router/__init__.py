@@ -1,0 +1,9 @@
+"""OpenAI-compatible request router (the stack's data plane).
+
+TPU-native rebuild of the reference's ``src/vllm_router/`` package: service
+discovery, routing algorithms (roundrobin / session / prefix-aware /
+kv-aware / disaggregated-prefill), streaming request proxy, stats, metrics,
+dynamic config, files/batch APIs and experimental features — served by
+aiohttp (the reference uses FastAPI/uvicorn; aiohttp gives us a single
+event-loop data plane with no ASGI layer in the hot path).
+"""

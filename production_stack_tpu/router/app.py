@@ -1,0 +1,559 @@
+"""Router application: wiring, routes, lifespan, entrypoint.
+
+Rebuild of reference ``src/vllm_router/app.py`` (304 LoC: ``initialize_all``
+``:112-272``, ``lifespan``, ``main``) plus the OpenAI route table from
+``routers/main_router.py:50-246`` and files/batches routers — served by
+aiohttp instead of FastAPI/uvicorn.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from aiohttp import web
+
+import production_stack_tpu
+from production_stack_tpu.protocols import ModelCard, ModelList
+from production_stack_tpu.router import metrics as metrics_mod
+from production_stack_tpu.router import request_service
+from production_stack_tpu.router.engine_stats import (
+    EngineStatsScraper,
+    initialize_engine_stats_scraper,
+)
+from production_stack_tpu.router.parser import parse_args
+from production_stack_tpu.router.request_stats import (
+    RequestStatsMonitor,
+    initialize_request_stats_monitor,
+)
+from production_stack_tpu.router.routing_logic import initialize_routing_logic
+from production_stack_tpu.router.service_discovery import (
+    ServiceDiscoveryType,
+    initialize_service_discovery,
+)
+from production_stack_tpu.utils.log import init_logger
+from production_stack_tpu.utils.misc import (
+    parse_comma_separated_args,
+    parse_static_aliases,
+    parse_static_model_types,
+    parse_static_urls,
+    set_ulimit,
+)
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class RouterState:
+    """Singletons attached to the aiohttp app (reference app.state, :268-272)."""
+
+    service_discovery: Any = None
+    router: Any = None
+    engine_stats_scraper: Optional[EngineStatsScraper] = None
+    request_stats_monitor: Optional[RequestStatsMonitor] = None
+    request_rewriter: Any = None
+    callbacks: Any = None
+    feature_gates: Any = None
+    semantic_cache: Any = None
+    pii_detector: Any = None
+    kv_controller: Any = None
+    batch_queue: Any = None
+    batch_processor: Any = None
+    file_storage: Any = None
+    dynamic_config_watcher: Any = None
+    log_stats_thread: Optional[threading.Thread] = None
+    extra: dict = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Route handlers (reference routers/main_router.py:50-246)
+# ---------------------------------------------------------------------------
+
+
+def _proxy(endpoint: str):
+    async def handler(request: web.Request) -> web.StreamResponse:
+        state = request.app["state"]
+        if state.semantic_cache is not None and endpoint == "/v1/chat/completions":
+            hit = await state.semantic_cache.check(await request.json())
+            if hit is not None:
+                return web.json_response(hit)
+        return await request_service.route_general_request(request, endpoint)
+
+    return handler
+
+
+async def show_models(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    cards = [ModelCard(id=m) for m in state.service_discovery.get_model_names()]
+    aliases = getattr(state.service_discovery, "aliases", None) or {}
+    cards += [ModelCard(id=a, root=m) for a, m in aliases.items()]
+    return web.json_response(ModelList(data=cards).model_dump())
+
+
+async def show_engines(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    engine_stats = state.engine_stats_scraper.get_engine_stats()
+    request_stats = state.request_stats_monitor.get_request_stats()
+    out = {}
+    for ep in state.service_discovery.get_endpoint_info():
+        es = engine_stats.get(ep.url)
+        rs = request_stats.get(ep.url)
+        out[ep.url] = {
+            "model_names": ep.model_names,
+            "model_label": ep.model_label,
+            "sleep": ep.sleep,
+            "engine_stats": es.__dict__ if es else None,
+            "request_stats": rs.__dict__ if rs else None,
+        }
+    return web.json_response(out)
+
+
+async def health(request: web.Request) -> web.Response:
+    """Reference main_router.py:201-236: check threads are alive."""
+    state = request.app["state"]
+    if not state.service_discovery.get_health():
+        return web.json_response(
+            {"status": "unhealthy", "reason": "service discovery down"}, status=503
+        )
+    if state.engine_stats_scraper and not state.engine_stats_scraper.get_health():
+        return web.json_response(
+            {"status": "unhealthy", "reason": "engine stats scraper down"},
+            status=503,
+        )
+    if (
+        state.dynamic_config_watcher is not None
+        and not state.dynamic_config_watcher.get_health()
+    ):
+        return web.json_response(
+            {"status": "unhealthy", "reason": "dynamic config watcher down"},
+            status=503,
+        )
+    return web.json_response({"status": "healthy"})
+
+
+async def version(request: web.Request) -> web.Response:
+    return web.json_response({"version": production_stack_tpu.__version__})
+
+
+async def metrics_handler(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    metrics_mod.update_gauges(
+        state.service_discovery.get_endpoint_info(),
+        state.engine_stats_scraper.get_engine_stats(),
+        state.request_stats_monitor.get_request_stats(),
+    )
+    return web.Response(
+        body=metrics_mod.render_metrics(),
+        content_type="text/plain",
+        charset="utf-8",
+    )
+
+
+async def dynamic_config_handler(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    watcher = state.dynamic_config_watcher
+    if watcher is None or watcher.get_current_config() is None:
+        return web.json_response({"error": "dynamic config not enabled"}, status=404)
+    return web.json_response(
+        __import__("json").loads(watcher.get_current_config().to_json_str())
+    )
+
+
+# -- files & batches (reference routers/files_router.py, batches_router.py) --
+
+
+async def upload_file(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    reader = await request.multipart()
+    filename, content, purpose = "upload", b"", "batch"
+    while True:
+        part = await reader.next()
+        if part is None:
+            break
+        if part.name == "file":
+            filename = part.filename or "upload"
+            content = await part.read(decode=False)
+        elif part.name == "purpose":
+            purpose = (await part.read(decode=False)).decode()
+    info = await state.file_storage.save_file(filename, content, purpose)
+    return web.json_response(info.metadata())
+
+
+async def get_file(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    try:
+        info = await state.file_storage.get_file(request.match_info["file_id"])
+    except FileNotFoundError:
+        return web.json_response({"error": "file not found"}, status=404)
+    return web.json_response(info.metadata())
+
+
+async def list_files(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    files = await state.file_storage.list_files()
+    return web.json_response(
+        {"object": "list", "data": [f.metadata() for f in files]}
+    )
+
+
+async def get_file_content(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    try:
+        content = await state.file_storage.get_file_content(
+            request.match_info["file_id"]
+        )
+    except FileNotFoundError:
+        return web.json_response({"error": "file not found"}, status=404)
+    return web.Response(body=content, content_type="application/octet-stream")
+
+
+async def create_batch_handler(request: web.Request) -> web.Response:
+    from production_stack_tpu.router.batch_service import create_batch
+
+    state = request.app["state"]
+    if state.batch_queue is None:
+        return web.json_response({"error": "batch API not enabled"}, status=501)
+    body = await request.json()
+    batch = await create_batch(
+        state.batch_queue,
+        input_file_id=body["input_file_id"],
+        endpoint=body.get("endpoint", "/v1/chat/completions"),
+        completion_window=body.get("completion_window", "24h"),
+        metadata=body.get("metadata"),
+    )
+    return web.json_response(batch.to_dict())
+
+
+async def get_batch(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    if state.batch_queue is None:
+        return web.json_response({"error": "batch API not enabled"}, status=501)
+    batch = await state.batch_queue.get(request.match_info["batch_id"])
+    if batch is None:
+        return web.json_response({"error": "batch not found"}, status=404)
+    return web.json_response(batch.to_dict())
+
+
+async def list_batches(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    if state.batch_queue is None:
+        return web.json_response({"error": "batch API not enabled"}, status=501)
+    batches = await state.batch_queue.list()
+    return web.json_response(
+        {"object": "list", "data": [b.to_dict() for b in batches]}
+    )
+
+
+async def cancel_batch(request: web.Request) -> web.Response:
+    from production_stack_tpu.router.batch_service import BatchStatus
+
+    state = request.app["state"]
+    batch = await state.batch_queue.get(request.match_info["batch_id"])
+    if batch is None:
+        return web.json_response({"error": "batch not found"}, status=404)
+    if batch.status in (BatchStatus.VALIDATING, BatchStatus.IN_PROGRESS):
+        batch.status = BatchStatus.CANCELLED
+        await state.batch_queue.put(batch)
+    return web.json_response(batch.to_dict())
+
+
+# -- KV controller endpoints (LMCache controller↔worker channel equivalent) --
+
+
+async def kv_register(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    body = await request.json()
+    await state.kv_controller.register_instance(body["instance_id"], body["url"])
+    return web.json_response({"status": "ok"})
+
+
+async def kv_admit(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    body = await request.json()
+    if "hashes" in body:
+        await state.kv_controller.admit(body["instance_id"], body["hashes"])
+    else:
+        await state.kv_controller.admit_text(body["instance_id"], body["text"])
+    return web.json_response({"status": "ok"})
+
+
+async def kv_evict(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    body = await request.json()
+    await state.kv_controller.evict(body["instance_id"], body.get("hashes", []))
+    return web.json_response({"status": "ok"})
+
+
+async def kv_lookup(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    body = await request.json()
+    match = await state.kv_controller.lookup(body.get("text", ""))
+    if match is None:
+        return web.json_response({"matched": 0, "instance_id": None})
+    return web.json_response({"matched": match[0], "instance_id": match[1]})
+
+
+# ---------------------------------------------------------------------------
+# Assembly
+# ---------------------------------------------------------------------------
+
+
+def build_app(args) -> web.Application:
+    app = web.Application(client_max_size=1024**3)
+    state = initialize_all(args)
+    app["state"] = state
+
+    openai_passthrough = [
+        "/v1/chat/completions",
+        "/v1/completions",
+        "/v1/embeddings",
+        "/v1/rerank",
+        "/rerank",
+        "/v1/score",
+        "/score",
+        "/tokenize",
+        "/detokenize",
+    ]
+    for ep in openai_passthrough:
+        app.router.add_post(ep, _proxy(ep))
+    app.router.add_post(
+        "/v1/audio/transcriptions", request_service.route_general_transcriptions
+    )
+    app.router.add_get("/v1/models", show_models)
+    app.router.add_get("/models", show_models)
+    app.router.add_get("/engines", show_engines)
+    app.router.add_get("/health", health)
+    app.router.add_get("/version", version)
+    app.router.add_get("/metrics", metrics_handler)
+    app.router.add_get("/dynamic_config", dynamic_config_handler)
+    async def _sleep(r):
+        return await request_service.route_sleep_wakeup_request(r, "sleep")
+
+    async def _wake(r):
+        return await request_service.route_sleep_wakeup_request(r, "wake_up")
+
+    async def _is_sleeping(r):
+        return await request_service.route_sleep_wakeup_request(r, "is_sleeping")
+
+    app.router.add_post("/sleep", _sleep)
+    app.router.add_post("/wake_up", _wake)
+    app.router.add_get("/is_sleeping", _is_sleeping)
+    # Files API
+    app.router.add_post("/v1/files", upload_file)
+    app.router.add_get("/v1/files", list_files)
+    app.router.add_get("/v1/files/{file_id}", get_file)
+    app.router.add_get("/v1/files/{file_id}/content", get_file_content)
+    # Batch API
+    app.router.add_post("/v1/batches", create_batch_handler)
+    app.router.add_get("/v1/batches", list_batches)
+    app.router.add_get("/v1/batches/{batch_id}", get_batch)
+    app.router.add_post("/v1/batches/{batch_id}/cancel", cancel_batch)
+    # KV controller channel
+    app.router.add_post("/kv/register", kv_register)
+    app.router.add_post("/kv/admit", kv_admit)
+    app.router.add_post("/kv/evict", kv_evict)
+    app.router.add_post("/kv/lookup", kv_lookup)
+
+    async def on_startup(app: web.Application):
+        st = app["state"]
+        if st.batch_processor is not None:
+            st.batch_processor.start()
+
+    async def on_cleanup(app: web.Application):
+        from production_stack_tpu.router.httpclient import AiohttpClientWrapper
+
+        st = app["state"]
+        for closable in (
+            st.service_discovery, st.engine_stats_scraper,
+            st.dynamic_config_watcher, st.batch_processor,
+        ):
+            if closable is not None and hasattr(closable, "close"):
+                result = closable.close()
+                if asyncio.iscoroutine(result):
+                    await result
+        await AiohttpClientWrapper().close()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+def initialize_all(args) -> RouterState:
+    """Wire all singletons (reference app.py:112-272)."""
+    state = RouterState()
+
+    # Service discovery.
+    if args.service_discovery == "static":
+        state.service_discovery = initialize_service_discovery(
+            ServiceDiscoveryType.STATIC,
+            urls=parse_static_urls(args.static_backends or ""),
+            models=parse_comma_separated_args(args.static_models) or [],
+            aliases=parse_static_aliases(args.static_aliases or ""),
+            model_labels=parse_comma_separated_args(args.static_model_labels),
+            model_types=parse_static_model_types(args.static_model_types)
+            if args.static_model_types else None,
+            static_backend_health_checks=bool(
+                getattr(args, "static_backend_health_checks", False)
+            ),
+            prefill_model_labels=parse_comma_separated_args(
+                args.prefill_model_labels
+            ),
+            decode_model_labels=parse_comma_separated_args(
+                args.decode_model_labels
+            ),
+        )
+    else:
+        state.service_discovery = initialize_service_discovery(
+            ServiceDiscoveryType.K8S_POD_IP,
+            namespace=args.k8s_namespace,
+            port=args.k8s_port,
+            label_selector=args.k8s_label_selector,
+            prefill_model_labels=parse_comma_separated_args(
+                args.prefill_model_labels
+            ),
+            decode_model_labels=parse_comma_separated_args(
+                args.decode_model_labels
+            ),
+        )
+
+    # Stats.
+    state.engine_stats_scraper = initialize_engine_stats_scraper(
+        args.engine_stats_interval
+    )
+    state.request_stats_monitor = initialize_request_stats_monitor(
+        args.request_stats_window
+    )
+
+    # KV controller (in-process, as the reference embeds LMCache's).
+    from production_stack_tpu.kv.controller import initialize_kv_controller
+
+    state.kv_controller = initialize_kv_controller()
+
+    # Routing.
+    state.router = initialize_routing_logic(
+        args.routing_logic,
+        session_key=args.session_key,
+        kv_aware_threshold=args.kv_aware_threshold,
+        kv_controller=state.kv_controller,
+        prefill_model_labels=parse_comma_separated_args(args.prefill_model_labels),
+        decode_model_labels=parse_comma_separated_args(args.decode_model_labels),
+    )
+
+    # Rewriter / callbacks.
+    from production_stack_tpu.router.rewriter import get_request_rewriter
+
+    state.request_rewriter = get_request_rewriter(
+        getattr(args, "request_rewriter", "noop")
+    )
+    if getattr(args, "callbacks", None):
+        from production_stack_tpu.router.callbacks import configure_custom_callbacks
+
+        state.callbacks = configure_custom_callbacks(args.callbacks)
+
+    # Feature gates + experimental features.
+    from production_stack_tpu.router.feature_gates import initialize_feature_gates
+
+    state.feature_gates = initialize_feature_gates(
+        getattr(args, "feature_gates", "")
+    )
+    if state.feature_gates.is_enabled("SemanticCache"):
+        from production_stack_tpu.experimental.semantic_cache import SemanticCache
+
+        state.semantic_cache = SemanticCache(
+            model_name=args.semantic_cache_model,
+            cache_dir=args.semantic_cache_dir,
+            threshold=args.semantic_cache_threshold,
+        )
+    if state.feature_gates.is_enabled("PIIDetection"):
+        from production_stack_tpu.experimental.pii import PIIDetector
+
+        state.pii_detector = PIIDetector()
+
+    # Files + batch API.
+    if getattr(args, "enable_batch_api", False):
+        from production_stack_tpu.router.batch_service import (
+            BatchQueue,
+            LocalBatchProcessor,
+        )
+        from production_stack_tpu.router.files_service import initialize_storage
+
+        state.file_storage = initialize_storage(
+            args.file_storage_class, args.file_storage_path
+        )
+        state.batch_queue = BatchQueue(
+            db_path=f"{args.file_storage_path}/batches.db"
+        )
+        state.batch_processor = LocalBatchProcessor(
+            state.file_storage, state.batch_queue, state
+        )
+    else:
+        from production_stack_tpu.router.files_service import initialize_storage
+
+        state.file_storage = initialize_storage(
+            "local_file", getattr(args, "file_storage_path", "/tmp/tpu_stack_files")
+        )
+
+    # Dynamic config watcher.
+    if getattr(args, "dynamic_config_json", None):
+        from production_stack_tpu.router.dynamic_config import (
+            initialize_dynamic_config_watcher,
+        )
+
+        state.dynamic_config_watcher = initialize_dynamic_config_watcher(
+            args.dynamic_config_json, state
+        )
+
+    # Periodic stats logger (reference stats/log_stats.py:37-115, app.py:287-295).
+    if getattr(args, "log_stats", False):
+        state.log_stats_thread = _start_log_stats_thread(
+            state, getattr(args, "log_stats_interval", 10.0)
+        )
+    return state
+
+
+def _start_log_stats_thread(state: RouterState, interval: float) -> threading.Thread:
+    def loop():
+        while True:
+            time.sleep(interval)
+            try:
+                endpoints = state.service_discovery.get_endpoint_info()
+                engine_stats = state.engine_stats_scraper.get_engine_stats()
+                request_stats = state.request_stats_monitor.get_request_stats()
+                metrics_mod.update_gauges(endpoints, engine_stats, request_stats)
+                lines = ["", "==== Router stats ===="]
+                for ep in endpoints:
+                    rs = request_stats.get(ep.url)
+                    es = engine_stats.get(ep.url)
+                    lines.append(
+                        f"{ep.url}: qps={getattr(rs, 'qps', 0):.2f} "
+                        f"ttft={getattr(rs, 'ttft', -1):.3f} "
+                        f"running={getattr(es, 'num_running_requests', 0)} "
+                        f"waiting={getattr(es, 'num_queuing_requests', 0)} "
+                        f"kv_usage={getattr(es, 'gpu_cache_usage_perc', 0):.2%}"
+                    )
+                lines.append("=" * 22)
+                logger.info("\n".join(lines))
+            except Exception as e:  # noqa: BLE001
+                logger.debug("log_stats iteration failed: %s", e)
+
+    t = threading.Thread(target=loop, daemon=True, name="log-stats")
+    t.start()
+    return t
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    import logging
+
+    logging.getLogger().setLevel(args.log_level.upper())
+    set_ulimit()
+    app = build_app(args)
+    logger.info("Router listening on %s:%d", args.host, args.port)
+    web.run_app(app, host=args.host, port=args.port, access_log=None)
+
+
+if __name__ == "__main__":
+    main()

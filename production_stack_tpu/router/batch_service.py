@@ -1,0 +1,230 @@
+"""OpenAI Batch API: SQLite-backed queue + background processor.
+
+Rebuild of reference ``src/vllm_router/services/batch_service/``
+(``batch.py:19-104``, ``local_processor.py``). The reference's processor is a
+stub that writes a result file without real inference; ours actually executes
+each batch line against the routed engines (chat/completions/embeddings) and
+writes an OpenAI-format output file, which is strictly more capable.
+
+SQLite access runs in a worker thread (``aiosqlite`` is not in this image).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sqlite3
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+from production_stack_tpu.router.files_service import Storage
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+class BatchStatus:
+    VALIDATING = "validating"
+    IN_PROGRESS = "in_progress"
+    COMPLETED = "completed"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+
+
+@dataclass
+class BatchInfo:
+    id: str
+    input_file_id: str
+    endpoint: str
+    completion_window: str = "24h"
+    status: str = BatchStatus.VALIDATING
+    created_at: int = field(default_factory=lambda: int(time.time()))
+    completed_at: Optional[int] = None
+    output_file_id: Optional[str] = None
+    error_file_id: Optional[str] = None
+    metadata: Optional[dict] = None
+    request_counts: dict = field(default_factory=lambda: {"total": 0, "completed": 0, "failed": 0})
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "object": "batch",
+            "endpoint": self.endpoint,
+            "input_file_id": self.input_file_id,
+            "completion_window": self.completion_window,
+            "status": self.status,
+            "created_at": self.created_at,
+            "completed_at": self.completed_at,
+            "output_file_id": self.output_file_id,
+            "error_file_id": self.error_file_id,
+            "metadata": self.metadata,
+            "request_counts": self.request_counts,
+        }
+
+
+class BatchQueue:
+    """Durable batch queue on SQLite (reference local_processor.py:35-66)."""
+
+    def __init__(self, db_path: str = "/tmp/tpu_stack_batches.db"):
+        self.db_path = db_path
+        self._conn = sqlite3.connect(db_path, check_same_thread=False)
+        self._lock = asyncio.Lock()
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS batches ("
+            "id TEXT PRIMARY KEY, data TEXT NOT NULL)"
+        )
+        self._conn.commit()
+
+    async def put(self, batch: BatchInfo) -> None:
+        async with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO batches (id, data) VALUES (?, ?)",
+                (batch.id, json.dumps(batch.to_dict())),
+            )
+            self._conn.commit()
+
+    async def get(self, batch_id: str) -> Optional[BatchInfo]:
+        async with self._lock:
+            row = self._conn.execute(
+                "SELECT data FROM batches WHERE id = ?", (batch_id,)
+            ).fetchone()
+        if row is None:
+            return None
+        return _batch_from_dict(json.loads(row[0]))
+
+    async def list(self) -> "list[BatchInfo]":
+        async with self._lock:
+            rows = self._conn.execute("SELECT data FROM batches").fetchall()
+        return [_batch_from_dict(json.loads(r[0])) for r in rows]
+
+    async def pending(self) -> "list[BatchInfo]":
+        return [
+            b for b in await self.list()
+            if b.status in (BatchStatus.VALIDATING, BatchStatus.IN_PROGRESS)
+        ]
+
+
+def _batch_from_dict(d: dict) -> BatchInfo:
+    return BatchInfo(
+        id=d["id"],
+        input_file_id=d["input_file_id"],
+        endpoint=d["endpoint"],
+        completion_window=d.get("completion_window", "24h"),
+        status=d.get("status", BatchStatus.VALIDATING),
+        created_at=d.get("created_at", 0),
+        completed_at=d.get("completed_at"),
+        output_file_id=d.get("output_file_id"),
+        error_file_id=d.get("error_file_id"),
+        metadata=d.get("metadata"),
+        request_counts=d.get("request_counts") or {"total": 0, "completed": 0, "failed": 0},
+    )
+
+
+class LocalBatchProcessor:
+    """Background task that executes queued batches against the engines
+    (reference LocalBatchProcessor.process_batches:170-221, but with real
+    inference via the router's own routing + HTTP client)."""
+
+    def __init__(self, storage: Storage, queue: BatchQueue, state, poll_interval: float = 2.0):
+        self.storage = storage
+        self.queue = queue
+        self.state = state
+        self.poll_interval = poll_interval
+        self._task: Optional[asyncio.Task] = None
+        self._running = True
+
+    def start(self) -> None:
+        self._task = asyncio.get_event_loop().create_task(self._loop())
+
+    async def _loop(self) -> None:
+        while self._running:
+            try:
+                for batch in await self.queue.pending():
+                    await self._process_one(batch)
+            except Exception as e:  # noqa: BLE001
+                logger.error("Batch processor error: %s", e)
+            await asyncio.sleep(self.poll_interval)
+
+    async def _process_one(self, batch: BatchInfo) -> None:
+        from production_stack_tpu.router.httpclient import get_client_session
+
+        batch.status = BatchStatus.IN_PROGRESS
+        await self.queue.put(batch)
+        try:
+            content = await self.storage.get_file_content(batch.input_file_id)
+        except FileNotFoundError:
+            batch.status = BatchStatus.FAILED
+            await self.queue.put(batch)
+            return
+        lines = [ln for ln in content.decode().splitlines() if ln.strip()]
+        batch.request_counts["total"] = len(lines)
+        results, errors = [], []
+        session = get_client_session()
+        for line in lines:
+            try:
+                item = json.loads(line)
+                body = item.get("body", {})
+                endpoints = [
+                    ep for ep in self.state.service_discovery.get_endpoint_info()
+                    if ep.serves(body.get("model", "")) and not ep.sleep
+                ]
+                if not endpoints:
+                    raise RuntimeError(f"no engine for model {body.get('model')}")
+                url = self.state.router.route_request(
+                    endpoints, None, None, {}, body
+                )
+                if asyncio.iscoroutine(url):
+                    url = await url
+                async with session.post(
+                    f"{url}{item.get('url', batch.endpoint)}", json=body
+                ) as resp:
+                    resp_body = await resp.json()
+                    results.append({
+                        "id": f"batch_req_{uuid.uuid4().hex[:12]}",
+                        "custom_id": item.get("custom_id"),
+                        "response": {"status_code": resp.status, "body": resp_body},
+                        "error": None,
+                    })
+                    batch.request_counts["completed"] += 1
+            except Exception as e:  # noqa: BLE001
+                errors.append({"custom_id": item.get("custom_id") if "item" in dir() else None,
+                               "error": str(e)})
+                batch.request_counts["failed"] += 1
+        out = "\n".join(json.dumps(r) for r in results)
+        info = await self.storage.save_file(
+            f"{batch.id}_output.jsonl", out.encode(), purpose="batch_output"
+        )
+        batch.output_file_id = info.id
+        if errors:
+            err_info = await self.storage.save_file(
+                f"{batch.id}_errors.jsonl",
+                "\n".join(json.dumps(e) for e in errors).encode(),
+                purpose="batch_output",
+            )
+            batch.error_file_id = err_info.id
+        batch.status = BatchStatus.COMPLETED
+        batch.completed_at = int(time.time())
+        await self.queue.put(batch)
+        logger.info("Batch %s completed: %s", batch.id, batch.request_counts)
+
+    def close(self) -> None:
+        self._running = False
+        if self._task:
+            self._task.cancel()
+
+
+async def create_batch(
+    queue: BatchQueue, input_file_id: str, endpoint: str,
+    completion_window: str = "24h", metadata: Optional[dict] = None,
+) -> BatchInfo:
+    batch = BatchInfo(
+        id=f"batch_{uuid.uuid4().hex}",
+        input_file_id=input_file_id,
+        endpoint=endpoint,
+        completion_window=completion_window,
+        metadata=metadata,
+    )
+    await queue.put(batch)
+    return batch

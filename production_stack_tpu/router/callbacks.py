@@ -1,0 +1,28 @@
+"""User-supplied request callbacks (reference
+src/vllm_router/services/callbacks_service/callbacks.py:23-32).
+
+``--callbacks module.submodule.object`` loads an object exposing optional
+``pre_request(request, request_json, request_id)`` and
+``post_request(request_json, response_body, request_id)`` hooks (sync or
+async). ``pre_request`` may return a response to short-circuit routing.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+def configure_custom_callbacks(spec: str):
+    module_path, _, obj_name = spec.rpartition(".")
+    if not module_path:
+        raise ValueError(
+            f"--callbacks must be `module.object`, got {spec!r}"
+        )
+    module = importlib.import_module(module_path)
+    obj = getattr(module, obj_name)
+    logger.info("Loaded custom callbacks from %s", spec)
+    return obj

@@ -1,0 +1,182 @@
+"""Dynamic router configuration: hot reload from a watched file.
+
+Rebuild of reference ``src/vllm_router/dynamic_config.py`` (310 LoC):
+a thread polls a YAML/JSON config file every N seconds; when the content
+changes, service discovery / routing logic / callbacks are reconfigured in
+place (reference ``DynamicRouterConfig:43-117``, ``reconfigure_all:236-244``,
+``_watch_worker:256-280``). ``/health`` exposes the watcher's liveness and
+the current config is served at ``/dynamic_config``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Optional
+
+import yaml
+
+from production_stack_tpu.router.parser import expand_static_models_config
+from production_stack_tpu.utils.log import init_logger
+from production_stack_tpu.utils.misc import (
+    parse_comma_separated_args,
+    parse_static_aliases,
+    parse_static_model_types,
+    parse_static_urls,
+)
+
+logger = init_logger(__name__)
+
+_global_watcher: Optional["DynamicConfigWatcher"] = None
+
+
+@dataclasses.dataclass
+class DynamicRouterConfig:
+    """Hot-reloadable subset of router config (reference :43-117)."""
+
+    service_discovery: Optional[str] = None
+    static_backends: Optional[str] = None
+    static_models: Optional[str] = None
+    static_aliases: Optional[str] = None
+    static_model_labels: Optional[str] = None
+    static_model_types: Optional[str] = None
+    routing_logic: Optional[str] = None
+    session_key: Optional[str] = None
+    prefill_model_labels: Optional[str] = None
+    decode_model_labels: Optional[str] = None
+    callbacks: Optional[str] = None
+
+    @staticmethod
+    def from_file(path: str) -> "DynamicRouterConfig":
+        with open(path) as f:
+            if path.endswith((".yaml", ".yml")):
+                raw = yaml.safe_load(f) or {}
+            else:
+                raw = json.load(f)
+        raw = expand_static_models_config(raw)
+        fields = {f.name for f in dataclasses.fields(DynamicRouterConfig)}
+        kwargs = {
+            k.replace("-", "_"): v
+            for k, v in raw.items()
+            if k.replace("-", "_") in fields
+        }
+        return DynamicRouterConfig(**kwargs)
+
+    def to_json_str(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+
+def reconfigure_service_discovery(config: DynamicRouterConfig, state) -> None:
+    from production_stack_tpu.router.service_discovery import (
+        ServiceDiscoveryType,
+        initialize_service_discovery,
+    )
+
+    if config.static_backends is None:
+        return
+    old = state.service_discovery
+    sd = initialize_service_discovery(
+        ServiceDiscoveryType.STATIC,
+        urls=parse_static_urls(config.static_backends),
+        models=parse_comma_separated_args(config.static_models) or [],
+        aliases=parse_static_aliases(config.static_aliases or ""),
+        model_labels=parse_comma_separated_args(config.static_model_labels),
+        model_types=parse_static_model_types(config.static_model_types)
+        if config.static_model_types else None,
+    )
+    state.service_discovery = sd
+    if old is not None and old is not sd:
+        old.close()
+
+
+def reconfigure_routing_logic(config: DynamicRouterConfig, state) -> None:
+    from production_stack_tpu.router import routing_logic as rl
+
+    if config.routing_logic is None:
+        return
+    state.router = rl.reconfigure_routing_logic(
+        config.routing_logic,
+        session_key=config.session_key,
+        prefill_model_labels=parse_comma_separated_args(
+            config.prefill_model_labels
+        ),
+        decode_model_labels=parse_comma_separated_args(
+            config.decode_model_labels
+        ),
+    )
+
+
+def reconfigure_all(config: DynamicRouterConfig, state) -> None:
+    reconfigure_service_discovery(config, state)
+    reconfigure_routing_logic(config, state)
+    if config.callbacks:
+        from production_stack_tpu.router.callbacks import configure_custom_callbacks
+
+        state.callbacks = configure_custom_callbacks(config.callbacks)
+
+
+class DynamicConfigWatcher:
+    """Polls the config file and hot-applies diffs (reference :120-288)."""
+
+    def __init__(
+        self,
+        config_path: str,
+        state,
+        poll_interval: float = 10.0,
+    ):
+        self.config_path = config_path
+        self.state = state
+        self.poll_interval = poll_interval
+        self._current: Optional[DynamicRouterConfig] = None
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._watch_worker, daemon=True, name="dynamic-config"
+        )
+        self._thread.start()
+
+    def get_current_config(self) -> Optional[DynamicRouterConfig]:
+        return self._current
+
+    def _watch_worker(self) -> None:
+        while self._running:
+            try:
+                config = DynamicRouterConfig.from_file(self.config_path)
+                if (
+                    self._current is None
+                    or config.to_json_str() != self._current.to_json_str()
+                ):
+                    logger.info(
+                        "Dynamic config changed; reconfiguring router"
+                    )
+                    reconfigure_all(config, self.state)
+                    self._current = config
+            except FileNotFoundError:
+                logger.warning(
+                    "Dynamic config file %s missing", self.config_path
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.error("Dynamic config reload failed: %s", e)
+            for _ in range(int(self.poll_interval * 10)):
+                if not self._running:
+                    return
+                time.sleep(0.1)
+
+    def get_health(self) -> bool:
+        return self._thread.is_alive()
+
+    def close(self) -> None:
+        self._running = False
+
+
+def initialize_dynamic_config_watcher(
+    config_path: str, state, poll_interval: float = 10.0
+) -> DynamicConfigWatcher:
+    global _global_watcher
+    _global_watcher = DynamicConfigWatcher(config_path, state, poll_interval)
+    return _global_watcher
+
+
+def get_dynamic_config_watcher() -> Optional[DynamicConfigWatcher]:
+    return _global_watcher

@@ -1,0 +1,82 @@
+"""Feature gate registry (reference src/vllm_router/experimental/feature_gates.py:48-109)."""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+_global_feature_gates: Optional["FeatureGates"] = None
+
+
+class FeatureStage(enum.Enum):
+    ALPHA = "Alpha"
+    BETA = "Beta"
+    GA = "GA"
+
+
+@dataclass
+class Feature:
+    name: str
+    default: bool
+    stage: FeatureStage
+    description: str = ""
+
+
+KNOWN_FEATURES = {
+    "SemanticCache": Feature("SemanticCache", False, FeatureStage.ALPHA,
+                             "Serve chat completions from a semantic cache"),
+    "PIIDetection": Feature("PIIDetection", False, FeatureStage.ALPHA,
+                            "Block requests containing detected PII"),
+    "KVOffload": Feature("KVOffload", False, FeatureStage.BETA,
+                         "Engine-side HBM->host KV offload"),
+}
+
+
+class FeatureGates:
+    def __init__(self, gates: Dict[str, bool]):
+        self.gates = dict(gates)
+
+    def is_enabled(self, name: str) -> bool:
+        if name in self.gates:
+            return self.gates[name]
+        feature = KNOWN_FEATURES.get(name)
+        return feature.default if feature else False
+
+
+def parse_feature_gates(spec: str) -> Dict[str, bool]:
+    gates: Dict[str, bool] = {}
+    for item in (spec or "").split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if "=" not in item:
+            raise ValueError(f"Invalid feature gate {item!r}, expected Name=bool")
+        name, value = item.split("=", 1)
+        name = name.strip()
+        if name not in KNOWN_FEATURES:
+            raise ValueError(
+                f"Unknown feature gate {name!r}; known: {sorted(KNOWN_FEATURES)}"
+            )
+        gates[name] = value.strip().lower() in ("true", "1", "yes")
+    return gates
+
+
+def initialize_feature_gates(spec: str) -> "FeatureGates":
+    global _global_feature_gates
+    _global_feature_gates = FeatureGates(parse_feature_gates(spec))
+    for name, enabled in _global_feature_gates.gates.items():
+        stage = KNOWN_FEATURES[name].stage.value
+        logger.info("Feature gate %s=%s (%s)", name, enabled, stage)
+    return _global_feature_gates
+
+
+def get_feature_gates() -> "FeatureGates":
+    global _global_feature_gates
+    if _global_feature_gates is None:
+        _global_feature_gates = FeatureGates({})
+    return _global_feature_gates

@@ -1,0 +1,130 @@
+"""OpenAI Files API backed by local disk.
+
+Rebuild of reference ``src/vllm_router/services/files_service/``
+(``file_storage.py:27-136``, ``storage.py``): `Storage` ABC + `FileStorage`
+storing file bytes and metadata under a root directory, addressed by
+``file-<uuid>`` ids.
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+import os
+import time
+import uuid
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+import aiofiles
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class FileInfo:
+    id: str
+    object: str = "file"
+    bytes: int = 0
+    created_at: int = field(default_factory=lambda: int(time.time()))
+    filename: str = ""
+    purpose: str = "batch"
+
+    def metadata(self) -> dict:
+        return asdict(self)
+
+
+class Storage(abc.ABC):
+    @abc.abstractmethod
+    async def save_file(self, filename: str, content: bytes, purpose: str) -> FileInfo: ...
+
+    @abc.abstractmethod
+    async def get_file(self, file_id: str) -> FileInfo: ...
+
+    @abc.abstractmethod
+    async def get_file_content(self, file_id: str) -> bytes: ...
+
+    @abc.abstractmethod
+    async def list_files(self) -> List[FileInfo]: ...
+
+    @abc.abstractmethod
+    async def delete_file(self, file_id: str) -> None: ...
+
+
+class FileStorage(Storage):
+    """Local-disk file storage (reference file_storage.py:27-136)."""
+
+    def __init__(self, base_path: str = "/tmp/tpu_stack_files"):
+        self.base_path = base_path
+        os.makedirs(base_path, exist_ok=True)
+
+    def _dir(self, file_id: str) -> str:
+        return os.path.join(self.base_path, file_id)
+
+    async def save_file(
+        self, filename: str, content: bytes, purpose: str = "batch",
+        file_id: Optional[str] = None,
+    ) -> FileInfo:
+        file_id = file_id or f"file-{uuid.uuid4().hex}"
+        info = FileInfo(
+            id=file_id, bytes=len(content), filename=filename, purpose=purpose
+        )
+        os.makedirs(self._dir(file_id), exist_ok=True)
+        async with aiofiles.open(
+            os.path.join(self._dir(file_id), filename), "wb"
+        ) as f:
+            await f.write(content)
+        async with aiofiles.open(
+            os.path.join(self._dir(file_id), "metadata.json"), "w"
+        ) as f:
+            await f.write(json.dumps(info.metadata()))
+        return info
+
+    async def get_file(self, file_id: str) -> FileInfo:
+        path = os.path.join(self._dir(file_id), "metadata.json")
+        try:
+            async with aiofiles.open(path) as f:
+                return FileInfo(**json.loads(await f.read()))
+        except FileNotFoundError:
+            raise FileNotFoundError(f"File {file_id} not found")
+
+    async def get_file_content(self, file_id: str) -> bytes:
+        info = await self.get_file(file_id)
+        async with aiofiles.open(
+            os.path.join(self._dir(file_id), info.filename), "rb"
+        ) as f:
+            return await f.read()
+
+    async def list_files(self) -> List[FileInfo]:
+        out = []
+        for name in sorted(os.listdir(self.base_path)):
+            if name.startswith("file-"):
+                try:
+                    out.append(await self.get_file(name))
+                except FileNotFoundError:
+                    continue
+        return out
+
+    async def delete_file(self, file_id: str) -> None:
+        import shutil
+
+        shutil.rmtree(self._dir(file_id), ignore_errors=True)
+
+
+_storages: Dict[str, Storage] = {}
+
+
+def initialize_storage(storage_class: str = "local_file", base_path: str = "/tmp/tpu_stack_files") -> Storage:
+    if storage_class != "local_file":
+        raise ValueError(f"Unsupported storage class {storage_class}")
+    storage = FileStorage(base_path)
+    _storages["default"] = storage
+    return storage
+
+
+def get_storage() -> Storage:
+    if "default" not in _storages:
+        raise RuntimeError("Storage not initialized")
+    return _storages["default"]

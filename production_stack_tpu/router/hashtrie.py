@@ -1,0 +1,116 @@
+"""Async prefix hash trie for prefix-aware routing.
+
+Rebuild of reference ``src/vllm_router/prefix/hashtrie.py:24-103``: prompts
+are split into fixed-size character chunks, each chunk hashed with xxhash64,
+and the hash sequence inserted into a trie whose nodes record which endpoints
+have seen that prefix. ``longest_prefix_match`` walks the trie intersecting
+node endpoint-sets with the currently-available endpoints.
+
+Differences from the reference: one asyncio lock per *trie* rather than per
+node. The router is single-event-loop, so per-node locks buy nothing, and a
+single lock makes eviction (which the reference lacks) race-free. We also add
+LRU-ish eviction to bound memory over long uptimes.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Set, Tuple
+
+import xxhash
+
+import asyncio
+
+
+class TrieNode:
+    __slots__ = ("children", "endpoints", "last_access")
+
+    def __init__(self):
+        self.children: Dict[int, "TrieNode"] = {}
+        self.endpoints: Set[str] = set()
+        self.last_access: float = time.monotonic()
+
+
+class HashTrie:
+    def __init__(self, chunk_size: int = 128, max_nodes: int = 1_000_000):
+        self.chunk_size = chunk_size
+        self.max_nodes = max_nodes
+        self.root = TrieNode()
+        self.node_count = 0
+        self._lock = asyncio.Lock()
+
+    def _chunk_hashes(self, text: str):
+        for i in range(0, len(text), self.chunk_size):
+            yield xxhash.xxh64_intdigest(text[i : i + self.chunk_size])
+
+    async def insert(self, text: str, endpoint: str) -> None:
+        async with self._lock:
+            node = self.root
+            now = time.monotonic()
+            for h in self._chunk_hashes(text):
+                nxt = node.children.get(h)
+                if nxt is None:
+                    if self.node_count >= self.max_nodes:
+                        self._evict_oldest_locked()
+                    nxt = TrieNode()
+                    node.children[h] = nxt
+                    self.node_count += 1
+                nxt.last_access = now
+                nxt.endpoints.add(endpoint)
+                node = nxt
+
+    async def longest_prefix_match(
+        self, text: str, available_endpoints: Set[str]
+    ) -> Tuple[int, Set[str]]:
+        """Return (matched_chunk_count, endpoint set at the deepest match).
+
+        The returned endpoints are always a subset of ``available_endpoints``;
+        if nothing matches, (0, available_endpoints) is returned so callers
+        can fall back to any endpoint (reference hashtrie.py:75-103).
+        """
+        async with self._lock:
+            node = self.root
+            matched = 0
+            selected: Set[str] = set(available_endpoints)
+            now = time.monotonic()
+            for h in self._chunk_hashes(text):
+                nxt = node.children.get(h)
+                if nxt is None:
+                    break
+                live = nxt.endpoints & available_endpoints
+                if not live:
+                    break
+                nxt.last_access = now
+                selected = live
+                matched += 1
+                node = nxt
+            return matched, selected
+
+    async def remove_endpoint(self, endpoint: str) -> None:
+        """Drop a dead endpoint from every node (cheap full walk)."""
+        async with self._lock:
+            stack = [self.root]
+            while stack:
+                node = stack.pop()
+                node.endpoints.discard(endpoint)
+                stack.extend(node.children.values())
+
+    def _evict_oldest_locked(self, fraction: float = 0.1) -> None:
+        """Evict the oldest-accessed top-level subtrees to free space."""
+        items = sorted(
+            self.root.children.items(), key=lambda kv: kv[1].last_access
+        )
+        n_evict = max(1, int(len(items) * fraction))
+        for h, child in items[:n_evict]:
+            self.node_count -= _count_nodes(child)
+            del self.root.children[h]
+
+
+def _count_nodes(node: TrieNode) -> int:
+    total = 1
+    stack = list(node.children.values())
+    while stack:
+        n = stack.pop()
+        total += 1
+        stack.extend(n.children.values())
+    return total

@@ -1,0 +1,36 @@
+"""Shared aiohttp client session (reference src/vllm_router/aiohttp_client.py:21-48)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import aiohttp
+
+from production_stack_tpu.utils.misc import SingletonMeta
+
+
+class AiohttpClientWrapper(metaclass=SingletonMeta):
+    """Singleton wrapper; session created lazily on the running loop."""
+
+    def __init__(self):
+        if hasattr(self, "_initialized"):
+            return
+        self._initialized = True
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    def session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(limit=0),
+                timeout=aiohttp.ClientTimeout(total=None, sock_connect=30),
+            )
+        return self._session
+
+    async def close(self) -> None:
+        if self._session and not self._session.closed:
+            await self._session.close()
+        self._session = None
+
+
+def get_client_session() -> aiohttp.ClientSession:
+    return AiohttpClientWrapper().session()

@@ -1,0 +1,109 @@
+"""Minimal Kubernetes API client (list/watch pods, patch labels).
+
+The reference router depends on the official ``kubernetes`` Python client
+(``src/vllm_router/service_discovery.py:344-760``); that package is not in
+this image, so this module speaks the K8s REST API directly: in-cluster
+service-account token + CA, or an explicit host for tests. Only the three
+operations the stack needs are implemented: list pods, watch pods
+(streaming JSON events), and patch pod labels (used to mark ``sleeping``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, Optional
+
+import requests
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class K8sClient:
+    def __init__(
+        self,
+        host: Optional[str] = None,
+        token: Optional[str] = None,
+        ca_cert: Optional[str] = None,
+    ):
+        if host is None:
+            k8s_host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            k8s_port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not k8s_host:
+                raise RuntimeError(
+                    "Not running in a cluster and no K8s host provided"
+                )
+            host = f"https://{k8s_host}:{k8s_port}"
+        self.host = host.rstrip("/")
+        if token is None and os.path.exists(f"{SA_DIR}/token"):
+            with open(f"{SA_DIR}/token") as f:
+                token = f.read().strip()
+        self.token = token
+        if ca_cert is None and os.path.exists(f"{SA_DIR}/ca.crt"):
+            ca_cert = f"{SA_DIR}/ca.crt"
+        self.verify = ca_cert if ca_cert else False
+        self.session = requests.Session()
+        if self.token:
+            self.session.headers["Authorization"] = f"Bearer {self.token}"
+
+    def list_pods(self, namespace: str, label_selector: Optional[str] = None) -> dict:
+        params = {}
+        if label_selector:
+            params["labelSelector"] = label_selector
+        resp = self.session.get(
+            f"{self.host}/api/v1/namespaces/{namespace}/pods",
+            params=params,
+            verify=self.verify,
+            timeout=30,
+        )
+        resp.raise_for_status()
+        return resp.json()
+
+    def watch_pods(
+        self,
+        namespace: str,
+        label_selector: Optional[str] = None,
+        timeout_seconds: int = 300,
+    ) -> Iterator[dict]:
+        """Stream pod watch events. Replays current pods as ADDED first."""
+        current = self.list_pods(namespace, label_selector)
+        resource_version = current.get("metadata", {}).get("resourceVersion")
+        for pod in current.get("items", []):
+            yield {"type": "ADDED", "object": pod}
+        params = {
+            "watch": "true",
+            "timeoutSeconds": str(timeout_seconds),
+        }
+        if label_selector:
+            params["labelSelector"] = label_selector
+        if resource_version:
+            params["resourceVersion"] = resource_version
+        resp = self.session.get(
+            f"{self.host}/api/v1/namespaces/{namespace}/pods",
+            params=params,
+            verify=self.verify,
+            stream=True,
+            timeout=timeout_seconds + 10,
+        )
+        resp.raise_for_status()
+        for line in resp.iter_lines():
+            if line:
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError:
+                    logger.warning("Malformed watch line: %r", line[:200])
+
+    def patch_pod_labels(self, namespace: str, pod_name: str, labels: dict) -> None:
+        """Merge-patch labels on a pod (reference labels pods sleeping=true)."""
+        resp = self.session.patch(
+            f"{self.host}/api/v1/namespaces/{namespace}/pods/{pod_name}",
+            json={"metadata": {"labels": labels}},
+            headers={"Content-Type": "application/merge-patch+json"},
+            verify=self.verify,
+            timeout=30,
+        )
+        resp.raise_for_status()

@@ -1,0 +1,73 @@
+"""Router /metrics: Prometheus exposition of per-engine stats.
+
+Rebuild of reference ``src/vllm_router/routers/metrics_router.py:57-123`` and
+``services/metrics_service/prometheus_gauge.py``: per-engine-URL gauges for
+QPS, TTFT, latency, ITL, prefill/decode/finished counts, scraped engine-side
+running/waiting/cache-usage, plus router-process CPU/mem/disk via psutil and
+a healthy-endpoint count.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import psutil
+from prometheus_client import CollectorRegistry, Gauge, generate_latest
+
+REGISTRY = CollectorRegistry()
+
+_L = ["server"]
+
+current_qps = Gauge("vllm_router:current_qps", "Sliding-window QPS", _L, registry=REGISTRY)
+avg_ttft = Gauge("vllm_router:avg_ttft", "Average time to first token (s)", _L, registry=REGISTRY)
+avg_latency = Gauge("vllm_router:avg_latency", "Average request latency (s)", _L, registry=REGISTRY)
+avg_itl = Gauge("vllm_router:avg_itl", "Average inter-token latency (s)", _L, registry=REGISTRY)
+avg_decoding_length = Gauge("vllm_router:avg_decoding_length", "Average decode phase duration (s)", _L, registry=REGISTRY)
+num_prefill_requests = Gauge("vllm_router:num_prefill_requests", "Requests in prefill", _L, registry=REGISTRY)
+num_decoding_requests = Gauge("vllm_router:num_decoding_requests", "Requests in decode", _L, registry=REGISTRY)
+num_finished_requests = Gauge("vllm_router:num_finished_requests", "Finished requests", _L, registry=REGISTRY)
+num_swapped_requests = Gauge("vllm_router:num_swapped_requests", "Swapped requests", _L, registry=REGISTRY)
+num_requests_running = Gauge("vllm_router:num_requests_running", "Engine-reported running requests", _L, registry=REGISTRY)
+num_requests_waiting = Gauge("vllm_router:num_requests_waiting", "Engine-reported waiting requests", _L, registry=REGISTRY)
+kv_cache_usage = Gauge("vllm_router:gpu_cache_usage_perc", "Engine KV cache usage fraction (TPU HBM)", _L, registry=REGISTRY)
+prefix_cache_hit_rate = Gauge("vllm_router:gpu_prefix_cache_hit_rate", "Engine prefix cache hit rate", _L, registry=REGISTRY)
+healthy_pods = Gauge("vllm_router:healthy_pods_total", "Healthy engine endpoints", registry=REGISTRY)
+router_cpu_pct = Gauge("vllm_router:cpu_usage_pct", "Router process CPU percent", registry=REGISTRY)
+router_mem_bytes = Gauge("vllm_router:mem_usage_bytes", "Router process RSS bytes", registry=REGISTRY)
+router_disk_pct = Gauge("vllm_router:disk_usage_pct", "Disk usage percent of /", registry=REGISTRY)
+
+_PROCESS = psutil.Process()
+
+
+def update_gauges(endpoints, engine_stats: Dict, request_stats: Dict) -> None:
+    """Refresh all gauges from the current stat snapshots.
+
+    Called from both the /metrics handler and the periodic stats logger
+    (reference log_stats.py re-sets gauges too, :37-115).
+    """
+    healthy_pods.set(len(endpoints))
+    for url, stats in (request_stats or {}).items():
+        current_qps.labels(server=url).set(stats.qps)
+        avg_ttft.labels(server=url).set(stats.ttft)
+        avg_latency.labels(server=url).set(stats.avg_latency)
+        avg_itl.labels(server=url).set(stats.avg_itl)
+        avg_decoding_length.labels(server=url).set(stats.avg_decoding_length)
+        num_prefill_requests.labels(server=url).set(stats.in_prefill_requests)
+        num_decoding_requests.labels(server=url).set(stats.in_decoding_requests)
+        num_finished_requests.labels(server=url).set(stats.finished_requests)
+        num_swapped_requests.labels(server=url).set(stats.num_swapped_requests)
+    for url, stats in (engine_stats or {}).items():
+        num_requests_running.labels(server=url).set(stats.num_running_requests)
+        num_requests_waiting.labels(server=url).set(stats.num_queuing_requests)
+        kv_cache_usage.labels(server=url).set(stats.gpu_cache_usage_perc)
+        prefix_cache_hit_rate.labels(server=url).set(stats.gpu_prefix_cache_hit_rate)
+    router_cpu_pct.set(_PROCESS.cpu_percent(interval=None))
+    router_mem_bytes.set(_PROCESS.memory_info().rss)
+    try:
+        router_disk_pct.set(psutil.disk_usage("/").percent)
+    except OSError:
+        pass
+
+
+def render_metrics() -> bytes:
+    return generate_latest(REGISTRY)

@@ -1,0 +1,155 @@
+"""Router CLI argument parsing and validation.
+
+Rebuild of reference ``src/vllm_router/parsers/parser.py:118-386`` (~40 flags)
+including the dynamic-config-file initial merge (reference ``:47-68``,
+``parsers/yaml_utils.py:39-56``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Optional
+
+import yaml
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description="TPU production-stack router")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8001)
+    # Service discovery
+    parser.add_argument(
+        "--service-discovery", choices=["static", "k8s"], default="static"
+    )
+    parser.add_argument("--static-backends", type=str, default=None,
+                        help="Comma-separated engine URLs")
+    parser.add_argument("--static-models", type=str, default=None,
+                        help="Comma-separated model names, one per backend")
+    parser.add_argument("--static-aliases", type=str, default=None,
+                        help="alias:model pairs, comma-separated")
+    parser.add_argument("--static-model-labels", type=str, default=None)
+    parser.add_argument("--static-model-types", type=str, default=None)
+    parser.add_argument("--static-backend-health-checks", action="store_true")
+    parser.add_argument("--k8s-namespace", default="default")
+    parser.add_argument("--k8s-port", type=int, default=8000)
+    parser.add_argument("--k8s-label-selector", default=None)
+    # Routing
+    parser.add_argument(
+        "--routing-logic",
+        choices=["roundrobin", "session", "kvaware", "prefixaware",
+                 "disaggregated_prefill"],
+        default="roundrobin",
+    )
+    parser.add_argument("--session-key", default="x-user-id")
+    parser.add_argument("--kv-aware-threshold", type=int, default=2000)
+    parser.add_argument("--prefill-model-labels", type=str, default=None)
+    parser.add_argument("--decode-model-labels", type=str, default=None)
+    # Stats
+    parser.add_argument("--engine-stats-interval", type=float, default=10.0)
+    parser.add_argument("--request-stats-window", type=float, default=60.0)
+    parser.add_argument("--log-stats", action="store_true")
+    parser.add_argument("--log-stats-interval", type=float, default=10.0)
+    # Batch & files API
+    parser.add_argument("--enable-batch-api", action="store_true")
+    parser.add_argument("--file-storage-class", default="local_file")
+    parser.add_argument("--file-storage-path", default="/tmp/tpu_stack_files")
+    parser.add_argument("--batch-processor", default="local")
+    # Dynamic config
+    parser.add_argument("--dynamic-config-json", type=str, default=None)
+    # Callbacks / rewriter / feature gates
+    parser.add_argument("--callbacks", type=str, default=None,
+                        help="Import path `module.object` with pre/post_request")
+    parser.add_argument("--request-rewriter", default="noop")
+    parser.add_argument("--feature-gates", type=str, default="",
+                        help="e.g. SemanticCache=true,PIIDetection=true")
+    # Semantic cache
+    parser.add_argument("--semantic-cache-model", default="all-MiniLM-L6-v2")
+    parser.add_argument("--semantic-cache-dir", default=None)
+    parser.add_argument("--semantic-cache-threshold", type=float, default=0.95)
+    # Logging / tracing
+    parser.add_argument("--log-level", default="info",
+                        choices=["debug", "info", "warning", "error", "critical"])
+    parser.add_argument("--sentry-dsn", default=None)
+    parser.add_argument("--otel-endpoint", default=None,
+                        help="OTLP endpoint for request span export")
+    return parser
+
+
+def validate_args(args: argparse.Namespace) -> None:
+    """Cross-field validation (reference parser.py:70-116)."""
+    if args.service_discovery == "static":
+        if args.dynamic_config_json is None and not args.static_backends:
+            raise ValueError(
+                "--static-backends required with static service discovery"
+            )
+        if args.dynamic_config_json is None and not args.static_models:
+            raise ValueError(
+                "--static-models required with static service discovery"
+            )
+    if args.routing_logic == "disaggregated_prefill" and (
+        not args.prefill_model_labels or not args.decode_model_labels
+    ):
+        raise ValueError(
+            "disaggregated_prefill routing requires --prefill-model-labels "
+            "and --decode-model-labels"
+        )
+
+
+def expand_static_models_config(config: dict) -> dict:
+    """Expand a structured `static_models` list into flag strings
+    (reference parsers/yaml_utils.py:39-56)."""
+    static_models = config.pop("static_models", None)
+    if not static_models:
+        return config
+    urls, models, labels, types = [], [], [], []
+    aliases = {}
+    for entry in static_models:
+        urls.append(entry["url"])
+        models.append(entry["model"])
+        labels.append(entry.get("model_label") or "")
+        types.append(entry.get("model_type") or "chat")
+        for alias in entry.get("aliases", []) or []:
+            aliases[alias] = entry["model"]
+    config.setdefault("static_backends", ",".join(urls))
+    config.setdefault("static_models", ",".join(models))
+    if any(labels):
+        config.setdefault("static_model_labels", ",".join(labels))
+    config.setdefault("static_model_types", ",".join(types))
+    if aliases:
+        config.setdefault(
+            "static_aliases", ",".join(f"{a}:{m}" for a, m in aliases.items())
+        )
+    return config
+
+
+def load_initial_config_from_config_file_if_required(
+    args: argparse.Namespace,
+) -> argparse.Namespace:
+    """Merge values from --dynamic-config-json into unset args
+    (reference parser.py:47-68)."""
+    if not args.dynamic_config_json:
+        return args
+    with open(args.dynamic_config_json) as f:
+        if args.dynamic_config_json.endswith((".yaml", ".yml")):
+            config = yaml.safe_load(f)
+        else:
+            config = json.load(f)
+    config = expand_static_models_config(config or {})
+    for key, value in config.items():
+        attr = key.replace("-", "_")
+        if hasattr(args, attr) and getattr(args, attr) in (None, "", False):
+            setattr(args, attr, value)
+    return args
+
+
+def parse_args(argv: Optional[list] = None) -> argparse.Namespace:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    args = load_initial_config_from_config_file_if_required(args)
+    validate_args(args)
+    return args

@@ -1,0 +1,393 @@
+"""Request proxying: the router's hot path.
+
+Rebuild of reference ``src/vllm_router/services/request_service/request.py``
+(689 LoC):
+
+- :func:`process_request` -- streamed POST to the chosen backend with the
+  stats hook trio around it (reference ``:55-137``; hot loop ``:109-119``).
+- :func:`route_general_request` -- body parse, model alias rewrite, endpoint
+  filtering (model + not-sleeping), routing decision, streaming response
+  (reference ``:140-302``).
+- :func:`route_disaggregated_prefill_request` -- two-phase prefill→decode
+  flow (reference ``:339-431``).
+- :func:`route_sleep_wakeup_request` -- engine sleep/wake control
+  (reference ``:434-510``).
+- :func:`route_general_transcriptions` -- multipart audio proxy
+  (reference ``:513-689``).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import AsyncGenerator, Optional, Tuple
+
+import aiohttp
+from aiohttp import web
+
+from production_stack_tpu.router.httpclient import get_client_session
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+HOP_BY_HOP = {
+    "connection", "keep-alive", "proxy-authenticate", "proxy-authorization",
+    "te", "trailers", "transfer-encoding", "upgrade", "host", "content-length",
+}
+
+
+def _forward_headers(request: web.Request) -> dict:
+    return {
+        k: v for k, v in request.headers.items() if k.lower() not in HOP_BY_HOP
+    }
+
+
+async def process_request(
+    state,
+    request_id: str,
+    backend_url: str,
+    endpoint: str,
+    body: bytes,
+    headers: dict,
+    method: str = "POST",
+) -> AsyncGenerator[Tuple[str, object], None]:
+    """Stream a backend request; yields ("headers", (status, hdrs)) then
+    ("chunk", bytes)... — mirroring reference request.py:55-137."""
+    monitor = state.request_stats_monitor
+    monitor.on_new_request(backend_url, request_id, time.time())
+    session = get_client_session()
+    first = True
+    try:
+        async with session.request(
+            method, f"{backend_url}{endpoint}", data=body, headers=headers
+        ) as resp:
+            yield "headers", (resp.status, dict(resp.headers))
+            async for chunk in resp.content.iter_any():
+                now = time.time()
+                if first:
+                    monitor.on_request_response(backend_url, request_id, now)
+                    first = False
+                else:
+                    monitor.on_token(backend_url, request_id, now)
+                yield "chunk", chunk
+    finally:
+        monitor.on_request_complete(backend_url, request_id, time.time())
+
+
+async def route_general_request(
+    request: web.Request, endpoint: str
+) -> web.StreamResponse:
+    """Parse, route, and stream one OpenAI-API request (reference :140-302)."""
+    state = request.app["state"]
+    in_router_time = time.time()
+    body = await request.read()
+    request_id = request.headers.get("X-Request-Id") or str(uuid.uuid4())
+
+    try:
+        request_json = json.loads(body) if body else {}
+    except json.JSONDecodeError:
+        return web.json_response(
+            {"error": "Request body is not JSON parsable."}, status=400
+        )
+
+    # Optional user callbacks (reference :174-180).
+    if state.callbacks and hasattr(state.callbacks, "pre_request"):
+        result = await _maybe_await(
+            state.callbacks.pre_request(request, request_json, request_id)
+        )
+        if isinstance(result, web.StreamResponse):
+            return result
+
+    # PII detection (reference experimental/pii/middleware.py).
+    if state.pii_detector is not None:
+        hit = await state.pii_detector.check_request(request_json)
+        if hit:
+            return web.json_response(
+                {"error": f"Request blocked: detected PII ({hit})"}, status=400
+            )
+
+    # Model alias rewrite (reference :182-214).
+    requested_model = request_json.get("model")
+    aliases = getattr(state.service_discovery, "aliases", None) or {}
+    if requested_model in aliases:
+        requested_model = aliases[requested_model]
+        request_json["model"] = requested_model
+        body = json.dumps(request_json).encode()
+
+    # Request rewriting hook (reference rewriter.py).
+    if state.request_rewriter is not None:
+        body = state.request_rewriter.rewrite(body, endpoint)
+
+    # Disaggregated prefill two-phase flow (reference :158-162).
+    from production_stack_tpu.router.routing_logic import DisaggregatedPrefillRouter
+
+    if isinstance(state.router, DisaggregatedPrefillRouter):
+        return await route_disaggregated_prefill_request(
+            request, endpoint, request_json, request_id
+        )
+
+    endpoints = state.service_discovery.get_endpoint_info()
+    if requested_model is not None:
+        endpoints = [
+            ep for ep in endpoints
+            if ep.serves(requested_model) and not ep.sleep
+        ]
+    else:
+        endpoints = [ep for ep in endpoints if not ep.sleep]
+    if not endpoints:
+        return web.json_response(
+            {"error": f"Model {requested_model} not found or all engines sleeping."},
+            status=400,
+        )
+
+    engine_stats = state.engine_stats_scraper.get_engine_stats()
+    request_stats = state.request_stats_monitor.get_request_stats()
+
+    import inspect
+
+    route_result = state.router.route_request(
+        endpoints, engine_stats, request_stats, dict(request.headers), request_json
+    )
+    server_url = (
+        await route_result if inspect.isawaitable(route_result) else route_result
+    )
+
+    logger.info(
+        "Routing request %s for model %s to %s at %.3f (took %.1f ms)",
+        request_id, requested_model, server_url,
+        in_router_time, (time.time() - in_router_time) * 1e3,
+    )
+
+    stream = process_request(
+        state, request_id, server_url, endpoint, body, _forward_headers(request)
+    )
+    response: Optional[web.StreamResponse] = None
+    full_response = bytearray()
+    try:
+        async for kind, payload in stream:
+            if kind == "headers":
+                status, hdrs = payload
+                response = web.StreamResponse(status=status)
+                ct = hdrs.get("Content-Type")
+                if ct:
+                    response.content_type = ct.split(";")[0]
+                    if "charset=" in ct:
+                        response.charset = ct.split("charset=")[-1]
+                response.headers["X-Request-Id"] = request_id
+                await response.prepare(request)
+            else:
+                full_response.extend(payload)
+                assert response is not None
+                await response.write(payload)
+    except aiohttp.ClientError as e:
+        logger.error("Backend %s failed for %s: %s", server_url, request_id, e)
+        if response is None:
+            return web.json_response(
+                {"error": f"Backend connection failed: {e}"}, status=502
+            )
+        raise
+    if response is None:
+        return web.json_response({"error": "Empty backend response"}, status=502)
+    await response.write_eof()
+
+    # Post-request hooks: semantic cache store + callbacks (reference :129-137).
+    if state.semantic_cache is not None and endpoint.endswith("chat/completions"):
+        await state.semantic_cache.maybe_store(request_json, bytes(full_response))
+    if state.callbacks and hasattr(state.callbacks, "post_request"):
+        await _maybe_await(
+            state.callbacks.post_request(request_json, bytes(full_response), request_id)
+        )
+    return response
+
+
+async def send_request_to_prefiller(
+    session: aiohttp.ClientSession, url: str, endpoint: str, body: dict, headers: dict
+) -> dict:
+    """Fire the prefill phase (max_tokens=1) — reference request.py:305-321."""
+    async with session.post(
+        f"{url}{endpoint}", json=body, headers=headers
+    ) as resp:
+        resp.raise_for_status()
+        return await resp.json()
+
+
+async def route_disaggregated_prefill_request(
+    request: web.Request, endpoint: str, request_json: dict, request_id: str
+) -> web.StreamResponse:
+    """Two-phase prefill→decode flow (reference request.py:339-431).
+
+    Phase 1 sends the request with ``max_tokens=1`` (and ``max_completion_tokens``
+    for chat) to a prefill engine; the KV it produces moves to the decode
+    engine out-of-band over the KV transfer fabric
+    (:mod:`production_stack_tpu.kv.transfer`). Phase 2 streams the real
+    request from a decode engine.
+    """
+    state = request.app["state"]
+    session = get_client_session()
+    endpoints = state.service_discovery.get_endpoint_info()
+    router = state.router
+
+    prefill_url = router.pick(endpoints, "prefill")
+    decode_url = router.pick(endpoints, "decode")
+
+    saved = {
+        k: request_json.get(k) for k in ("max_tokens", "max_completion_tokens")
+    }
+    prefill_json = dict(request_json)
+    prefill_json["max_tokens"] = 1
+    if "max_completion_tokens" in prefill_json:
+        prefill_json["max_completion_tokens"] = 1
+    prefill_json["stream"] = False
+    headers = _forward_headers(request)
+    headers["X-Request-Id"] = request_id
+    headers.pop("Content-Type", None)
+
+    monitor = state.request_stats_monitor
+    monitor.on_new_request(prefill_url, request_id, time.time())
+    t0 = time.time()
+    try:
+        await send_request_to_prefiller(
+            session, prefill_url, endpoint, prefill_json, headers
+        )
+    except aiohttp.ClientError as e:
+        monitor.on_request_complete(prefill_url, request_id, time.time())
+        return web.json_response({"error": f"Prefill failed: {e}"}, status=502)
+    ttft = time.time() - t0
+    monitor.on_request_response(prefill_url, request_id, time.time())
+    monitor.on_request_complete(prefill_url, request_id, time.time())
+    logger.info("Disagg prefill for %s took %.3f s (TTFT)", request_id, ttft)
+
+    decode_json = dict(request_json)
+    for k, v in saved.items():
+        if v is not None:
+            decode_json[k] = v
+    body = json.dumps(decode_json).encode()
+    headers["Content-Type"] = "application/json"
+
+    stream = process_request(
+        state, request_id, decode_url, endpoint, body, headers
+    )
+    response: Optional[web.StreamResponse] = None
+    async for kind, payload in stream:
+        if kind == "headers":
+            status, hdrs = payload
+            response = web.StreamResponse(status=status)
+            ct = hdrs.get("Content-Type")
+            if ct:
+                response.content_type = ct.split(";")[0]
+            response.headers["X-Request-Id"] = request_id
+            await response.prepare(request)
+        else:
+            assert response is not None
+            await response.write(payload)
+    if response is None:
+        return web.json_response({"error": "Empty decode response"}, status=502)
+    await response.write_eof()
+    return response
+
+
+async def route_sleep_wakeup_request(
+    request: web.Request, action: str
+) -> web.Response:
+    """Proxy /sleep, /wake_up, /is_sleeping to a specific engine
+    (reference request.py:434-510). Engine chosen by ``url`` query param or
+    model name; discovery sleep status is refreshed after the call."""
+    state = request.app["state"]
+    session = get_client_session()
+    target_url = request.query.get("url")
+    model = request.query.get("model")
+    endpoints = state.service_discovery.get_endpoint_info()
+    if target_url:
+        matches = [ep for ep in endpoints if ep.url == target_url]
+    elif model:
+        matches = [ep for ep in endpoints if ep.serves(model)]
+    else:
+        matches = list(endpoints)
+    if not matches:
+        return web.json_response({"error": "No matching engine"}, status=404)
+    results = {}
+    for ep in matches:
+        try:
+            if action == "is_sleeping":
+                async with session.get(f"{ep.url}/is_sleeping") as resp:
+                    results[ep.url] = await resp.json()
+            else:
+                params = dict(request.query)
+                params.pop("url", None)
+                params.pop("model", None)
+                async with session.post(
+                    f"{ep.url}/{action}", params=params
+                ) as resp:
+                    results[ep.url] = {"status": resp.status}
+                if hasattr(state.service_discovery, "set_sleep_status"):
+                    state.service_discovery.set_sleep_status(
+                        ep.url, action == "sleep"
+                    )
+        except aiohttp.ClientError as e:
+            results[ep.url] = {"error": str(e)}
+    return web.json_response(results)
+
+
+async def route_general_transcriptions(request: web.Request) -> web.StreamResponse:
+    """Proxy multipart audio transcription requests (reference :513-689)."""
+    state = request.app["state"]
+    request_id = request.headers.get("X-Request-Id") or str(uuid.uuid4())
+    reader = await request.multipart()
+    form = aiohttp.FormData()
+    model = None
+    while True:
+        part = await reader.next()
+        if part is None:
+            break
+        if part.name == "file":
+            payload = await part.read(decode=False)
+            form.add_field(
+                "file", payload,
+                filename=part.filename or "audio.wav",
+                content_type=part.headers.get("Content-Type", "audio/wav"),
+            )
+        else:
+            value = (await part.read(decode=False)).decode()
+            if part.name == "model":
+                model = value
+            form.add_field(part.name, value)
+    endpoints = [
+        ep for ep in state.service_discovery.get_endpoint_info()
+        if not ep.sleep and (model is None or ep.serves(model))
+    ]
+    if not endpoints:
+        return web.json_response(
+            {"error": f"Model {model} not found"}, status=400
+        )
+    engine_stats = state.engine_stats_scraper.get_engine_stats()
+    request_stats = state.request_stats_monitor.get_request_stats()
+    import inspect
+
+    route_result = state.router.route_request(
+        endpoints, engine_stats, request_stats, dict(request.headers), None
+    )
+    url = await route_result if inspect.isawaitable(route_result) else route_result
+    monitor = state.request_stats_monitor
+    monitor.on_new_request(url, request_id, time.time())
+    session = get_client_session()
+    try:
+        async with session.post(
+            f"{url}/v1/audio/transcriptions", data=form
+        ) as resp:
+            monitor.on_request_response(url, request_id, time.time())
+            data = await resp.read()
+            return web.Response(
+                body=data, status=resp.status,
+                content_type=resp.headers.get("Content-Type", "application/json").split(";")[0],
+            )
+    finally:
+        monitor.on_request_complete(url, request_id, time.time())
+
+
+async def _maybe_await(value):
+    import inspect
+
+    if inspect.isawaitable(value):
+        return await value
+    return value

@@ -1,0 +1,210 @@
+"""Per-engine request statistics: sliding-window QPS, TTFT, latency.
+
+Rebuild of reference ``src/vllm_router/stats/request_stats.py`` (314 LoC):
+:class:`MovingAverageMonitor` (reference ``:58-103``) and
+:class:`RequestStatsMonitor` with the ``on_new_request`` /
+``on_request_response`` / ``on_request_complete`` hook trio the request
+service calls around every proxied request (reference ``:145-236``), and
+``get_request_stats`` producing the per-URL snapshot that feeds both the
+session-router QPS fallback and ``/metrics`` (reference ``:238-306``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, Optional, Tuple
+
+from production_stack_tpu.utils.misc import SingletonMeta
+
+
+@dataclass
+class RequestStats:
+    """Snapshot of one engine's request statistics (reference :31-55)."""
+
+    qps: float = 0.0
+    ttft: float = -1.0
+    in_prefill_requests: int = 0
+    in_decoding_requests: int = 0
+    finished_requests: int = 0
+    uncomputed_latency_requests: int = 0
+    avg_decoding_length: float = -1.0
+    avg_latency: float = -1.0
+    avg_itl: float = -1.0
+    num_swapped_requests: int = 0
+
+
+class MovingAverageMonitor:
+    """Sliding time-window average (reference :58-103)."""
+
+    def __init__(self, sliding_window_size: float):
+        self.window = sliding_window_size
+        self.timestamps: Deque[float] = deque()
+        self.values: Deque[float] = deque()
+        self._sum = 0.0
+
+    def update(self, timestamp: float, value: float) -> None:
+        self.timestamps.append(timestamp)
+        self.values.append(value)
+        self._sum += value
+        self._expire(timestamp)
+
+    def update_no_value(self, timestamp: float) -> None:
+        self.update(timestamp, 0.0)
+
+    def _expire(self, now: float) -> None:
+        while self.timestamps and now - self.timestamps[0] > self.window:
+            self.timestamps.popleft()
+            self._sum -= self.values.popleft()
+
+    def get_average(self) -> float:
+        if not self.values:
+            return -1.0
+        return self._sum / len(self.values)
+
+    def get_sum(self) -> float:
+        return self._sum
+
+    def get_count(self) -> int:
+        return len(self.values)
+
+
+class RequestStatsMonitor(metaclass=SingletonMeta):
+    """Tracks per-engine request lifecycle statistics (reference :106-306)."""
+
+    def __init__(self, sliding_window_size: float = 60.0):
+        if hasattr(self, "_initialized"):
+            return
+        self._initialized = True
+        self.sliding_window_size = sliding_window_size
+        self._lock = threading.Lock()
+        self.qps_monitors: Dict[str, MovingAverageMonitor] = {}
+        self.ttft_monitors: Dict[str, MovingAverageMonitor] = {}
+        self.latency_monitors: Dict[str, MovingAverageMonitor] = {}
+        self.decoding_length_monitors: Dict[str, MovingAverageMonitor] = {}
+        self.itl_monitors: Dict[str, MovingAverageMonitor] = {}
+        # (engine_url, request_id) -> timestamps
+        self.request_start_time: Dict[Tuple[str, str], float] = {}
+        self.first_token_time: Dict[Tuple[str, str], float] = {}
+        self.last_token_time: Dict[Tuple[str, str], float] = {}
+        self.tokens_seen: Dict[Tuple[str, str], int] = {}
+        self.in_prefill: Dict[str, int] = {}
+        self.in_decoding: Dict[str, int] = {}
+        self.finished: Dict[str, int] = {}
+        self.swapped: Dict[str, int] = {}
+
+    # -- lifecycle hooks ----------------------------------------------------
+    def on_new_request(self, engine_url: str, request_id: str, timestamp: float) -> None:
+        with self._lock:
+            self.request_start_time[(engine_url, request_id)] = timestamp
+            self.in_prefill[engine_url] = self.in_prefill.get(engine_url, 0) + 1
+            mon = self.qps_monitors.setdefault(
+                engine_url, MovingAverageMonitor(self.sliding_window_size)
+            )
+            mon.update_no_value(timestamp)
+
+    def on_request_response(self, engine_url: str, request_id: str, timestamp: float) -> None:
+        """First stream chunk received → TTFT; request moves prefill→decode."""
+        with self._lock:
+            key = (engine_url, request_id)
+            if key not in self.request_start_time:
+                return
+            ttft = timestamp - self.request_start_time[key]
+            self.first_token_time[key] = timestamp
+            self.last_token_time[key] = timestamp
+            self.tokens_seen[key] = 1
+            self.ttft_monitors.setdefault(
+                engine_url, MovingAverageMonitor(self.sliding_window_size)
+            ).update(timestamp, ttft)
+            self.in_prefill[engine_url] = max(
+                0, self.in_prefill.get(engine_url, 0) - 1
+            )
+            self.in_decoding[engine_url] = self.in_decoding.get(engine_url, 0) + 1
+
+    def on_token(self, engine_url: str, request_id: str, timestamp: float) -> None:
+        """Optional per-chunk hook: feeds inter-token latency."""
+        with self._lock:
+            key = (engine_url, request_id)
+            last = self.last_token_time.get(key)
+            if last is not None:
+                self.itl_monitors.setdefault(
+                    engine_url, MovingAverageMonitor(self.sliding_window_size)
+                ).update(timestamp, timestamp - last)
+            self.last_token_time[key] = timestamp
+            self.tokens_seen[key] = self.tokens_seen.get(key, 0) + 1
+
+    def on_request_complete(self, engine_url: str, request_id: str, timestamp: float) -> None:
+        with self._lock:
+            key = (engine_url, request_id)
+            start = self.request_start_time.pop(key, None)
+            first = self.first_token_time.pop(key, None)
+            self.last_token_time.pop(key, None)
+            ntokens = self.tokens_seen.pop(key, 0)
+            if first is not None:
+                self.in_decoding[engine_url] = max(
+                    0, self.in_decoding.get(engine_url, 0) - 1
+                )
+                self.decoding_length_monitors.setdefault(
+                    engine_url, MovingAverageMonitor(self.sliding_window_size)
+                ).update(timestamp, timestamp - first)
+            else:
+                self.in_prefill[engine_url] = max(
+                    0, self.in_prefill.get(engine_url, 0) - 1
+                )
+            if start is not None:
+                self.latency_monitors.setdefault(
+                    engine_url, MovingAverageMonitor(self.sliding_window_size)
+                ).update(timestamp, timestamp - start)
+            self.finished[engine_url] = self.finished.get(engine_url, 0) + 1
+
+    def on_request_swapped(self, engine_url: str, request_id: str, timestamp: float) -> None:
+        with self._lock:
+            self.swapped[engine_url] = self.swapped.get(engine_url, 0) + 1
+
+    # -- snapshot ----------------------------------------------------------
+    def get_request_stats(self, current_time: Optional[float] = None) -> Dict[str, RequestStats]:
+        now = current_time if current_time is not None else time.time()
+        out: Dict[str, RequestStats] = {}
+        with self._lock:
+            urls = (
+                set(self.qps_monitors)
+                | set(self.in_prefill)
+                | set(self.in_decoding)
+                | set(self.finished)
+            )
+            for url in urls:
+                qps_mon = self.qps_monitors.get(url)
+                if qps_mon is not None:
+                    qps_mon._expire(now)
+                    qps = qps_mon.get_count() / self.sliding_window_size
+                else:
+                    qps = 0.0
+                ttft_mon = self.ttft_monitors.get(url)
+                lat_mon = self.latency_monitors.get(url)
+                dec_mon = self.decoding_length_monitors.get(url)
+                itl_mon = self.itl_monitors.get(url)
+                out[url] = RequestStats(
+                    qps=qps,
+                    ttft=ttft_mon.get_average() if ttft_mon else -1.0,
+                    in_prefill_requests=self.in_prefill.get(url, 0),
+                    in_decoding_requests=self.in_decoding.get(url, 0),
+                    finished_requests=self.finished.get(url, 0),
+                    uncomputed_latency_requests=len(
+                        [k for k in self.request_start_time if k[0] == url]
+                    ),
+                    avg_decoding_length=dec_mon.get_average() if dec_mon else -1.0,
+                    avg_latency=lat_mon.get_average() if lat_mon else -1.0,
+                    avg_itl=itl_mon.get_average() if itl_mon else -1.0,
+                    num_swapped_requests=self.swapped.get(url, 0),
+                )
+        return out
+
+
+def initialize_request_stats_monitor(sliding_window_size: float = 60.0) -> RequestStatsMonitor:
+    return RequestStatsMonitor(sliding_window_size)
+
+
+def get_request_stats_monitor() -> RequestStatsMonitor:
+    return RequestStatsMonitor()

@@ -1,0 +1,32 @@
+"""Pluggable request body rewriting (reference
+src/vllm_router/services/request_service/rewriter.py:29-119)."""
+
+from __future__ import annotations
+
+import abc
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+class RequestRewriter(abc.ABC):
+    @abc.abstractmethod
+    def rewrite(self, body: bytes, endpoint: str) -> bytes:
+        """Return the (possibly rewritten) request body."""
+
+
+class NoopRequestRewriter(RequestRewriter):
+    def rewrite(self, body: bytes, endpoint: str) -> bytes:
+        return body
+
+
+def get_request_rewriter(name: str = "noop") -> RequestRewriter:
+    if name in (None, "", "noop"):
+        return NoopRequestRewriter()
+    # Custom rewriter by import path "module:Class".
+    import importlib
+
+    module_name, _, attr = name.partition(":")
+    cls = getattr(importlib.import_module(module_name), attr)
+    return cls()

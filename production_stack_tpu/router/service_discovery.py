@@ -1,0 +1,325 @@
+"""Engine service discovery: who are the engines, what do they serve.
+
+Rebuild of reference ``src/vllm_router/service_discovery.py:178-1176``:
+
+- :class:`StaticServiceDiscovery` -- fixed URL list with optional periodic
+  real-inference health probes (reference ``:206-342``).
+- :class:`K8sPodIPServiceDiscovery` -- watches pods by label selector and
+  routes to pod IPs (reference ``:344-760``). The reference uses the
+  ``kubernetes`` client; that package is not in this image, so we ship a
+  minimal raw K8s API client (service-account token + watch stream) in
+  :mod:`production_stack_tpu.router.k8s_client`.
+
+Endpoints carry ``sleep`` status (reference ``:414-496``) so sleeping engines
+can be excluded from routing, and prefill/decode model labels for
+disaggregated prefill (reference ``:321-341``).
+"""
+
+from __future__ import annotations
+
+import abc
+import enum
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import requests
+
+from production_stack_tpu.utils.log import init_logger
+from production_stack_tpu.utils.misc import ModelType, is_model_healthy
+
+logger = init_logger(__name__)
+
+_global_service_discovery: Optional["ServiceDiscovery"] = None
+
+
+class ServiceDiscoveryType(enum.Enum):
+    STATIC = "static"
+    K8S_POD_IP = "k8s"
+    K8S_SERVICE_NAME = "k8s_service_name"
+
+
+@dataclass
+class EndpointInfo:
+    """One engine endpoint (reference service_discovery.py:178-203)."""
+
+    url: str
+    model_names: List[str] = field(default_factory=list)
+    added_timestamp: float = field(default_factory=time.time)
+    model_label: Optional[str] = None
+    model_type: str = "chat"
+    sleep: bool = False
+    pod_name: Optional[str] = None
+    namespace: Optional[str] = None
+    lora_adapters: List[str] = field(default_factory=list)
+    model_aliases: Dict[str, str] = field(default_factory=dict)
+
+    def serves(self, model: str) -> bool:
+        return model in self.model_names or model in self.lora_adapters
+
+
+class ServiceDiscovery(abc.ABC):
+    @abc.abstractmethod
+    def get_endpoint_info(self) -> List[EndpointInfo]:
+        """Snapshot of currently known endpoints."""
+
+    def get_unhealthy_endpoint_hashes(self) -> List[str]:
+        return []
+
+    def get_health(self) -> bool:
+        return True
+
+    def get_model_names(self) -> List[str]:
+        names: List[str] = []
+        for ep in self.get_endpoint_info():
+            for m in ep.model_names + ep.lora_adapters:
+                if m not in names:
+                    names.append(m)
+        return names
+
+    def get_endpoints_for_model(
+        self, model: str, exclude_sleeping: bool = True
+    ) -> List[EndpointInfo]:
+        return [
+            ep
+            for ep in self.get_endpoint_info()
+            if ep.serves(model) and not (exclude_sleeping and ep.sleep)
+        ]
+
+    def close(self) -> None:  # pragma: no cover - trivial
+        pass
+
+
+def _probe_models(url: str, timeout: float = 5.0) -> List[str]:
+    """Ask an engine which models it serves (reference :498-531)."""
+    try:
+        resp = requests.get(f"{url}/v1/models", timeout=timeout)
+        resp.raise_for_status()
+        return [m["id"] for m in resp.json().get("data", [])]
+    except Exception as e:  # noqa: BLE001
+        logger.debug("Model probe failed for %s: %s", url, e)
+        return []
+
+
+def _probe_sleep(url: str, timeout: float = 3.0) -> bool:
+    """Query /is_sleeping (reference :443-460)."""
+    try:
+        resp = requests.get(f"{url}/is_sleeping", timeout=timeout)
+        resp.raise_for_status()
+        return bool(resp.json().get("is_sleeping", False))
+    except Exception:  # noqa: BLE001
+        return False
+
+
+class StaticServiceDiscovery(ServiceDiscovery):
+    """Fixed endpoint list (reference service_discovery.py:206-342)."""
+
+    def __init__(
+        self,
+        urls: List[str],
+        models: List[str],
+        aliases: Optional[Dict[str, str]] = None,
+        model_labels: Optional[List[str]] = None,
+        model_types: Optional[List[str]] = None,
+        static_backend_health_checks: bool = False,
+        prefill_model_labels: Optional[List[str]] = None,
+        decode_model_labels: Optional[List[str]] = None,
+        health_check_interval: float = 60.0,
+    ):
+        if len(urls) != len(models):
+            raise ValueError("Number of URLs must match number of models")
+        self.aliases = aliases or {}
+        self.prefill_model_labels = prefill_model_labels or []
+        self.decode_model_labels = decode_model_labels or []
+        self._lock = threading.Lock()
+        self._endpoints: List[EndpointInfo] = []
+        for i, (url, model) in enumerate(zip(urls, models)):
+            label = model_labels[i] if model_labels else None
+            mtype = model_types[i] if model_types else "chat"
+            self._endpoints.append(
+                EndpointInfo(
+                    url=url,
+                    model_names=[model],
+                    model_label=label,
+                    model_type=mtype,
+                    model_aliases=self.aliases,
+                )
+            )
+        self._unhealthy: set = set()
+        self._running = True
+        self._hc_thread: Optional[threading.Thread] = None
+        if static_backend_health_checks:
+            self._hc_interval = health_check_interval
+            self._hc_thread = threading.Thread(
+                target=self._health_check_loop, daemon=True, name="static-health"
+            )
+            self._hc_thread.start()
+
+    # -- health checking (reference :252-265, utils.py:188-223) ------------
+    def _health_check_loop(self) -> None:
+        while self._running:
+            self._check_health_once()
+            for _ in range(int(self._hc_interval * 10)):
+                if not self._running:
+                    return
+                time.sleep(0.1)
+
+    def _check_health_once(self) -> None:
+        with self._lock:
+            eps = list(self._endpoints)
+        unhealthy = set()
+        for ep in eps:
+            for model in ep.model_names:
+                if not is_model_healthy(ep.url, model, ep.model_type):
+                    unhealthy.add(ep.url)
+        with self._lock:
+            self._unhealthy = unhealthy
+
+    def get_unhealthy_endpoint_hashes(self) -> List[str]:
+        with self._lock:
+            return sorted(self._unhealthy)
+
+    def get_endpoint_info(self) -> List[EndpointInfo]:
+        with self._lock:
+            return [ep for ep in self._endpoints if ep.url not in self._unhealthy]
+
+    def set_sleep_status(self, url: str, sleep: bool) -> None:
+        with self._lock:
+            for ep in self._endpoints:
+                if ep.url == url:
+                    ep.sleep = sleep
+
+    def refresh_sleep_status(self) -> None:
+        with self._lock:
+            eps = list(self._endpoints)
+        for ep in eps:
+            ep.sleep = _probe_sleep(ep.url)
+
+    def get_health(self) -> bool:
+        return self._hc_thread is None or self._hc_thread.is_alive()
+
+    def close(self) -> None:
+        self._running = False
+
+
+class K8sPodIPServiceDiscovery(ServiceDiscovery):
+    """Watch engine pods via the K8s API, route to pod IPs.
+
+    Reference service_discovery.py:344-760 (_watch_engines:579-630).
+    """
+
+    def __init__(
+        self,
+        namespace: str = "default",
+        port: int = 8000,
+        label_selector: Optional[str] = None,
+        prefill_model_labels: Optional[List[str]] = None,
+        decode_model_labels: Optional[List[str]] = None,
+        k8s_client=None,
+    ):
+        from production_stack_tpu.router.k8s_client import K8sClient
+
+        self.namespace = namespace
+        self.port = port
+        self.label_selector = label_selector
+        self.prefill_model_labels = prefill_model_labels or []
+        self.decode_model_labels = decode_model_labels or []
+        self._k8s = k8s_client or K8sClient()
+        self._lock = threading.Lock()
+        self._endpoints: Dict[str, EndpointInfo] = {}  # pod name -> info
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._watch_engines, daemon=True, name="k8s-watch"
+        )
+        self._thread.start()
+
+    def _watch_engines(self) -> None:
+        while self._running:
+            try:
+                for event in self._k8s.watch_pods(
+                    self.namespace, self.label_selector
+                ):
+                    if not self._running:
+                        return
+                    self._handle_event(event)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("K8s watch error (retrying in 2s): %s", e)
+                time.sleep(2)
+
+    def _handle_event(self, event: dict) -> None:
+        etype = event.get("type")
+        pod = event.get("object", {})
+        meta = pod.get("metadata", {})
+        status = pod.get("status", {})
+        name = meta.get("name")
+        if not name:
+            return
+        pod_ip = status.get("podIP")
+        ready = _pod_is_ready(status)
+        terminating = meta.get("deletionTimestamp") is not None
+        if etype == "DELETED" or terminating or not ready or not pod_ip:
+            with self._lock:
+                if name in self._endpoints:
+                    logger.info("Engine pod %s removed from routing", name)
+                    del self._endpoints[name]
+            return
+        url = f"http://{pod_ip}:{self.port}"
+        labels = meta.get("labels", {})
+        model_label = labels.get("model")
+        sleeping = labels.get("sleeping") == "true" or _probe_sleep(url)
+        models = _probe_models(url)
+        if not models:
+            return
+        with self._lock:
+            self._endpoints[name] = EndpointInfo(
+                url=url,
+                model_names=models,
+                model_label=model_label,
+                sleep=sleeping,
+                pod_name=name,
+                namespace=self.namespace,
+            )
+
+    def get_endpoint_info(self) -> List[EndpointInfo]:
+        with self._lock:
+            return list(self._endpoints.values())
+
+    def get_health(self) -> bool:
+        return self._thread.is_alive()
+
+    def close(self) -> None:
+        self._running = False
+
+
+def _pod_is_ready(status: dict) -> bool:
+    if status.get("phase") != "Running":
+        return False
+    for cond in status.get("conditions", []) or []:
+        if cond.get("type") == "Ready":
+            return cond.get("status") == "True"
+    return False
+
+
+def initialize_service_discovery(
+    sd_type: ServiceDiscoveryType, **kwargs
+) -> ServiceDiscovery:
+    global _global_service_discovery
+    if sd_type == ServiceDiscoveryType.STATIC:
+        _global_service_discovery = StaticServiceDiscovery(**kwargs)
+    elif sd_type == ServiceDiscoveryType.K8S_POD_IP:
+        _global_service_discovery = K8sPodIPServiceDiscovery(**kwargs)
+    else:
+        raise ValueError(f"Unsupported service discovery type: {sd_type}")
+    return _global_service_discovery
+
+
+def get_service_discovery() -> ServiceDiscovery:
+    if _global_service_discovery is None:
+        raise RuntimeError("Service discovery not initialized")
+    return _global_service_discovery
+
+
+def _set_service_discovery_for_test(sd: Optional[ServiceDiscovery]) -> None:
+    global _global_service_discovery
+    _global_service_discovery = sd

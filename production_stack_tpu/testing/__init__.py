@@ -1,0 +1,11 @@
+"""Test fixtures: fake engine backend and load generator.
+
+The reference's load-bearing fixture is a fake vLLM backend with controllable
+token rate and TTFT (``src/tests/perftest/fake-openai-server.py:31-80``);
+this package provides the same for the TPU stack, importable from unit tests
+and runnable standalone for router perf testing.
+"""
+
+from production_stack_tpu.testing.fake_engine import FakeEngine, run_fake_engine
+
+__all__ = ["FakeEngine", "run_fake_engine"]

@@ -1,0 +1,248 @@
+"""Fake OpenAI-compatible engine with controllable TTFT / token rate.
+
+Mirrors the role of reference ``src/tests/perftest/fake-openai-server.py``:
+lets the router's multi-backend behavior (routing, streaming, stats, metrics
+scraping, sleep mode) be exercised hermetically with no TPU or cluster.
+
+Serves: /v1/models, /v1/chat/completions, /v1/completions, /v1/embeddings,
+/tokenize, /detokenize, /metrics (vllm:* exposition), /sleep, /wake_up,
+/is_sleeping, /health, /v1/audio/transcriptions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+import uuid
+from typing import List, Optional
+
+from aiohttp import web
+
+
+class FakeEngine:
+    def __init__(
+        self,
+        model: str = "fake-model",
+        ttft: float = 0.0,
+        tokens_per_sec: float = 0.0,
+        max_tokens_default: int = 16,
+        models: Optional[List[str]] = None,
+    ):
+        self.models = models or [model]
+        self.ttft = ttft
+        self.tokens_per_sec = tokens_per_sec
+        self.max_tokens_default = max_tokens_default
+        self.sleeping = False
+        self.num_running = 0
+        self.num_waiting = 0
+        self.requests_seen: List[dict] = []
+        self.kv_usage = 0.42
+
+    # -- helpers -----------------------------------------------------------
+    def _token_delay(self) -> float:
+        return 1.0 / self.tokens_per_sec if self.tokens_per_sec > 0 else 0.0
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/v1/models", self.handle_models)
+        app.router.add_post("/v1/chat/completions", self.handle_chat)
+        app.router.add_post("/v1/completions", self.handle_completion)
+        app.router.add_post("/v1/embeddings", self.handle_embeddings)
+        app.router.add_post("/tokenize", self.handle_tokenize)
+        app.router.add_post("/detokenize", self.handle_detokenize)
+        app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_post("/sleep", self.handle_sleep)
+        app.router.add_post("/wake_up", self.handle_wake)
+        app.router.add_get("/is_sleeping", self.handle_is_sleeping)
+        app.router.add_get("/health", self.handle_health)
+        app.router.add_post("/v1/audio/transcriptions", self.handle_transcription)
+        return app
+
+    async def handle_models(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "object": "list",
+            "data": [
+                {"id": m, "object": "model", "created": int(time.time()),
+                 "owned_by": "fake"} for m in self.models
+            ],
+        })
+
+    async def handle_chat(self, request: web.Request) -> web.StreamResponse:
+        body = await request.json()
+        self.requests_seen.append(body)
+        n_tokens = int(
+            body.get("max_tokens")
+            or body.get("max_completion_tokens")
+            or self.max_tokens_default
+        )
+        stream = bool(body.get("stream", False))
+        rid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
+        model = body.get("model", self.models[0])
+        self.num_running += 1
+        try:
+            if self.ttft > 0:
+                await asyncio.sleep(self.ttft)
+            if not stream:
+                for _ in range(n_tokens):
+                    await asyncio.sleep(self._token_delay())
+                return web.json_response({
+                    "id": rid, "object": "chat.completion", "model": model,
+                    "created": int(time.time()),
+                    "choices": [{
+                        "index": 0,
+                        "message": {"role": "assistant",
+                                    "content": "Hello " * n_tokens},
+                        "finish_reason": "length",
+                    }],
+                    "usage": {"prompt_tokens": 5,
+                              "completion_tokens": n_tokens,
+                              "total_tokens": 5 + n_tokens},
+                })
+            resp = web.StreamResponse()
+            resp.content_type = "text/event-stream"
+            await resp.prepare(request)
+            for i in range(n_tokens):
+                chunk = {
+                    "id": rid, "object": "chat.completion.chunk",
+                    "created": int(time.time()), "model": model,
+                    "choices": [{
+                        "index": 0,
+                        "delta": ({"role": "assistant", "content": "Hello "}
+                                  if i == 0 else {"content": "Hello "}),
+                        "finish_reason": None,
+                    }],
+                }
+                await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                await asyncio.sleep(self._token_delay())
+            final = {
+                "id": rid, "object": "chat.completion.chunk",
+                "created": int(time.time()), "model": model,
+                "choices": [{"index": 0, "delta": {}, "finish_reason": "length"}],
+            }
+            await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+        finally:
+            self.num_running -= 1
+
+    async def handle_completion(self, request: web.Request) -> web.StreamResponse:
+        body = await request.json()
+        self.requests_seen.append(body)
+        n_tokens = int(body.get("max_tokens") or self.max_tokens_default)
+        stream = bool(body.get("stream", False))
+        rid = f"cmpl-{uuid.uuid4().hex[:12]}"
+        model = body.get("model", self.models[0])
+        if self.ttft > 0:
+            await asyncio.sleep(self.ttft)
+        if not stream:
+            return web.json_response({
+                "id": rid, "object": "text_completion", "model": model,
+                "created": int(time.time()),
+                "choices": [{"index": 0, "text": "Hello " * n_tokens,
+                             "finish_reason": "length"}],
+                "usage": {"prompt_tokens": 5, "completion_tokens": n_tokens,
+                          "total_tokens": 5 + n_tokens},
+            })
+        resp = web.StreamResponse()
+        resp.content_type = "text/event-stream"
+        await resp.prepare(request)
+        for _ in range(n_tokens):
+            chunk = {
+                "id": rid, "object": "text_completion",
+                "created": int(time.time()), "model": model,
+                "choices": [{"index": 0, "text": "Hello ",
+                             "finish_reason": None}],
+            }
+            await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+            await asyncio.sleep(self._token_delay())
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+    async def handle_embeddings(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        inputs = body.get("input")
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        return web.json_response({
+            "object": "list", "model": body.get("model", self.models[0]),
+            "data": [{"object": "embedding", "index": i, "embedding": [0.0] * 8}
+                     for i in range(len(inputs or []))],
+            "usage": {"prompt_tokens": 0, "total_tokens": 0},
+        })
+
+    async def handle_tokenize(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        text = body.get("prompt") or ""
+        tokens = list(range(len(text.split())))
+        return web.json_response({"tokens": tokens, "count": len(tokens)})
+
+    async def handle_detokenize(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        return web.json_response({"prompt": " ".join(map(str, body.get("tokens", [])))})
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        text = (
+            "# TYPE vllm:num_requests_running gauge\n"
+            f"vllm:num_requests_running {self.num_running}\n"
+            "# TYPE vllm:num_requests_waiting gauge\n"
+            f"vllm:num_requests_waiting {self.num_waiting}\n"
+            "# TYPE vllm:gpu_cache_usage_perc gauge\n"
+            f"vllm:gpu_cache_usage_perc {self.kv_usage}\n"
+            "# TYPE vllm:gpu_prefix_cache_hits counter\n"
+            "vllm:gpu_prefix_cache_hits_total 30\n"
+            "# TYPE vllm:gpu_prefix_cache_queries counter\n"
+            "vllm:gpu_prefix_cache_queries_total 100\n"
+        )
+        return web.Response(text=text, content_type="text/plain")
+
+    async def handle_sleep(self, request: web.Request) -> web.Response:
+        self.sleeping = True
+        return web.json_response({"status": "sleeping"})
+
+    async def handle_wake(self, request: web.Request) -> web.Response:
+        self.sleeping = False
+        return web.json_response({"status": "awake"})
+
+    async def handle_is_sleeping(self, request: web.Request) -> web.Response:
+        return web.json_response({"is_sleeping": self.sleeping})
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def handle_transcription(self, request: web.Request) -> web.Response:
+        await request.post()
+        return web.json_response({"text": "fake transcription"})
+
+
+async def run_fake_engine(engine: FakeEngine, host: str, port: int) -> web.AppRunner:
+    runner = web.AppRunner(engine.make_app())
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    return runner
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="Fake OpenAI engine")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=9000)
+    parser.add_argument("--model", default="fake-model")
+    parser.add_argument("--ttft", type=float, default=0.0)
+    parser.add_argument("--tokens-per-sec", type=float, default=0.0)
+    args = parser.parse_args()
+
+    async def _run():
+        engine = FakeEngine(args.model, args.ttft, args.tokens_per_sec)
+        await run_fake_engine(engine, args.host, args.port)
+        while True:
+            await asyncio.sleep(3600)
+
+    asyncio.run(_run())
+
+
+if __name__ == "__main__":
+    main()

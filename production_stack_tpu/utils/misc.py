@@ -148,6 +148,35 @@ def parse_static_aliases(static_aliases: str) -> "dict[str, str]":
     return aliases
 
 
+def is_model_healthy(url: str, model: str, model_type: str, timeout: float = 10.0) -> bool:
+    """Probe an engine with a real dummy inference (cf. reference utils.py:188-223).
+
+    Sends the per-model-type test payload to the matching endpoint and treats
+    any 200 response as healthy.
+    """
+    import requests
+
+    mt = ModelType[model_type]
+    payload = ModelType.get_test_payload(model_type)
+    try:
+        if mt == ModelType.transcription:
+            resp = requests.post(
+                f"{url}{mt.value}",
+                files={"file": ("probe.wav", payload["file"], "audio/wav")},
+                data={"model": model},
+                timeout=timeout,
+            )
+        else:
+            resp = requests.post(
+                f"{url}{mt.value}",
+                json={"model": model, **payload},
+                timeout=timeout,
+            )
+        return resp.status_code == 200
+    except Exception:  # noqa: BLE001
+        return False
+
+
 def set_ulimit(target_soft_limit: int = 65535) -> None:
     """Raise RLIMIT_NOFILE soft limit so many concurrent streams can be open."""
     res = resource.RLIMIT_NOFILE

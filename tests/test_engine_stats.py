@@ -1,0 +1,50 @@
+"""EngineStats scrape parsing tests (cf. reference stats/engine_stats.py:42-85)."""
+
+from production_stack_tpu.router.engine_stats import EngineStats
+
+VLLM_EXPO = """
+# TYPE vllm:num_requests_running gauge
+vllm:num_requests_running{model_name="m"} 3
+# TYPE vllm:num_requests_waiting gauge
+vllm:num_requests_waiting{model_name="m"} 7
+# TYPE vllm:gpu_cache_usage_perc gauge
+vllm:gpu_cache_usage_perc{model_name="m"} 0.25
+# TYPE vllm:gpu_prefix_cache_hits counter
+vllm:gpu_prefix_cache_hits_total{model_name="m"} 30
+# TYPE vllm:gpu_prefix_cache_queries counter
+vllm:gpu_prefix_cache_queries_total{model_name="m"} 120
+"""
+
+TPU_EXPO = """
+# TYPE vllm:num_requests_running gauge
+vllm:num_requests_running 1
+# TYPE vllm:num_requests_waiting gauge
+vllm:num_requests_waiting 0
+# TYPE tpu:hbm_kv_usage_perc gauge
+tpu:hbm_kv_usage_perc 0.5
+# TYPE tpu:prefix_cache_hits counter
+tpu:prefix_cache_hits_total 5
+# TYPE tpu:prefix_cache_queries counter
+tpu:prefix_cache_queries_total 10
+"""
+
+
+def test_parse_vllm_exposition():
+    stats = EngineStats.from_vllm_scrape(VLLM_EXPO)
+    assert stats.num_running_requests == 3
+    assert stats.num_queuing_requests == 7
+    assert stats.gpu_cache_usage_perc == 0.25
+    assert stats.gpu_prefix_cache_hit_rate == 0.25
+
+
+def test_parse_tpu_exposition():
+    stats = EngineStats.from_vllm_scrape(TPU_EXPO)
+    assert stats.num_running_requests == 1
+    assert stats.gpu_cache_usage_perc == 0.5
+    assert stats.gpu_prefix_cache_hit_rate == 0.5
+
+
+def test_parse_empty():
+    stats = EngineStats.from_vllm_scrape("")
+    assert stats.num_running_requests == 0
+    assert stats.gpu_prefix_cache_hit_rate == 0.0

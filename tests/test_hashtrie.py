@@ -1,0 +1,52 @@
+"""HashTrie unit tests (cf. reference src/vllm_router/prefix/hashtrie.py)."""
+
+from production_stack_tpu.router.hashtrie import HashTrie
+
+
+async def test_insert_and_match():
+    trie = HashTrie(chunk_size=4)
+    await trie.insert("abcdefgh", "e1")
+    matched, eps = await trie.longest_prefix_match("abcdefgh", {"e1", "e2"})
+    assert matched == 2
+    assert eps == {"e1"}
+
+
+async def test_no_match_returns_all_available():
+    trie = HashTrie(chunk_size=4)
+    await trie.insert("abcdefgh", "e1")
+    matched, eps = await trie.longest_prefix_match("zzzz", {"e1", "e2"})
+    assert matched == 0
+    assert eps == {"e1", "e2"}
+
+
+async def test_partial_prefix_match():
+    trie = HashTrie(chunk_size=4)
+    await trie.insert("abcd1234", "e1")
+    await trie.insert("abcdXXXX", "e2")
+    matched, eps = await trie.longest_prefix_match("abcd1234", {"e1", "e2"})
+    assert matched == 2 and eps == {"e1"}
+    matched, eps = await trie.longest_prefix_match("abcdZZZZ", {"e1", "e2"})
+    assert matched == 1 and eps == {"e1", "e2"}
+
+
+async def test_dead_endpoint_excluded():
+    trie = HashTrie(chunk_size=4)
+    await trie.insert("abcd", "dead")
+    matched, eps = await trie.longest_prefix_match("abcd", {"live"})
+    assert matched == 0
+    assert eps == {"live"}
+
+
+async def test_remove_endpoint():
+    trie = HashTrie(chunk_size=4)
+    await trie.insert("abcd", "e1")
+    await trie.remove_endpoint("e1")
+    matched, eps = await trie.longest_prefix_match("abcd", {"e1"})
+    assert matched == 0
+
+
+async def test_eviction_bounds_nodes():
+    trie = HashTrie(chunk_size=4, max_nodes=50)
+    for i in range(100):
+        await trie.insert(f"pref{i:04d}suffix{i:04d}", "e1")
+    assert trie.node_count <= 60

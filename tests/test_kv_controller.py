@@ -1,0 +1,55 @@
+"""KV controller tests (the LMCache-controller-equivalent index)."""
+
+from production_stack_tpu.kv.controller import KVController, chunk_hashes
+
+
+async def test_register_lookup_roundtrip():
+    ctrl = KVController(chunk_size=8)
+    await ctrl.register_instance("i1", "http://e1:8000")
+    text = "0123456789abcdef" * 4
+    await ctrl.admit_text("i1", text)
+    match = await ctrl.lookup(text)
+    assert match is not None
+    matched_chars, inst = match
+    assert inst == "i1"
+    assert matched_chars == len(text)
+    assert await ctrl.instance_url("i1") == "http://e1:8000"
+
+
+async def test_lookup_partial_prefix():
+    ctrl = KVController(chunk_size=8)
+    await ctrl.register_instance("i1", "http://e1:8000")
+    await ctrl.admit_text("i1", "abcdefgh" + "ijklmnop")
+    match = await ctrl.lookup("abcdefgh" + "XXXXXXXX")
+    assert match is not None
+    assert match[0] == 8
+
+
+async def test_deregister_removes_holdings():
+    ctrl = KVController(chunk_size=8)
+    await ctrl.register_instance("i1", "http://e1:8000")
+    await ctrl.admit_text("i1", "abcdefgh")
+    await ctrl.deregister_instance("i1")
+    assert await ctrl.lookup("abcdefgh") is None
+
+
+async def test_evict_subtree():
+    ctrl = KVController(chunk_size=8)
+    await ctrl.register_instance("i1", "http://e1:8000")
+    long_text = "abcdefgh" * 4
+    await ctrl.admit_text("i1", long_text)
+    # Evict from the second chunk down.
+    await ctrl.evict("i1", chunk_hashes(long_text, 8)[:2])
+    match = await ctrl.lookup(long_text)
+    assert match is not None
+    assert match[0] == 8  # only the first chunk survives
+
+
+async def test_recency_tiebreak():
+    ctrl = KVController(chunk_size=8)
+    await ctrl.register_instance("i1", "http://e1:8000")
+    await ctrl.register_instance("i2", "http://e2:8000")
+    await ctrl.admit_text("i1", "abcdefgh")
+    await ctrl.admit_text("i2", "abcdefgh")  # i2 reported later
+    match = await ctrl.lookup("abcdefgh")
+    assert match[1] == "i2"

@@ -1,0 +1,68 @@
+"""RequestStatsMonitor tests (cf. reference src/vllm_router/stats/request_stats.py)."""
+
+from production_stack_tpu.router.request_stats import (
+    MovingAverageMonitor,
+    RequestStatsMonitor,
+)
+from production_stack_tpu.utils.misc import SingletonMeta
+
+
+def fresh_monitor(window=10.0) -> RequestStatsMonitor:
+    SingletonMeta._reset_instance(RequestStatsMonitor)
+    return RequestStatsMonitor(window)
+
+
+def test_moving_average_window_expiry():
+    mon = MovingAverageMonitor(10.0)
+    mon.update(0.0, 1.0)
+    mon.update(5.0, 3.0)
+    assert mon.get_average() == 2.0
+    mon.update(12.0, 5.0)  # t=0 sample expires
+    assert mon.get_average() == 4.0
+    assert mon.get_count() == 2
+
+
+def test_request_lifecycle_stats():
+    m = fresh_monitor(window=60.0)
+    url = "http://e1:8000"
+    m.on_new_request(url, "r1", 100.0)
+    stats = m.get_request_stats(current_time=100.5)
+    assert stats[url].in_prefill_requests == 1
+    m.on_request_response(url, "r1", 100.8)  # TTFT = 0.8
+    stats = m.get_request_stats(current_time=101.0)
+    assert stats[url].in_prefill_requests == 0
+    assert stats[url].in_decoding_requests == 1
+    assert abs(stats[url].ttft - 0.8) < 1e-9
+    m.on_request_complete(url, "r1", 102.0)
+    stats = m.get_request_stats(current_time=102.0)
+    assert stats[url].finished_requests == 1
+    assert stats[url].in_decoding_requests == 0
+    assert abs(stats[url].avg_latency - 2.0) < 1e-9
+
+
+def test_qps_counts_requests_in_window():
+    m = fresh_monitor(window=10.0)
+    url = "http://e1:8000"
+    for i in range(5):
+        m.on_new_request(url, f"r{i}", 100.0 + i)
+    stats = m.get_request_stats(current_time=105.0)
+    assert abs(stats[url].qps - 0.5) < 1e-9  # 5 requests / 10 s window
+
+
+def test_swapped_counter():
+    m = fresh_monitor()
+    m.on_request_swapped("http://e1:8000", "r1", 1.0)
+    m.on_new_request("http://e1:8000", "r1", 1.0)
+    stats = m.get_request_stats(current_time=2.0)
+    assert stats["http://e1:8000"].num_swapped_requests == 1
+
+
+def test_itl_tracking():
+    m = fresh_monitor()
+    url = "http://e1:8000"
+    m.on_new_request(url, "r1", 0.0)
+    m.on_request_response(url, "r1", 1.0)
+    m.on_token(url, "r1", 1.1)
+    m.on_token(url, "r1", 1.3)
+    stats = m.get_request_stats(current_time=2.0)
+    assert 0.1 < stats[url].avg_itl < 0.2

@@ -1,0 +1,293 @@
+"""Hermetic router end-to-end tests: real HTTP through the router to fake
+engines (cf. reference src/tests/perftest/ + tests/e2e/test-routing.py)."""
+
+import argparse
+import asyncio
+import json
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from production_stack_tpu.router import routing_logic as rl
+from production_stack_tpu.router.app import build_app
+from production_stack_tpu.router.engine_stats import EngineStatsScraper
+from production_stack_tpu.router.request_stats import RequestStatsMonitor
+from production_stack_tpu.testing.fake_engine import FakeEngine
+from production_stack_tpu.utils.misc import SingletonABCMeta, SingletonMeta
+
+
+def _args(**overrides) -> argparse.Namespace:
+    from production_stack_tpu.router.parser import build_parser
+
+    argv = []
+    args = build_parser().parse_args(argv)
+    for k, v in overrides.items():
+        setattr(args, k, v)
+    return args
+
+
+async def _start(app: web.Application):
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    for cls in (
+        rl.RoundRobinRouter, rl.SessionRouter, rl.PrefixAwareRouter,
+        rl.KvawareRouter, rl.DisaggregatedPrefillRouter,
+    ):
+        SingletonABCMeta._reset_instance(cls)
+    SingletonMeta._reset_instance(RequestStatsMonitor)
+    SingletonMeta._reset_instance(EngineStatsScraper)
+    yield
+    for cls in (
+        rl.RoundRobinRouter, rl.SessionRouter, rl.PrefixAwareRouter,
+        rl.KvawareRouter, rl.DisaggregatedPrefillRouter,
+    ):
+        SingletonABCMeta._reset_instance(cls)
+    SingletonMeta._reset_instance(RequestStatsMonitor)
+    SingletonMeta._reset_instance(EngineStatsScraper)
+
+
+async def _router_with_engines(n_engines=2, routing="roundrobin", **argover):
+    engines = [FakeEngine(model="test-model") for _ in range(n_engines)]
+    runners, urls = [], []
+    for e in engines:
+        r, url = await _start(e.make_app())
+        runners.append(r)
+        urls.append(url)
+    args = _args(
+        static_backends=",".join(urls),
+        static_models=",".join(["test-model"] * n_engines),
+        routing_logic=routing,
+        engine_stats_interval=0.2,
+        **argover,
+    )
+    router_app = build_app(args)
+    router_runner, router_url = await _start(router_app)
+    runners.append(router_runner)
+    return engines, urls, router_app, router_url, runners
+
+
+async def _cleanup(runners):
+    for r in reversed(runners):
+        await r.cleanup()
+
+
+async def test_models_health_version_metrics():
+    engines, urls, app, router_url, runners = await _router_with_engines()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{router_url}/v1/models") as resp:
+                assert resp.status == 200
+                data = await resp.json()
+                assert data["data"][0]["id"] == "test-model"
+            async with s.get(f"{router_url}/health") as resp:
+                assert resp.status == 200
+            async with s.get(f"{router_url}/version") as resp:
+                assert (await resp.json())["version"]
+            # Wait for a scrape cycle then check /metrics.
+            await asyncio.sleep(0.5)
+            async with s.get(f"{router_url}/metrics") as resp:
+                text = await resp.text()
+                assert "vllm_router:healthy_pods_total 2.0" in text
+            async with s.get(f"{router_url}/engines") as resp:
+                info = await resp.json()
+                assert set(info) == set(urls)
+    finally:
+        await _cleanup(runners)
+
+
+async def test_chat_completion_nonstream_roundrobin():
+    engines, urls, app, router_url, runners = await _router_with_engines(2)
+    try:
+        async with aiohttp.ClientSession() as s:
+            for _ in range(4):
+                async with s.post(
+                    f"{router_url}/v1/chat/completions",
+                    json={"model": "test-model", "max_tokens": 3,
+                          "messages": [{"role": "user", "content": "hi"}]},
+                ) as resp:
+                    assert resp.status == 200
+                    body = await resp.json()
+                    assert "Hello" in body["choices"][0]["message"]["content"]
+        # Round-robin spread requests evenly.
+        assert len(engines[0].requests_seen) == 2
+        assert len(engines[1].requests_seen) == 2
+    finally:
+        await _cleanup(runners)
+
+
+async def test_chat_completion_streaming():
+    engines, urls, app, router_url, runners = await _router_with_engines(1)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{router_url}/v1/chat/completions",
+                json={"model": "test-model", "max_tokens": 5, "stream": True,
+                      "messages": [{"role": "user", "content": "hi"}]},
+            ) as resp:
+                assert resp.status == 200
+                chunks = []
+                async for line in resp.content:
+                    line = line.decode().strip()
+                    if line.startswith("data: ") and line != "data: [DONE]":
+                        chunks.append(json.loads(line[6:]))
+                assert len(chunks) == 6  # 5 tokens + finish chunk
+        # Stats recorded: request finished.
+        state = app["state"]
+        stats = state.request_stats_monitor.get_request_stats()
+        assert sum(s.finished_requests for s in stats.values()) == 1
+        assert any(s.ttft >= 0 for s in stats.values())
+    finally:
+        await _cleanup(runners)
+
+
+async def test_unknown_model_rejected():
+    engines, urls, app, router_url, runners = await _router_with_engines(1)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{router_url}/v1/chat/completions",
+                json={"model": "nope", "messages": []},
+            ) as resp:
+                assert resp.status == 400
+    finally:
+        await _cleanup(runners)
+
+
+async def test_model_alias_rewrite():
+    engines, urls, app, router_url, runners = await _router_with_engines(
+        1, static_aliases="gpt-4:test-model"
+    )
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{router_url}/v1/chat/completions",
+                json={"model": "gpt-4", "max_tokens": 2,
+                      "messages": [{"role": "user", "content": "hi"}]},
+            ) as resp:
+                assert resp.status == 200
+        assert engines[0].requests_seen[0]["model"] == "test-model"
+    finally:
+        await _cleanup(runners)
+
+
+async def test_session_routing_sticky_e2e():
+    engines, urls, app, router_url, runners = await _router_with_engines(
+        3, routing="session"
+    )
+    try:
+        async with aiohttp.ClientSession() as s:
+            for _ in range(6):
+                async with s.post(
+                    f"{router_url}/v1/chat/completions",
+                    headers={"x-user-id": "alice"},
+                    json={"model": "test-model", "max_tokens": 1,
+                          "messages": [{"role": "user", "content": "hi"}]},
+                ) as resp:
+                    assert resp.status == 200
+        hit = [len(e.requests_seen) for e in engines]
+        assert sorted(hit) == [0, 0, 6]  # all stuck to one engine
+    finally:
+        await _cleanup(runners)
+
+
+async def test_sleep_wake_cycle():
+    engines, urls, app, router_url, runners = await _router_with_engines(2)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{router_url}/sleep", params={"url": urls[0]}) as resp:
+                assert resp.status == 200
+            assert engines[0].sleeping
+            # Sleeping engine excluded from routing.
+            for _ in range(4):
+                async with s.post(
+                    f"{router_url}/v1/chat/completions",
+                    json={"model": "test-model", "max_tokens": 1,
+                          "messages": [{"role": "user", "content": "hi"}]},
+                ) as resp:
+                    assert resp.status == 200
+            assert len(engines[0].requests_seen) == 0
+            assert len(engines[1].requests_seen) == 4
+            async with s.get(
+                f"{router_url}/is_sleeping", params={"url": urls[0]}
+            ) as resp:
+                data = await resp.json()
+                assert data[urls[0]]["is_sleeping"] is True
+            async with s.post(f"{router_url}/wake_up", params={"url": urls[0]}) as resp:
+                assert resp.status == 200
+            assert not engines[0].sleeping
+    finally:
+        await _cleanup(runners)
+
+
+async def test_files_api_roundtrip():
+    engines, urls, app, router_url, runners = await _router_with_engines(
+        1, file_storage_path="/tmp/tpu_stack_files_test"
+    )
+    try:
+        async with aiohttp.ClientSession() as s:
+            form = aiohttp.FormData()
+            form.add_field("file", b'{"x": 1}', filename="batch.jsonl")
+            form.add_field("purpose", "batch")
+            async with s.post(f"{router_url}/v1/files", data=form) as resp:
+                assert resp.status == 200
+                meta = await resp.json()
+                fid = meta["id"]
+            async with s.get(f"{router_url}/v1/files/{fid}/content") as resp:
+                assert await resp.read() == b'{"x": 1}'
+            async with s.get(f"{router_url}/v1/files") as resp:
+                listing = await resp.json()
+                assert any(f["id"] == fid for f in listing["data"])
+    finally:
+        await _cleanup(runners)
+
+
+async def test_batch_api_end_to_end():
+    engines, urls, app, router_url, runners = await _router_with_engines(
+        1, enable_batch_api=True, file_storage_path="/tmp/tpu_stack_batch_test"
+    )
+    try:
+        async with aiohttp.ClientSession() as s:
+            lines = "\n".join(
+                json.dumps({
+                    "custom_id": f"req-{i}",
+                    "method": "POST",
+                    "url": "/v1/chat/completions",
+                    "body": {"model": "test-model", "max_tokens": 2,
+                             "messages": [{"role": "user", "content": "hi"}]},
+                }) for i in range(3)
+            )
+            form = aiohttp.FormData()
+            form.add_field("file", lines.encode(), filename="input.jsonl")
+            form.add_field("purpose", "batch")
+            async with s.post(f"{router_url}/v1/files", data=form) as resp:
+                fid = (await resp.json())["id"]
+            async with s.post(
+                f"{router_url}/v1/batches",
+                json={"input_file_id": fid, "endpoint": "/v1/chat/completions"},
+            ) as resp:
+                assert resp.status == 200
+                batch = await resp.json()
+            for _ in range(50):
+                async with s.get(f"{router_url}/v1/batches/{batch['id']}") as resp:
+                    batch = await resp.json()
+                if batch["status"] == "completed":
+                    break
+                await asyncio.sleep(0.2)
+            assert batch["status"] == "completed"
+            assert batch["request_counts"]["completed"] == 3
+            async with s.get(
+                f"{router_url}/v1/files/{batch['output_file_id']}/content"
+            ) as resp:
+                out_lines = (await resp.read()).decode().splitlines()
+                assert len(out_lines) == 3
+    finally:
+        await _cleanup(runners)

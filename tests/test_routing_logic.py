@@ -1,0 +1,153 @@
+"""Routing algorithm unit tests (cf. reference src/tests/test_session_router.py,
+test_roundrobin_router.py and tests/e2e/test-routing.py invariants)."""
+
+import pytest
+
+from production_stack_tpu.kv.controller import KVController
+from production_stack_tpu.router import routing_logic as rl
+from production_stack_tpu.router.request_stats import RequestStats
+from production_stack_tpu.router.service_discovery import EndpointInfo
+from production_stack_tpu.utils.misc import SingletonABCMeta
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    for cls in (
+        rl.RoundRobinRouter, rl.SessionRouter, rl.PrefixAwareRouter,
+        rl.KvawareRouter, rl.DisaggregatedPrefillRouter,
+    ):
+        SingletonABCMeta._reset_instance(cls)
+    yield
+    for cls in (
+        rl.RoundRobinRouter, rl.SessionRouter, rl.PrefixAwareRouter,
+        rl.KvawareRouter, rl.DisaggregatedPrefillRouter,
+    ):
+        SingletonABCMeta._reset_instance(cls)
+
+
+def _eps(n=3, label=None):
+    return [EndpointInfo(url=f"http://e{i}:8000", model_names=["m"]) for i in range(n)]
+
+
+def test_roundrobin_even_distribution():
+    router = rl.RoundRobinRouter()
+    eps = _eps(3)
+    counts = {}
+    for _ in range(30):
+        url = router.route_request(eps, None, None, {})
+        counts[url] = counts.get(url, 0) + 1
+    assert all(c == 10 for c in counts.values())
+
+
+def test_session_stickiness():
+    router = rl.SessionRouter("x-user-id")
+    eps = _eps(4)
+    first = router.route_request(eps, None, None, {"x-user-id": "alice"})
+    for _ in range(10):
+        assert router.route_request(eps, None, None, {"x-user-id": "alice"}) == first
+    # Different sessions spread across endpoints (probabilistically).
+    urls = {
+        router.route_request(eps, None, None, {"x-user-id": f"user{i}"})
+        for i in range(50)
+    }
+    assert len(urls) > 1
+
+
+def test_session_qps_fallback():
+    router = rl.SessionRouter("x-user-id")
+    eps = _eps(3)
+    stats = {
+        "http://e0:8000": RequestStats(qps=5.0),
+        "http://e1:8000": RequestStats(qps=0.5),
+        "http://e2:8000": RequestStats(qps=3.0),
+    }
+    # No session header -> lowest QPS endpoint.
+    assert router.route_request(eps, None, stats, {}) == "http://e1:8000"
+
+
+def test_session_sticky_survives_unrelated_scale_out():
+    router = rl.SessionRouter("x-user-id")
+    eps = _eps(3)
+    before = router.route_request(eps, None, None, {"x-user-id": "bob"})
+    # Consistent hashing: most keys keep their node when one is added.
+    moved = 0
+    keys = [f"k{i}" for i in range(100)]
+    assignment = {
+        k: router.route_request(eps, None, None, {"x-user-id": k}) for k in keys
+    }
+    eps4 = _eps(4)
+    for k in keys:
+        if router.route_request(eps4, None, None, {"x-user-id": k}) != assignment[k]:
+            moved += 1
+    assert moved < 60  # far fewer than a full reshuffle
+    assert router.route_request(eps4, None, None, {"x-user-id": "bob"}) in {
+        e.url for e in eps4
+    }
+    del before
+
+
+async def test_prefixaware_same_prefix_same_endpoint():
+    router = rl.PrefixAwareRouter()
+    eps = _eps(4)
+    prompt = "You are a helpful assistant. " * 30
+    first = await router.route_request(eps, None, None, {}, {"prompt": prompt})
+    for _ in range(5):
+        got = await router.route_request(
+            eps, None, None, {}, {"prompt": prompt + " and more text here"}
+        )
+        assert got == first
+
+
+async def test_prefixaware_messages_extraction():
+    router = rl.PrefixAwareRouter()
+    eps = _eps(3)
+    msgs = {"messages": [{"role": "user", "content": "hello " * 100}]}
+    first = await router.route_request(eps, None, None, {}, msgs)
+    again = await router.route_request(eps, None, None, {}, msgs)
+    assert first == again
+
+
+async def test_kvaware_prefers_holder():
+    ctrl = KVController()
+    await ctrl.register_instance("engine-1", "http://e1:8000")
+    prompt = "The quick brown fox " * 50
+    await ctrl.admit_text("engine-1", prompt)
+    router = rl.KvawareRouter(kv_controller=ctrl, threshold=2000)
+    eps = _eps(3)
+    eps[1].url = "http://e1:8000"
+    got = await router.route_request(eps, None, None, {}, {"prompt": prompt})
+    assert got == "http://e1:8000"
+
+
+async def test_kvaware_fallback_when_no_match():
+    ctrl = KVController()
+    router = rl.KvawareRouter(kv_controller=ctrl, threshold=10)
+    eps = _eps(3)
+    prompt = "x" * 5000  # nothing admitted -> fallback routing
+    got = await router.route_request(
+        eps, None, None, {"x-user-id": "u"}, {"prompt": prompt}
+    )
+    assert got in {e.url for e in eps}
+
+
+def test_disaggregated_prefill_pools():
+    router = rl.DisaggregatedPrefillRouter(["prefill"], ["decode"])
+    eps = [
+        EndpointInfo(url="http://p0:8000", model_names=["m"], model_label="prefill"),
+        EndpointInfo(url="http://p1:8000", model_names=["m"], model_label="prefill"),
+        EndpointInfo(url="http://d0:8000", model_names=["m"], model_label="decode"),
+    ]
+    assert {e.url for e in router.pool(eps, "prefill")} == {
+        "http://p0:8000", "http://p1:8000"
+    }
+    assert router.pick(eps, "decode") == "http://d0:8000"
+    picks = {router.pick(eps, "prefill") for _ in range(4)}
+    assert picks == {"http://p0:8000", "http://p1:8000"}
+
+
+def test_initialize_routing_logic_registry():
+    router = rl.initialize_routing_logic("roundrobin")
+    assert isinstance(router, rl.RoundRobinRouter)
+    assert rl.get_routing_logic() is router
+    router2 = rl.reconfigure_routing_logic("session", session_key="x-user-id")
+    assert isinstance(router2, rl.SessionRouter)
