@@ -1,0 +1,10 @@
+"""The TPU serving engine: what the reference outsources to vLLM images.
+
+An OpenAI-compatible server (aiohttp) over a JAX/XLA/Pallas engine core:
+paged KV cache in TPU HBM with prefix caching, continuous batching with
+bucketed prefill shapes (no recompilation storms), on-device sampling,
+fixed-slot LoRA (hot swap without recompiles), sleep mode (weights to host
+RAM, HBM freed), and ``vllm:*``-compatible /metrics so the router, Grafana
+dashboards and autoscaling rules work unchanged (SURVEY §7 "metric-name
+compatibility").
+"""

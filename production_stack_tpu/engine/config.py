@@ -1,0 +1,51 @@
+"""Engine configuration."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: str = "tiny-llama"
+    dtype: str = "bfloat16"
+    max_model_len: int = 2048
+    max_num_seqs: int = 8           # decode batch width (static shape)
+    block_size: int = 16            # tokens per KV page
+    num_blocks: Optional[int] = None  # None -> sized from hbm_utilization
+    hbm_utilization: float = 0.7    # fraction of free HBM for KV pages
+    enable_prefix_caching: bool = True
+    # Prefill shape bucketing (powers of two between min and max_model_len).
+    min_prefill_bucket: int = 32
+    # Parallelism (within this engine replica).
+    tensor_parallel_size: int = 1
+    data_parallel_size: int = 1
+    # LoRA slots (always compiled in; slot 0 is the zero/no-op adapter).
+    max_loras: int = 8
+    max_lora_rank: int = 16
+    # Sampling safety cap
+    max_top_k: int = 64
+    seed: int = 0
+    enforce_eager: bool = False
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return (self.max_model_len + self.block_size - 1) // self.block_size
+
+    def prefill_buckets(self) -> "list[int]":
+        buckets = []
+        b = self.min_prefill_bucket
+        while b < self.max_model_len:
+            buckets.append(b)
+            b *= 2
+        buckets.append(self.max_model_len)
+        return buckets
+
+    def bucket_for(self, length: int) -> int:
+        for b in self.prefill_buckets():
+            if length <= b:
+                return b
+        raise ValueError(
+            f"Sequence length {length} exceeds max_model_len {self.max_model_len}"
+        )

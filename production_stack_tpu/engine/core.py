@@ -1,0 +1,502 @@
+"""Engine core: model execution + continuous batching on the TPU mesh.
+
+Owns the sharded parameters, the paged KV cache in HBM, the two compiled
+programs (bucketed prefill, fixed-width decode), on-device sampling, the
+scheduler, and the background engine thread that drives them. The OpenAI
+server (:mod:`production_stack_tpu.engine.server`) talks to this class only.
+
+This is the stack's replacement for the vLLM engine process the reference
+launches in each pod (``helm/templates/deployment-vllm-multi.yaml:108-199``).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.kvcache import KVCacheManager
+from production_stack_tpu.engine.sampling import (
+    SamplingParams,
+    make_rng_keys,
+    sample_tokens,
+)
+from production_stack_tpu.engine.scheduler import (
+    EngineRequest,
+    RunningSeq,
+    Scheduler,
+)
+from production_stack_tpu.engine.tokenizer import build_tokenizer
+from production_stack_tpu.models import build_model, get_model_config
+from production_stack_tpu.parallel.mesh import build_mesh
+from production_stack_tpu.parallel.sharding import (
+    kv_pages_sharding,
+    param_shardings,
+)
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+class EngineCore:
+    def __init__(
+        self,
+        config: EngineConfig,
+        devices: Optional[list] = None,
+    ):
+        self.config = config
+        self.model_config = get_model_config(config.model)
+        if config.dtype:
+            self.model_config = self.model_config.replace(dtype=config.dtype)
+        self.tokenizer = build_tokenizer(
+            config.model, self.model_config.vocab_size
+        )
+
+        all_devices = list(devices if devices is not None else jax.devices())
+        n_needed = config.tensor_parallel_size * max(config.data_parallel_size, 1)
+        self.mesh = build_mesh(
+            tensor_parallel_size=config.tensor_parallel_size,
+            data_parallel_size=max(config.data_parallel_size, 1),
+            devices=all_devices[:n_needed],
+        )
+
+        self._init_fn, self._apply = build_model(self.model_config)
+
+        # -- parameters (sharded over the mesh) ----------------------------
+        lora_kwargs = {}
+        if self.model_config.arch == "llama" and config.max_loras > 0:
+            lora_kwargs = {
+                "lora_slots": config.max_loras,
+                "lora_rank": config.max_lora_rank,
+            }
+        rng = jax.random.key(config.seed)
+
+        def _init():
+            return self._init_fn(self.model_config, rng, **lora_kwargs)
+
+        shapes = jax.eval_shape(_init)
+        self._param_shardings = param_shardings(
+            self.model_config, self.mesh, shapes
+        )
+        self.params = jax.jit(_init, out_shardings=self._param_shardings)()
+
+        # -- KV pages ------------------------------------------------------
+        self.num_blocks = config.num_blocks or self._auto_num_blocks()
+        self._kv_sharding = kv_pages_sharding(self.model_config, self.mesh)
+        self.kv = self._alloc_kv()
+        self.kv_mgr = KVCacheManager(
+            self.num_blocks, config.block_size, config.enable_prefix_caching
+        )
+        self.scheduler = Scheduler(
+            self.kv_mgr, config.max_num_seqs, config.max_model_len
+        )
+
+        # -- compiled programs --------------------------------------------
+        self._prefill_fn = self._make_forward("prefill")
+        self._decode_fn = self._make_forward("decode")
+
+        # -- LoRA slot registry -------------------------------------------
+        self.lora_slots: Dict[str, int] = {}  # adapter name -> slot (1-based)
+
+        # -- counters (exported via /metrics) ------------------------------
+        self.prompt_tokens_total = 0
+        self.generation_tokens_total = 0
+        self.requests_finished_total = 0
+        self.step_count = 0
+        self._sleeping = False
+        self._sleep_level = 1
+        self._host_params = None
+
+        # -- engine thread -------------------------------------------------
+        self._lock = threading.Condition()
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="engine-core"
+        )
+
+    # ------------------------------------------------------------------ #
+    # setup helpers
+    # ------------------------------------------------------------------ #
+    def _kv_bytes_per_block(self) -> int:
+        mc = self.model_config
+        itemsize = jnp.dtype(mc.dtype).itemsize
+        return (
+            mc.num_layers * 2 * self.config.block_size
+            * mc.num_kv_heads * mc.head_dim * itemsize
+        )
+
+    def _auto_num_blocks(self) -> int:
+        """Size the KV pool from free device memory (hbm_utilization)."""
+        try:
+            stats = self.mesh.devices.flat[0].memory_stats()
+            free = stats["bytes_limit"] - stats["bytes_in_use"]
+            tp = self.mesh.shape.get("tp", 1)
+            budget = free * self.config.hbm_utilization * tp
+            num = int(budget // self._kv_bytes_per_block())
+        except Exception:  # noqa: BLE001 - CPU backend has no memory_stats
+            num = 0
+        min_blocks = self.config.max_blocks_per_seq * 2
+        num = max(num, min_blocks)
+        # Cap by what max_num_seqs could ever use, plus prefix-cache headroom.
+        cap = self.config.max_blocks_per_seq * (self.config.max_num_seqs * 4)
+        return min(num, cap)
+
+    def _alloc_kv(self):
+        mc = self.model_config
+        shape = (
+            mc.num_layers, self.num_blocks, self.config.block_size,
+            mc.num_kv_heads, mc.head_dim,
+        )
+
+        @functools.partial(jax.jit, out_shardings=(self._kv_sharding, self._kv_sharding))
+        def zeros():
+            z = jnp.zeros(shape, mc.jnp_dtype)
+            return z, jnp.zeros(shape, mc.jnp_dtype)
+
+        return zeros()
+
+    def _make_forward(self, mode: str):
+        apply = self._apply
+        cfg = self.model_config
+
+        def fwd(params, kv, token_ids, positions, slot_mapping,
+                block_tables, context_lens, seq_lens, adapter_ids):
+            logits, kv = apply(
+                params, cfg, token_ids, positions, kv, slot_mapping,
+                block_tables, context_lens, seq_lens,
+                mode=mode, adapter_ids=adapter_ids,
+            )
+            if mode == "prefill":
+                idx = jnp.maximum(seq_lens - 1, 0)[:, None, None]
+                last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
+            else:
+                last = logits[:, 0]
+            return last, kv
+
+        return jax.jit(fwd, donate_argnums=(1,))
+
+    # ------------------------------------------------------------------ #
+    # public API (thread-safe)
+    # ------------------------------------------------------------------ #
+    def start(self) -> None:
+        self._thread.start()
+
+    def add_request(
+        self,
+        request_id: str,
+        prompt_token_ids: List[int],
+        sampling: SamplingParams,
+        on_token: Callable[[Optional[int], Optional[str]], None],
+        adapter_name: Optional[str] = None,
+    ) -> None:
+        adapter_id = self.lora_slots.get(adapter_name or "", 0)
+        req = EngineRequest(
+            request_id=request_id,
+            prompt_token_ids=list(prompt_token_ids),
+            sampling=sampling,
+            on_token=on_token,
+            adapter_id=adapter_id,
+        )
+        with self._lock:
+            self.scheduler.add(req)
+            self._lock.notify()
+
+    def abort_request(self, request_id: str) -> bool:
+        with self._lock:
+            return self.scheduler.abort(request_id)
+
+    def stop(self) -> None:
+        with self._lock:
+            self._running = False
+            self._lock.notify()
+        self._thread.join(timeout=10)
+
+    # -- sleep mode (reference relies on vLLM --enable-sleep-mode) ---------
+    def sleep(self, level: int = 1) -> None:
+        """Free HBM: discard KV, move weights to host RAM."""
+        with self._lock:
+            if self._sleeping:
+                return
+            self._sleeping = True
+            self._sleep_level = level
+            # Preempt everything so wake-up re-prefills from scratch.
+            while self.scheduler.running():
+                self.scheduler.preempt_youngest()
+            self._host_params = jax.device_get(self.params)
+            self.params = None
+            self.kv = None
+            self._lock.notify()
+        logger.info("Engine asleep (level %d): HBM released", level)
+
+    def wake_up(self) -> None:
+        with self._lock:
+            if not self._sleeping:
+                return
+            self.params = jax.device_put(
+                self._host_params, self._param_shardings
+            )
+            self._host_params = None
+            self.kv = self._alloc_kv()
+            self._sleeping = False
+            self._lock.notify()
+        logger.info("Engine awake: weights restored, KV reallocated")
+
+    @property
+    def is_sleeping(self) -> bool:
+        return self._sleeping
+
+    # -- LoRA hot-swap -----------------------------------------------------
+    def load_lora_adapter(
+        self, name: str, rank: Optional[int] = None,
+        weights: Optional[dict] = None, alpha: float = 16.0,
+    ) -> bool:
+        """Install an adapter into a free slot without recompiling."""
+        if "lora" not in (self.params or {}):
+            return False
+        if name in self.lora_slots:
+            return True
+        used = set(self.lora_slots.values())
+        free = [
+            s for s in range(1, self.config.max_loras) if s not in used
+        ]
+        if not free:
+            return False
+        slot = free[0]
+        rank = min(rank or self.config.max_lora_rank, self.config.max_lora_rank)
+        with self._lock:
+            lora = dict(self.params["lora"])
+            if weights is not None:
+                for key in ("wq_a", "wq_b", "wv_a", "wv_b"):
+                    if key in weights:
+                        w = jnp.asarray(weights[key], lora[key].dtype)
+                        lora[key] = lora[key].at[:, slot].set(w)
+            else:
+                # No weight source (zero egress): deterministic small init so
+                # the adapter is a real, observable delta.
+                key = jax.random.key(hash(name) % (2**31))
+                for kname in ("wq_a", "wv_a"):
+                    shape = lora[kname].shape  # [L, S, Hd, R]
+                    upd = 0.01 * jax.random.normal(
+                        key, (shape[0], shape[2], shape[3]), jnp.float32
+                    ).astype(lora[kname].dtype)
+                    lora[kname] = lora[kname].at[:, slot].set(upd)
+            lora["scaling"] = lora["scaling"].at[slot].set(alpha / rank)
+            self.params = {**self.params, "lora": lora}
+            self.lora_slots[name] = slot
+        logger.info("Loaded LoRA adapter %s into slot %d", name, slot)
+        return True
+
+    def unload_lora_adapter(self, name: str) -> bool:
+        if name not in self.lora_slots:
+            return False
+        slot = self.lora_slots.pop(name)
+        with self._lock:
+            lora = dict(self.params["lora"])
+            lora["scaling"] = lora["scaling"].at[slot].set(0.0)
+            self.params = {**self.params, "lora": lora}
+        logger.info("Unloaded LoRA adapter %s (slot %d)", name, slot)
+        return True
+
+    # -- stats -------------------------------------------------------------
+    def stats(self) -> dict:
+        alloc = self.kv_mgr.allocator
+        return {
+            "num_requests_running": self.scheduler.num_running,
+            "num_requests_waiting": self.scheduler.num_waiting,
+            "kv_usage": self.kv_mgr.usage(),
+            "prefix_cache_hits": alloc.prefix_hits,
+            "prefix_cache_queries": alloc.prefix_queries,
+            "prompt_tokens_total": self.prompt_tokens_total,
+            "generation_tokens_total": self.generation_tokens_total,
+            "requests_finished_total": self.requests_finished_total,
+            "num_preempted_total": self.scheduler.num_preempted_total,
+            "num_blocks": self.num_blocks,
+            "is_sleeping": self._sleeping,
+        }
+
+    # ------------------------------------------------------------------ #
+    # engine loop
+    # ------------------------------------------------------------------ #
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                while self._running and (
+                    self._sleeping or not self.scheduler.has_work()
+                ):
+                    self._lock.wait(timeout=0.1)
+                if not self._running:
+                    return
+                action, req = self.scheduler.next_action()
+            try:
+                if action == "prefill":
+                    self._do_prefill(req)
+                elif action == "decode":
+                    self._do_decode()
+                else:
+                    time.sleep(0.001)
+            except Exception as e:  # noqa: BLE001
+                logger.exception("Engine step failed: %s", e)
+                if req is not None:
+                    req.on_token(None, "error")
+            self.step_count += 1
+
+    # -- prefill -----------------------------------------------------------
+    def _do_prefill(self, req: EngineRequest) -> None:
+        cfg = self.config
+        tokens = req.all_token_ids
+        n = len(tokens)
+        alloc = self.kv_mgr.allocate_prompt(req.request_id, tokens)
+        if alloc is None:
+            # Raced out of blocks; requeue.
+            with self._lock:
+                self.scheduler.waiting.appendleft(req)
+            return
+        block_ids, _cached = alloc
+        bucket = cfg.bucket_for(n)
+        maxb = cfg.max_blocks_per_seq
+
+        token_arr = np.zeros((1, bucket), np.int32)
+        token_arr[0, :n] = tokens
+        positions = np.zeros((1, bucket), np.int32)
+        positions[0, :bucket] = np.arange(bucket)
+        slot_mapping = np.full((1, bucket), -1, np.int64)
+        pos_idx = np.arange(n)
+        blocks = np.asarray(block_ids, np.int64)
+        slot_mapping[0, :n] = (
+            blocks[pos_idx // cfg.block_size] * cfg.block_size
+            + pos_idx % cfg.block_size
+        )
+        block_table = np.zeros((1, maxb), np.int32)
+        block_table[0, : len(block_ids)] = block_ids
+        context_lens = np.asarray([n], np.int32)
+        seq_lens = np.asarray([n], np.int32)
+        adapter_ids = np.asarray([req.adapter_id], np.int32)
+
+        last_logits, self.kv = self._prefill_fn(
+            self.params, self.kv, token_arr, positions, slot_mapping,
+            block_table, context_lens, seq_lens, adapter_ids,
+        )
+        token = self._sample(
+            last_logits, [req], np.asarray([n], np.int64)
+        )[0]
+        self.prompt_tokens_total += n
+
+        with self._lock:
+            slot = self.scheduler._free_slot()
+            seq = self.scheduler.start_running(req, slot)
+        self._emit_token(seq, int(token))
+
+    # -- decode ------------------------------------------------------------
+    def _do_decode(self) -> None:
+        cfg = self.config
+        B = cfg.max_num_seqs
+        maxb = cfg.max_blocks_per_seq
+
+        with self._lock:
+            # Account the about-to-be-written token; preempt on OOM.
+            for seq in list(self.scheduler.running()):
+                if self.scheduler.slots[seq.slot] is not seq:
+                    continue  # already preempted this pass
+                ok = self.kv_mgr.append_token(
+                    seq.req.request_id, seq.req.all_token_ids[-1]
+                )
+                while not ok:
+                    victim = self.scheduler.preempt_youngest()
+                    if victim is None or victim.req is seq.req:
+                        break
+                    ok = self.kv_mgr.append_token(
+                        seq.req.request_id, seq.req.all_token_ids[-1]
+                    )
+            active = self.scheduler.running()
+        if not active:
+            return
+
+        token_arr = np.zeros((B, 1), np.int32)
+        positions = np.zeros((B, 1), np.int32)
+        slot_mapping = np.full((B, 1), -1, np.int64)
+        block_table = np.zeros((B, maxb), np.int32)
+        context_lens = np.zeros((B,), np.int32)
+        seq_lens = np.ones((B,), np.int32)
+        adapter_ids = np.zeros((B,), np.int32)
+
+        for seq in active:
+            i = seq.slot
+            toks = seq.req.all_token_ids
+            pos = len(toks) - 1
+            token_arr[i, 0] = toks[-1]
+            positions[i, 0] = pos
+            bids = self.kv_mgr.block_table(seq.req.request_id)
+            block_table[i, : len(bids)] = bids
+            slot_mapping[i, 0] = (
+                bids[pos // cfg.block_size] * cfg.block_size
+                + pos % cfg.block_size
+            )
+            context_lens[i] = len(toks)
+            adapter_ids[i] = seq.req.adapter_id
+
+        logits, self.kv = self._decode_fn(
+            self.params, self.kv, token_arr, positions, slot_mapping,
+            block_table, context_lens, seq_lens, adapter_ids,
+        )
+        reqs = [None] * B
+        for seq in active:
+            reqs[seq.slot] = seq.req
+        steps = np.asarray(
+            [len(r.output_token_ids) if r else 0 for r in reqs], np.int64
+        )
+        sampled = self._sample(logits, reqs, steps)
+        self.generation_tokens_total += len(active)
+        for seq in active:
+            self._emit_token(seq, int(sampled[seq.slot]))
+
+    def _sample(self, logits, reqs, steps) -> np.ndarray:
+        """Batched on-device sampling; per-request params are data."""
+        B = logits.shape[0]
+        temperature = np.zeros((B,), np.float32)
+        top_k = np.zeros((B,), np.int32)
+        top_p = np.ones((B,), np.float32)
+        seq_seeds = np.zeros((B,), np.int64)
+        for i, r in enumerate(reqs):
+            if r is None:
+                continue
+            temperature[i] = r.sampling.temperature
+            top_k[i] = min(r.sampling.top_k, self.config.max_top_k)
+            top_p[i] = r.sampling.top_p
+            seq_seeds[i] = (
+                r.sampling.seed if r.sampling.seed is not None
+                else hash(r.request_id) % (2**31)
+            )
+        keys = make_rng_keys(
+            self.config.seed, int(steps.max() if len(steps) else 0),
+            jnp.asarray(seq_seeds + steps),
+        )
+        out = sample_tokens(
+            logits, keys, jnp.asarray(temperature), jnp.asarray(top_k),
+            jnp.asarray(top_p), max_top_k=self.config.max_top_k,
+        )
+        return np.asarray(jax.device_get(out))
+
+    def _emit_token(self, seq: RunningSeq, token: int) -> None:
+        req = seq.req
+        req.output_token_ids.append(token)
+        finish = None
+        eos = getattr(self.tokenizer, "eos_token_id", None)
+        if (not req.sampling.ignore_eos) and eos is not None and token == eos:
+            finish = "stop"
+        elif len(req.output_token_ids) >= req.sampling.max_tokens:
+            finish = "length"
+        elif len(req.all_token_ids) >= self.config.max_model_len:
+            finish = "length"
+        if finish is None:
+            req.on_token(token, None)
+        else:
+            req.on_token(token, None)
+            with self._lock:
+                self.scheduler.finish(seq, finish)
+            self.requests_finished_total += 1

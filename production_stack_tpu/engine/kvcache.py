@@ -1,0 +1,234 @@
+"""Host-side paged KV cache management: block allocator + prefix cache.
+
+The device arrays (K/V pages in TPU HBM) live in the engine core; this
+module owns the *accounting*: which pages are free, which belong to which
+sequence, and — when prefix caching is on — which full pages hold which
+token-prefix (hash-chained, vLLM-style) so identical prompt prefixes reuse
+pages instead of recomputing. Reference-stack context: vLLM's
+``--enable-prefix-caching`` is a chart toggle
+(``helm/values.yaml``/``deployment-vllm-multi.yaml:164-167``); here it is
+implemented natively. Hit/query counters feed the ``vllm:gpu_prefix_cache_*``
+metrics the router scrapes (``engine_stats.py:63-76``).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import xxhash
+
+
+@dataclass
+class Block:
+    block_id: int
+    ref_count: int = 0
+    # Hash of the token-prefix this (full) block completes; None if partial.
+    prefix_hash: Optional[int] = None
+    token_count: int = 0
+
+
+class BlockAllocator:
+    """Ref-counted page allocator with hash-chained prefix reuse."""
+
+    def __init__(self, num_blocks: int, block_size: int, enable_prefix_caching: bool = True):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.enable_prefix_caching = enable_prefix_caching
+        self.blocks: List[Block] = [Block(i) for i in range(num_blocks)]
+        self.free_ids: List[int] = list(range(num_blocks))
+        # prefix_hash -> block_id for full, cached blocks (insertion-ordered
+        # for LRU eviction of ref_count==0 entries).
+        self.prefix_map: "OrderedDict[int, int]" = OrderedDict()
+        self.prefix_hits = 0
+        self.prefix_queries = 0
+
+    # -- hashing ----------------------------------------------------------
+    @staticmethod
+    def chain_hash(parent: Optional[int], tokens: Tuple[int, ...]) -> int:
+        h = xxhash.xxh64()
+        h.update(str(parent).encode())
+        h.update(bytes(b for t in tokens for b in int(t).to_bytes(4, "little", signed=True)))
+        return h.intdigest()
+
+    @property
+    def num_free(self) -> int:
+        return len(self.free_ids)
+
+    def usage(self) -> float:
+        return 1.0 - len(self.free_ids) / max(self.num_blocks, 1)
+
+    # -- allocation -------------------------------------------------------
+    def _pop_free(self) -> Optional[int]:
+        while self.free_ids:
+            bid = self.free_ids.pop()
+            blk = self.blocks[bid]
+            # Blocks still registered in the prefix map are reusable cache;
+            # drop the registration when we recycle them.
+            if blk.prefix_hash is not None:
+                self.prefix_map.pop(blk.prefix_hash, None)
+                blk.prefix_hash = None
+            blk.token_count = 0
+            return bid
+        return None
+
+    def _evict_cached(self) -> Optional[int]:
+        """Evict the oldest ref_count==0 cached block (LRU)."""
+        for prefix_hash, bid in self.prefix_map.items():
+            if self.blocks[bid].ref_count == 0:
+                del self.prefix_map[prefix_hash]
+                blk = self.blocks[bid]
+                blk.prefix_hash = None
+                blk.token_count = 0
+                return bid
+        return None
+
+    def allocate(self) -> Optional[int]:
+        bid = self._pop_free()
+        if bid is None:
+            bid = self._evict_cached()
+        if bid is None:
+            return None
+        self.blocks[bid].ref_count = 1
+        return bid
+
+    def lookup_prefix(self, prefix_hash: int) -> Optional[int]:
+        """Find a cached full block for this prefix; bumps refcount on hit."""
+        self.prefix_queries += 1
+        if not self.enable_prefix_caching:
+            return None
+        bid = self.prefix_map.get(prefix_hash)
+        if bid is None:
+            return None
+        self.prefix_hits += 1
+        self.prefix_map.move_to_end(prefix_hash)
+        self.blocks[bid].ref_count += 1
+        return bid
+
+    def register_full_block(self, bid: int, prefix_hash: int) -> None:
+        if not self.enable_prefix_caching:
+            return
+        blk = self.blocks[bid]
+        blk.prefix_hash = prefix_hash
+        blk.token_count = self.block_size
+        existing = self.prefix_map.get(prefix_hash)
+        if existing is None:
+            self.prefix_map[prefix_hash] = bid
+
+    def release(self, bid: int) -> None:
+        blk = self.blocks[bid]
+        blk.ref_count -= 1
+        if blk.ref_count <= 0:
+            blk.ref_count = 0
+            if blk.prefix_hash is None or blk.prefix_hash not in self.prefix_map:
+                # Not cached -> immediately reusable.
+                blk.prefix_hash = None
+                self.free_ids.append(bid)
+            # else: stays as cold cache until evicted.
+
+
+@dataclass
+class SequenceBlocks:
+    """Block bookkeeping for one running sequence."""
+
+    block_ids: List[int] = field(default_factory=list)
+    # How many leading tokens were satisfied from the prefix cache.
+    num_cached_tokens: int = 0
+    # Hash of the last *full* block's prefix chain.
+    last_full_hash: Optional[int] = None
+    num_tokens: int = 0
+
+
+class KVCacheManager:
+    """Per-sequence block table maintenance on top of the allocator."""
+
+    def __init__(self, num_blocks: int, block_size: int, enable_prefix_caching: bool = True):
+        self.allocator = BlockAllocator(num_blocks, block_size, enable_prefix_caching)
+        self.block_size = block_size
+        self.seqs: Dict[str, SequenceBlocks] = {}
+
+    def can_allocate(self, num_tokens: int) -> bool:
+        needed = (num_tokens + self.block_size - 1) // self.block_size
+        return self.allocator.num_free + self._evictable() >= needed
+
+    def _evictable(self) -> int:
+        return sum(
+            1 for _, bid in self.allocator.prefix_map.items()
+            if self.allocator.blocks[bid].ref_count == 0
+        )
+
+    def allocate_prompt(
+        self, seq_id: str, tokens: List[int]
+    ) -> Optional[Tuple[List[int], int]]:
+        """Allocate blocks for a prompt. Returns (block_ids, cached_tokens)
+        or None if out of memory. Leading full blocks may come from the
+        prefix cache (cached_tokens tells the scheduler how much prefill to
+        skip)."""
+        bs = self.block_size
+        seq = SequenceBlocks(num_tokens=len(tokens))
+        parent: Optional[int] = None
+        i = 0
+        # Reuse cached full blocks for the longest matching prefix.
+        while i + bs <= len(tokens):
+            chunk = tuple(tokens[i : i + bs])
+            h = BlockAllocator.chain_hash(parent, chunk)
+            bid = self.allocator.lookup_prefix(h)
+            if bid is None:
+                break
+            seq.block_ids.append(bid)
+            seq.num_cached_tokens += bs
+            seq.last_full_hash = h
+            parent = h
+            i += bs
+        # Allocate fresh blocks for the rest.
+        remaining = len(tokens) - i
+        n_new = (remaining + bs - 1) // bs
+        fresh: List[int] = []
+        for _ in range(n_new):
+            bid = self.allocator.allocate()
+            if bid is None:
+                for b in fresh:
+                    self.allocator.release(b)
+                for b in seq.block_ids:
+                    self.allocator.release(b)
+                return None
+            fresh.append(bid)
+        # Register chain hashes for the new *full* blocks.
+        j = i
+        for bid in fresh:
+            seq.block_ids.append(bid)
+            if j + bs <= len(tokens):
+                chunk = tuple(tokens[j : j + bs])
+                h = BlockAllocator.chain_hash(parent, chunk)
+                self.allocator.register_full_block(bid, h)
+                seq.last_full_hash = h
+                parent = h
+                j += bs
+        self.seqs[seq_id] = seq
+        return seq.block_ids, seq.num_cached_tokens
+
+    def append_token(self, seq_id: str, token: int) -> bool:
+        """Account for one generated token; allocates a page on boundary.
+        Returns False if out of memory (caller should preempt)."""
+        seq = self.seqs[seq_id]
+        if seq.num_tokens % self.block_size == 0:
+            bid = self.allocator.allocate()
+            if bid is None:
+                return False
+            seq.block_ids.append(bid)
+        seq.num_tokens += 1
+        return True
+
+    def free(self, seq_id: str) -> None:
+        seq = self.seqs.pop(seq_id, None)
+        if seq is None:
+            return
+        for bid in seq.block_ids:
+            self.allocator.release(bid)
+
+    def block_table(self, seq_id: str) -> List[int]:
+        return self.seqs[seq_id].block_ids
+
+    def usage(self) -> float:
+        return self.allocator.usage()

@@ -1,0 +1,104 @@
+"""On-device batched sampling: greedy / temperature / top-k / top-p.
+
+One jitted function with static batch width samples the whole decode batch:
+per-sequence temperature, top-k, top-p and seeds are *data*, not trace
+constants, so mixed sampling configs never recompile. Top-k/top-p operate on
+the top ``max_top_k`` logits only (one ``lax.top_k``), which keeps the
+sort lane-friendly and bounds VMEM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    max_tokens: int = 16
+    stop: Optional[list] = None
+    seed: Optional[int] = None
+    ignore_eos: bool = False
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    n: int = 1
+
+    @staticmethod
+    def from_request(body: dict, default_max_tokens: int = 16) -> "SamplingParams":
+        stop = body.get("stop")
+        if isinstance(stop, str):
+            stop = [stop]
+        t = body.get("temperature")
+        p = body.get("top_p")
+        return SamplingParams(
+            temperature=1.0 if t is None else float(t),
+            top_p=1.0 if p is None else float(p),
+            top_k=int(body.get("top_k") or 0),
+            max_tokens=int(
+                body.get("max_tokens")
+                or body.get("max_completion_tokens")
+                or default_max_tokens
+            ),
+            stop=stop,
+            seed=body.get("seed"),
+            ignore_eos=bool(body.get("ignore_eos", False)),
+            presence_penalty=float(body.get("presence_penalty") or 0.0),
+            frequency_penalty=float(body.get("frequency_penalty") or 0.0),
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("max_top_k",))
+def sample_tokens(
+    logits: jax.Array,       # [B, V] float32
+    rng_keys: jax.Array,     # [B, 2] uint32 (one PRNG key per sequence)
+    temperature: jax.Array,  # [B] float32; <=0 means greedy
+    top_k: jax.Array,        # [B] int32; 0 disables
+    top_p: jax.Array,        # [B] float32
+    *,
+    max_top_k: int = 64,
+) -> jax.Array:
+    """Return sampled token ids [B]."""
+    B, V = logits.shape
+    greedy_ids = jnp.argmax(logits, axis=-1)
+
+    # Work on the top max_top_k candidates only.
+    top_vals, top_idx = jax.lax.top_k(logits, max_top_k)  # [B, K]
+    K = max_top_k
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = top_vals / temp
+
+    # Per-sequence top-k mask (0 = disabled = keep all K candidates).
+    ranks = jnp.arange(K)[None, :]
+    k_eff = jnp.where(top_k[:, None] <= 0, K, jnp.minimum(top_k[:, None], K))
+    keep_k = ranks < k_eff
+
+    # Top-p (nucleus) mask over the sorted candidates.
+    probs = jax.nn.softmax(jnp.where(keep_k, scaled, -jnp.inf), axis=-1)
+    cumprobs = jnp.cumsum(probs, axis=-1)
+    keep_p = (cumprobs - probs) < top_p[:, None]  # always keeps rank 0
+    masked = jnp.where(keep_k & keep_p, scaled, -jnp.inf)
+
+    def sample_one(key, row):
+        return jax.random.categorical(key, row)
+
+    choice = jax.vmap(sample_one)(rng_keys, masked)  # [B] in [0, K)
+    sampled_ids = jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0]
+    return jnp.where(temperature <= 0.0, greedy_ids, sampled_ids)
+
+
+def make_rng_keys(seed: int, step: int, seq_seeds: jax.Array) -> jax.Array:
+    """Per-sequence PRNG keys derived from (engine seed, step, seq seed)."""
+    base = jax.random.key(seed)
+    base = jax.random.fold_in(base, step)
+
+    def per_seq(s):
+        return jax.random.key_data(jax.random.fold_in(base, s))
+
+    return jax.vmap(per_seq)(seq_seeds)

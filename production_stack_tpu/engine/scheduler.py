@@ -1,0 +1,166 @@
+"""Continuous-batching scheduler.
+
+Decides, each engine step, whether to run a prefill (admit one waiting
+sequence) or a decode step over all running sequences — vLLM-style
+continuous batching, but shaped for XLA: the decode batch has a fixed width
+(``max_num_seqs`` slots, inactive slots masked) and prefill lengths snap to
+power-of-two buckets, so steady-state serving touches exactly two compiled
+programs (SURVEY §7 "continuous batching without recompilation storms").
+
+Preemption: when a decode step needs a KV page and none is free, the
+youngest running sequence is evicted back to the waiting queue (its pages
+freed, generated tokens kept so re-prefill resumes exactly); the router
+surfaces these as ``num_swapped_requests``.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, List, Optional, Tuple
+
+from production_stack_tpu.engine.kvcache import KVCacheManager
+from production_stack_tpu.engine.sampling import SamplingParams
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+class RequestStatus(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    PREEMPTED = "preempted"
+    FINISHED = "finished"
+    REJECTED = "rejected"
+
+
+@dataclass
+class EngineRequest:
+    request_id: str
+    prompt_token_ids: List[int]
+    sampling: SamplingParams
+    # Called from the engine thread: (token_id | None, finish_reason | None).
+    on_token: Callable[[Optional[int], Optional[str]], None]
+    adapter_id: int = 0
+    arrival_time: float = field(default_factory=time.time)
+    output_token_ids: List[int] = field(default_factory=list)
+    status: RequestStatus = RequestStatus.WAITING
+    num_preemptions: int = 0
+
+    @property
+    def all_token_ids(self) -> List[int]:
+        return self.prompt_token_ids + self.output_token_ids
+
+
+@dataclass
+class RunningSeq:
+    req: EngineRequest
+    slot: int  # decode batch slot index
+
+
+class Scheduler:
+    def __init__(
+        self,
+        kv_mgr: KVCacheManager,
+        max_num_seqs: int,
+        max_model_len: int,
+    ):
+        self.kv_mgr = kv_mgr
+        self.max_num_seqs = max_num_seqs
+        self.max_model_len = max_model_len
+        self.waiting: Deque[EngineRequest] = deque()
+        self.slots: List[Optional[RunningSeq]] = [None] * max_num_seqs
+        self.num_preempted_total = 0
+
+    # -- queue ops ---------------------------------------------------------
+    def add(self, req: EngineRequest) -> None:
+        if len(req.prompt_token_ids) >= self.max_model_len:
+            req.status = RequestStatus.REJECTED
+            req.on_token(None, "length")
+            return
+        self.waiting.append(req)
+
+    def abort(self, request_id: str) -> bool:
+        for req in list(self.waiting):
+            if req.request_id == request_id:
+                self.waiting.remove(req)
+                req.status = RequestStatus.FINISHED
+                req.on_token(None, "abort")
+                return True
+        for seq in self.running():
+            if seq.req.request_id == request_id:
+                self.finish(seq, "abort")
+                return True
+        return False
+
+    def running(self) -> List[RunningSeq]:
+        return [s for s in self.slots if s is not None]
+
+    @property
+    def num_running(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    def has_work(self) -> bool:
+        return self.num_running > 0 or self.num_waiting > 0
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    # -- scheduling decisions ---------------------------------------------
+    def next_action(self) -> Tuple[str, Optional[EngineRequest]]:
+        """Returns ("prefill", req) | ("decode", None) | ("idle", None)."""
+        slot = self._free_slot()
+        if self.waiting and slot is not None:
+            req = self.waiting[0]
+            # +1 block headroom so the first decode step can't immediately
+            # trigger a preemption.
+            if self.kv_mgr.can_allocate(len(req.all_token_ids) + 1):
+                return "prefill", self.waiting.popleft()
+            if self.num_running == 0:
+                # Nothing to preempt and it still doesn't fit: reject.
+                self.waiting.popleft()
+                req.status = RequestStatus.REJECTED
+                req.on_token(None, "length")
+                return self.next_action()
+        if self.num_running > 0:
+            return "decode", None
+        return "idle", None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start_running(self, req: EngineRequest, slot: int) -> RunningSeq:
+        seq = RunningSeq(req=req, slot=slot)
+        req.status = RequestStatus.RUNNING
+        self.slots[slot] = seq
+        return seq
+
+    def finish(self, seq: RunningSeq, reason: str) -> None:
+        self.kv_mgr.free(seq.req.request_id)
+        self.slots[seq.slot] = None
+        seq.req.status = RequestStatus.FINISHED
+        seq.req.on_token(None, reason)
+
+    def preempt_youngest(self) -> Optional[RunningSeq]:
+        """Evict the most recent running sequence back to waiting."""
+        running = self.running()
+        if not running:
+            return None
+        victim = max(running, key=lambda s: s.req.arrival_time)
+        self.kv_mgr.free(victim.req.request_id)
+        self.slots[victim.slot] = None
+        victim.req.status = RequestStatus.PREEMPTED
+        victim.req.num_preemptions += 1
+        self.waiting.appendleft(victim.req)
+        self.num_preempted_total += 1
+        logger.info(
+            "Preempted request %s (blocks exhausted)", victim.req.request_id
+        )
+        return victim

@@ -1,0 +1,111 @@
+"""Tokenizers for the engine.
+
+Two implementations behind one interface:
+
+- :class:`HFTokenizer` — wraps a local HuggingFace tokenizer directory
+  (transformers is available in-image; downloads are not, so only local
+  paths work).
+- :class:`ByteTokenizer` — dependency-free byte-level tokenizer (UTF-8
+  bytes + specials). Default for preset models with no local checkpoint:
+  random-weight models don't produce meaningful text anyway, and byte
+  round-tripping keeps streaming/detokenize tests exact.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer. ids 0..255 = bytes; 256=BOS, 257=EOS, 258=PAD."""
+
+    bos_token_id = 256
+    eos_token_id = 257
+    pad_token_id = 258
+
+    def __init__(self, vocab_size: int = 512):
+        self.vocab_size = max(vocab_size, 259)
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.bos_token_id] + ids) if add_bos else ids
+
+    def decode(self, ids: List[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages: List[dict]) -> str:
+        parts = []
+        for m in messages:
+            content = m.get("content")
+            if isinstance(content, list):
+                content = " ".join(
+                    seg.get("text", "") for seg in content if isinstance(seg, dict)
+                )
+            parts.append(f"<|{m.get('role', 'user')}|>\n{content or ''}")
+        parts.append("<|assistant|>\n")
+        return "\n".join(parts)
+
+
+class HFTokenizer:
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self.tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.vocab_size = self.tok.vocab_size
+        self.bos_token_id = self.tok.bos_token_id
+        self.eos_token_id = self.tok.eos_token_id
+        self.pad_token_id = self.tok.pad_token_id or self.tok.eos_token_id
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        return self.tok.encode(text, add_special_tokens=add_bos)
+
+    def decode(self, ids: List[int]) -> str:
+        return self.tok.decode(ids, skip_special_tokens=True)
+
+    def apply_chat_template(self, messages: List[dict]) -> str:
+        try:
+            return self.tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=True
+            )
+        except Exception:  # noqa: BLE001 - no template in tokenizer config
+            return ByteTokenizer.apply_chat_template(self, messages)  # type: ignore[arg-type]
+
+
+def build_tokenizer(model: str, vocab_size: int, tokenizer_path: Optional[str] = None):
+    import os
+
+    path = tokenizer_path or model
+    if os.path.isdir(path):
+        try:
+            return HFTokenizer(path)
+        except Exception:  # noqa: BLE001
+            pass
+    return ByteTokenizer(vocab_size)
+
+
+class IncrementalDetokenizer:
+    """Streams text from token ids, holding back bytes that may be a partial
+    UTF-8 sequence (byte tokenizer) or partial word (HF)."""
+
+    def __init__(self, tokenizer):
+        self.tokenizer = tokenizer
+        self.ids: List[int] = []
+        self.emitted = 0  # chars already emitted
+
+    def push(self, token_id: int) -> str:
+        self.ids.append(token_id)
+        text = self.tokenizer.decode(self.ids)
+        # Hold back a trailing replacement char (possible partial sequence).
+        safe_end = len(text)
+        while safe_end > 0 and text[safe_end - 1] == "�":
+            safe_end -= 1
+        delta = text[self.emitted : safe_end]
+        self.emitted = safe_end
+        return delta
+
+    def flush(self) -> str:
+        text = self.tokenizer.decode(self.ids)
+        delta = text[self.emitted :]
+        self.emitted = len(text)
+        return delta

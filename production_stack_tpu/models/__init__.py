@@ -1,0 +1,12 @@
+"""Functional JAX model definitions for the TPU serving engine.
+
+The reference stack consumes models through vLLM container images; here the
+model zoo is native: Llama-family (covers Llama 2/3, Mistral, TinyLlama via
+config), OPT, and Mixtral-style MoE — written as pure functions over a
+parameter pytree so they jit/pjit cleanly over a ``jax.sharding.Mesh``.
+"""
+
+from production_stack_tpu.models.config import ModelConfig, get_model_config
+from production_stack_tpu.models.registry import build_model
+
+__all__ = ["ModelConfig", "get_model_config", "build_model"]

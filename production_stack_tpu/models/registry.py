@@ -1,0 +1,20 @@
+"""Model registry: arch name -> (init_params, apply)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+from production_stack_tpu.models.config import ModelConfig
+
+
+def build_model(cfg: ModelConfig) -> Tuple[Callable, Callable]:
+    """Return (init_params(cfg, rng) -> params, apply(params, cfg, ...))."""
+    if cfg.arch == "llama":
+        from production_stack_tpu.models import llama as mod
+    elif cfg.arch == "opt":
+        from production_stack_tpu.models import opt as mod
+    elif cfg.arch == "mixtral":
+        from production_stack_tpu.models import mixtral as mod
+    else:
+        raise ValueError(f"Unknown arch {cfg.arch!r}")
+    return mod.init_params, mod.apply

@@ -1,0 +1,18 @@
+"""TPU compute kernels: paged attention, flash attention, KV page ops.
+
+Pallas TPU kernels with pure-XLA reference fallbacks (used on the CPU test
+mesh and as numerical ground truth). The engine's hot ops:
+
+- :func:`prefill_attention` -- causal attention over a prompt chunk.
+- :func:`paged_decode_attention` -- one-token-per-sequence attention against
+  the paged KV cache (the serving hot loop).
+- :func:`write_kv_pages` -- scatter fresh K/V into HBM pages.
+"""
+
+from production_stack_tpu.ops.attention import (
+    paged_decode_attention,
+    prefill_attention,
+    write_kv_pages,
+)
+
+__all__ = ["paged_decode_attention", "prefill_attention", "write_kv_pages"]

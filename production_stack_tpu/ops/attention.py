@@ -1,0 +1,144 @@
+"""Attention ops: XLA reference implementations + pallas dispatch.
+
+The serving engine replaces vLLM's CUDA PagedAttention (which the reference
+stack consumes via container images) with TPU-native equivalents:
+
+- prefill: causal self-attention over the prompt, computed from fresh K/V —
+  XLA fuses this into MXU-friendly batched matmuls.
+- decode: query length 1 per sequence against KV pages scattered in HBM.
+  The pallas kernel (:mod:`production_stack_tpu.ops.pallas_paged_attention`)
+  walks only the live blocks of each sequence; the XLA fallback gathers the
+  padded context (correct everywhere, used on CPU test meshes).
+
+All softmax accumulation is float32 regardless of compute dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _use_pallas() -> bool:
+    if os.environ.get("TPU_STACK_FORCE_XLA_ATTENTION"):
+        return False
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def prefill_attention(
+    q: jax.Array,  # [B, T, H, D]
+    k: jax.Array,  # [B, T, KVH, D]
+    v: jax.Array,  # [B, T, KVH, D]
+    *,
+    scale: float,
+    seq_lens: jax.Array | None = None,  # [B] valid lengths (padding masked)
+) -> jax.Array:
+    """Causal attention over a prompt chunk. Returns [B, T, H, D]."""
+    B, T, H, D = q.shape
+    KVH = k.shape[2]
+    group = H // KVH
+    qg = q.reshape(B, T, KVH, group, D)
+    scores = jnp.einsum(
+        "btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32
+    ) * scale
+    pos = jnp.arange(T)
+    causal = pos[None, :, None] >= pos[None, None, :]  # [1, T, S]
+    mask = causal
+    if seq_lens is not None:
+        valid = pos[None, None, :] < seq_lens[:, None, None]  # [B,1,S]
+        mask = causal & valid
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgts,bskd->btkgd", probs.astype(v.dtype), v,
+    )
+    return out.reshape(B, T, H, D)
+
+
+def write_kv_pages(
+    k_pages: jax.Array,  # [NB, bs, KVH, D]
+    v_pages: jax.Array,  # [NB, bs, KVH, D]
+    k_new: jax.Array,  # [B, T, KVH, D]
+    v_new: jax.Array,  # [B, T, KVH, D]
+    slot_mapping: jax.Array,  # [B, T] flat slot ids; negative = skip
+):
+    """Scatter fresh K/V into their HBM page slots."""
+    NB, bs, KVH, D = k_pages.shape
+    flat_k = k_pages.reshape(NB * bs, KVH, D)
+    flat_v = v_pages.reshape(NB * bs, KVH, D)
+    slots = slot_mapping.reshape(-1)
+    # Out-of-range slots are dropped by scatter mode="drop".
+    slots = jnp.where(slots < 0, NB * bs, slots)
+    flat_k = flat_k.at[slots].set(
+        k_new.reshape(-1, KVH, D).astype(k_pages.dtype), mode="drop"
+    )
+    flat_v = flat_v.at[slots].set(
+        v_new.reshape(-1, KVH, D).astype(v_pages.dtype), mode="drop"
+    )
+    return flat_k.reshape(NB, bs, KVH, D), flat_v.reshape(NB, bs, KVH, D)
+
+
+def paged_attention_reference(
+    q: jax.Array,  # [B, H, D]
+    k_pages: jax.Array,  # [NB, bs, KVH, D]
+    v_pages: jax.Array,  # [NB, bs, KVH, D]
+    block_tables: jax.Array,  # [B, MAXB] page ids
+    context_lens: jax.Array,  # [B]
+    *,
+    scale: float,
+) -> jax.Array:
+    """XLA fallback: gather the padded context, mask, soft-max. [B, H, D]."""
+    B, H, D = q.shape
+    NB, bs, KVH, _ = k_pages.shape
+    MAXB = block_tables.shape[1]
+    group = H // KVH
+    # Gather pages -> [B, MAXB*bs, KVH, D]
+    k_ctx = k_pages[block_tables].reshape(B, MAXB * bs, KVH, D)
+    v_ctx = v_pages[block_tables].reshape(B, MAXB * bs, KVH, D)
+    qg = q.reshape(B, KVH, group, D)
+    scores = jnp.einsum(
+        "bkgd,bskd->bkgs", qg, k_ctx, preferred_element_type=jnp.float32
+    ) * scale
+    span = jnp.arange(MAXB * bs)
+    mask = span[None, :] < context_lens[:, None]  # [B, S]
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs.astype(v_ctx.dtype), v_ctx)
+    return out.reshape(B, H, D)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_tables: jax.Array,
+    context_lens: jax.Array,
+    *,
+    scale: float,
+) -> jax.Array:
+    """Dispatch to the pallas kernel on TPU, XLA reference elsewhere."""
+    head_dim = q.shape[-1]
+    block_size = k_pages.shape[1]
+    tile_ok = head_dim % 128 == 0 and block_size % 8 == 0
+    if tile_ok and _use_pallas():
+        from production_stack_tpu.ops.pallas_paged_attention import (
+            pallas_paged_attention,
+        )
+
+        try:
+            return pallas_paged_attention(
+                q, k_pages, v_pages, block_tables, context_lens, scale=scale
+            )
+        except Exception:  # noqa: BLE001 - fall back rather than fail serving
+            pass
+    return paged_attention_reference(
+        q, k_pages, v_pages, block_tables, context_lens, scale=scale
+    )

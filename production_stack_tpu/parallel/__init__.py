@@ -1,0 +1,22 @@
+"""Parallelism layer: device meshes, sharding rules, distributed transforms.
+
+The reference stack's parallelism is NCCL-inside-vLLM (TP), Ray (PP), and
+NIXL/UCX (KV transfer) — see SURVEY §2.3. Here it is all
+``jax.sharding``: a named Mesh with ``dp``/``tp``(/``sp``/``ep``) axes,
+NamedSharding param placement (GSPMD inserts the ICI collectives), ring
+attention for sequence parallelism, and a host-relay KV transfer fabric for
+disaggregated prefill.
+"""
+
+from production_stack_tpu.parallel.mesh import build_mesh, mesh_shape_for
+from production_stack_tpu.parallel.sharding import (
+    kv_pages_sharding,
+    param_shardings,
+)
+
+__all__ = [
+    "build_mesh",
+    "mesh_shape_for",
+    "param_shardings",
+    "kv_pages_sharding",
+]

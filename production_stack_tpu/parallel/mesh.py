@@ -1,0 +1,38 @@
+"""Device mesh construction (dp × tp, extensible to pp/sp/ep)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def mesh_shape_for(
+    n_devices: int, tensor_parallel_size: int = 1, data_parallel_size: int = 0
+) -> "tuple[int, int]":
+    """Resolve (dp, tp) from requested sizes and available devices."""
+    tp = max(tensor_parallel_size, 1)
+    if n_devices % tp != 0:
+        raise ValueError(
+            f"tensor_parallel_size {tp} does not divide device count {n_devices}"
+        )
+    dp = data_parallel_size or n_devices // tp
+    if dp * tp != n_devices:
+        raise ValueError(
+            f"dp*tp = {dp}*{tp} != available devices {n_devices}"
+        )
+    return dp, tp
+
+
+def build_mesh(
+    tensor_parallel_size: int = 1,
+    data_parallel_size: int = 0,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_names: "tuple[str, str]" = ("dp", "tp"),
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    dp, tp = mesh_shape_for(len(devices), tensor_parallel_size, data_parallel_size)
+    arr = np.asarray(devices).reshape(dp, tp)
+    return Mesh(arr, axis_names)

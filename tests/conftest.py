@@ -7,13 +7,19 @@ how the driver dry-runs `__graft_entry__.dryrun_multichip`.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("TPU_STACK_LOG_LEVEL", "WARNING")
+
+# The axon sitecustomize registers the TPU backend in every interpreter and
+# the env var alone does not win; jax.config does.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

@@ -1,0 +1,76 @@
+"""Block allocator + prefix cache tests (engine/kvcache.py)."""
+
+from production_stack_tpu.engine.kvcache import KVCacheManager
+
+
+def test_allocate_and_free():
+    mgr = KVCacheManager(num_blocks=8, block_size=4)
+    out = mgr.allocate_prompt("s1", list(range(10)))  # 3 blocks
+    assert out is not None
+    blocks, cached = out
+    assert len(blocks) == 3
+    assert cached == 0
+    assert mgr.allocator.num_free == 5
+    mgr.free("s1")
+    # Full blocks stay cached; partial block returns to the free list.
+    assert mgr.allocator.num_free >= 1
+
+
+def test_prefix_cache_reuse():
+    mgr = KVCacheManager(num_blocks=16, block_size=4)
+    tokens = list(range(12))  # 3 full blocks
+    b1, cached1 = mgr.allocate_prompt("s1", tokens)
+    assert cached1 == 0
+    mgr.free("s1")
+    b2, cached2 = mgr.allocate_prompt("s2", tokens)
+    assert cached2 == 12  # all three full blocks reused
+    assert b2 == b1
+    assert mgr.allocator.prefix_hits == 3
+
+
+def test_prefix_cache_partial_match():
+    mgr = KVCacheManager(num_blocks=16, block_size=4)
+    mgr.allocate_prompt("s1", list(range(8)) + [99, 98])
+    mgr.free("s1")
+    # Same first 8 tokens, different continuation.
+    b2, cached = mgr.allocate_prompt("s2", list(range(8)) + [1, 2, 3, 4])
+    assert cached == 8
+
+
+def test_shared_prefix_refcount():
+    mgr = KVCacheManager(num_blocks=16, block_size=4)
+    tokens = list(range(8))
+    b1, _ = mgr.allocate_prompt("s1", tokens)
+    b2, cached = mgr.allocate_prompt("s2", tokens)
+    assert cached == 8
+    assert b1 == b2
+    assert mgr.allocator.blocks[b1[0]].ref_count == 2
+    mgr.free("s1")
+    assert mgr.allocator.blocks[b1[0]].ref_count == 1
+    mgr.free("s2")
+
+
+def test_oom_returns_none():
+    mgr = KVCacheManager(num_blocks=2, block_size=4, enable_prefix_caching=False)
+    assert mgr.allocate_prompt("s1", list(range(8))) is not None
+    assert mgr.allocate_prompt("s2", list(range(8))) is None
+    assert mgr.can_allocate(8) is False
+    mgr.free("s1")
+    assert mgr.can_allocate(8) is True
+
+
+def test_append_token_allocates_on_boundary():
+    mgr = KVCacheManager(num_blocks=4, block_size=4)
+    mgr.allocate_prompt("s1", [1, 2, 3, 4])  # exactly one block
+    assert len(mgr.block_table("s1")) == 1
+    assert mgr.append_token("s1", 5)  # boundary -> new block
+    assert len(mgr.block_table("s1")) == 2
+    assert mgr.append_token("s1", 6)
+    assert len(mgr.block_table("s1")) == 2
+
+
+def test_usage_fraction():
+    mgr = KVCacheManager(num_blocks=10, block_size=4)
+    assert mgr.usage() == 0.0
+    mgr.allocate_prompt("s1", list(range(20)))  # 5 blocks
+    assert abs(mgr.usage() - 0.5) < 1e-9

@@ -1,0 +1,165 @@
+"""Model correctness: paged decode must match full prefill, TP sharding must
+match single-device results."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from production_stack_tpu.models import build_model, get_model_config
+from production_stack_tpu.ops.attention import (
+    paged_attention_reference,
+    prefill_attention,
+)
+
+
+def _setup(model_name, num_blocks=32, block_size=4, lora=False):
+    cfg = get_model_config(model_name)
+    init_fn, apply = build_model(cfg)
+    kwargs = {"lora_slots": 4, "lora_rank": 8} if lora else {}
+    params = init_fn(cfg, jax.random.key(0), **kwargs)
+    kv = (
+        jnp.zeros((cfg.num_layers, num_blocks, block_size,
+                   cfg.num_kv_heads, cfg.head_dim), cfg.jnp_dtype),
+        jnp.zeros((cfg.num_layers, num_blocks, block_size,
+                   cfg.num_kv_heads, cfg.head_dim), cfg.jnp_dtype),
+    )
+    return cfg, apply, params, kv
+
+
+def _prefill_inputs(n, bucket, block_ids, block_size, maxb, rng):
+    tokens = np.zeros((1, bucket), np.int32)
+    tokens[0, :n] = rng.integers(0, 250, n)
+    positions = np.tile(np.arange(bucket), (1, 1)).astype(np.int32)
+    slot_mapping = np.full((1, bucket), -1, np.int64)
+    idx = np.arange(n)
+    blocks = np.asarray(block_ids)
+    slot_mapping[0, :n] = blocks[idx // block_size] * block_size + idx % block_size
+    bt = np.zeros((1, maxb), np.int32)
+    bt[0, : len(block_ids)] = block_ids
+    return tokens, positions, slot_mapping, bt
+
+
+@pytest.mark.parametrize("model_name", ["tiny-llama", "tiny-opt", "tiny-mixtral"])
+def test_decode_matches_prefill(model_name):
+    """Prefill n-1 tokens, decode token n -> same last logits as full prefill."""
+    bs, maxb = 4, 8
+    cfg, apply, params, kv = _setup(model_name, block_size=bs)
+    rng = np.random.default_rng(0)
+    n = 9
+    block_ids = [3, 5, 7]  # non-contiguous on purpose
+    tokens, positions, slots, bt = _prefill_inputs(n, 16, block_ids, bs, maxb, rng)
+
+    # Full prefill of n tokens.
+    full_logits, _ = apply(
+        params, cfg, jnp.asarray(tokens), jnp.asarray(positions),
+        kv, jnp.asarray(slots), jnp.asarray(bt),
+        jnp.asarray([n], np.int32), jnp.asarray([n], np.int32),
+        mode="prefill",
+    )
+    want = np.asarray(full_logits[0, n - 1])
+
+    # Prefill n-1, then decode the n-th token.
+    slots_partial = slots.copy()
+    slots_partial[0, n - 1] = -1
+    tokens_partial = tokens.copy()
+    tokens_partial[0, n - 1] = 0
+    _, kv2 = apply(
+        params, cfg, jnp.asarray(tokens_partial), jnp.asarray(positions),
+        kv, jnp.asarray(slots_partial), jnp.asarray(bt),
+        jnp.asarray([n - 1], np.int32), jnp.asarray([n - 1], np.int32),
+        mode="prefill",
+    )
+    dec_tokens = np.asarray([[tokens[0, n - 1]]], np.int32)
+    dec_pos = np.asarray([[n - 1]], np.int32)
+    dec_slot = np.asarray([[slots[0, n - 1]]], np.int64)
+    dec_logits, _ = apply(
+        params, cfg, jnp.asarray(dec_tokens), jnp.asarray(dec_pos),
+        kv2, jnp.asarray(dec_slot), jnp.asarray(bt),
+        jnp.asarray([n], np.int32), jnp.asarray([1], np.int32),
+        mode="decode",
+    )
+    got = np.asarray(dec_logits[0, 0])
+    np.testing.assert_allclose(got, want, atol=6e-2, rtol=6e-2)  # bf16
+
+
+def test_paged_reference_matches_prefill_attention():
+    """The paged decode op must agree with dense causal attention."""
+    rng = np.random.default_rng(1)
+    B, T, H, KVH, D, bs = 2, 8, 4, 2, 16, 4
+    NB, MAXB = 16, 4
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, KVH, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, KVH, D)), jnp.float32)
+    dense = prefill_attention(q, k, v, scale=0.25)
+
+    # Scatter k/v into pages and decode the last position of each sequence.
+    k_pages = jnp.zeros((NB, bs, KVH, D), jnp.float32)
+    v_pages = jnp.zeros((NB, bs, KVH, D), jnp.float32)
+    bt = np.asarray([[1, 2, 0, 0], [3, 9, 0, 0]], np.int32)
+    for b in range(B):
+        for t in range(T):
+            blk, off = bt[b][t // bs], t % bs
+            k_pages = k_pages.at[blk, off].set(k[b, t])
+            v_pages = v_pages.at[blk, off].set(v[b, t])
+    out = paged_attention_reference(
+        q[:, T - 1], k_pages, v_pages, jnp.asarray(bt),
+        jnp.asarray([T, T], np.int32), scale=0.25,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense[:, T - 1]), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_lora_slot_changes_output_only_when_selected():
+    cfg, apply, params, kv = _setup("tiny-llama", lora=True)
+    # Install a non-zero adapter in slot 1.
+    lora = dict(params["lora"])
+    lora["wq_a"] = lora["wq_a"].at[:, 1].set(0.1)
+    lora["wq_b"] = lora["wq_b"].at[:, 1].set(0.1)
+    lora["scaling"] = lora["scaling"].at[1].set(2.0)
+    params = {**params, "lora": lora}
+
+    tokens = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    positions = jnp.asarray([[0, 1, 2, 3]], jnp.int32)
+    slots = jnp.asarray([[0, 1, 2, 3]], jnp.int64)
+    bt = jnp.zeros((1, 8), jnp.int32)
+    lens = jnp.asarray([4], jnp.int32)
+
+    base, _ = apply(params, cfg, tokens, positions, kv, slots, bt, lens, lens,
+                    mode="prefill", adapter_ids=jnp.asarray([0], jnp.int32))
+    base2, _ = apply(params, cfg, tokens, positions, kv, slots, bt, lens, lens,
+                     mode="prefill", adapter_ids=jnp.asarray([0], jnp.int32))
+    adapted, _ = apply(params, cfg, tokens, positions, kv, slots, bt, lens, lens,
+                       mode="prefill", adapter_ids=jnp.asarray([1], jnp.int32))
+    np.testing.assert_allclose(np.asarray(base), np.asarray(base2))
+    assert not np.allclose(np.asarray(base), np.asarray(adapted))
+
+
+def test_tp_sharded_matches_single_device():
+    """tiny-llama on a tp=2 mesh must produce the same logits."""
+    from production_stack_tpu.parallel.mesh import build_mesh
+    from production_stack_tpu.parallel.sharding import (
+        kv_pages_sharding,
+        param_shardings,
+    )
+
+    cfg, apply, params, kv = _setup("tiny-llama")
+    tokens = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+    positions = jnp.asarray([[0, 1, 2, 3]], jnp.int32)
+    slots = jnp.asarray([[0, 1, 2, 3]], jnp.int64)
+    bt = jnp.zeros((1, 8), jnp.int32)
+    lens = jnp.asarray([4], jnp.int32)
+
+    want, _ = apply(params, cfg, tokens, positions, kv, slots, bt, lens, lens,
+                    mode="prefill")
+
+    mesh = build_mesh(tensor_parallel_size=2, data_parallel_size=1,
+                      devices=jax.devices()[:2])
+    p_shard = jax.device_put(params, param_shardings(cfg, mesh, params))
+    kv_shard = jax.device_put(kv, kv_pages_sharding(cfg, mesh))
+    got, _ = apply(p_shard, cfg, tokens, positions, kv_shard, slots, bt,
+                   lens, lens, mode="prefill")
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=6e-2, rtol=0  # bf16 noise
+    )
