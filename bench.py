@@ -1,0 +1,216 @@
+"""Full-stack benchmark: multi-round QA through router + TPU engine.
+
+Reproduces the shape of the reference's headline harness
+(``benchmarks/multi-round-qa/multi-round-qa.py``): N users × M rounds of
+streaming chat completions with a shared system prompt and growing per-user
+history, driven through the router (static discovery, session routing) to a
+real in-process engine on the available accelerator.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N, ...}``
+
+Knobs (env): BENCH_MODEL, BENCH_USERS, BENCH_ROUNDS, BENCH_ANSWER_TOKENS,
+BENCH_SYS_PROMPT_TOKENS, BENCH_MAX_NUM_SEQS, BENCH_BASELINE_TOKS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import statistics
+import time
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+MODEL = os.environ.get("BENCH_MODEL", "facebook/opt-125m")
+USERS = _env_int("BENCH_USERS", 8)
+ROUNDS = _env_int("BENCH_ROUNDS", 3)
+ANSWER_TOKENS = _env_int("BENCH_ANSWER_TOKENS", 64)
+SYS_PROMPT_TOKENS = _env_int("BENCH_SYS_PROMPT_TOKENS", 128)
+MAX_NUM_SEQS = _env_int("BENCH_MAX_NUM_SEQS", 16)
+MAX_MODEL_LEN = _env_int("BENCH_MAX_MODEL_LEN", 2048)
+# No absolute numbers are published in the reference repo
+# (BASELINE.json published == {}). vs_baseline is reported against
+# BENCH_BASELINE_TOKS when set (e.g. a recorded A100 run or a prior round's
+# value); otherwise 1.0 (numbers-gathering run, per BASELINE.md).
+BASELINE_TOKS = float(os.environ.get("BENCH_BASELINE_TOKS", 0) or 0)
+
+
+async def _start_site(app):
+    from aiohttp import web
+
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+def _make_prompt(words: int, tag: str) -> str:
+    return " ".join(f"{tag}{i}" for i in range(words))
+
+
+async def _drive(router_url: str):
+    import aiohttp
+
+    sys_prompt = _make_prompt(SYS_PROMPT_TOKENS, "ctx")
+    ttfts = []
+    latencies = []
+    tokens_done = 0
+    failures = 0
+
+    async def one_user(session, uid: int):
+        nonlocal tokens_done, failures
+        history = [{"role": "system", "content": sys_prompt}]
+        for rnd in range(ROUNDS):
+            history.append({
+                "role": "user",
+                "content": f"user{uid} round{rnd} "
+                           + _make_prompt(24, f"q{uid}_{rnd}_"),
+            })
+            t0 = time.perf_counter()
+            first = None
+            n_chunks = 0
+            answer = []
+            try:
+                async with session.post(
+                    router_url + "/v1/chat/completions",
+                    json={
+                        "model": MODEL, "messages": history,
+                        "max_tokens": ANSWER_TOKENS, "stream": True,
+                        "temperature": 0.0, "ignore_eos": True,
+                    },
+                    headers={"x-user-id": str(uid)},
+                    timeout=aiohttp.ClientTimeout(total=600),
+                ) as resp:
+                    if resp.status != 200:
+                        failures += 1
+                        return
+                    async for line in resp.content:
+                        line = line.decode().strip()
+                        if not line.startswith("data: "):
+                            continue
+                        data = line[len("data: "):]
+                        if data == "[DONE]":
+                            break
+                        chunk = json.loads(data)
+                        delta = chunk["choices"][0].get("delta", {})
+                        content = delta.get("content")
+                        if content:
+                            if first is None:
+                                first = time.perf_counter()
+                            n_chunks += 1
+                            answer.append(content)
+            except Exception:  # noqa: BLE001 - count and continue
+                failures += 1
+                return
+            if first is not None:
+                ttfts.append(first - t0)
+            latencies.append(time.perf_counter() - t0)
+            tokens_done += ANSWER_TOKENS
+            history.append({"role": "assistant", "content": "".join(answer)})
+
+    async with aiohttp.ClientSession() as session:
+        # Warmup: trigger prefill-bucket + decode compiles before timing.
+        warm = [{"role": "user", "content": _make_prompt(16, "w")}]
+        for _ in range(2):
+            async with session.post(
+                router_url + "/v1/chat/completions",
+                json={"model": MODEL, "messages": warm, "max_tokens": 4,
+                      "temperature": 0.0, "ignore_eos": True},
+                timeout=aiohttp.ClientTimeout(total=600),
+            ) as resp:
+                await resp.read()
+        t_start = time.perf_counter()
+        await asyncio.gather(*[one_user(session, u) for u in range(USERS)])
+        elapsed = time.perf_counter() - t_start
+    return tokens_done, elapsed, ttfts, latencies, failures
+
+
+async def _main() -> dict:
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.server import (
+        EngineServer,
+        run_engine_server,
+    )
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.parser import build_parser
+
+    config = EngineConfig(
+        model=MODEL,
+        max_model_len=MAX_MODEL_LEN,
+        max_num_seqs=MAX_NUM_SEQS,
+        max_loras=0,
+    )
+    server = EngineServer(config)
+    engine_runner = await run_engine_server(server, "127.0.0.1", 0)
+    engine_port = (
+        list(engine_runner.sites)[0]._server.sockets[0].getsockname()[1]
+    )
+    engine_url = f"http://127.0.0.1:{engine_port}"
+
+    args = build_parser().parse_args([])
+    args.static_backends = engine_url
+    args.static_models = MODEL
+    args.routing_logic = "session"
+    args.session_key = "x-user-id"
+    args.engine_stats_interval = 5
+    router_app = build_app(args)
+    router_runner, router_url = await _start_site(router_app)
+
+    try:
+        tokens, elapsed, ttfts, latencies, failures = await _drive(router_url)
+    finally:
+        await router_runner.cleanup()
+        await engine_runner.cleanup()
+        server.core.stop()
+
+    tok_s = tokens / elapsed if elapsed > 0 else 0.0
+    result = {
+        "metric": f"multi_round_qa_gen_throughput({MODEL})",
+        "value": round(tok_s, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / BASELINE_TOKS, 3) if BASELINE_TOKS else 1.0,
+        "p50_ttft_s": round(statistics.median(ttfts), 4) if ttfts else None,
+        "p99_ttft_s": (
+            round(sorted(ttfts)[max(0, int(len(ttfts) * 0.99) - 1)], 4)
+            if ttfts else None
+        ),
+        "p50_latency_s": (
+            round(statistics.median(latencies), 4) if latencies else None
+        ),
+        "requests": len(latencies),
+        "failures": failures,
+        "users": USERS,
+        "rounds": ROUNDS,
+        "answer_tokens": ANSWER_TOKENS,
+        "backend": None,  # filled below
+    }
+    return result
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cpu", action="store_true",
+                        help="force CPU backend (for smoke testing)")
+    args = parser.parse_args()
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    result = asyncio.run(_main())
+    result["backend"] = jax.devices()[0].platform
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

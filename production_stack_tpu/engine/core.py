@@ -115,6 +115,10 @@ class EngineCore:
 
         # -- engine thread -------------------------------------------------
         self._lock = threading.Condition()
+        # Held for the duration of each forward step; sleep()/wake_up() take
+        # it before swapping params/kv so a mid-flight step never sees None.
+        # Lock order: _step_lock before _lock.
+        self._step_lock = threading.Lock()
         self._running = True
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="engine-core"
@@ -220,31 +224,33 @@ class EngineCore:
     # -- sleep mode (reference relies on vLLM --enable-sleep-mode) ---------
     def sleep(self, level: int = 1) -> None:
         """Free HBM: discard KV, move weights to host RAM."""
-        with self._lock:
-            if self._sleeping:
-                return
-            self._sleeping = True
-            self._sleep_level = level
-            # Preempt everything so wake-up re-prefills from scratch.
-            while self.scheduler.running():
-                self.scheduler.preempt_youngest()
-            self._host_params = jax.device_get(self.params)
-            self.params = None
-            self.kv = None
-            self._lock.notify()
+        with self._step_lock:  # wait out any in-flight forward step
+            with self._lock:
+                if self._sleeping:
+                    return
+                self._sleeping = True
+                self._sleep_level = level
+                # Preempt everything so wake-up re-prefills from scratch.
+                while self.scheduler.running():
+                    self.scheduler.preempt_youngest()
+                self._host_params = jax.device_get(self.params)
+                self.params = None
+                self.kv = None
+                self._lock.notify()
         logger.info("Engine asleep (level %d): HBM released", level)
 
     def wake_up(self) -> None:
-        with self._lock:
-            if not self._sleeping:
-                return
-            self.params = jax.device_put(
-                self._host_params, self._param_shardings
-            )
-            self._host_params = None
-            self.kv = self._alloc_kv()
-            self._sleeping = False
-            self._lock.notify()
+        with self._step_lock:
+            with self._lock:
+                if not self._sleeping:
+                    return
+                self.params = jax.device_put(
+                    self._host_params, self._param_shardings
+                )
+                self._host_params = None
+                self.kv = self._alloc_kv()
+                self._sleeping = False
+                self._lock.notify()
         logger.info("Engine awake: weights restored, KV reallocated")
 
     @property
@@ -295,6 +301,8 @@ class EngineCore:
     def unload_lora_adapter(self, name: str) -> bool:
         if name not in self.lora_slots:
             return False
+        if self.params is None:  # sleeping: weights are on the host
+            return False
         slot = self.lora_slots.pop(name)
         with self._lock:
             lora = dict(self.params["lora"])
@@ -302,6 +310,22 @@ class EngineCore:
             self.params = {**self.params, "lora": lora}
         logger.info("Unloaded LoRA adapter %s (slot %d)", name, slot)
         return True
+
+    # -- embeddings --------------------------------------------------------
+    def embed(self, prompt_token_ids: List[int]) -> "list[float]":
+        """Mean-pooled, L2-normalised token-embedding vector (served by
+        /v1/embeddings). Runs off the scheduler path: no KV pages touched."""
+        ids = np.asarray(prompt_token_ids, np.int32)
+        ids = np.clip(ids, 0, self.model_config.vocab_size - 1)
+        with self._lock:  # consistent snapshot vs sleep()/wake_up()
+            params, host_params = self.params, self._host_params
+        table = (params if params is not None else host_params)["embed"]
+        vecs = np.asarray(jax.device_get(table[ids]), np.float32)
+        pooled = vecs.mean(axis=0)
+        norm = np.linalg.norm(pooled)
+        if norm > 0:
+            pooled = pooled / norm
+        return pooled.tolist()
 
     # -- stats -------------------------------------------------------------
     def stats(self) -> dict:
@@ -334,12 +358,20 @@ class EngineCore:
                     return
                 action, req = self.scheduler.next_action()
             try:
-                if action == "prefill":
-                    self._do_prefill(req)
-                elif action == "decode":
-                    self._do_decode()
-                else:
-                    time.sleep(0.001)
+                with self._step_lock:
+                    if self._sleeping or self.params is None:
+                        # sleep() won the race after next_action popped a
+                        # request: requeue it for wake-up instead of failing.
+                        if req is not None:
+                            with self._lock:
+                                self.scheduler.waiting.appendleft(req)
+                        continue
+                    if action == "prefill":
+                        self._do_prefill(req)
+                    elif action == "decode":
+                        self._do_decode()
+                    else:
+                        time.sleep(0.001)
             except Exception as e:  # noqa: BLE001
                 logger.exception("Engine step failed: %s", e)
                 if req is not None:
@@ -351,7 +383,9 @@ class EngineCore:
         cfg = self.config
         tokens = req.all_token_ids
         n = len(tokens)
-        alloc = self.kv_mgr.allocate_prompt(req.request_id, tokens)
+        alloc = self.kv_mgr.allocate_prompt(
+            req.request_id, tokens, adapter_id=req.adapter_id
+        )
         if alloc is None:
             # Raced out of blocks; requeue.
             with self._lock:

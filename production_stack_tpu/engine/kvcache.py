@@ -46,7 +46,9 @@ class BlockAllocator:
 
     # -- hashing ----------------------------------------------------------
     @staticmethod
-    def chain_hash(parent: Optional[int], tokens: Tuple[int, ...]) -> int:
+    def chain_hash(parent, tokens: Tuple[int, ...]) -> int:
+        """parent: None (chain root), a previous chain hash (int), or an
+        adapter namespace string."""
         h = xxhash.xxh64()
         h.update(str(parent).encode())
         h.update(bytes(b for t in tokens for b in int(t).to_bytes(4, "little", signed=True)))
@@ -110,10 +112,12 @@ class BlockAllocator:
         if not self.enable_prefix_caching:
             return
         blk = self.blocks[bid]
-        blk.prefix_hash = prefix_hash
         blk.token_count = self.block_size
-        existing = self.prefix_map.get(prefix_hash)
-        if existing is None:
+        # If another block already caches this prefix, leave this one
+        # unregistered (prefix_hash=None): tagging it would orphan it on
+        # release (it is not reachable via prefix_map for eviction).
+        if prefix_hash not in self.prefix_map:
+            blk.prefix_hash = prefix_hash
             self.prefix_map[prefix_hash] = bid
 
     def release(self, bid: int) -> None:
@@ -121,8 +125,10 @@ class BlockAllocator:
         blk.ref_count -= 1
         if blk.ref_count <= 0:
             blk.ref_count = 0
-            if blk.prefix_hash is None or blk.prefix_hash not in self.prefix_map:
-                # Not cached -> immediately reusable.
+            if (blk.prefix_hash is None
+                    or self.prefix_map.get(blk.prefix_hash) != bid):
+                # Not cached (or the map points at a different block) ->
+                # immediately reusable.
                 blk.prefix_hash = None
                 self.free_ids.append(bid)
             # else: stays as cold cache until evicted.
@@ -159,15 +165,18 @@ class KVCacheManager:
         )
 
     def allocate_prompt(
-        self, seq_id: str, tokens: List[int]
+        self, seq_id: str, tokens: List[int], adapter_id: int = 0
     ) -> Optional[Tuple[List[int], int]]:
         """Allocate blocks for a prompt. Returns (block_ids, cached_tokens)
         or None if out of memory. Leading full blocks may come from the
         prefix cache (cached_tokens tells the scheduler how much prefill to
-        skip)."""
+        skip). ``adapter_id`` namespaces the hash chain: LoRA adapters alter
+        the V projection, so KV pages are only shareable within one adapter."""
         bs = self.block_size
         seq = SequenceBlocks(num_tokens=len(tokens))
-        parent: Optional[int] = None
+        # Root of the hash chain; ints are never confused with chain hashes
+        # because chain_hash feeds str(parent) into xxhash either way.
+        parent = f"adapter:{adapter_id}" if adapter_id else None
         i = 0
         # Reuse cached full blocks for the longest matching prefix.
         while i + bs <= len(tokens):

@@ -1,0 +1,520 @@
+"""OpenAI-compatible HTTP server wrapping :class:`EngineCore`.
+
+This is the TPU-native replacement for the ``vllm serve`` process the
+reference launches in every engine pod
+(``helm/templates/deployment-vllm-multi.yaml:108-199``,
+``operator/internal/controller/vllmruntime_controller.go:228-286``). The
+surface is exactly what the stack's router and operator need:
+
+- OpenAI API: ``/v1/chat/completions``, ``/v1/completions``,
+  ``/v1/embeddings``, ``/v1/models``, ``/tokenize``, ``/detokenize``
+- lifecycle: ``/health``, ``/sleep``, ``/wake_up``, ``/is_sleeping``
+  (sleep mode semantics of vLLM ``--enable-sleep-mode``,
+  ``service_discovery.py:443-460``)
+- LoRA: ``/v1/load_lora_adapter``, ``/v1/unload_lora_adapter``,
+  ``/v1/lora_adapters`` (vLLM API used by the reference's LoraAdapter
+  controller, ``loraadapter_controller.go:582-610``)
+- ``/metrics`` in the exact ``vllm:*`` Prometheus exposition the router's
+  scraper parses (``engine_stats.py:63-76``) — with TPU HBM KV usage
+  exported under ``vllm:gpu_cache_usage_perc`` for dashboard compatibility
+  and additionally as ``tpu:hbm_kv_usage_perc``.
+- KV transfer (disaggregated prefill): ``/kv/extract``, ``/kv/inject``
+  handled by :mod:`production_stack_tpu.kv.transfer` when enabled.
+
+Token flow: EngineCore emits tokens on its engine thread; each request owns
+an asyncio queue bridged with ``call_soon_threadsafe``; SSE chunks stream as
+tokens land (true token-level streaming, TTFT = first sampled token).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+import uuid
+from typing import List, Optional
+
+from aiohttp import web
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.core import EngineCore
+from production_stack_tpu.engine.sampling import SamplingParams
+from production_stack_tpu.engine.tokenizer import IncrementalDetokenizer
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+class _TokenStream:
+    """Bridges engine-thread token callbacks into an asyncio queue."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self.loop = loop
+        self.queue: asyncio.Queue = asyncio.Queue()
+
+    def on_token(self, token_id: Optional[int], finish: Optional[str]) -> None:
+        self.loop.call_soon_threadsafe(self.queue.put_nowait, (token_id, finish))
+
+    async def __aiter__(self):
+        while True:
+            token_id, finish = await self.queue.get()
+            yield token_id, finish
+            if finish is not None:
+                return
+
+
+class EngineServer:
+    def __init__(self, config: EngineConfig, served_model_names: Optional[List[str]] = None):
+        self.config = config
+        self.core = EngineCore(config)
+        self.core.start()
+        self.served_models = served_model_names or [config.model]
+        self.start_time = time.time()
+
+    # ------------------------------------------------------------------ #
+    # app assembly
+    # ------------------------------------------------------------------ #
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        r = app.router
+        r.add_get("/v1/models", self.handle_models)
+        r.add_post("/v1/chat/completions", self.handle_chat)
+        r.add_post("/v1/completions", self.handle_completion)
+        r.add_post("/v1/embeddings", self.handle_embeddings)
+        r.add_post("/tokenize", self.handle_tokenize)
+        r.add_post("/detokenize", self.handle_detokenize)
+        r.add_get("/metrics", self.handle_metrics)
+        r.add_get("/health", self.handle_health)
+        r.add_get("/version", self.handle_version)
+        r.add_post("/sleep", self.handle_sleep)
+        r.add_post("/wake_up", self.handle_wake)
+        r.add_get("/is_sleeping", self.handle_is_sleeping)
+        r.add_post("/v1/load_lora_adapter", self.handle_load_lora)
+        r.add_post("/v1/unload_lora_adapter", self.handle_unload_lora)
+        r.add_get("/v1/lora_adapters", self.handle_list_lora)
+        app["engine_server"] = self
+        return app
+
+    # ------------------------------------------------------------------ #
+    # helpers
+    # ------------------------------------------------------------------ #
+    def _resolve_adapter(self, model: str) -> Optional[str]:
+        """A request for a loaded adapter name selects that LoRA slot."""
+        return model if model in self.core.lora_slots else None
+
+    def _check_model(self, model: str) -> bool:
+        return (
+            model in self.served_models
+            or model == self.config.model
+            or model in self.core.lora_slots
+        )
+
+    async def _generate(self, prompt_ids: List[int], sampling: SamplingParams,
+                        request_id: str, adapter: Optional[str]):
+        stream = _TokenStream(asyncio.get_running_loop())
+        self.core.add_request(
+            request_id, prompt_ids, sampling, stream.on_token,
+            adapter_name=adapter,
+        )
+        return stream
+
+    @staticmethod
+    def _apply_stop(text_so_far: str, delta: str, stop: Optional[List[str]]):
+        """Returns (emit_delta, stopped). Stop strings end the stream and are
+        not emitted."""
+        if not stop:
+            return delta, False
+        combined = text_so_far + delta
+        for s in stop:
+            idx = combined.find(s)
+            if idx >= 0:
+                return combined[len(text_so_far):idx], True
+        return delta, False
+
+    # ------------------------------------------------------------------ #
+    # OpenAI handlers
+    # ------------------------------------------------------------------ #
+    async def handle_models(self, request: web.Request) -> web.Response:
+        now = int(self.start_time)
+        data = [
+            {"id": m, "object": "model", "created": now,
+             "owned_by": "production-stack-tpu"}
+            for m in self.served_models
+        ] + [
+            {"id": name, "object": "model", "created": now,
+             "owned_by": "production-stack-tpu", "parent": self.config.model}
+            for name in self.core.lora_slots
+        ]
+        return web.json_response({"object": "list", "data": data})
+
+    async def handle_chat(self, request: web.Request) -> web.StreamResponse:
+        body = await request.json()
+        model = body.get("model", self.config.model)
+        if not self._check_model(model):
+            return web.json_response(
+                {"error": {"message": f"model {model!r} not found",
+                           "type": "NotFoundError"}}, status=404)
+        if self.core.is_sleeping:
+            return web.json_response(
+                {"error": {"message": "engine is sleeping",
+                           "type": "ServiceUnavailable"}}, status=503)
+        messages = body.get("messages", [])
+        prompt = self.core.tokenizer.apply_chat_template(messages)
+        prompt_ids = self.core.tokenizer.encode(prompt)
+        sampling = SamplingParams.from_request(body, default_max_tokens=128)
+        rid = request.headers.get("X-Request-Id") or f"chatcmpl-{uuid.uuid4().hex[:16]}"
+        adapter = self._resolve_adapter(model)
+        return await self._respond(
+            request, body, prompt_ids, sampling, rid, model, adapter,
+            kind="chat",
+        )
+
+    async def handle_completion(self, request: web.Request) -> web.StreamResponse:
+        body = await request.json()
+        model = body.get("model", self.config.model)
+        if not self._check_model(model):
+            return web.json_response(
+                {"error": {"message": f"model {model!r} not found",
+                           "type": "NotFoundError"}}, status=404)
+        if self.core.is_sleeping:
+            return web.json_response(
+                {"error": {"message": "engine is sleeping",
+                           "type": "ServiceUnavailable"}}, status=503)
+        prompt = body.get("prompt", "")
+        # OpenAI accepts: str | [str, ...] | [int, ...] | [[int, ...], ...].
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], list):
+            prompt = prompt[0]
+        if isinstance(prompt, list) and prompt and all(
+            isinstance(t, int) for t in prompt
+        ):
+            prompt_ids = [int(t) for t in prompt]  # pre-tokenized
+        else:
+            if isinstance(prompt, list):
+                prompt = prompt[0] if prompt else ""
+            prompt_ids = self.core.tokenizer.encode(str(prompt))
+        sampling = SamplingParams.from_request(body, default_max_tokens=16)
+        rid = request.headers.get("X-Request-Id") or f"cmpl-{uuid.uuid4().hex[:16]}"
+        adapter = self._resolve_adapter(model)
+        return await self._respond(
+            request, body, prompt_ids, sampling, rid, model, adapter,
+            kind="completion",
+        )
+
+    async def _respond(self, request, body, prompt_ids, sampling, rid, model,
+                       adapter, *, kind: str) -> web.StreamResponse:
+        stream_mode = bool(body.get("stream", False))
+        stream = await self._generate(prompt_ids, sampling, rid, adapter)
+        detok = IncrementalDetokenizer(self.core.tokenizer)
+        created = int(time.time())
+        obj = "chat.completion" if kind == "chat" else "text_completion"
+
+        def chunk_payload(delta_text: str, finish: Optional[str], first: bool):
+            if kind == "chat":
+                delta = {}
+                if first:
+                    delta["role"] = "assistant"
+                if delta_text:
+                    delta["content"] = delta_text
+                choice = {"index": 0, "delta": delta, "finish_reason": finish}
+                return {"id": rid, "object": "chat.completion.chunk",
+                        "created": created, "model": model, "choices": [choice]}
+            choice = {"index": 0, "text": delta_text, "finish_reason": finish}
+            return {"id": rid, "object": obj, "created": created,
+                    "model": model, "choices": [choice]}
+
+        if stream_mode:
+            resp = web.StreamResponse()
+            resp.content_type = "text/event-stream"
+            resp.headers["Cache-Control"] = "no-cache"
+            resp.headers["X-Request-Id"] = rid
+            await resp.prepare(request)
+            text_so_far = ""
+            first = True
+            finish_reason = "stop"
+            try:
+                async for token_id, finish in stream:
+                    if token_id is None:
+                        if finish in ("stop", "length", "abort"):
+                            finish_reason = finish
+                        if finish == "error":
+                            finish_reason = "stop"
+                        break
+                    delta = detok.push(token_id)
+                    if finish is not None:
+                        delta += detok.flush()
+                        finish_reason = finish
+                    emit, stopped = self._apply_stop(
+                        text_so_far, delta, sampling.stop)
+                    if emit or first:
+                        payload = chunk_payload(emit, None, first)
+                        await resp.write(
+                            f"data: {json.dumps(payload)}\n\n".encode())
+                        first = False
+                        text_so_far += emit
+                    if stopped:
+                        finish_reason = "stop"
+                        self.core.abort_request(rid)
+                        break
+                    if finish is not None:
+                        break
+                final = chunk_payload("", finish_reason, first)
+                await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+            except (ConnectionResetError, asyncio.CancelledError):
+                self.core.abort_request(rid)
+                raise
+            return resp
+
+        # Non-streaming: collect all tokens.
+        pieces: List[str] = []
+        n_generated = 0
+        finish_reason = "stop"
+        text_so_far = ""
+        async for token_id, finish in stream:
+            if token_id is None:
+                if finish in ("stop", "length", "abort"):
+                    finish_reason = finish
+                break
+            n_generated += 1
+            delta = detok.push(token_id)
+            if finish is not None:
+                delta += detok.flush()
+                finish_reason = finish
+            emit, stopped = self._apply_stop(text_so_far, delta, sampling.stop)
+            pieces.append(emit)
+            text_so_far += emit
+            if stopped:
+                finish_reason = "stop"
+                self.core.abort_request(rid)
+                break
+            if finish is not None:
+                break
+        text = "".join(pieces)
+        usage = {
+            "prompt_tokens": len(prompt_ids),
+            "completion_tokens": n_generated,
+            "total_tokens": len(prompt_ids) + n_generated,
+        }
+        if kind == "chat":
+            payload = {
+                "id": rid, "object": obj, "created": created, "model": model,
+                "choices": [{
+                    "index": 0,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": finish_reason,
+                }],
+                "usage": usage,
+            }
+        else:
+            payload = {
+                "id": rid, "object": obj, "created": created, "model": model,
+                "choices": [{"index": 0, "text": text,
+                             "finish_reason": finish_reason}],
+                "usage": usage,
+            }
+        return web.json_response(payload, headers={"X-Request-Id": rid})
+
+    async def handle_embeddings(self, request: web.Request) -> web.Response:
+        """Mean-pooled final hidden state as the embedding vector."""
+        body = await request.json()
+        inputs = body.get("input", [])
+        # str | [str, ...] | [int, ...] (one token array) | [[int, ...], ...]
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        elif isinstance(inputs, list) and inputs and all(
+            isinstance(t, int) for t in inputs
+        ):
+            inputs = [inputs]
+        data = []
+        total_tokens = 0
+        for i, text in enumerate(inputs):
+            if isinstance(text, list):
+                ids = [int(t) for t in text]  # pre-tokenized
+            else:
+                ids = self.core.tokenizer.encode(str(text))
+            total_tokens += len(ids)
+            vec = await asyncio.get_running_loop().run_in_executor(
+                None, self.core.embed, ids
+            )
+            data.append({"object": "embedding", "index": i, "embedding": vec})
+        return web.json_response({
+            "object": "list", "model": body.get("model", self.config.model),
+            "data": data,
+            "usage": {"prompt_tokens": total_tokens,
+                      "total_tokens": total_tokens},
+        })
+
+    async def handle_tokenize(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        text = body.get("prompt")
+        if text is None and "messages" in body:
+            text = self.core.tokenizer.apply_chat_template(body["messages"])
+        ids = self.core.tokenizer.encode(text or "")
+        return web.json_response({
+            "tokens": ids, "count": len(ids),
+            "max_model_len": self.config.max_model_len,
+        })
+
+    async def handle_detokenize(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        return web.json_response(
+            {"prompt": self.core.tokenizer.decode(body.get("tokens", []))})
+
+    # ------------------------------------------------------------------ #
+    # lifecycle / metrics
+    # ------------------------------------------------------------------ #
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def handle_version(self, request: web.Request) -> web.Response:
+        from production_stack_tpu import __version__
+
+        return web.json_response({"version": __version__})
+
+    async def handle_sleep(self, request: web.Request) -> web.Response:
+        level = int(request.query.get("level", "1"))
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.core.sleep, level)
+        return web.json_response({"status": "sleeping", "level": level})
+
+    async def handle_wake(self, request: web.Request) -> web.Response:
+        await asyncio.get_running_loop().run_in_executor(None, self.core.wake_up)
+        return web.json_response({"status": "awake"})
+
+    async def handle_is_sleeping(self, request: web.Request) -> web.Response:
+        return web.json_response({"is_sleeping": self.core.is_sleeping})
+
+    async def handle_load_lora(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        name = body.get("lora_name")
+        if not name:
+            return web.json_response(
+                {"error": "lora_name required"}, status=400)
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.core.load_lora_adapter(
+                name, rank=body.get("lora_rank"),
+            ))
+        if not ok:
+            return web.json_response(
+                {"error": f"could not load adapter {name!r} "
+                          "(no free slots or LoRA disabled)"}, status=400)
+        return web.json_response({"status": "ok", "lora_name": name})
+
+    async def handle_unload_lora(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        name = body.get("lora_name")
+        ok = self.core.unload_lora_adapter(name or "")
+        if not ok:
+            return web.json_response(
+                {"error": f"adapter {name!r} not loaded"}, status=400)
+        return web.json_response({"status": "ok", "lora_name": name})
+
+    async def handle_list_lora(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "adapters": [
+                {"lora_name": name, "slot": slot}
+                for name, slot in self.core.lora_slots.items()
+            ]
+        })
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        s = self.core.stats()
+        model = self.config.model
+        labels = f'model_name="{model}"'
+        lines = [
+            "# TYPE vllm:num_requests_running gauge",
+            f"vllm:num_requests_running{{{labels}}} {s['num_requests_running']}",
+            "# TYPE vllm:num_requests_waiting gauge",
+            f"vllm:num_requests_waiting{{{labels}}} {s['num_requests_waiting']}",
+            # TPU HBM KV usage exported under the GPU metric name so the
+            # unchanged router scraper (engine_stats.py:63-76) and Grafana
+            # dashboards keep working; tpu:* is the native name.
+            "# TYPE vllm:gpu_cache_usage_perc gauge",
+            f"vllm:gpu_cache_usage_perc{{{labels}}} {s['kv_usage']:.6f}",
+            "# TYPE tpu:hbm_kv_usage_perc gauge",
+            f"tpu:hbm_kv_usage_perc{{{labels}}} {s['kv_usage']:.6f}",
+            "# TYPE vllm:gpu_prefix_cache_hits counter",
+            f"vllm:gpu_prefix_cache_hits_total{{{labels}}} {s['prefix_cache_hits']}",
+            "# TYPE vllm:gpu_prefix_cache_queries counter",
+            f"vllm:gpu_prefix_cache_queries_total{{{labels}}} {s['prefix_cache_queries']}",
+            "# TYPE vllm:prompt_tokens counter",
+            f"vllm:prompt_tokens_total{{{labels}}} {s['prompt_tokens_total']}",
+            "# TYPE vllm:generation_tokens counter",
+            f"vllm:generation_tokens_total{{{labels}}} {s['generation_tokens_total']}",
+            "# TYPE vllm:request_success counter",
+            f"vllm:request_success_total{{{labels}}} {s['requests_finished_total']}",
+            "# TYPE vllm:num_preemptions counter",
+            f"vllm:num_preemptions_total{{{labels}}} {s['num_preempted_total']}",
+            "# TYPE tpu:num_kv_blocks gauge",
+            f"tpu:num_kv_blocks{{{labels}}} {s['num_blocks']}",
+            "# TYPE tpu:engine_sleeping gauge",
+            f"tpu:engine_sleeping{{{labels}}} {int(s['is_sleeping'])}",
+        ]
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
+
+
+async def run_engine_server(server: EngineServer, host: str, port: int) -> web.AppRunner:
+    runner = web.AppRunner(server.make_app())
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    logger.info("Engine server on %s:%d (model=%s)", host, port,
+                server.config.model)
+    return runner
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="TPU-native OpenAI engine server")
+    p.add_argument("model", nargs="?", default=None)
+    p.add_argument("--model", dest="model_flag", default=None)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--served-model-name", action="append", default=None)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--max-model-len", type=int, default=2048)
+    p.add_argument("--max-num-seqs", type=int, default=8)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--num-blocks", type=int, default=None)
+    p.add_argument("--hbm-utilization", type=float, default=0.7)
+    p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--enable-prefix-caching", action="store_true", default=True)
+    p.add_argument("--no-enable-prefix-caching", dest="enable_prefix_caching",
+                   action="store_false")
+    p.add_argument("--max-loras", type=int, default=8)
+    p.add_argument("--max-lora-rank", type=int, default=16)
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = build_arg_parser().parse_args(argv)
+    model = args.model_flag or args.model or "tiny-llama"
+    config = EngineConfig(
+        model=model,
+        dtype=args.dtype,
+        max_model_len=args.max_model_len,
+        max_num_seqs=args.max_num_seqs,
+        block_size=args.block_size,
+        num_blocks=args.num_blocks,
+        hbm_utilization=args.hbm_utilization,
+        tensor_parallel_size=args.tensor_parallel_size,
+        enable_prefix_caching=args.enable_prefix_caching,
+        max_loras=args.max_loras,
+        max_lora_rank=args.max_lora_rank,
+        seed=args.seed,
+    )
+    server = EngineServer(config, args.served_model_name)
+
+    async def _run():
+        await run_engine_server(server, args.host, args.port)
+        while True:
+            await asyncio.sleep(3600)
+
+    asyncio.run(_run())
+
+
+if __name__ == "__main__":
+    main()

@@ -31,7 +31,14 @@ class ByteTokenizer:
         return ([self.bos_token_id] + ids) if add_bos else ids
 
     def decode(self, ids: List[int]) -> str:
-        data = bytes(i for i in ids if 0 <= i < 256)
+        # ids >= 259 (possible with vocab_size > 259, e.g. random-weight
+        # preset models) decode to a deterministic printable char so
+        # generated streams are visible; specials (BOS/EOS/PAD) decode to "".
+        data = bytes(
+            32 + (i - 259) % 95 if i >= 259 else i
+            for i in ids
+            if 0 <= i < 256 or i >= 259
+        )
         return data.decode("utf-8", errors="replace")
 
     def apply_chat_template(self, messages: List[dict]) -> str:
@@ -86,26 +93,40 @@ def build_tokenizer(model: str, vocab_size: int, tokenizer_path: Optional[str] =
 
 class IncrementalDetokenizer:
     """Streams text from token ids, holding back bytes that may be a partial
-    UTF-8 sequence (byte tokenizer) or partial word (HF)."""
+    UTF-8 sequence (byte tokenizer) or partial word (HF).
+
+    Decodes only a sliding window of recent ids (prefix_offset..end), not the
+    whole accumulated list, so a T-token stream costs O(T) decodes of bounded
+    length instead of O(T^2)."""
 
     def __init__(self, tokenizer):
         self.tokenizer = tokenizer
         self.ids: List[int] = []
-        self.emitted = 0  # chars already emitted
+        # ids[prefix_offset:read_offset] decode to text already emitted; the
+        # prefix window gives the tokenizer context (spacing, merges) for the
+        # unemitted tail.
+        self.prefix_offset = 0
+        self.read_offset = 0
 
     def push(self, token_id: int) -> str:
         self.ids.append(token_id)
-        text = self.tokenizer.decode(self.ids)
-        # Hold back a trailing replacement char (possible partial sequence).
-        safe_end = len(text)
-        while safe_end > 0 and text[safe_end - 1] == "�":
-            safe_end -= 1
-        delta = text[self.emitted : safe_end]
-        self.emitted = safe_end
-        return delta
+        prefix_text = self.tokenizer.decode(
+            self.ids[self.prefix_offset:self.read_offset]
+        )
+        new_text = self.tokenizer.decode(self.ids[self.prefix_offset:])
+        if len(new_text) > len(prefix_text) and not new_text.endswith("�"):
+            delta = new_text[len(prefix_text):]
+            self.prefix_offset = self.read_offset
+            self.read_offset = len(self.ids)
+            return delta
+        # Partial sequence (or nothing new): hold back.
+        return ""
 
     def flush(self) -> str:
-        text = self.tokenizer.decode(self.ids)
-        delta = text[self.emitted :]
-        self.emitted = len(text)
+        prefix_text = self.tokenizer.decode(
+            self.ids[self.prefix_offset:self.read_offset]
+        )
+        new_text = self.tokenizer.decode(self.ids[self.prefix_offset:])
+        delta = new_text[len(prefix_text):]
+        self.prefix_offset = self.read_offset = len(self.ids)
         return delta

@@ -1,0 +1,99 @@
+"""Regression tests for code-review findings: prefix-cache adapter
+namespacing, allocator leak on aliased prefix hashes, detokenizer windowing,
+and pre-tokenized API inputs."""
+
+from production_stack_tpu.engine.kvcache import BlockAllocator, KVCacheManager
+from production_stack_tpu.engine.tokenizer import (
+    ByteTokenizer,
+    IncrementalDetokenizer,
+)
+
+
+def test_prefix_cache_is_adapter_namespaced():
+    mgr = KVCacheManager(num_blocks=64, block_size=4)
+    tokens = list(range(16))
+    mgr.allocate_prompt("base", tokens, adapter_id=0)
+    base_blocks = list(mgr.block_table("base"))
+    # Same prompt under a LoRA adapter must NOT share the base KV pages.
+    mgr.allocate_prompt("lora", tokens, adapter_id=3)
+    lora_blocks = list(mgr.block_table("lora"))
+    assert not set(base_blocks) & set(lora_blocks)
+    # But the same adapter does share.
+    mgr.allocate_prompt("lora2", tokens, adapter_id=3)
+    assert mgr.seqs["lora2"].num_cached_tokens == 16
+
+
+def test_no_block_leak_on_aliased_prefix_hash():
+    """Re-registering a chain whose later hashes still map to recycled
+    blocks must not orphan the new blocks on release."""
+    bs = 4
+    mgr = KVCacheManager(num_blocks=8, block_size=bs)
+    tokens = list(range(4 * bs))  # needs 4 blocks
+
+    mgr.allocate_prompt("a", tokens)
+    mgr.free("a")  # all 4 stay cached (cold)
+
+    # Fill the pool with a different prompt so a's cached blocks are evicted
+    # in part (allocate 8 blocks -> evicts all 4 cold + 4 free).
+    other = [100 + t for t in range(8 * bs)]
+    mgr.allocate_prompt("b", other)
+    mgr.free("b")
+
+    # Re-allocate the original prompt: the early chain blocks were recycled,
+    # so fresh blocks are allocated and later chain hashes may still alias
+    # stale prefix_map entries.
+    mgr.allocate_prompt("a2", tokens)
+    mgr.free("a2")
+
+    # Every block must be either free or reachable via the prefix map.
+    alloc = mgr.allocator
+    reachable = set(alloc.free_ids) | set(alloc.prefix_map.values())
+    leaked = [
+        b.block_id for b in alloc.blocks
+        if b.ref_count == 0 and b.block_id not in reachable
+    ]
+    assert not leaked, f"leaked blocks: {leaked}"
+    # And the pool must still be fully usable.
+    big = [999 + t for t in range(8 * bs)]
+    assert mgr.allocate_prompt("c", big) is not None
+
+
+def test_release_when_map_points_elsewhere_frees_block():
+    alloc = BlockAllocator(num_blocks=4, block_size=2)
+    b1 = alloc.allocate()
+    b2 = alloc.allocate()
+    h = 12345
+    alloc.register_full_block(b1, h)
+    alloc.register_full_block(b2, h)  # alias: map keeps b1
+    assert alloc.prefix_map[h] == b1
+    alloc.release(b2)
+    assert b2 in alloc.free_ids  # not orphaned
+
+
+def test_incremental_detokenizer_windowed():
+    tok = ByteTokenizer()
+    detok = IncrementalDetokenizer(tok)
+    text = "hello ✓ world"  # includes a multi-byte char
+    ids = tok.encode(text, add_bos=False)
+    out = "".join(detok.push(i) for i in ids) + detok.flush()
+    assert out == text
+    # The decode window stays bounded: prefix_offset advances.
+    assert detok.prefix_offset > 0
+
+
+def test_incremental_detokenizer_holds_partial_utf8():
+    tok = ByteTokenizer()
+    detok = IncrementalDetokenizer(tok)
+    ids = list("✓".encode("utf-8"))  # 3-byte char arrives byte by byte
+    assert detok.push(ids[0]) == ""
+    assert detok.push(ids[1]) == ""
+    assert detok.push(ids[2]) == "✓"
+
+
+def test_byte_tokenizer_maps_high_ids_printable():
+    tok = ByteTokenizer(vocab_size=50000)
+    text = tok.decode([300, 4999, 259])
+    assert len(text) == 3
+    assert all(32 <= ord(c) < 127 for c in text)
+    # Round-trip of real text is unchanged.
+    assert tok.decode(tok.encode("abc", add_bos=False)) == "abc"
