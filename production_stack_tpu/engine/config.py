@@ -24,6 +24,9 @@ class EngineConfig:
     # LoRA slots (always compiled in; slot 0 is the zero/no-op adapter).
     max_loras: int = 8
     max_lora_rank: int = 16
+    # KV offload (HBM -> host RAM -> remote cache server). 0 disables.
+    kv_offload_bytes: int = 0
+    kv_remote_url: Optional[str] = None
     # Sampling safety cap
     max_top_k: int = 64
     seed: int = 0

@@ -97,15 +97,29 @@ class EngineCore:
             self.kv_mgr, config.max_num_seqs, config.max_model_len
         )
 
+        # -- KV offload tier (LMCache-equivalent, SURVEY §7 step 4) --------
+        self.offload = None
+        if config.kv_offload_bytes > 0 or config.kv_remote_url:
+            from production_stack_tpu.kv.offload import HostKVStore
+
+            self.offload = HostKVStore(
+                max(config.kv_offload_bytes, 0), config.kv_remote_url
+            )
+            self.kv_mgr.allocator.on_evict = self._offload_block
+            self.kv_mgr.external_lookup = self.offload.contains
+
         # -- compiled programs --------------------------------------------
         self._prefill_fn = self._make_forward("prefill")
+        self._prefill_cached_fn = self._make_forward("prefill_cached")
         self._decode_fn = self._make_forward("decode")
+        self._write_block_fn = self._make_write_block()
 
         # -- LoRA slot registry -------------------------------------------
         self.lora_slots: Dict[str, int] = {}  # adapter name -> slot (1-based)
 
         # -- counters (exported via /metrics) ------------------------------
         self.prompt_tokens_total = 0
+        self.cached_tokens_total = 0  # prompt tokens skipped via prefix cache
         self.generation_tokens_total = 0
         self.requests_finished_total = 0
         self.step_count = 0
@@ -176,14 +190,113 @@ class EngineCore:
                 block_tables, context_lens, seq_lens,
                 mode=mode, adapter_ids=adapter_ids,
             )
-            if mode == "prefill":
+            if mode == "decode":
+                last = logits[:, 0]
+            else:  # prefill / prefill_cached: logits of the last real token
                 idx = jnp.maximum(seq_lens - 1, 0)[:, None, None]
                 last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
-            else:
-                last = logits[:, 0]
             return last, kv
 
         return jax.jit(fwd, donate_argnums=(1,))
+
+    def _make_write_block(self):
+        """Jitted single-block page write (offload restore / KV inject)."""
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def write_block(kv, bid, k, v):
+            k_pages, v_pages = kv
+            k_pages = k_pages.at[:, bid].set(k.astype(k_pages.dtype))
+            v_pages = v_pages.at[:, bid].set(v.astype(v_pages.dtype))
+            return k_pages, v_pages
+
+        return write_block
+
+    # -- KV offload / transfer helpers ------------------------------------
+    def _offload_block(self, prefix_hash: int, bid: int) -> None:
+        """Allocator eviction hook: spill a cached block's pages to host RAM
+        (runs on the engine thread, under the step lock)."""
+        if self.offload is None or self.kv is None:
+            return
+        k_pages, v_pages = self.kv
+        k = np.asarray(jax.device_get(k_pages[:, bid]))
+        v = np.asarray(jax.device_get(v_pages[:, bid]))
+        self.offload.put(prefix_hash, k, v)
+
+    def _restore_blocks(self, restores) -> bool:
+        """Copy offloaded pages back into HBM. Returns False on any miss."""
+        for bid, h in restores:
+            entry = self.offload.get(h) if self.offload is not None else None
+            if entry is None:
+                return False
+            k, v = entry
+            self.kv = self._write_block_fn(self.kv, bid, k, v)
+        return True
+
+    def extract_kv(self, token_ids: List[int], adapter_id: int = 0):
+        """Serialize the KV pages of the longest cached prefix of
+        ``token_ids`` (disaggregated-prefill sender side; the NIXL-pipe
+        replacement, SURVEY §2.3). Returns dict or None."""
+        from production_stack_tpu.engine.kvcache import BlockAllocator
+
+        bs = self.config.block_size
+        alloc = self.kv_mgr.allocator
+        parent = f"adapter:{adapter_id}" if adapter_id else None
+        hashes: List[int] = []
+        bids: List[int] = []
+        with self._step_lock:
+            if self.kv is None:
+                return None
+            with self._lock:
+                i = 0
+                while i + bs <= len(token_ids):
+                    h = BlockAllocator.chain_hash(
+                        parent, tuple(token_ids[i : i + bs])
+                    )
+                    bid = alloc.prefix_map.get(h)
+                    if bid is None:
+                        break
+                    hashes.append(h)
+                    bids.append(bid)
+                    parent = h
+                    i += bs
+            if not hashes:
+                return None
+            k_pages, v_pages = self.kv
+            idx = jnp.asarray(bids)
+            # [L, N, bs, KVH, D] -> [N, L, bs, KVH, D] (per-block payloads)
+            k = np.asarray(jax.device_get(k_pages[:, idx])).swapaxes(0, 1)
+            v = np.asarray(jax.device_get(v_pages[:, idx])).swapaxes(0, 1)
+        return {
+            "hashes": hashes,
+            "num_tokens": len(hashes) * bs,
+            "k": k,
+            "v": v,
+        }
+
+    def inject_kv(self, hashes: List[int], k_blocks, v_blocks) -> int:
+        """Install transferred KV blocks as cached (cold) prefix pages
+        (disaggregated-prefill receiver side). Returns #blocks installed."""
+        alloc = self.kv_mgr.allocator
+        injected = 0
+        with self._step_lock:
+            if self.kv is None or not alloc.enable_prefix_caching:
+                return 0
+            for h, k_b, v_b in zip(hashes, k_blocks, v_blocks):
+                with self._lock:
+                    if h in alloc.prefix_map:
+                        injected += 1
+                        continue
+                    bid = alloc.allocate()
+                if bid is None:
+                    break
+                self.kv = self._write_block_fn(
+                    self.kv, bid, np.asarray(k_b), np.asarray(v_b)
+                )
+                with self._lock:
+                    alloc.register_full_block(bid, h)
+                    alloc.release(bid)  # cached, ref_count 0
+                injected += 1
+        return injected
 
     # ------------------------------------------------------------------ #
     # public API (thread-safe)
@@ -337,7 +450,9 @@ class EngineCore:
             "prefix_cache_hits": alloc.prefix_hits,
             "prefix_cache_queries": alloc.prefix_queries,
             "prompt_tokens_total": self.prompt_tokens_total,
+            "cached_tokens_total": self.cached_tokens_total,
             "generation_tokens_total": self.generation_tokens_total,
+            "offload": self.offload.stats() if self.offload else None,
             "requests_finished_total": self.requests_finished_total,
             "num_preempted_total": self.scheduler.num_preempted_total,
             "num_blocks": self.num_blocks,
@@ -391,28 +506,59 @@ class EngineCore:
             with self._lock:
                 self.scheduler.waiting.appendleft(req)
             return
-        block_ids, _cached = alloc
-        bucket = cfg.bucket_for(n)
+        block_ids, cached, restores = alloc
+        if restores and not self._restore_blocks(restores):
+            # Offload tier lied (e.g. remote evicted between HEAD and GET):
+            # recompute from scratch with the external tier bypassed. The
+            # restore blocks were registered in the prefix map before their
+            # pages were written — unregister them so the retry (and any
+            # concurrent prompt) cannot reuse garbage pages as cache.
+            kv_alloc = self.kv_mgr.allocator
+            with self._lock:
+                for bid, h in restores:
+                    if kv_alloc.prefix_map.get(h) == bid:
+                        del kv_alloc.prefix_map[h]
+                        kv_alloc.blocks[bid].prefix_hash = None
+            self.kv_mgr.free(req.request_id)
+            ext = self.kv_mgr.external_lookup
+            self.kv_mgr.external_lookup = None
+            try:
+                alloc = self.kv_mgr.allocate_prompt(
+                    req.request_id, tokens, adapter_id=req.adapter_id
+                )
+            finally:
+                self.kv_mgr.external_lookup = ext
+            if alloc is None:
+                with self._lock:
+                    self.scheduler.waiting.appendleft(req)
+                return
+            block_ids, cached, _ = alloc
+
+        # Only the un-cached suffix runs through the model; its queries
+        # attend to the cached prefix via the HBM pages (prefill_cached).
+        ns = n - cached
+        bucket = cfg.bucket_for(ns)
         maxb = cfg.max_blocks_per_seq
 
         token_arr = np.zeros((1, bucket), np.int32)
-        token_arr[0, :n] = tokens
+        token_arr[0, :ns] = tokens[cached:]
         positions = np.zeros((1, bucket), np.int32)
-        positions[0, :bucket] = np.arange(bucket)
+        positions[0, :bucket] = cached + np.arange(bucket)
         slot_mapping = np.full((1, bucket), -1, np.int64)
-        pos_idx = np.arange(n)
+        pos_idx = cached + np.arange(ns)
         blocks = np.asarray(block_ids, np.int64)
-        slot_mapping[0, :n] = (
+        slot_mapping[0, :ns] = (
             blocks[pos_idx // cfg.block_size] * cfg.block_size
             + pos_idx % cfg.block_size
         )
         block_table = np.zeros((1, maxb), np.int32)
         block_table[0, : len(block_ids)] = block_ids
         context_lens = np.asarray([n], np.int32)
-        seq_lens = np.asarray([n], np.int32)
+        seq_lens = np.asarray([ns], np.int32)
         adapter_ids = np.asarray([req.adapter_id], np.int32)
 
-        last_logits, self.kv = self._prefill_fn(
+        fn = self._prefill_cached_fn if cached > 0 else self._prefill_fn
+        last_logits, self.kv = fn(
             self.params, self.kv, token_arr, positions, slot_mapping,
             block_table, context_lens, seq_lens, adapter_ids,
         )
@@ -420,6 +566,7 @@ class EngineCore:
             last_logits, [req], np.asarray([n], np.int64)
         )[0]
         self.prompt_tokens_total += n
+        self.cached_tokens_total += cached
 
         with self._lock:
             slot = self.scheduler._free_slot()

@@ -43,6 +43,10 @@ class BlockAllocator:
         self.prefix_map: "OrderedDict[int, int]" = OrderedDict()
         self.prefix_hits = 0
         self.prefix_queries = 0
+        # Called as on_evict(prefix_hash, block_id) just before a cached
+        # block's pages are recycled — the KV-offload hook (HBM -> host RAM,
+        # the LMCache CPU-offload equivalent).
+        self.on_evict = None
 
     # -- hashing ----------------------------------------------------------
     @staticmethod
@@ -69,6 +73,8 @@ class BlockAllocator:
             # Blocks still registered in the prefix map are reusable cache;
             # drop the registration when we recycle them.
             if blk.prefix_hash is not None:
+                if self.on_evict is not None:
+                    self.on_evict(blk.prefix_hash, bid)
                 self.prefix_map.pop(blk.prefix_hash, None)
                 blk.prefix_hash = None
             blk.token_count = 0
@@ -79,6 +85,8 @@ class BlockAllocator:
         """Evict the oldest ref_count==0 cached block (LRU)."""
         for prefix_hash, bid in self.prefix_map.items():
             if self.blocks[bid].ref_count == 0:
+                if self.on_evict is not None:
+                    self.on_evict(prefix_hash, bid)
                 del self.prefix_map[prefix_hash]
                 blk = self.blocks[bid]
                 blk.prefix_hash = None
@@ -153,6 +161,11 @@ class KVCacheManager:
         self.allocator = BlockAllocator(num_blocks, block_size, enable_prefix_caching)
         self.block_size = block_size
         self.seqs: Dict[str, SequenceBlocks] = {}
+        # Optional second-tier lookup (host-RAM / remote KV store): called as
+        # external_lookup(prefix_hash) -> bool. A hit means the block's pages
+        # can be restored into HBM by the engine (see allocate_prompt's
+        # ``restores`` return).
+        self.external_lookup = None
 
     def can_allocate(self, num_tokens: int) -> bool:
         needed = (num_tokens + self.block_size - 1) // self.block_size
@@ -166,23 +179,40 @@ class KVCacheManager:
 
     def allocate_prompt(
         self, seq_id: str, tokens: List[int], adapter_id: int = 0
-    ) -> Optional[Tuple[List[int], int]]:
-        """Allocate blocks for a prompt. Returns (block_ids, cached_tokens)
-        or None if out of memory. Leading full blocks may come from the
-        prefix cache (cached_tokens tells the scheduler how much prefill to
-        skip). ``adapter_id`` namespaces the hash chain: LoRA adapters alter
-        the V projection, so KV pages are only shareable within one adapter."""
+    ) -> Optional[Tuple[List[int], int, List[Tuple[int, int]]]]:
+        """Allocate blocks for a prompt.
+
+        Returns ``(block_ids, cached_tokens, restores)`` or None if out of
+        memory. Leading full blocks may come from the prefix cache
+        (``cached_tokens`` tells the engine how much prefill to skip);
+        ``restores`` lists ``(block_id, prefix_hash)`` pairs whose pages must
+        be copied back into HBM from the offload tier before use (they count
+        as cached). ``adapter_id`` namespaces the hash chain: LoRA adapters
+        alter the V projection, so KV pages are only shareable within one
+        adapter."""
         bs = self.block_size
         seq = SequenceBlocks(num_tokens=len(tokens))
         # Root of the hash chain; ints are never confused with chain hashes
         # because chain_hash feeds str(parent) into xxhash either way.
         parent = f"adapter:{adapter_id}" if adapter_id else None
         i = 0
-        # Reuse cached full blocks for the longest matching prefix.
-        while i + bs <= len(tokens):
+        restores: List[Tuple[int, int]] = []
+        # Reuse cached full blocks for the longest matching prefix. Never
+        # reuse past the last token: at least one suffix token must run
+        # through the model to produce next-token logits.
+        while i + bs <= len(tokens) - 1:
             chunk = tuple(tokens[i : i + bs])
             h = BlockAllocator.chain_hash(parent, chunk)
             bid = self.allocator.lookup_prefix(h)
+            if bid is None and self.external_lookup is not None \
+                    and self.allocator.enable_prefix_caching \
+                    and self.external_lookup(h):
+                # Offload-tier hit: allocate a fresh block; the engine
+                # restores its pages from the store before prefill.
+                bid = self.allocator.allocate()
+                if bid is not None:
+                    self.allocator.register_full_block(bid, h)
+                    restores.append((bid, h))
             if bid is None:
                 break
             seq.block_ids.append(bid)
@@ -215,7 +245,7 @@ class KVCacheManager:
                 parent = h
                 j += bs
         self.seqs[seq_id] = seq
-        return seq.block_ids, seq.num_cached_tokens
+        return seq.block_ids, seq.num_cached_tokens, restores
 
     def append_token(self, seq_id: str, token: int) -> bool:
         """Account for one generated token; allocates a page on boundary.

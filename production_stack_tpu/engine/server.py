@@ -93,6 +93,10 @@ class EngineServer:
         r.add_post("/v1/load_lora_adapter", self.handle_load_lora)
         r.add_post("/v1/unload_lora_adapter", self.handle_unload_lora)
         r.add_get("/v1/lora_adapters", self.handle_list_lora)
+        # KV transfer (disaggregated prefill / cross-engine KV sharing).
+        r.add_post("/kv/extract", self.handle_kv_extract)
+        r.add_post("/kv/inject", self.handle_kv_inject)
+        r.add_post("/kv/pull", self.handle_kv_pull)
         app["engine_server"] = self
         return app
 
@@ -419,6 +423,104 @@ class EngineServer:
             ]
         })
 
+    # ------------------------------------------------------------------ #
+    # KV transfer (the reference's NIXL/LMCache pipe equivalent)
+    # ------------------------------------------------------------------ #
+    def _tokens_from_body(self, body: dict) -> List[int]:
+        """Token ids for a KV-transfer request: explicit ids, a raw prompt,
+        or chat messages (both engines share the tokenizer, so ids match)."""
+        if body.get("token_ids"):
+            return [int(t) for t in body["token_ids"]]
+        if body.get("messages") is not None:
+            prompt = self.core.tokenizer.apply_chat_template(body["messages"])
+            return self.core.tokenizer.encode(prompt)
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            return [int(t) for t in prompt]
+        return self.core.tokenizer.encode(str(prompt))
+
+    async def handle_kv_extract(self, request: web.Request) -> web.Response:
+        """Serialize the cached KV pages for a prompt's prefix."""
+        from production_stack_tpu.kv.offload import pack_transfer
+
+        body = await request.json()
+        token_ids = self._tokens_from_body(body)
+        adapter = self._resolve_adapter(body.get("model", ""))
+        adapter_id = self.core.lora_slots.get(adapter or "", 0)
+        payload = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.core.extract_kv(token_ids, adapter_id)
+        )
+        if payload is None:
+            return web.json_response(
+                {"error": "no cached prefix for these tokens"}, status=404)
+        data = pack_transfer(
+            payload["hashes"], payload["num_tokens"],
+            payload["k"], payload["v"],
+        )
+        return web.Response(
+            body=data, content_type="application/octet-stream",
+            headers={"X-KV-Tokens": str(payload["num_tokens"])},
+        )
+
+    async def handle_kv_inject(self, request: web.Request) -> web.Response:
+        """Install transferred KV blocks (inverse of /kv/extract)."""
+        from production_stack_tpu.kv.offload import unpack_transfer
+
+        data = await request.read()
+        try:
+            payload = unpack_transfer(data)
+        except Exception:  # noqa: BLE001
+            return web.json_response({"error": "bad payload"}, status=400)
+        injected = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.core.inject_kv(
+                payload["hashes"], payload["k"], payload["v"])
+        )
+        return web.json_response(
+            {"status": "ok", "injected_blocks": injected,
+             "num_tokens": payload["num_tokens"]})
+
+    async def handle_kv_pull(self, request: web.Request) -> web.Response:
+        """Pull the KV for a prompt from another engine and install it —
+        the decode-side step of disaggregated prefill. Data moves engine to
+        engine; the router only sends this control message."""
+        import aiohttp
+
+        from production_stack_tpu.kv.offload import unpack_transfer
+
+        body = await request.json()
+        source = body.get("source_url")
+        if not source:
+            return web.json_response(
+                {"error": "source_url required"}, status=400)
+        req_body = body.get("request", body)
+        token_ids = self._tokens_from_body(req_body)
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    source.rstrip("/") + "/kv/extract",
+                    json={"token_ids": token_ids,
+                          "model": req_body.get("model", "")},
+                    timeout=aiohttp.ClientTimeout(total=60),
+                ) as resp:
+                    if resp.status != 200:
+                        return web.json_response(
+                            {"status": "miss", "injected_blocks": 0})
+                    data = await resp.read()
+        except aiohttp.ClientError as e:
+            return web.json_response(
+                {"error": f"source unreachable: {e}"}, status=502)
+        try:
+            payload = unpack_transfer(data)
+        except Exception:  # noqa: BLE001 - truncated/version-skewed payload
+            return web.json_response({"status": "miss", "injected_blocks": 0})
+        injected = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.core.inject_kv(
+                payload["hashes"], payload["k"], payload["v"])
+        )
+        return web.json_response(
+            {"status": "ok", "injected_blocks": injected,
+             "num_tokens": payload["num_tokens"]})
+
     async def handle_metrics(self, request: web.Request) -> web.Response:
         s = self.core.stats()
         model = self.config.model
@@ -451,7 +553,21 @@ class EngineServer:
             f"tpu:num_kv_blocks{{{labels}}} {s['num_blocks']}",
             "# TYPE tpu:engine_sleeping gauge",
             f"tpu:engine_sleeping{{{labels}}} {int(s['is_sleeping'])}",
+            "# TYPE tpu:cached_prompt_tokens counter",
+            f"tpu:cached_prompt_tokens_total{{{labels}}} {s['cached_tokens_total']}",
         ]
+        if s.get("offload"):
+            off = s["offload"]
+            lines += [
+                "# TYPE tpu:kv_offload_blocks gauge",
+                f"tpu:kv_offload_blocks{{{labels}}} {off['blocks']}",
+                "# TYPE tpu:kv_offload_bytes gauge",
+                f"tpu:kv_offload_bytes{{{labels}}} {off['bytes']}",
+                "# TYPE tpu:kv_offload_hits counter",
+                f"tpu:kv_offload_hits_total{{{labels}}} {off['hits']}",
+                "# TYPE tpu:kv_offload_misses counter",
+                f"tpu:kv_offload_misses_total{{{labels}}} {off['misses']}",
+            ]
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 
@@ -486,6 +602,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-loras", type=int, default=8)
     p.add_argument("--max-lora-rank", type=int, default=16)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--kv-offload-gb", type=float, default=0.0,
+                   help="host-RAM KV offload budget (0 disables)")
+    p.add_argument("--kv-remote-url", default=None,
+                   help="remote cache server URL (second offload tier)")
     return p
 
 
@@ -505,6 +625,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         max_loras=args.max_loras,
         max_lora_rank=args.max_lora_rank,
         seed=args.seed,
+        kv_offload_bytes=int(args.kv_offload_gb * (1 << 30)),
+        kv_remote_url=args.kv_remote_url,
     )
     server = EngineServer(config, args.served_model_name)
 

@@ -1,0 +1,131 @@
+"""Standalone KV cache server: the remote offload tier.
+
+TPU-native equivalent of the reference's ``lmcache_experimental_server``
+process (deployed by the CacheServer CRD,
+``operator/internal/controller/cacheserver_controller.go:135-206``, and the
+helm ``deployment-cache-server.yaml``). Engines spill evicted KV blocks here
+(via :class:`production_stack_tpu.kv.offload.RemoteKVClient`) and pull them
+back on prefix-cache misses, which also gives cross-engine KV sharing: an
+engine can reuse a prefix another engine computed
+(``docs/source/use_cases/sharing-kv-cache.rst``).
+
+API (block payloads are opaque bytes — the .npz format of
+``offload.pack_block``):
+
+- ``PUT  /v1/blocks/{hash}``  store a block
+- ``GET  /v1/blocks/{hash}``  fetch a block (404 on miss)
+- ``HEAD /v1/blocks/{hash}``  existence probe
+- ``GET  /health``, ``GET /metrics``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+from collections import OrderedDict
+from typing import Optional
+
+from aiohttp import web
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+class CacheServer:
+    def __init__(self, capacity_bytes: int = 4 << 30):
+        self.capacity_bytes = capacity_bytes
+        self._store: "OrderedDict[str, bytes]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evicted = 0
+
+    def make_app(self) -> web.Application:
+        app = web.Application(client_max_size=1 << 30)
+        app.router.add_put("/v1/blocks/{hash}", self.handle_put)
+        # add_get also serves HEAD (existence probe) via the same handler.
+        app.router.add_get("/v1/blocks/{hash}", self.handle_get)
+        app.router.add_get("/health", self.handle_health)
+        app.router.add_get("/metrics", self.handle_metrics)
+        return app
+
+    async def handle_put(self, request: web.Request) -> web.Response:
+        key = request.match_info["hash"]
+        data = await request.read()
+        if key in self._store:
+            self._bytes -= len(self._store.pop(key))
+        while self._bytes + len(data) > self.capacity_bytes and self._store:
+            _, old = self._store.popitem(last=False)
+            self._bytes -= len(old)
+            self.evicted += 1
+        if self._bytes + len(data) > self.capacity_bytes:
+            return web.json_response({"error": "block exceeds capacity"},
+                                     status=413)
+        self._store[key] = data
+        self._bytes += len(data)
+        return web.json_response({"status": "ok", "bytes": len(data)})
+
+    async def handle_get(self, request: web.Request) -> web.Response:
+        key = request.match_info["hash"]
+        if request.method == "HEAD":  # existence probe: no LRU/stat churn
+            status = 200 if key in self._store else 404
+            return web.Response(status=status)
+        data = self._store.get(key)
+        if data is None:
+            self.misses += 1
+            return web.Response(status=404)
+        self._store.move_to_end(key)
+        self.hits += 1
+        return web.Response(body=data,
+                            content_type="application/octet-stream")
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        text = (
+            "# TYPE kvcache:blocks gauge\n"
+            f"kvcache:blocks {len(self._store)}\n"
+            "# TYPE kvcache:bytes gauge\n"
+            f"kvcache:bytes {self._bytes}\n"
+            "# TYPE kvcache:capacity_bytes gauge\n"
+            f"kvcache:capacity_bytes {self.capacity_bytes}\n"
+            "# TYPE kvcache:hits counter\n"
+            f"kvcache:hits_total {self.hits}\n"
+            "# TYPE kvcache:misses counter\n"
+            f"kvcache:misses_total {self.misses}\n"
+            "# TYPE kvcache:evicted counter\n"
+            f"kvcache:evicted_total {self.evicted}\n"
+        )
+        return web.Response(text=text, content_type="text/plain")
+
+
+async def run_cache_server(server: CacheServer, host: str, port: int) -> web.AppRunner:
+    runner = web.AppRunner(server.make_app())
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    logger.info("KV cache server on %s:%d (capacity %.1f GiB)",
+                host, port, server.capacity_bytes / (1 << 30))
+    return runner
+
+
+def main(argv: Optional[list] = None) -> None:
+    p = argparse.ArgumentParser(description="Standalone KV cache server")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8200)
+    p.add_argument("--capacity-gb", type=float, default=4.0)
+    args = p.parse_args(argv)
+    server = CacheServer(capacity_bytes=int(args.capacity_gb * (1 << 30)))
+
+    async def _run():
+        await run_cache_server(server, args.host, args.port)
+        while True:
+            await asyncio.sleep(3600)
+
+    asyncio.run(_run())
+
+
+if __name__ == "__main__":
+    main()

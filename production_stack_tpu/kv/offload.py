@@ -1,0 +1,268 @@
+"""KV offload tiers: TPU HBM -> host RAM -> remote cache server.
+
+The reference stack buys this from LMCache: engine KV blocks spill to a CPU
+buffer (``values-05-cpu-offloading.yaml``, 60 GB buffers in
+``values-17-kv-aware.yaml:20-25``) and optionally to a remote
+``lmcache_experimental_server`` (CacheServer CRD,
+``operator/internal/controller/cacheserver_controller.go:135-206``). Here it
+is native: the engine's block allocator calls ``on_evict`` just before
+recycling a cached page, the pages land in this store keyed by their prefix
+chain hash, and ``allocate_prompt`` consults :meth:`contains` so evicted
+prefixes re-enter HBM with a device_put instead of a recompute.
+
+Serialization is a single .npz payload per block (k and v pages for every
+layer), the same wire format the cache server and the disaggregated-prefill
+transfer use.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+import urllib.error
+import urllib.request
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+import numpy as np
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+def _dtype_name(arr: np.ndarray) -> str:
+    return str(arr.dtype)
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    # np.savez cannot represent ml_dtypes (bfloat16 degrades to void), so
+    # the wire format ships raw bytes + a dtype name resolved here.
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _pack_arrays(**arrays: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    fields = {}
+    for key, arr in arrays.items():
+        arr = np.ascontiguousarray(arr)
+        fields[key] = np.frombuffer(arr.tobytes(), np.uint8)
+        fields[f"{key}_shape"] = np.asarray(arr.shape, np.int64)
+        fields[f"{key}_dtype"] = np.frombuffer(
+            _dtype_name(arr).encode(), np.uint8
+        )
+    np.savez(buf, **fields)
+    return buf.getvalue()
+
+
+def _unpack_arrays(data: bytes, keys) -> dict:
+    out = {}
+    with np.load(io.BytesIO(data)) as z:
+        for key in keys:
+            shape = tuple(z[f"{key}_shape"])
+            dtype = _resolve_dtype(bytes(z[f"{key}_dtype"]).decode())
+            out[key] = np.frombuffer(
+                z[key].tobytes(), dtype
+            ).reshape(shape)
+    return out
+
+
+def pack_block(k: np.ndarray, v: np.ndarray) -> bytes:
+    """Serialize one block's pages ([L, bs, KVH, D] each) to bytes."""
+    return _pack_arrays(k=k, v=v)
+
+
+def unpack_block(data: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    out = _unpack_arrays(data, ("k", "v"))
+    return out["k"], out["v"]
+
+
+def pack_transfer(hashes, num_tokens: int, k: np.ndarray, v: np.ndarray) -> bytes:
+    """Multi-block wire format for /kv/extract -> /kv/inject transfers.
+    ``k``/``v``: [N_blocks, L, bs, KVH, D]."""
+    return _pack_arrays(
+        hashes=np.asarray(hashes, np.uint64),
+        num_tokens=np.asarray([num_tokens], np.int64),
+        k=k, v=v,
+    )
+
+
+def unpack_transfer(data: bytes) -> dict:
+    out = _unpack_arrays(data, ("hashes", "num_tokens", "k", "v"))
+    return {
+        "hashes": [int(h) for h in out["hashes"]],
+        "num_tokens": int(out["num_tokens"][0]),
+        "k": out["k"],
+        "v": out["v"],
+    }
+
+
+class RemoteKVClient:
+    """Blocking HTTP client for the standalone cache server
+    (:mod:`production_stack_tpu.kv.cache_server`). Used from the engine
+    thread; failures degrade to recompute, never to request failure."""
+
+    def __init__(self, base_url: str, timeout: float = 5.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def put(self, prefix_hash: int, data: bytes) -> bool:
+        req = urllib.request.Request(
+            f"{self.base_url}/v1/blocks/{prefix_hash}", data=data,
+            method="PUT",
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                return True
+        except (urllib.error.URLError, OSError) as e:
+            logger.debug("remote KV put failed: %s", e)
+            return False
+
+    def get(self, prefix_hash: int) -> Optional[bytes]:
+        try:
+            with urllib.request.urlopen(
+                f"{self.base_url}/v1/blocks/{prefix_hash}",
+                timeout=self.timeout,
+            ) as resp:
+                return resp.read()
+        except (urllib.error.URLError, OSError):
+            return None
+
+    def contains(self, prefix_hash: int) -> bool:
+        # Existence probes run on the engine thread during prompt
+        # allocation — keep the worst case short.
+        req = urllib.request.Request(
+            f"{self.base_url}/v1/blocks/{prefix_hash}", method="HEAD"
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=min(1.0, self.timeout)):
+                return True
+        except (urllib.error.URLError, OSError):
+            return False
+
+
+class HostKVStore:
+    """LRU byte-capped host-RAM block store with an optional remote tier.
+
+    Thread-safe: written from the engine thread (eviction hook) and read
+    from server threads (extract)."""
+
+    def __init__(self, capacity_bytes: int, remote_url: Optional[str] = None):
+        self.capacity_bytes = capacity_bytes
+        self._store: "OrderedDict[int, Tuple[np.ndarray, np.ndarray]]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.remote = RemoteKVClient(remote_url) if remote_url else None
+        self.hits = 0
+        self.misses = 0
+        self.stored = 0
+        self.evicted = 0
+        # Remote uploads happen on a background writer so a slow/unreachable
+        # cache server never stalls the engine thread (put is called from
+        # the allocator's eviction hook, under engine locks). Bounded queue:
+        # under pressure we drop uploads (cache, not correctness).
+        self._remote_queue: "list[Tuple[int, bytes]]" = []
+        self._remote_cv = threading.Condition()
+        self._writer: Optional[threading.Thread] = None
+        if self.remote is not None:
+            self._writer = threading.Thread(
+                target=self._remote_writer, daemon=True, name="kv-offload-tx"
+            )
+            self._writer.start()
+
+    _REMOTE_QUEUE_MAX = 256
+
+    def _enqueue_remote(self, prefix_hash: int, data: bytes) -> None:
+        with self._remote_cv:
+            if len(self._remote_queue) >= self._REMOTE_QUEUE_MAX:
+                self._remote_queue.pop(0)  # drop oldest upload
+            self._remote_queue.append((prefix_hash, data))
+            self._remote_cv.notify()
+
+    def _remote_writer(self) -> None:
+        while True:
+            with self._remote_cv:
+                while not self._remote_queue:
+                    self._remote_cv.wait()
+                prefix_hash, data = self._remote_queue.pop(0)
+            self.remote.put(prefix_hash, data)
+
+    def flush_remote(self, timeout: float = 10.0) -> None:
+        """Wait for queued remote uploads to drain (tests/shutdown)."""
+        import time as _time
+
+        deadline = _time.time() + timeout
+        while _time.time() < deadline:
+            with self._remote_cv:
+                if not self._remote_queue:
+                    return
+            _time.sleep(0.02)
+
+    @staticmethod
+    def _size(k: np.ndarray, v: np.ndarray) -> int:
+        return k.nbytes + v.nbytes
+
+    def put(self, prefix_hash: int, k: np.ndarray, v: np.ndarray) -> None:
+        size = self._size(k, v)
+        spill: "list[Tuple[int, np.ndarray, np.ndarray]]" = []
+        with self._lock:
+            if prefix_hash in self._store:
+                return
+            # Evict LRU entries to fit; spill them to the remote tier.
+            while self._bytes + size > self.capacity_bytes and self._store:
+                old_hash, (ok, ov) = self._store.popitem(last=False)
+                self._bytes -= self._size(ok, ov)
+                self.evicted += 1
+                spill.append((old_hash, ok, ov))
+            if self._bytes + size <= self.capacity_bytes:
+                self._store[prefix_hash] = (k, v)
+                self._bytes += size
+                self.stored += 1
+            elif self.remote is not None:
+                # Doesn't fit locally (remote-only config, or block larger
+                # than the host budget): ship it straight to the remote tier.
+                spill.append((prefix_hash, k, v))
+                self.stored += 1
+        if self.remote is not None:
+            for h, sk, sv in spill:
+                self._enqueue_remote(h, pack_block(sk, sv))
+
+    def get(self, prefix_hash: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        with self._lock:
+            entry = self._store.get(prefix_hash)
+            if entry is not None:
+                self._store.move_to_end(prefix_hash)
+                self.hits += 1
+                return entry
+        if self.remote is not None:
+            data = self.remote.get(prefix_hash)
+            if data is not None:
+                k, v = unpack_block(data)
+                self.hits += 1
+                return k, v
+        self.misses += 1
+        return None
+
+    def contains(self, prefix_hash: int) -> bool:
+        with self._lock:
+            if prefix_hash in self._store:
+                return True
+        return self.remote is not None and self.remote.contains(prefix_hash)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "blocks": len(self._store),
+                "bytes": self._bytes,
+                "capacity_bytes": self.capacity_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "stored": self.stored,
+                "evicted": self.evicted,
+            }

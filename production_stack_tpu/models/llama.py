@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from production_stack_tpu.models.config import ModelConfig
 from production_stack_tpu.ops.attention import (
+    context_prefill_attention,
     paged_decode_attention,
     prefill_attention,
     write_kv_pages,
@@ -163,6 +164,13 @@ def _layer(
 
     if mode == "prefill":
         attn = prefill_attention(q, k, v, scale=scale, seq_lens=seq_lens)
+    elif mode == "prefill_cached":
+        # Suffix prefill after a prefix-cache hit: attend over HBM pages
+        # (cached prefix + just-written suffix).
+        attn = context_prefill_attention(
+            q, k_pages, v_pages, block_tables, positions, context_lens,
+            scale=scale,
+        )
     else:
         attn = paged_decode_attention(
             q[:, 0], k_pages, v_pages, block_tables, context_lens, scale=scale

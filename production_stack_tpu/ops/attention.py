@@ -63,6 +63,42 @@ def prefill_attention(
     return out.reshape(B, T, H, D)
 
 
+def context_prefill_attention(
+    q: jax.Array,  # [B, T, H, D] suffix queries
+    k_pages: jax.Array,  # [NB, bs, KVH, D]
+    v_pages: jax.Array,  # [NB, bs, KVH, D]
+    block_tables: jax.Array,  # [B, MAXB]
+    positions: jax.Array,  # [B, T] absolute positions of the queries
+    total_lens: jax.Array,  # [B] full context length (cached + suffix)
+    *,
+    scale: float,
+) -> jax.Array:
+    """Prefill attention for a suffix whose K/V (and the cached prefix's)
+    already live in HBM pages: query at absolute position p attends to page
+    positions 0..p. This is what makes prefix-cache hits skip recompute —
+    only the suffix runs through the model, attending to reused pages
+    (reference buys this from vLLM ``--enable-prefix-caching`` +
+    LMCache offload; here it is native). Returns [B, T, H, D]."""
+    B, T, H, D = q.shape
+    NB, bs, KVH, _ = k_pages.shape
+    MAXB = block_tables.shape[1]
+    group = H // KVH
+    k_ctx = k_pages[block_tables].reshape(B, MAXB * bs, KVH, D)
+    v_ctx = v_pages[block_tables].reshape(B, MAXB * bs, KVH, D)
+    qg = q.reshape(B, T, KVH, group, D)
+    scores = jnp.einsum(
+        "btkgd,bskd->bkgts", qg, k_ctx, preferred_element_type=jnp.float32
+    ) * scale
+    span = jnp.arange(MAXB * bs)
+    causal = span[None, None, :] <= positions[:, :, None]  # [B, T, S]
+    valid = span[None, None, :] < total_lens[:, None, None]
+    mask = causal & valid
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs.astype(v_ctx.dtype), v_ctx)
+    return out.reshape(B, T, H, D)
+
+
 def write_kv_pages(
     k_pages: jax.Array,  # [NB, bs, KVH, D]
     v_pages: jax.Array,  # [NB, bs, KVH, D]

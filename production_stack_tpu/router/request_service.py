@@ -18,6 +18,7 @@ Rebuild of reference ``src/vllm_router/services/request_service/request.py``
 
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 import uuid
@@ -257,6 +258,25 @@ async def route_disaggregated_prefill_request(
     monitor.on_request_response(prefill_url, request_id, time.time())
     monitor.on_request_complete(prefill_url, request_id, time.time())
     logger.info("Disagg prefill for %s took %.3f s (TTFT)", request_id, ttft)
+
+    # Tell the decode engine to pull the prefilled KV from the prefill
+    # engine (data moves engine-to-engine; this is only the control
+    # message — the reference's out-of-band NIXL transfer equivalent).
+    # Failure is non-fatal: decode recomputes the prefix.
+    if prefill_url != decode_url:
+        try:
+            async with session.post(
+                f"{decode_url}/kv/pull",
+                json={"source_url": prefill_url, "request": request_json},
+                timeout=aiohttp.ClientTimeout(total=60),
+            ) as pull_resp:
+                pull = await pull_resp.json()
+                logger.info(
+                    "Disagg KV pull for %s: %s", request_id, pull)
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            logger.warning(
+                "Disagg KV pull failed for %s (decode will recompute): %s",
+                request_id, e)
 
     decode_json = dict(request_json)
     for k, v in saved.items():

@@ -1,4 +1,9 @@
-"""Block allocator + prefix cache tests (engine/kvcache.py)."""
+"""Block allocator + prefix cache tests (engine/kvcache.py).
+
+Note on the cache cap: allocate_prompt never serves the *entire* prompt from
+cache — at least one suffix token must run through the model to produce
+next-token logits — so a fully-cached prompt reuses all but its last block.
+"""
 
 from production_stack_tpu.engine.kvcache import KVCacheManager
 
@@ -7,9 +12,10 @@ def test_allocate_and_free():
     mgr = KVCacheManager(num_blocks=8, block_size=4)
     out = mgr.allocate_prompt("s1", list(range(10)))  # 3 blocks
     assert out is not None
-    blocks, cached = out
+    blocks, cached, restores = out
     assert len(blocks) == 3
     assert cached == 0
+    assert restores == []
     assert mgr.allocator.num_free == 5
     mgr.free("s1")
     # Full blocks stay cached; partial block returns to the free list.
@@ -19,13 +25,14 @@ def test_allocate_and_free():
 def test_prefix_cache_reuse():
     mgr = KVCacheManager(num_blocks=16, block_size=4)
     tokens = list(range(12))  # 3 full blocks
-    b1, cached1 = mgr.allocate_prompt("s1", tokens)
+    b1, cached1, _ = mgr.allocate_prompt("s1", tokens)
     assert cached1 == 0
     mgr.free("s1")
-    b2, cached2 = mgr.allocate_prompt("s2", tokens)
-    assert cached2 == 12  # all three full blocks reused
-    assert b2 == b1
-    assert mgr.allocator.prefix_hits == 3
+    b2, cached2, _ = mgr.allocate_prompt("s2", tokens)
+    # First two blocks reused; the last is recomputed (logits needed).
+    assert cached2 == 8
+    assert b2[:2] == b1[:2]
+    assert mgr.allocator.prefix_hits == 2
 
 
 def test_prefix_cache_partial_match():
@@ -33,17 +40,17 @@ def test_prefix_cache_partial_match():
     mgr.allocate_prompt("s1", list(range(8)) + [99, 98])
     mgr.free("s1")
     # Same first 8 tokens, different continuation.
-    b2, cached = mgr.allocate_prompt("s2", list(range(8)) + [1, 2, 3, 4])
+    b2, cached, _ = mgr.allocate_prompt("s2", list(range(8)) + [1, 2, 3, 4])
     assert cached == 8
 
 
 def test_shared_prefix_refcount():
     mgr = KVCacheManager(num_blocks=16, block_size=4)
-    tokens = list(range(8))
-    b1, _ = mgr.allocate_prompt("s1", tokens)
-    b2, cached = mgr.allocate_prompt("s2", tokens)
+    tokens = list(range(12))
+    b1, _, _ = mgr.allocate_prompt("s1", tokens)
+    b2, cached, _ = mgr.allocate_prompt("s2", tokens)
     assert cached == 8
-    assert b1 == b2
+    assert b1[:2] == b2[:2]
     assert mgr.allocator.blocks[b1[0]].ref_count == 2
     mgr.free("s1")
     assert mgr.allocator.blocks[b1[0]].ref_count == 1
@@ -74,3 +81,43 @@ def test_usage_fraction():
     assert mgr.usage() == 0.0
     mgr.allocate_prompt("s1", list(range(20)))  # 5 blocks
     assert abs(mgr.usage() - 0.5) < 1e-9
+
+
+def test_external_lookup_produces_restores():
+    mgr = KVCacheManager(num_blocks=16, block_size=4)
+    store = set()
+
+    # First allocation records the chain hashes via the eviction hook path:
+    # simulate by registering hashes into a fake external store.
+    b1, _, _ = mgr.allocate_prompt("s1", list(range(12)))
+    full_hashes = [
+        mgr.allocator.blocks[b].prefix_hash
+        for b in b1 if mgr.allocator.blocks[b].prefix_hash is not None
+    ]
+    store.update(full_hashes)
+    mgr.free("s1")
+
+    # Wipe the device prefix cache entirely (simulates eviction).
+    for h in list(mgr.allocator.prefix_map):
+        bid = mgr.allocator.prefix_map.pop(h)
+        mgr.allocator.blocks[bid].prefix_hash = None
+        mgr.allocator.free_ids.append(bid)
+    mgr.seqs.clear()
+
+    mgr.external_lookup = lambda h: h in store
+    b2, cached, restores = mgr.allocate_prompt("s2", list(range(12)))
+    assert cached == 8  # two blocks restored from the external tier
+    assert len(restores) == 2
+    restored_bids = [bid for bid, _ in restores]
+    assert all(bid in b2 for bid in restored_bids)
+
+
+def test_eviction_callback_fires():
+    mgr = KVCacheManager(num_blocks=4, block_size=4)
+    evicted = []
+    mgr.allocator.on_evict = lambda h, bid: evicted.append((h, bid))
+    mgr.allocate_prompt("s1", list(range(8)))
+    mgr.free("s1")  # blocks become cold cache
+    # Exhaust the pool so cold cache gets recycled.
+    mgr.allocate_prompt("s2", list(range(100, 116)))
+    assert evicted, "eviction hook did not fire"
