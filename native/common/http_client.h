@@ -1,0 +1,167 @@
+// Minimal blocking HTTP/1.1 client over POSIX sockets (header-only).
+//
+// The native operator talks to the Kubernetes API through a plain-HTTP
+// base URL — in-cluster via a `kubectl proxy` sidecar (the image has no
+// TLS library), in tests via a fake API server. This mirrors how the
+// reference operator's client-go is configured with a rest.Config; the
+// transport is swappable without touching reconciler logic.
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <sstream>
+#include <string>
+
+namespace tpustack {
+
+struct HttpResponse {
+  int status = 0;
+  std::string body;
+  bool ok() const { return status >= 200 && status < 300; }
+};
+
+struct HttpUrl {
+  std::string host;
+  int port = 80;
+  std::string base_path;  // prefix prepended to request paths
+
+  static HttpUrl parse(const std::string& url) {
+    HttpUrl out;
+    std::string rest = url;
+    const std::string scheme = "http://";
+    if (rest.rfind(scheme, 0) == 0) rest = rest.substr(scheme.size());
+    auto slash = rest.find('/');
+    std::string hostport = rest.substr(0, slash);
+    if (slash != std::string::npos) out.base_path = rest.substr(slash);
+    if (!out.base_path.empty() && out.base_path.back() == '/')
+      out.base_path.pop_back();
+    auto colon = hostport.find(':');
+    if (colon == std::string::npos) {
+      out.host = hostport;
+    } else {
+      out.host = hostport.substr(0, colon);
+      out.port = std::stoi(hostport.substr(colon + 1));
+    }
+    return out;
+  }
+};
+
+class HttpClient {
+ public:
+  explicit HttpClient(const std::string& base_url, int timeout_sec = 10)
+      : url_(HttpUrl::parse(base_url)), timeout_sec_(timeout_sec) {}
+
+  HttpResponse request(const std::string& method, const std::string& path,
+                       const std::string& body = "",
+                       const std::string& content_type =
+                           "application/json") const {
+    HttpResponse resp;
+    int fd = connect_();
+    if (fd < 0) return resp;  // status 0 = transport error
+
+    std::ostringstream req;
+    req << method << ' ' << url_.base_path << path << " HTTP/1.1\r\n"
+        << "Host: " << url_.host << ':' << url_.port << "\r\n"
+        << "Connection: close\r\n"
+        << "Accept: application/json\r\n";
+    if (!body.empty() || method == "POST" || method == "PUT" ||
+        method == "PATCH") {
+      req << "Content-Type: " << content_type << "\r\n"
+          << "Content-Length: " << body.size() << "\r\n";
+    }
+    req << "\r\n" << body;
+    std::string data = req.str();
+
+    size_t sent = 0;
+    while (sent < data.size()) {
+      ssize_t n = ::send(fd, data.data() + sent, data.size() - sent, 0);
+      if (n <= 0) { ::close(fd); return resp; }
+      sent += static_cast<size_t>(n);
+    }
+
+    std::string raw;
+    char buf[8192];
+    ssize_t n;
+    while ((n = ::recv(fd, buf, sizeof(buf), 0)) > 0) {
+      raw.append(buf, static_cast<size_t>(n));
+    }
+    ::close(fd);
+
+    auto header_end = raw.find("\r\n\r\n");
+    if (header_end == std::string::npos) return resp;
+    std::string headers = raw.substr(0, header_end);
+    std::string payload = raw.substr(header_end + 4);
+
+    // Status line: HTTP/1.1 200 OK
+    auto sp1 = headers.find(' ');
+    if (sp1 != std::string::npos)
+      resp.status = std::atoi(headers.c_str() + sp1 + 1);
+
+    // Chunked transfer decoding (aiohttp uses it for JSON responses).
+    if (headers.find("chunked") != std::string::npos) {
+      std::string decoded;
+      size_t pos = 0;
+      while (pos < payload.size()) {
+        auto line_end = payload.find("\r\n", pos);
+        if (line_end == std::string::npos) break;
+        long chunk_len =
+            std::strtol(payload.substr(pos, line_end - pos).c_str(),
+                        nullptr, 16);
+        if (chunk_len <= 0) break;
+        decoded.append(payload, line_end + 2,
+                       static_cast<size_t>(chunk_len));
+        pos = line_end + 2 + static_cast<size_t>(chunk_len) + 2;
+      }
+      resp.body = std::move(decoded);
+    } else {
+      resp.body = std::move(payload);
+    }
+    return resp;
+  }
+
+  HttpResponse get(const std::string& path) const {
+    return request("GET", path);
+  }
+  HttpResponse post(const std::string& path, const std::string& body) const {
+    return request("POST", path, body);
+  }
+  HttpResponse put(const std::string& path, const std::string& body) const {
+    return request("PUT", path, body);
+  }
+  HttpResponse del(const std::string& path) const {
+    return request("DELETE", path);
+  }
+
+ private:
+  int connect_() const {
+    struct addrinfo hints{};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    std::string port_str = std::to_string(url_.port);
+    if (::getaddrinfo(url_.host.c_str(), port_str.c_str(), &hints, &res) != 0)
+      return -1;
+    int fd = -1;
+    for (auto* p = res; p; p = p->ai_next) {
+      fd = ::socket(p->ai_family, p->ai_socktype, p->ai_protocol);
+      if (fd < 0) continue;
+      struct timeval tv{timeout_sec_, 0};
+      ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+      if (::connect(fd, p->ai_addr, p->ai_addrlen) == 0) break;
+      ::close(fd);
+      fd = -1;
+    }
+    ::freeaddrinfo(res);
+    return fd;
+  }
+
+  HttpUrl url_;
+  int timeout_sec_;
+};
+
+}  // namespace tpustack

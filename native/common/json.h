@@ -1,0 +1,317 @@
+// Minimal JSON value / parser / serializer for the native operator.
+//
+// Self-contained (no third-party deps are available in the build image).
+// Supports the subset the Kubernetes API needs: objects, arrays, strings
+// with escapes, numbers (stored as double; integral values serialize
+// without a decimal point), booleans, null, UTF-8 pass-through.
+//
+// Plays the role client-go's unstructured/typed objects play in the
+// reference operator (operator/api/v1alpha1, operator/internal/controller).
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace tpustack {
+
+class Json;
+using JsonObject = std::map<std::string, Json>;
+using JsonArray = std::vector<Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Json() : type_(Type::Null) {}
+  Json(std::nullptr_t) : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), bool_(b) {}
+  Json(int v) : type_(Type::Number), num_(v) {}
+  Json(int64_t v) : type_(Type::Number), num_(static_cast<double>(v)) {}
+  Json(double v) : type_(Type::Number), num_(v) {}
+  Json(const char* s) : type_(Type::String), str_(s) {}
+  Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Json(JsonArray a) : type_(Type::Array), arr_(std::move(a)) {}
+  Json(JsonObject o) : type_(Type::Object), obj_(std::move(o)) {}
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_number() const { return type_ == Type::Number; }
+  bool is_bool() const { return type_ == Type::Bool; }
+
+  bool as_bool(bool dflt = false) const {
+    return type_ == Type::Bool ? bool_ : dflt;
+  }
+  double as_number(double dflt = 0) const {
+    return type_ == Type::Number ? num_ : dflt;
+  }
+  int64_t as_int(int64_t dflt = 0) const {
+    return type_ == Type::Number ? static_cast<int64_t>(num_) : dflt;
+  }
+  const std::string& as_string() const {
+    static const std::string empty;
+    return type_ == Type::String ? str_ : empty;
+  }
+  const JsonArray& as_array() const {
+    static const JsonArray empty;
+    return type_ == Type::Array ? arr_ : empty;
+  }
+  const JsonObject& as_object() const {
+    static const JsonObject empty;
+    return type_ == Type::Object ? obj_ : empty;
+  }
+
+  JsonArray& array() {
+    if (type_ != Type::Array) { type_ = Type::Array; arr_.clear(); }
+    return arr_;
+  }
+  JsonObject& object() {
+    if (type_ != Type::Object) { type_ = Type::Object; obj_.clear(); }
+    return obj_;
+  }
+
+  // Path access: j.get("spec").get("model").as_string()
+  const Json& get(const std::string& key) const {
+    static const Json null_json;
+    if (type_ != Type::Object) return null_json;
+    auto it = obj_.find(key);
+    return it == obj_.end() ? null_json : it->second;
+  }
+  bool has(const std::string& key) const {
+    return type_ == Type::Object && obj_.count(key) > 0;
+  }
+  Json& operator[](const std::string& key) { return object()[key]; }
+
+  std::string dump() const {
+    std::ostringstream os;
+    write(os);
+    return os.str();
+  }
+
+  static Json parse(const std::string& text) {
+    size_t pos = 0;
+    Json v = parse_value(text, pos);
+    skip_ws(text, pos);
+    if (pos != text.size()) throw std::runtime_error("trailing JSON data");
+    return v;
+  }
+
+  static bool try_parse(const std::string& text, Json* out) {
+    try {
+      *out = parse(text);
+      return true;
+    } catch (const std::exception&) {
+      return false;
+    }
+  }
+
+ private:
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  JsonArray arr_;
+  JsonObject obj_;
+
+  void write(std::ostringstream& os) const {
+    switch (type_) {
+      case Type::Null: os << "null"; break;
+      case Type::Bool: os << (bool_ ? "true" : "false"); break;
+      case Type::Number: {
+        if (std::isfinite(num_) && num_ == std::floor(num_) &&
+            std::abs(num_) < 9.0e15) {
+          os << static_cast<int64_t>(num_);
+        } else {
+          os << num_;
+        }
+        break;
+      }
+      case Type::String: write_string(os, str_); break;
+      case Type::Array: {
+        os << '[';
+        for (size_t i = 0; i < arr_.size(); ++i) {
+          if (i) os << ',';
+          arr_[i].write(os);
+        }
+        os << ']';
+        break;
+      }
+      case Type::Object: {
+        os << '{';
+        bool first = true;
+        for (const auto& [k, v] : obj_) {
+          if (!first) os << ',';
+          first = false;
+          write_string(os, k);
+          os << ':';
+          v.write(os);
+        }
+        os << '}';
+        break;
+      }
+    }
+  }
+
+  static void write_string(std::ostringstream& os, const std::string& s) {
+    os << '"';
+    for (char c : s) {
+      switch (c) {
+        case '"': os << "\\\""; break;
+        case '\\': os << "\\\\"; break;
+        case '\n': os << "\\n"; break;
+        case '\r': os << "\\r"; break;
+        case '\t': os << "\\t"; break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char buf[8];
+            std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+            os << buf;
+          } else {
+            os << c;  // UTF-8 bytes pass through
+          }
+      }
+    }
+    os << '"';
+  }
+
+  static void skip_ws(const std::string& t, size_t& pos) {
+    while (pos < t.size() &&
+           (t[pos] == ' ' || t[pos] == '\t' || t[pos] == '\n' ||
+            t[pos] == '\r')) {
+      ++pos;
+    }
+  }
+
+  static Json parse_value(const std::string& t, size_t& pos) {
+    skip_ws(t, pos);
+    if (pos >= t.size()) throw std::runtime_error("unexpected end of JSON");
+    char c = t[pos];
+    if (c == '{') return parse_object(t, pos);
+    if (c == '[') return parse_array(t, pos);
+    if (c == '"') return Json(parse_string(t, pos));
+    if (c == 't') { expect(t, pos, "true"); return Json(true); }
+    if (c == 'f') { expect(t, pos, "false"); return Json(false); }
+    if (c == 'n') { expect(t, pos, "null"); return Json(nullptr); }
+    return parse_number(t, pos);
+  }
+
+  static void expect(const std::string& t, size_t& pos, const char* word) {
+    size_t len = std::strlen(word);
+    if (t.compare(pos, len, word) != 0)
+      throw std::runtime_error("bad JSON literal");
+    pos += len;
+  }
+
+  static Json parse_number(const std::string& t, size_t& pos) {
+    size_t start = pos;
+    if (pos < t.size() && (t[pos] == '-' || t[pos] == '+')) ++pos;
+    while (pos < t.size() &&
+           (std::isdigit(static_cast<unsigned char>(t[pos])) ||
+            t[pos] == '.' || t[pos] == 'e' || t[pos] == 'E' ||
+            t[pos] == '-' || t[pos] == '+')) {
+      ++pos;
+    }
+    if (pos == start) throw std::runtime_error("bad JSON number");
+    return Json(std::stod(t.substr(start, pos - start)));
+  }
+
+  static std::string parse_string(const std::string& t, size_t& pos) {
+    if (t[pos] != '"') throw std::runtime_error("expected string");
+    ++pos;
+    std::string out;
+    while (pos < t.size() && t[pos] != '"') {
+      char c = t[pos];
+      if (c == '\\') {
+        ++pos;
+        if (pos >= t.size()) throw std::runtime_error("bad escape");
+        char e = t[pos];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            if (pos + 4 >= t.size()) throw std::runtime_error("bad \\u");
+            unsigned code = std::stoul(t.substr(pos + 1, 4), nullptr, 16);
+            pos += 4;
+            // Encode code point as UTF-8 (surrogate pairs for BMP+ are
+            // passed through as two escapes; good enough for K8s payloads).
+            if (code < 0x80) {
+              out += static_cast<char>(code);
+            } else if (code < 0x800) {
+              out += static_cast<char>(0xC0 | (code >> 6));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            } else {
+              out += static_cast<char>(0xE0 | (code >> 12));
+              out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: throw std::runtime_error("bad escape char");
+        }
+        ++pos;
+      } else {
+        out += c;
+        ++pos;
+      }
+    }
+    if (pos >= t.size()) throw std::runtime_error("unterminated string");
+    ++pos;  // closing quote
+    return out;
+  }
+
+  static Json parse_array(const std::string& t, size_t& pos) {
+    ++pos;  // [
+    JsonArray arr;
+    skip_ws(t, pos);
+    if (pos < t.size() && t[pos] == ']') { ++pos; return Json(arr); }
+    while (true) {
+      arr.push_back(parse_value(t, pos));
+      skip_ws(t, pos);
+      if (pos >= t.size()) throw std::runtime_error("unterminated array");
+      if (t[pos] == ',') { ++pos; continue; }
+      if (t[pos] == ']') { ++pos; break; }
+      throw std::runtime_error("bad array separator");
+    }
+    return Json(std::move(arr));
+  }
+
+  static Json parse_object(const std::string& t, size_t& pos) {
+    ++pos;  // {
+    JsonObject obj;
+    skip_ws(t, pos);
+    if (pos < t.size() && t[pos] == '}') { ++pos; return Json(obj); }
+    while (true) {
+      skip_ws(t, pos);
+      std::string key = parse_string(t, pos);
+      skip_ws(t, pos);
+      if (pos >= t.size() || t[pos] != ':')
+        throw std::runtime_error("expected ':'");
+      ++pos;
+      obj[key] = parse_value(t, pos);
+      skip_ws(t, pos);
+      if (pos >= t.size()) throw std::runtime_error("unterminated object");
+      if (t[pos] == ',') { ++pos; continue; }
+      if (t[pos] == '}') { ++pos; break; }
+      throw std::runtime_error("bad object separator");
+    }
+    return Json(std::move(obj));
+  }
+};
+
+}  // namespace tpustack

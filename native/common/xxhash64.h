@@ -1,0 +1,111 @@
+// XXH64 — self-contained implementation of the public xxHash64 algorithm
+// (Yann Collet's specification, public domain). Bit-exact with the Python
+// `xxhash` package used by the router's prefix trie
+// (production_stack_tpu/router/hashtrie.py) and the KV controller, so
+// native pickers and Python components agree on chunk hashes.
+//
+// Reference parity: the Go gateway picker uses github.com/cespare/xxhash
+// (reference src/gateway_inference_extension/prefix_aware_picker.go:134-213);
+// this header plays that role for the C++ pickers.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string_view>
+
+namespace tpustack {
+
+namespace xxh_detail {
+constexpr uint64_t P1 = 11400714785074694791ULL;
+constexpr uint64_t P2 = 14029467366897019727ULL;
+constexpr uint64_t P3 = 1609587929392839161ULL;
+constexpr uint64_t P4 = 9650029242287828579ULL;
+constexpr uint64_t P5 = 2870177450012600261ULL;
+
+inline uint64_t rotl(uint64_t x, int r) { return (x << r) | (x >> (64 - r)); }
+
+inline uint64_t read64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;  // little-endian hosts only (x86_64/aarch64)
+}
+
+inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint64_t round_(uint64_t acc, uint64_t input) {
+  acc += input * P2;
+  acc = rotl(acc, 31);
+  acc *= P1;
+  return acc;
+}
+
+inline uint64_t merge_round(uint64_t acc, uint64_t val) {
+  val = round_(0, val);
+  acc ^= val;
+  acc = acc * P1 + P4;
+  return acc;
+}
+}  // namespace xxh_detail
+
+inline uint64_t xxhash64(const void* data, size_t len, uint64_t seed = 0) {
+  using namespace xxh_detail;
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  const uint8_t* end = p + len;
+  uint64_t h;
+
+  if (len >= 32) {
+    const uint8_t* limit = end - 32;
+    uint64_t v1 = seed + P1 + P2;
+    uint64_t v2 = seed + P2;
+    uint64_t v3 = seed + 0;
+    uint64_t v4 = seed - P1;
+    do {
+      v1 = round_(v1, read64(p)); p += 8;
+      v2 = round_(v2, read64(p)); p += 8;
+      v3 = round_(v3, read64(p)); p += 8;
+      v4 = round_(v4, read64(p)); p += 8;
+    } while (p <= limit);
+    h = rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18);
+    h = merge_round(h, v1);
+    h = merge_round(h, v2);
+    h = merge_round(h, v3);
+    h = merge_round(h, v4);
+  } else {
+    h = seed + P5;
+  }
+
+  h += static_cast<uint64_t>(len);
+
+  while (p + 8 <= end) {
+    h ^= round_(0, read64(p));
+    h = rotl(h, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h ^= static_cast<uint64_t>(read32(p)) * P1;
+    h = rotl(h, 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= (*p) * P5;
+    h = rotl(h, 11) * P1;
+    ++p;
+  }
+
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
+inline uint64_t xxhash64(std::string_view s, uint64_t seed = 0) {
+  return xxhash64(s.data(), s.size(), seed);
+}
+
+}  // namespace tpustack

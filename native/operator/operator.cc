@@ -1,0 +1,634 @@
+// tpu-stack-operator — native (C++) control plane for the TPU serving
+// stack. Compiled equivalent of the reference's Go kubebuilder operator
+// (operator/cmd/main.go, operator/internal/controller/*): reconciles four
+// CRDs under group `production-stack.tpu/v1alpha1` into core Kubernetes
+// objects:
+//
+//   TPURuntime  -> Service + Deployment running the engine server
+//                  (`python -m production_stack_tpu.engine.server`) with
+//                  google.com/tpu resources and GKE TPU topology node
+//                  selectors (replaces nvidia.com/gpu provisioning in
+//                  vllmruntime_controller.go:190-523)
+//   TPURouter   -> ServiceAccount + Deployment + Service for the router
+//                  (vllmrouter_controller.go:197-364)
+//   CacheServer -> Deployment + Service for the standalone KV cache server
+//                  (cacheserver_controller.go:135-206)
+//   LoraAdapter -> loads/unloads adapters on ready engine pods through the
+//                  engine HTTP API /v1/load_lora_adapter
+//                  (loraadapter_controller.go:582-610)
+//
+// Transport: plain-HTTP Kubernetes API base (kubectl-proxy sidecar
+// in-cluster; fake API server in tests). Reconciliation is level-based
+// polling — each pass lists CRs, ensures child objects, detects drift
+// (replicas/image/args/port) and updates CR status.
+
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "../common/http_client.h"
+#include "../common/json.h"
+
+using tpustack::HttpClient;
+using tpustack::HttpResponse;
+using tpustack::Json;
+using tpustack::JsonArray;
+using tpustack::JsonObject;
+
+namespace {
+
+struct Config {
+  std::string api_base = "http://127.0.0.1:8001";
+  std::string ns = "default";
+  std::string default_engine_image = "production-stack-tpu:latest";
+  std::string default_router_image = "production-stack-tpu:latest";
+  int interval_sec = 5;
+  bool once = false;
+};
+
+const char* kGroup = "production-stack.tpu";
+const char* kVersion = "v1alpha1";
+
+std::string cr_path(const Config& cfg, const std::string& plural,
+                    const std::string& name = "") {
+  std::string p = std::string("/apis/") + kGroup + "/" + kVersion +
+                  "/namespaces/" + cfg.ns + "/" + plural;
+  if (!name.empty()) p += "/" + name;
+  return p;
+}
+
+std::string deploy_path(const Config& cfg, const std::string& name = "") {
+  std::string p = "/apis/apps/v1/namespaces/" + cfg.ns + "/deployments";
+  if (!name.empty()) p += "/" + name;
+  return p;
+}
+
+std::string svc_path(const Config& cfg, const std::string& name = "") {
+  std::string p = "/api/v1/namespaces/" + cfg.ns + "/services";
+  if (!name.empty()) p += "/" + name;
+  return p;
+}
+
+void log_line(const std::string& msg) {
+  std::fprintf(stderr, "[tpu-stack-operator] %s\n", msg.c_str());
+}
+
+Json owner_ref(const Json& cr, const std::string& kind) {
+  JsonObject ref;
+  ref["apiVersion"] = std::string(kGroup) + "/" + kVersion;
+  ref["kind"] = kind;
+  ref["name"] = cr.get("metadata").get("name").as_string();
+  ref["uid"] = cr.get("metadata").get("uid").as_string();
+  ref["controller"] = true;
+  return Json(ref);
+}
+
+Json make_metadata(const Config& cfg, const std::string& name,
+                   const JsonObject& labels, const Json& cr,
+                   const std::string& owner_kind) {
+  JsonObject meta;
+  meta["name"] = name;
+  meta["namespace"] = cfg.ns;
+  JsonObject lbl = labels;
+  lbl["app.kubernetes.io/managed-by"] = "tpu-stack-operator";
+  meta["labels"] = Json(lbl);
+  meta["ownerReferences"] = Json(JsonArray{owner_ref(cr, owner_kind)});
+  return Json(meta);
+}
+
+// ---------------------------------------------------------------------- //
+// TPURuntime -> engine Deployment + Service
+// ---------------------------------------------------------------------- //
+
+Json runtime_container(const Json& spec) {
+  JsonObject c;
+  c["name"] = "engine";
+  c["image"] = spec.get("image").is_string()
+                   ? spec.get("image").as_string()
+                   : std::string("production-stack-tpu:latest");
+  int port = static_cast<int>(spec.get("port").as_int(8000));
+
+  JsonArray cmd;
+  cmd.push_back("python");
+  cmd.push_back("-m");
+  cmd.push_back("production_stack_tpu.engine.server");
+  cmd.push_back(spec.get("model").as_string());
+  cmd.push_back("--host"); cmd.push_back("0.0.0.0");
+  cmd.push_back("--port"); cmd.push_back(std::to_string(port));
+  if (spec.has("tensorParallelSize")) {
+    cmd.push_back("--tensor-parallel-size");
+    cmd.push_back(std::to_string(spec.get("tensorParallelSize").as_int(1)));
+  }
+  if (spec.has("maxModelLen")) {
+    cmd.push_back("--max-model-len");
+    cmd.push_back(std::to_string(spec.get("maxModelLen").as_int(2048)));
+  }
+  if (spec.has("maxNumSeqs")) {
+    cmd.push_back("--max-num-seqs");
+    cmd.push_back(std::to_string(spec.get("maxNumSeqs").as_int(8)));
+  }
+  if (spec.has("kvOffloadGb")) {
+    cmd.push_back("--kv-offload-gb");
+    cmd.push_back(std::to_string(spec.get("kvOffloadGb").as_number(0)));
+  }
+  if (spec.get("kvRemoteUrl").is_string()) {
+    cmd.push_back("--kv-remote-url");
+    cmd.push_back(spec.get("kvRemoteUrl").as_string());
+  }
+  for (const auto& arg : spec.get("extraArgs").as_array())
+    cmd.push_back(arg.as_string());
+  c["command"] = Json(cmd);
+
+  JsonObject port_obj;
+  port_obj["containerPort"] = port;
+  port_obj["name"] = "http";
+  c["ports"] = Json(JsonArray{Json(port_obj)});
+
+  // TPU resources (google.com/tpu replaces the reference's
+  // nvidia.com/gpu, helm _helpers.tpl:108-150 swap point).
+  const Json& tpu = spec.get("tpu");
+  int chips = static_cast<int>(tpu.get("chips").as_int(0));
+  if (chips > 0) {
+    JsonObject amount;
+    amount["google.com/tpu"] = chips;
+    JsonObject res;
+    res["requests"] = Json(amount);
+    res["limits"] = Json(amount);
+    c["resources"] = Json(res);
+  }
+
+  JsonObject probe_get;
+  probe_get["path"] = "/health";
+  probe_get["port"] = port;
+  JsonObject probe;
+  probe["httpGet"] = Json(probe_get);
+  probe["initialDelaySeconds"] = 30;
+  probe["periodSeconds"] = 10;
+  c["readinessProbe"] = probe;
+  c["livenessProbe"] = probe;
+  return Json(c);
+}
+
+Json runtime_deployment(const Config& cfg, const Json& cr) {
+  const Json& spec = cr.get("spec");
+  std::string name = cr.get("metadata").get("name").as_string();
+  JsonObject labels;
+  labels["app"] = name;
+  labels["model"] = spec.get("modelLabel").is_string()
+                        ? spec.get("modelLabel").as_string()
+                        : name;
+
+  JsonObject pod_spec;
+  pod_spec["containers"] = Json(JsonArray{runtime_container(spec)});
+  const Json& tpu = spec.get("tpu");
+  if (tpu.is_object() &&
+      (tpu.get("topology").is_string() ||
+       tpu.get("accelerator").is_string())) {
+    JsonObject sel;
+    if (tpu.get("accelerator").is_string())
+      sel["cloud.google.com/gke-tpu-accelerator"] =
+          tpu.get("accelerator").as_string();
+    if (tpu.get("topology").is_string())
+      sel["cloud.google.com/gke-tpu-topology"] =
+          tpu.get("topology").as_string();
+    pod_spec["nodeSelector"] = Json(sel);
+  }
+
+  JsonObject pod_meta;
+  pod_meta["labels"] = Json(labels);
+  JsonObject tmpl;
+  tmpl["metadata"] = Json(pod_meta);
+  tmpl["spec"] = Json(pod_spec);
+
+  JsonObject match;
+  match["matchLabels"] = Json(JsonObject{{"app", Json(name)}});
+  JsonObject dspec;
+  dspec["replicas"] = static_cast<int>(spec.get("replicas").as_int(1));
+  dspec["selector"] = Json(match);
+  dspec["template"] = Json(tmpl);
+
+  JsonObject d;
+  d["apiVersion"] = "apps/v1";
+  d["kind"] = "Deployment";
+  d["metadata"] = make_metadata(cfg, name + "-engine", labels, cr,
+                                "TPURuntime");
+  d["spec"] = Json(dspec);
+  return Json(d);
+}
+
+Json runtime_service(const Config& cfg, const Json& cr) {
+  const Json& spec = cr.get("spec");
+  std::string name = cr.get("metadata").get("name").as_string();
+  int port = static_cast<int>(spec.get("port").as_int(8000));
+  JsonObject port_obj;
+  port_obj["name"] = "http";
+  port_obj["port"] = port;
+  port_obj["targetPort"] = port;
+  JsonObject sspec;
+  sspec["selector"] = Json(JsonObject{{"app", Json(name)}});
+  sspec["ports"] = Json(JsonArray{Json(port_obj)});
+  JsonObject s;
+  s["apiVersion"] = "v1";
+  s["kind"] = "Service";
+  s["metadata"] = make_metadata(cfg, name + "-engine-service",
+                                JsonObject{{"app", Json(name)}}, cr,
+                                "TPURuntime");
+  s["spec"] = Json(sspec);
+  return Json(s);
+}
+
+// ---------------------------------------------------------------------- //
+// TPURouter -> router Deployment + Service
+// ---------------------------------------------------------------------- //
+
+Json router_deployment(const Config& cfg, const Json& cr) {
+  const Json& spec = cr.get("spec");
+  std::string name = cr.get("metadata").get("name").as_string();
+  int port = static_cast<int>(spec.get("port").as_int(8080));
+
+  JsonArray cmd;
+  cmd.push_back("python");
+  cmd.push_back("-m");
+  cmd.push_back("production_stack_tpu.router.app");
+  cmd.push_back("--host"); cmd.push_back("0.0.0.0");
+  cmd.push_back("--port"); cmd.push_back(std::to_string(port));
+  cmd.push_back("--service-discovery");
+  cmd.push_back(spec.get("serviceDiscovery").is_string()
+                    ? spec.get("serviceDiscovery").as_string()
+                    : std::string("k8s"));
+  if (spec.get("routingLogic").is_string()) {
+    cmd.push_back("--routing-logic");
+    cmd.push_back(spec.get("routingLogic").as_string());
+  }
+  if (spec.get("staticBackends").is_string()) {
+    cmd.push_back("--static-backends");
+    cmd.push_back(spec.get("staticBackends").as_string());
+  }
+  if (spec.get("staticModels").is_string()) {
+    cmd.push_back("--static-models");
+    cmd.push_back(spec.get("staticModels").as_string());
+  }
+  for (const auto& arg : spec.get("extraArgs").as_array())
+    cmd.push_back(arg.as_string());
+
+  JsonObject c;
+  c["name"] = "router";
+  c["image"] = spec.get("image").is_string()
+                   ? spec.get("image").as_string()
+                   : std::string("production-stack-tpu:latest");
+  c["command"] = Json(cmd);
+  JsonObject port_obj;
+  port_obj["containerPort"] = port;
+  c["ports"] = Json(JsonArray{Json(port_obj)});
+
+  JsonObject labels{{"app", Json(name)}};
+  JsonObject pod_spec;
+  pod_spec["serviceAccountName"] = name + "-sa";
+  pod_spec["containers"] = Json(JsonArray{Json(c)});
+  JsonObject pod_meta;
+  pod_meta["labels"] = Json(labels);
+  JsonObject tmpl;
+  tmpl["metadata"] = Json(pod_meta);
+  tmpl["spec"] = Json(pod_spec);
+  JsonObject match;
+  match["matchLabels"] = Json(labels);
+  JsonObject dspec;
+  dspec["replicas"] = static_cast<int>(spec.get("replicas").as_int(1));
+  dspec["selector"] = Json(match);
+  dspec["template"] = Json(tmpl);
+  JsonObject d;
+  d["apiVersion"] = "apps/v1";
+  d["kind"] = "Deployment";
+  d["metadata"] = make_metadata(cfg, name + "-router", labels, cr,
+                                "TPURouter");
+  d["spec"] = Json(dspec);
+  return Json(d);
+}
+
+Json router_service(const Config& cfg, const Json& cr) {
+  const Json& spec = cr.get("spec");
+  std::string name = cr.get("metadata").get("name").as_string();
+  int port = static_cast<int>(spec.get("port").as_int(8080));
+  JsonObject port_obj;
+  port_obj["name"] = "http";
+  port_obj["port"] = 80;
+  port_obj["targetPort"] = port;
+  JsonObject sspec;
+  sspec["selector"] = Json(JsonObject{{"app", Json(name)}});
+  sspec["ports"] = Json(JsonArray{Json(port_obj)});
+  JsonObject s;
+  s["apiVersion"] = "v1";
+  s["kind"] = "Service";
+  s["metadata"] = make_metadata(cfg, name + "-router-service",
+                                JsonObject{{"app", Json(name)}}, cr,
+                                "TPURouter");
+  s["spec"] = Json(sspec);
+  return Json(s);
+}
+
+Json router_service_account(const Config& cfg, const Json& cr) {
+  std::string name = cr.get("metadata").get("name").as_string();
+  JsonObject sa;
+  sa["apiVersion"] = "v1";
+  sa["kind"] = "ServiceAccount";
+  sa["metadata"] = make_metadata(cfg, name + "-sa", JsonObject{}, cr,
+                                 "TPURouter");
+  return Json(sa);
+}
+
+// ---------------------------------------------------------------------- //
+// CacheServer -> Deployment + Service
+// ---------------------------------------------------------------------- //
+
+Json cache_deployment(const Config& cfg, const Json& cr) {
+  const Json& spec = cr.get("spec");
+  std::string name = cr.get("metadata").get("name").as_string();
+  int port = static_cast<int>(spec.get("port").as_int(8200));
+  JsonArray cmd;
+  cmd.push_back("python");
+  cmd.push_back("-m");
+  cmd.push_back("production_stack_tpu.kv.cache_server");
+  cmd.push_back("--host"); cmd.push_back("0.0.0.0");
+  cmd.push_back("--port"); cmd.push_back(std::to_string(port));
+  if (spec.has("capacityGb")) {
+    cmd.push_back("--capacity-gb");
+    cmd.push_back(std::to_string(spec.get("capacityGb").as_number(4)));
+  }
+  JsonObject c;
+  c["name"] = "cache-server";
+  c["image"] = spec.get("image").is_string()
+                   ? spec.get("image").as_string()
+                   : std::string("production-stack-tpu:latest");
+  c["command"] = Json(cmd);
+  JsonObject port_obj;
+  port_obj["containerPort"] = port;
+  c["ports"] = Json(JsonArray{Json(port_obj)});
+
+  JsonObject labels{{"app", Json(name)}};
+  JsonObject pod_spec;
+  pod_spec["containers"] = Json(JsonArray{Json(c)});
+  JsonObject pod_meta;
+  pod_meta["labels"] = Json(labels);
+  JsonObject tmpl;
+  tmpl["metadata"] = Json(pod_meta);
+  tmpl["spec"] = Json(pod_spec);
+  JsonObject match;
+  match["matchLabels"] = Json(labels);
+  JsonObject dspec;
+  dspec["replicas"] = static_cast<int>(spec.get("replicas").as_int(1));
+  dspec["selector"] = Json(match);
+  dspec["template"] = Json(tmpl);
+  JsonObject d;
+  d["apiVersion"] = "apps/v1";
+  d["kind"] = "Deployment";
+  d["metadata"] = make_metadata(cfg, name + "-cache", labels, cr,
+                                "CacheServer");
+  d["spec"] = Json(dspec);
+  return Json(d);
+}
+
+Json cache_service(const Config& cfg, const Json& cr) {
+  const Json& spec = cr.get("spec");
+  std::string name = cr.get("metadata").get("name").as_string();
+  int port = static_cast<int>(spec.get("port").as_int(8200));
+  JsonObject port_obj;
+  port_obj["name"] = "http";
+  port_obj["port"] = port;
+  port_obj["targetPort"] = port;
+  JsonObject sspec;
+  sspec["selector"] = Json(JsonObject{{"app", Json(name)}});
+  sspec["ports"] = Json(JsonArray{Json(port_obj)});
+  JsonObject s;
+  s["apiVersion"] = "v1";
+  s["kind"] = "Service";
+  s["metadata"] = make_metadata(cfg, name + "-cache-service",
+                                JsonObject{{"app", Json(name)}}, cr,
+                                "CacheServer");
+  s["spec"] = Json(sspec);
+  return Json(s);
+}
+
+// ---------------------------------------------------------------------- //
+// Generic ensure/drift helpers
+// ---------------------------------------------------------------------- //
+
+bool needs_update(const Json& existing, const Json& desired) {
+  const Json& ex_spec = existing.get("spec");
+  const Json& ds_spec = desired.get("spec");
+  if (ex_spec.get("replicas").as_int(1) !=
+      ds_spec.get("replicas").as_int(1))
+    return true;
+  const auto& ex_cs = ex_spec.get("template").get("spec")
+                          .get("containers").as_array();
+  const auto& ds_cs = ds_spec.get("template").get("spec")
+                          .get("containers").as_array();
+  if (ex_cs.size() != ds_cs.size()) return true;
+  for (size_t i = 0; i < ex_cs.size(); ++i) {
+    if (ex_cs[i].get("image").as_string() !=
+        ds_cs[i].get("image").as_string())
+      return true;
+    if (ex_cs[i].get("command").dump() != ds_cs[i].get("command").dump())
+      return true;
+  }
+  return false;
+}
+
+void ensure_object(const HttpClient& api, const std::string& list_path,
+                   const std::string& name, const Json& desired,
+                   bool check_drift) {
+  HttpResponse got = api.get(list_path + "/" + name);
+  if (got.status == 404) {
+    HttpResponse created = api.post(list_path, desired.dump());
+    log_line("create " + name + " -> " + std::to_string(created.status));
+    return;
+  }
+  if (!got.ok()) {
+    log_line("get " + name + " failed: " + std::to_string(got.status));
+    return;
+  }
+  if (!check_drift) return;
+  Json existing;
+  if (!Json::try_parse(got.body, &existing)) return;
+  if (needs_update(existing, desired)) {
+    Json updated = desired;
+    // Carry immutable/bookkeeping fields over.
+    updated["metadata"].object()["resourceVersion"] =
+        existing.get("metadata").get("resourceVersion");
+    HttpResponse put = api.put(list_path + "/" + name, updated.dump());
+    log_line("update " + name + " -> " + std::to_string(put.status));
+  }
+}
+
+void update_status(const HttpClient& api, const Config& cfg,
+                   const std::string& plural, const Json& cr,
+                   const std::string& deployment_name) {
+  std::string name = cr.get("metadata").get("name").as_string();
+  HttpResponse got = api.get(deploy_path(cfg, deployment_name));
+  std::string phase = "Pending";
+  int64_t ready = 0, wanted = 0;
+  if (got.ok()) {
+    Json dep;
+    if (Json::try_parse(got.body, &dep)) {
+      ready = dep.get("status").get("readyReplicas").as_int(0);
+      wanted = dep.get("spec").get("replicas").as_int(1);
+      if (ready >= wanted && wanted > 0) phase = "Ready";
+      else if (ready > 0) phase = "Updating";
+      else phase = "NotReady";
+    }
+  }
+  Json patch = cr;
+  JsonObject status;
+  status["phase"] = phase;
+  status["readyReplicas"] = static_cast<int>(ready);
+  status["replicas"] = static_cast<int>(wanted);
+  patch["status"] = Json(status);
+  api.put(cr_path(cfg, plural, name) + "/status", patch.dump());
+}
+
+// ---------------------------------------------------------------------- //
+// LoraAdapter reconciler: drive engine pods' LoRA HTTP API
+// ---------------------------------------------------------------------- //
+
+void update_status_raw(const HttpClient& api, const Config& cfg,
+                       const std::string& plural, const Json& cr,
+                       const Json& patch);
+
+void reconcile_lora(const HttpClient& api, const Config& cfg,
+                    const Json& cr) {
+  const Json& spec = cr.get("spec");
+  std::string adapter = spec.get("adapterName").as_string();
+  std::string app = spec.get("runtimeName").as_string();
+  if (adapter.empty() || app.empty()) return;
+  int port = static_cast<int>(spec.get("port").as_int(8000));
+
+  HttpResponse pods = api.get("/api/v1/namespaces/" + cfg.ns +
+                              "/pods?labelSelector=app%3D" + app);
+  if (!pods.ok()) return;
+  Json pod_list;
+  if (!Json::try_parse(pods.body, &pod_list)) return;
+
+  int loaded = 0;
+  for (const auto& pod : pod_list.get("items").as_array()) {
+    std::string ip = pod.get("status").get("podIP").as_string();
+    std::string pod_phase = pod.get("status").get("phase").as_string();
+    if (ip.empty() || pod_phase != "Running") continue;
+    HttpClient engine("http://" + ip + ":" + std::to_string(port), 5);
+    JsonObject body;
+    body["lora_name"] = adapter;
+    if (spec.has("rank"))
+      body["lora_rank"] = static_cast<int>(spec.get("rank").as_int(16));
+    HttpResponse r = engine.post("/v1/load_lora_adapter",
+                                 Json(body).dump());
+    if (r.ok()) ++loaded;
+  }
+
+  Json patch = cr;
+  JsonObject status;
+  status["loadedOn"] = loaded;
+  status["phase"] = loaded > 0 ? "Loaded" : "Pending";
+  patch["status"] = Json(status);
+  update_status_raw(api, cfg, "loraadapters", cr, patch);
+}
+
+void update_status_raw(const HttpClient& api, const Config& cfg,
+                       const std::string& plural, const Json& cr,
+                       const Json& patch) {
+  std::string name = cr.get("metadata").get("name").as_string();
+  api.put(cr_path(cfg, plural, name) + "/status", patch.dump());
+}
+
+// ---------------------------------------------------------------------- //
+// Reconcile pass
+// ---------------------------------------------------------------------- //
+
+void reconcile_once(const HttpClient& api, const Config& cfg) {
+  // TPURuntime
+  HttpResponse resp = api.get(cr_path(cfg, "tpuruntimes"));
+  Json list;
+  if (resp.ok() && Json::try_parse(resp.body, &list)) {
+    for (const auto& cr : list.get("items").as_array()) {
+      std::string name = cr.get("metadata").get("name").as_string();
+      ensure_object(api, svc_path(cfg), name + "-engine-service",
+                    runtime_service(cfg, cr), false);
+      ensure_object(api, deploy_path(cfg), name + "-engine",
+                    runtime_deployment(cfg, cr), true);
+      update_status(api, cfg, "tpuruntimes", cr, name + "-engine");
+    }
+  }
+  // TPURouter
+  resp = api.get(cr_path(cfg, "tpurouters"));
+  if (resp.ok() && Json::try_parse(resp.body, &list)) {
+    for (const auto& cr : list.get("items").as_array()) {
+      std::string name = cr.get("metadata").get("name").as_string();
+      ensure_object(api, "/api/v1/namespaces/" + cfg.ns +
+                        "/serviceaccounts", name + "-sa",
+                    router_service_account(cfg, cr), false);
+      ensure_object(api, svc_path(cfg), name + "-router-service",
+                    router_service(cfg, cr), false);
+      ensure_object(api, deploy_path(cfg), name + "-router",
+                    router_deployment(cfg, cr), true);
+      update_status(api, cfg, "tpurouters", cr, name + "-router");
+    }
+  }
+  // CacheServer
+  resp = api.get(cr_path(cfg, "cacheservers"));
+  if (resp.ok() && Json::try_parse(resp.body, &list)) {
+    for (const auto& cr : list.get("items").as_array()) {
+      std::string name = cr.get("metadata").get("name").as_string();
+      ensure_object(api, svc_path(cfg), name + "-cache-service",
+                    cache_service(cfg, cr), false);
+      ensure_object(api, deploy_path(cfg), name + "-cache",
+                    cache_deployment(cfg, cr), true);
+      update_status(api, cfg, "cacheservers", cr, name + "-cache");
+    }
+  }
+  // LoraAdapter
+  resp = api.get(cr_path(cfg, "loraadapters"));
+  if (resp.ok() && Json::try_parse(resp.body, &list)) {
+    for (const auto& cr : list.get("items").as_array())
+      reconcile_lora(api, cfg, cr);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Config cfg;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&](const char* flag) -> std::string {
+      if (i + 1 >= argc) {
+        std::fprintf(stderr, "missing value for %s\n", flag);
+        std::exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "--api-base") cfg.api_base = next("--api-base");
+    else if (a == "--namespace") cfg.ns = next("--namespace");
+    else if (a == "--interval") cfg.interval_sec = std::stoi(next("--interval"));
+    else if (a == "--once") cfg.once = true;
+    else if (a == "--help" || a == "-h") {
+      std::printf(
+          "tpu-stack-operator: reconciles production-stack.tpu/v1alpha1 "
+          "CRDs\n"
+          "  --api-base URL   plain-HTTP K8s API base "
+          "(default http://127.0.0.1:8001, e.g. kubectl proxy)\n"
+          "  --namespace NS   namespace to watch (default: default)\n"
+          "  --interval SEC   reconcile interval (default 5)\n"
+          "  --once           single reconcile pass, then exit\n");
+      return 0;
+    }
+  }
+
+  HttpClient api(cfg.api_base);
+  log_line("watching namespace " + cfg.ns + " via " + cfg.api_base);
+  do {
+    reconcile_once(api, cfg);
+    if (!cfg.once) ::sleep(cfg.interval_sec);
+  } while (!cfg.once);
+  return 0;
+}

@@ -1,0 +1,269 @@
+// Endpoint pickers — compiled equivalents of the reference's Go
+// gateway-inference-extension plugins:
+//
+//   - prefix-aware: concurrent xxhash64 chunk trie, chunk = 128 chars,
+//     longest-prefix-match intersected with available endpoints, random
+//     tiebreak, insert-after-pick
+//     (reference src/gateway_inference_extension/prefix_aware_picker.go:52-213)
+//   - round-robin: atomic counter over the sorted endpoint list
+//     (reference roundrobin_picker.go)
+//   - kv-aware: longest stored prefix lookup over engine-reported chunk
+//     admissions (reference kv_aware_picker.go:47-112, with the LMCache
+//     controller lookup replaced by in-process admit/evict reports)
+//
+// Exposed as a C ABI so it can back (a) the Python router via ctypes
+// (production_stack_tpu/native), and (b) any gateway sidecar directly.
+// Thread safety: one shared_mutex per picker; reads take shared locks.
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <set>
+#include <shared_mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "../common/xxhash64.h"
+
+namespace {
+
+constexpr size_t kChunkChars = 128;  // matches router.hashtrie / kv controller
+
+std::vector<uint64_t> chunk_hashes(const char* text, size_t len) {
+  std::vector<uint64_t> out;
+  out.reserve(len / kChunkChars + 1);
+  for (size_t i = 0; i < len; i += kChunkChars) {
+    size_t n = std::min(kChunkChars, len - i);
+    out.push_back(tpustack::xxhash64(text + i, n));
+  }
+  return out;
+}
+
+struct TrieNode {
+  std::map<uint64_t, std::unique_ptr<TrieNode>> children;
+  std::set<std::string> endpoints;
+};
+
+class PrefixTrie {
+ public:
+  void insert(const char* text, size_t len, const std::string& endpoint) {
+    auto hashes = chunk_hashes(text, len);
+    std::unique_lock lock(mu_);
+    TrieNode* node = &root_;
+    for (uint64_t h : hashes) {
+      auto& child = node->children[h];
+      if (!child) child = std::make_unique<TrieNode>();
+      child->endpoints.insert(endpoint);
+      node = child.get();
+    }
+  }
+
+  // Longest prefix whose holders intersect `available`; returns the
+  // matched endpoint set at that depth and the matched chunk count.
+  std::pair<std::set<std::string>, size_t> longest_match(
+      const char* text, size_t len,
+      const std::set<std::string>& available) const {
+    auto hashes = chunk_hashes(text, len);
+    std::shared_lock lock(mu_);
+    const TrieNode* node = &root_;
+    std::set<std::string> best;
+    size_t depth = 0;
+    for (uint64_t h : hashes) {
+      auto it = node->children.find(h);
+      if (it == node->children.end()) break;
+      std::set<std::string> live;
+      std::set_intersection(
+          it->second->endpoints.begin(), it->second->endpoints.end(),
+          available.begin(), available.end(),
+          std::inserter(live, live.begin()));
+      if (live.empty()) break;
+      best = std::move(live);
+      ++depth;
+      node = it->second.get();
+    }
+    return {best, depth};
+  }
+
+  void remove_endpoint(const std::string& endpoint) {
+    std::unique_lock lock(mu_);
+    remove_rec(&root_, endpoint);
+  }
+
+ private:
+  static void remove_rec(TrieNode* node, const std::string& endpoint) {
+    node->endpoints.erase(endpoint);
+    for (auto& [_, child] : node->children) remove_rec(child.get(), endpoint);
+  }
+
+  mutable std::shared_mutex mu_;
+  TrieNode root_;
+};
+
+class Picker {
+ public:
+  void set_endpoints(const std::vector<std::string>& eps) {
+    std::unique_lock lock(mu_);
+    endpoints_ = eps;
+    std::sort(endpoints_.begin(), endpoints_.end());
+    endpoint_set_ = std::set<std::string>(endpoints_.begin(),
+                                          endpoints_.end());
+  }
+
+  std::string pick_roundrobin() {
+    std::shared_lock lock(mu_);
+    if (endpoints_.empty()) return "";
+    uint64_t n = rr_counter_.fetch_add(1, std::memory_order_relaxed);
+    return endpoints_[n % endpoints_.size()];
+  }
+
+  // Prefix-aware pick: longest match wins; unmatched -> round robin;
+  // insert-after-pick so the chosen endpoint owns this prompt's chunks.
+  std::string pick_prefix(const char* text, size_t len) {
+    std::set<std::string> avail;
+    {
+      std::shared_lock lock(mu_);
+      if (endpoints_.empty()) return "";
+      avail = endpoint_set_;
+    }
+    auto [matched, depth] = trie_.longest_match(text, len, avail);
+    std::string chosen;
+    if (!matched.empty()) {
+      // Deterministic-seed random tiebreak (reference picks randomly).
+      std::vector<std::string> v(matched.begin(), matched.end());
+      std::uniform_int_distribution<size_t> dist(0, v.size() - 1);
+      std::unique_lock lock(mu_);
+      chosen = v[dist(rng_)];
+    } else {
+      chosen = pick_roundrobin();
+    }
+    if (!chosen.empty()) trie_.insert(text, len, chosen);
+    return chosen;
+  }
+
+  // KV-aware: engines report admitted/evicted chunk hash chains.
+  void kv_admit(const std::string& endpoint, const uint64_t* hashes,
+                size_t n) {
+    std::unique_lock lock(mu_);
+    auto* node = &kv_root_;
+    for (size_t i = 0; i < n; ++i) {
+      auto& child = node->children[hashes[i]];
+      if (!child) child = std::make_unique<TrieNode>();
+      child->endpoints.insert(endpoint);
+      node = child.get();
+    }
+  }
+
+  void kv_evict_endpoint(const std::string& endpoint) {
+    std::unique_lock lock(mu_);
+    evict_rec(&kv_root_, endpoint);
+  }
+
+  // Returns endpoint with the longest stored KV prefix, or "" (caller
+  // falls back to round robin, as the reference picker does).
+  std::string pick_kv_aware(const char* text, size_t len,
+                            size_t* matched_chars) {
+    auto hashes = chunk_hashes(text, len);
+    std::shared_lock lock(mu_);
+    const TrieNode* node = &kv_root_;
+    const std::set<std::string>* best = nullptr;
+    size_t depth = 0;
+    for (uint64_t h : hashes) {
+      auto it = node->children.find(h);
+      if (it == node->children.end()) break;
+      std::set<std::string> live;
+      for (const auto& e : it->second->endpoints)
+        if (endpoint_set_.count(e)) live.insert(e);
+      if (live.empty()) break;
+      node = it->second.get();
+      best = &node->endpoints;
+      ++depth;
+    }
+    if (matched_chars)
+      *matched_chars = std::min(depth * kChunkChars, len);
+    if (!best || depth == 0) return "";
+    for (const auto& e : *best)
+      if (endpoint_set_.count(e)) return e;
+    return "";
+  }
+
+  void remove_endpoint_state(const std::string& endpoint) {
+    trie_.remove_endpoint(endpoint);
+    kv_evict_endpoint(endpoint);
+  }
+
+ private:
+  static void evict_rec(TrieNode* node, const std::string& endpoint) {
+    node->endpoints.erase(endpoint);
+    for (auto& [_, child] : node->children) evict_rec(child.get(), endpoint);
+  }
+
+  mutable std::shared_mutex mu_;
+  std::vector<std::string> endpoints_;
+  std::set<std::string> endpoint_set_;
+  std::atomic<uint64_t> rr_counter_{0};
+  PrefixTrie trie_;
+  TrieNode kv_root_;
+  std::mt19937_64 rng_{0xC0FFEE};
+};
+
+thread_local std::string g_last_result;
+
+}  // namespace
+
+extern "C" {
+
+void* tpu_picker_create() { return new Picker(); }
+
+void tpu_picker_destroy(void* p) { delete static_cast<Picker*>(p); }
+
+// endpoints: '\n'-separated list.
+void tpu_picker_set_endpoints(void* p, const char* endpoints) {
+  std::vector<std::string> eps;
+  const char* start = endpoints;
+  for (const char* c = endpoints;; ++c) {
+    if (*c == '\n' || *c == '\0') {
+      if (c > start) eps.emplace_back(start, c - start);
+      if (*c == '\0') break;
+      start = c + 1;
+    }
+  }
+  static_cast<Picker*>(p)->set_endpoints(eps);
+}
+
+const char* tpu_picker_pick_roundrobin(void* p) {
+  g_last_result = static_cast<Picker*>(p)->pick_roundrobin();
+  return g_last_result.c_str();
+}
+
+const char* tpu_picker_pick_prefix(void* p, const char* text, size_t len) {
+  g_last_result = static_cast<Picker*>(p)->pick_prefix(text, len);
+  return g_last_result.c_str();
+}
+
+const char* tpu_picker_pick_kv(void* p, const char* text, size_t len,
+                               size_t* matched_chars) {
+  g_last_result =
+      static_cast<Picker*>(p)->pick_kv_aware(text, len, matched_chars);
+  return g_last_result.c_str();
+}
+
+void tpu_picker_kv_admit(void* p, const char* endpoint,
+                         const uint64_t* hashes, size_t n) {
+  static_cast<Picker*>(p)->kv_admit(endpoint, hashes, n);
+}
+
+void tpu_picker_remove_endpoint(void* p, const char* endpoint) {
+  static_cast<Picker*>(p)->remove_endpoint_state(endpoint);
+}
+
+uint64_t tpu_xxhash64(const char* data, size_t len) {
+  return tpustack::xxhash64(data, len);
+}
+
+}  // extern "C"

@@ -91,7 +91,8 @@ class EngineCore:
         self._kv_sharding = kv_pages_sharding(self.model_config, self.mesh)
         self.kv = self._alloc_kv()
         self.kv_mgr = KVCacheManager(
-            self.num_blocks, config.block_size, config.enable_prefix_caching
+            self.num_blocks, config.block_size, config.enable_prefix_caching,
+            namespace=config.model,
         )
         self.scheduler = Scheduler(
             self.kv_mgr, config.max_num_seqs, config.max_model_len
@@ -99,6 +100,7 @@ class EngineCore:
 
         # -- KV offload tier (LMCache-equivalent, SURVEY §7 step 4) --------
         self.offload = None
+        self._pending_offload: "list[tuple[int, int]]" = []
         if config.kv_offload_bytes > 0 or config.kv_remote_url:
             from production_stack_tpu.kv.offload import HostKVStore
 
@@ -213,14 +215,27 @@ class EngineCore:
 
     # -- KV offload / transfer helpers ------------------------------------
     def _offload_block(self, prefix_hash: int, bid: int) -> None:
-        """Allocator eviction hook: spill a cached block's pages to host RAM
-        (runs on the engine thread, under the step lock)."""
+        """Allocator eviction hook: queue a cached block for spill to host
+        RAM. The hook can fire under ``self._lock`` (decode-path block
+        accounting), so the actual device_get happens later in
+        :meth:`_drain_offload`, after the lock is released but before any
+        forward step overwrites the recycled pages."""
         if self.offload is None or self.kv is None:
             return
+        self._pending_offload.append((prefix_hash, bid))
+
+    def _drain_offload(self) -> None:
+        """Copy queued evicted blocks to the host store (engine thread,
+        under _step_lock, no _lock held)."""
+        if not self._pending_offload or self.kv is None:
+            self._pending_offload.clear()
+            return
         k_pages, v_pages = self.kv
-        k = np.asarray(jax.device_get(k_pages[:, bid]))
-        v = np.asarray(jax.device_get(v_pages[:, bid]))
-        self.offload.put(prefix_hash, k, v)
+        for prefix_hash, bid in self._pending_offload:
+            k = np.asarray(jax.device_get(k_pages[:, bid]))
+            v = np.asarray(jax.device_get(v_pages[:, bid]))
+            self.offload.put(prefix_hash, k, v)
+        self._pending_offload.clear()
 
     def _restore_blocks(self, restores) -> bool:
         """Copy offloaded pages back into HBM. Returns False on any miss."""
@@ -232,7 +247,7 @@ class EngineCore:
             self.kv = self._write_block_fn(self.kv, bid, k, v)
         return True
 
-    def extract_kv(self, token_ids: List[int], adapter_id: int = 0):
+    def extract_kv(self, token_ids: List[int], adapter: str = ""):
         """Serialize the KV pages of the longest cached prefix of
         ``token_ids`` (disaggregated-prefill sender side; the NIXL-pipe
         replacement, SURVEY §2.3). Returns dict or None."""
@@ -240,7 +255,7 @@ class EngineCore:
 
         bs = self.config.block_size
         alloc = self.kv_mgr.allocator
-        parent = f"adapter:{adapter_id}" if adapter_id else None
+        parent = self.kv_mgr.chain_root(adapter)
         hashes: List[int] = []
         bids: List[int] = []
         with self._step_lock:
@@ -289,6 +304,9 @@ class EngineCore:
                     bid = alloc.allocate()
                 if bid is None:
                     break
+                # Spill anything evicted by the allocate before its pages
+                # are overwritten below.
+                self._drain_offload()
                 self.kv = self._write_block_fn(
                     self.kv, bid, np.asarray(k_b), np.asarray(v_b)
                 )
@@ -319,6 +337,7 @@ class EngineCore:
             sampling=sampling,
             on_token=on_token,
             adapter_id=adapter_id,
+            adapter_name=(adapter_name or "") if adapter_id else "",
         )
         with self._lock:
             self.scheduler.add(req)
@@ -499,8 +518,9 @@ class EngineCore:
         tokens = req.all_token_ids
         n = len(tokens)
         alloc = self.kv_mgr.allocate_prompt(
-            req.request_id, tokens, adapter_id=req.adapter_id
+            req.request_id, tokens, adapter=req.adapter_name
         )
+        self._drain_offload()
         if alloc is None:
             # Raced out of blocks; requeue.
             with self._lock:
@@ -524,10 +544,11 @@ class EngineCore:
             self.kv_mgr.external_lookup = None
             try:
                 alloc = self.kv_mgr.allocate_prompt(
-                    req.request_id, tokens, adapter_id=req.adapter_id
+                    req.request_id, tokens, adapter=req.adapter_name
                 )
             finally:
                 self.kv_mgr.external_lookup = ext
+            self._drain_offload()
             if alloc is None:
                 with self._lock:
                     self.scheduler.waiting.appendleft(req)
@@ -538,7 +559,13 @@ class EngineCore:
         # attend to the cached prefix via the HBM pages (prefill_cached).
         ns = n - cached
         bucket = cfg.bucket_for(ns)
-        maxb = cfg.max_blocks_per_seq
+        # Bucket the block-table width too (power of two): cached prefill
+        # attention gathers the whole table, so its cost must scale with the
+        # real context, not max_model_len.
+        maxb = 1
+        while maxb < len(block_ids):
+            maxb *= 2
+        maxb = min(maxb, cfg.max_blocks_per_seq)
 
         token_arr = np.zeros((1, bucket), np.int32)
         token_arr[0, :ns] = tokens[cached:]
@@ -595,6 +622,7 @@ class EngineCore:
                         seq.req.request_id, seq.req.all_token_ids[-1]
                     )
             active = self.scheduler.running()
+        self._drain_offload()  # spill pages evicted during block accounting
         if not active:
             return
 

@@ -157,15 +157,28 @@ class SequenceBlocks:
 class KVCacheManager:
     """Per-sequence block table maintenance on top of the allocator."""
 
-    def __init__(self, num_blocks: int, block_size: int, enable_prefix_caching: bool = True):
+    def __init__(self, num_blocks: int, block_size: int,
+                 enable_prefix_caching: bool = True, namespace: str = ""):
         self.allocator = BlockAllocator(num_blocks, block_size, enable_prefix_caching)
         self.block_size = block_size
         self.seqs: Dict[str, SequenceBlocks] = {}
+        # Hash-chain namespace root, usually the model name: keeps KV shared
+        # through the remote cache server / cross-engine transfer from
+        # matching across different models.
+        self.namespace = namespace
         # Optional second-tier lookup (host-RAM / remote KV store): called as
         # external_lookup(prefix_hash) -> bool. A hit means the block's pages
         # can be restored into HBM by the engine (see allocate_prompt's
         # ``restores`` return).
         self.external_lookup = None
+
+    def chain_root(self, adapter: str = "") -> "str | None":
+        """Root value for the prefix hash chain. Adapter names (stable
+        across engines, unlike slot indices) and the model namespace both
+        partition the cache."""
+        if not self.namespace and not adapter:
+            return None
+        return f"{self.namespace}|{adapter}"
 
     def can_allocate(self, num_tokens: int) -> bool:
         needed = (num_tokens + self.block_size - 1) // self.block_size
@@ -178,7 +191,7 @@ class KVCacheManager:
         )
 
     def allocate_prompt(
-        self, seq_id: str, tokens: List[int], adapter_id: int = 0
+        self, seq_id: str, tokens: List[int], adapter: str = ""
     ) -> Optional[Tuple[List[int], int, List[Tuple[int, int]]]]:
         """Allocate blocks for a prompt.
 
@@ -187,14 +200,12 @@ class KVCacheManager:
         (``cached_tokens`` tells the engine how much prefill to skip);
         ``restores`` lists ``(block_id, prefix_hash)`` pairs whose pages must
         be copied back into HBM from the offload tier before use (they count
-        as cached). ``adapter_id`` namespaces the hash chain: LoRA adapters
-        alter the V projection, so KV pages are only shareable within one
-        adapter."""
+        as cached). ``adapter`` (a LoRA adapter *name*, stable across
+        engines) namespaces the hash chain: adapters alter the V projection,
+        so KV pages are only shareable within one adapter."""
         bs = self.block_size
         seq = SequenceBlocks(num_tokens=len(tokens))
-        # Root of the hash chain; ints are never confused with chain hashes
-        # because chain_hash feeds str(parent) into xxhash either way.
-        parent = f"adapter:{adapter_id}" if adapter_id else None
+        parent = self.chain_root(adapter)
         i = 0
         restores: List[Tuple[int, int]] = []
         # Reuse cached full blocks for the longest matching prefix. Never
@@ -227,6 +238,13 @@ class KVCacheManager:
         for _ in range(n_new):
             bid = self.allocator.allocate()
             if bid is None:
+                # Restore blocks were registered before their pages were
+                # written; unregister them or release() would keep them as
+                # cold cache pointing at garbage pages.
+                for rbid, h in restores:
+                    if self.allocator.prefix_map.get(h) == rbid:
+                        del self.allocator.prefix_map[h]
+                    self.allocator.blocks[rbid].prefix_hash = None
                 for b in fresh:
                     self.allocator.release(b)
                 for b in seq.block_ids:

@@ -43,7 +43,8 @@ class EngineRequest:
     sampling: SamplingParams
     # Called from the engine thread: (token_id | None, finish_reason | None).
     on_token: Callable[[Optional[int], Optional[str]], None]
-    adapter_id: int = 0
+    adapter_id: int = 0  # LoRA slot (engine-local, selects weights)
+    adapter_name: str = ""  # stable name (namespaces the KV hash chain)
     arrival_time: float = field(default_factory=time.time)
     output_token_ids: List[int] = field(default_factory=list)
     status: RequestStatus = RequestStatus.WAITING

@@ -445,10 +445,9 @@ class EngineServer:
 
         body = await request.json()
         token_ids = self._tokens_from_body(body)
-        adapter = self._resolve_adapter(body.get("model", ""))
-        adapter_id = self.core.lora_slots.get(adapter or "", 0)
+        adapter = self._resolve_adapter(body.get("model", "")) or ""
         payload = await asyncio.get_running_loop().run_in_executor(
-            None, lambda: self.core.extract_kv(token_ids, adapter_id)
+            None, lambda: self.core.extract_kv(token_ids, adapter)
         )
         if payload is None:
             return web.json_response(

@@ -243,10 +243,17 @@ class HostKVStore:
         if self.remote is not None:
             data = self.remote.get(prefix_hash)
             if data is not None:
-                k, v = unpack_block(data)
-                self.hits += 1
-                return k, v
-        self.misses += 1
+                try:
+                    k, v = unpack_block(data)
+                except Exception as e:  # noqa: BLE001 - corrupt remote block
+                    logger.warning("corrupt remote KV block %d: %s",
+                                   prefix_hash, e)
+                else:
+                    with self._lock:
+                        self.hits += 1
+                    return k, v
+        with self._lock:
+            self.misses += 1
         return None
 
     def contains(self, prefix_hash: int) -> bool:

@@ -1,0 +1,139 @@
+"""ctypes bindings for the native (C++) components.
+
+``libtpu_stack_pickers.so`` implements the endpoint pickers (prefix-aware
+xxhash trie, round robin, kv-aware) — the compiled-router work the reference
+does in Go gateway plugins (``src/gateway_inference_extension/``). The
+Python router uses :class:`NativePicker` when the library is built
+(``cmake -S native -B native/build && cmake --build native/build``) and
+falls back to the pure-Python implementations otherwise.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Tuple
+
+_LIB_ENV = "TPU_STACK_NATIVE_LIB"
+_lib = None
+_load_attempted = False
+
+
+def _candidate_paths() -> List[str]:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    names = ["libtpu_stack_pickers.so"]
+    dirs = [
+        os.environ.get(_LIB_ENV, ""),
+        os.path.join(here, "native", "build"),
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "lib"),
+    ]
+    out = []
+    for d in dirs:
+        if not d:
+            continue
+        if d.endswith(".so"):
+            out.append(d)
+            continue
+        for n in names:
+            out.append(os.path.join(d, n))
+    return out
+
+
+def _load():
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    for path in _candidate_paths():
+        if not os.path.exists(path):
+            continue
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            continue
+        lib.tpu_picker_create.restype = ctypes.c_void_p
+        lib.tpu_picker_destroy.argtypes = [ctypes.c_void_p]
+        lib.tpu_picker_set_endpoints.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p]
+        lib.tpu_picker_pick_roundrobin.argtypes = [ctypes.c_void_p]
+        lib.tpu_picker_pick_roundrobin.restype = ctypes.c_char_p
+        lib.tpu_picker_pick_prefix.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+        lib.tpu_picker_pick_prefix.restype = ctypes.c_char_p
+        lib.tpu_picker_pick_kv.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t)]
+        lib.tpu_picker_pick_kv.restype = ctypes.c_char_p
+        lib.tpu_picker_kv_admit.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t]
+        lib.tpu_picker_remove_endpoint.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p]
+        lib.tpu_xxhash64.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.tpu_xxhash64.restype = ctypes.c_uint64
+        _lib = lib
+        break
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def xxhash64(data: bytes) -> int:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library not built")
+    return int(lib.tpu_xxhash64(data, len(data)))
+
+
+class NativePicker:
+    """Endpoint picker backed by the C++ shared library."""
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                "native library not built; run "
+                "`cmake -S native -B native/build && "
+                "cmake --build native/build`"
+            )
+        self._lib = lib
+        self._handle = lib.tpu_picker_create()
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.tpu_picker_destroy(handle)
+            self._handle = None
+
+    def set_endpoints(self, endpoints: List[str]) -> None:
+        blob = "\n".join(endpoints).encode()
+        self._lib.tpu_picker_set_endpoints(self._handle, blob)
+
+    def pick_roundrobin(self) -> Optional[str]:
+        out = self._lib.tpu_picker_pick_roundrobin(self._handle)
+        return out.decode() or None
+
+    def pick_prefix(self, prompt: str) -> Optional[str]:
+        data = prompt.encode()
+        out = self._lib.tpu_picker_pick_prefix(
+            self._handle, data, len(data))
+        return out.decode() or None
+
+    def pick_kv(self, prompt: str) -> Tuple[Optional[str], int]:
+        data = prompt.encode()
+        matched = ctypes.c_size_t(0)
+        out = self._lib.tpu_picker_pick_kv(
+            self._handle, data, len(data), ctypes.byref(matched))
+        return (out.decode() or None), int(matched.value)
+
+    def kv_admit(self, endpoint: str, hashes: List[int]) -> None:
+        arr = (ctypes.c_uint64 * len(hashes))(*hashes)
+        self._lib.tpu_picker_kv_admit(
+            self._handle, endpoint.encode(), arr, len(hashes))
+
+    def remove_endpoint(self, endpoint: str) -> None:
+        self._lib.tpu_picker_remove_endpoint(
+            self._handle, endpoint.encode())

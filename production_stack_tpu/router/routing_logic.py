@@ -173,10 +173,26 @@ class PrefixAwareRouter(RoutingInterface):
     Same-prefix requests land on the same engine so its KV prefix cache hits;
     ties broken randomly; the chosen (prompt, endpoint) pair is inserted back
     into the trie after the pick.
+
+    When the native (C++) picker library is built, the trie lives there —
+    the compiled-router path that the reference provides as a Go gateway
+    plugin (``prefix_aware_picker.go``). Hash chunking is identical
+    (xxhash64 over 128-char chunks), so the two backends route alike.
     """
 
-    def __init__(self, chunk_size: int = 128):
+    def __init__(self, chunk_size: int = 128, use_native: bool = True):
         self.trie = HashTrie(chunk_size=chunk_size)
+        self._native = None
+        if use_native:
+            try:
+                from production_stack_tpu import native
+
+                if native.available():
+                    self._native = native.NativePicker()
+                    logger.info(
+                        "PrefixAwareRouter using native C++ picker")
+            except Exception:  # noqa: BLE001 - fall back to Python trie
+                self._native = None
 
     async def route_request(
         self, endpoints, engine_stats, request_stats, request_headers,
@@ -187,6 +203,12 @@ class PrefixAwareRouter(RoutingInterface):
         prompt = _extract_prompt(request_json)
         available = {e.url for e in endpoints}
         if not prompt:
+            return random.choice(sorted(available))
+        if self._native is not None:
+            self._native.set_endpoints(sorted(available))
+            url = self._native.pick_prefix(prompt)
+            if url:
+                return url
             return random.choice(sorted(available))
         matched, candidates = await self.trie.longest_prefix_match(
             prompt, available

@@ -1,0 +1,341 @@
+"""Native (C++) component tests: xxhash parity, pickers, and the operator
+binary reconciling against a fake Kubernetes API server."""
+
+import asyncio
+import json
+import os
+import shutil
+import subprocess
+
+import pytest
+import xxhash
+from aiohttp import web
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUILD_DIR = os.path.join(REPO, "native", "build")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def build_native():
+    if not shutil.which("cmake"):
+        pytest.skip("cmake not available")
+    subprocess.run(
+        ["cmake", "-S", os.path.join(REPO, "native"), "-B", BUILD_DIR,
+         "-G", "Ninja" if shutil.which("ninja") else "Unix Makefiles"],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["cmake", "--build", BUILD_DIR], check=True, capture_output=True,
+    )
+    os.environ["TPU_STACK_NATIVE_LIB"] = BUILD_DIR
+    # Force a re-probe after setting the env var.
+    import production_stack_tpu.native as native
+
+    native._load_attempted = False
+    native._lib = None
+    assert native.available()
+
+
+def test_xxhash64_parity():
+    from production_stack_tpu.native import xxhash64
+
+    cases = [b"", b"a", b"abc", b"abcd", b"12345678", b"x" * 17,
+             b"y" * 31, b"z" * 32, b"w" * 33, b"q" * 100, b"m" * 1000,
+             "unicode ✓ text".encode()]
+    for data in cases:
+        assert xxhash64(data) == xxhash.xxh64_intdigest(data), data
+
+
+def test_native_roundrobin():
+    from production_stack_tpu.native import NativePicker
+
+    p = NativePicker()
+    p.set_endpoints(["http://b", "http://a", "http://c"])
+    picks = [p.pick_roundrobin() for _ in range(6)]
+    assert picks[:3] == ["http://a", "http://b", "http://c"]  # sorted order
+    assert picks[3:] == picks[:3]
+
+
+def test_native_prefix_stickiness():
+    from production_stack_tpu.native import NativePicker
+
+    p = NativePicker()
+    p.set_endpoints(["http://e1", "http://e2", "http://e3", "http://e4"])
+    prompt = "shared system prompt " * 20  # several 128-char chunks
+    first = p.pick_prefix(prompt + "user A")
+    # Same long prefix must route to the same endpoint.
+    for suffix in ("user B", "user C", "user D"):
+        assert p.pick_prefix(prompt + suffix) == first
+
+
+def test_native_prefix_respects_endpoint_removal():
+    from production_stack_tpu.native import NativePicker
+
+    p = NativePicker()
+    p.set_endpoints(["http://e1", "http://e2"])
+    prompt = "p" * 300
+    first = p.pick_prefix(prompt)
+    p.remove_endpoint(first)
+    remaining = [e for e in ("http://e1", "http://e2") if e != first]
+    p.set_endpoints(remaining)
+    assert p.pick_prefix(prompt) == remaining[0]
+
+
+def test_native_kv_aware():
+    from production_stack_tpu.native import NativePicker
+
+    p = NativePicker()
+    p.set_endpoints(["http://e1", "http://e2"])
+    prompt = "k" * 400  # 4 chunks of 128 -> 3 full + remainder
+    hashes = [
+        xxhash.xxh64_intdigest(prompt[i:i + 128])
+        for i in range(0, len(prompt), 128)
+    ]
+    endpoint, matched = p.pick_kv(prompt)
+    assert endpoint is None and matched == 0
+    p.kv_admit("http://e2", hashes)
+    endpoint, matched = p.pick_kv(prompt)
+    assert endpoint == "http://e2"
+    assert matched == len(prompt)
+    # Dead endpoints are filtered out.
+    p.set_endpoints(["http://e1"])
+    endpoint, _ = p.pick_kv(prompt)
+    assert endpoint is None
+
+
+# --------------------------------------------------------------------- #
+# Operator binary against a fake K8s API server
+# --------------------------------------------------------------------- #
+
+
+class FakeK8s:
+    """Tiny in-memory Kubernetes API server covering what the operator
+    uses: CR lists, deployments, services, serviceaccounts, pods, status
+    subresources."""
+
+    def __init__(self):
+        self.objects = {}  # path -> body dict
+        self.crs = {}      # plural -> [cr dicts]
+        self.pods = []
+        self.status_updates = []
+
+    def make_app(self):
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self.handle)
+        return app
+
+    async def handle(self, request: web.Request) -> web.Response:
+        path = "/" + request.match_info["tail"]
+        method = request.method
+        if "/pods" in path and method == "GET":
+            return web.json_response({"items": self.pods})
+        if "production-stack.tpu" in path:
+            parts = path.rstrip("/").split("/")
+            if path.endswith("/status") and method == "PUT":
+                body = json.loads(await request.text())
+                self.status_updates.append((path, body))
+                return web.json_response(body)
+            plural = parts[-1]
+            if method == "GET" and plural in self.crs:
+                return web.json_response({"items": self.crs[plural]})
+            return web.json_response({"items": []})
+        # Core objects (deployments/services/serviceaccounts).
+        if method == "GET":
+            if path in self.objects:
+                return web.json_response(self.objects[path])
+            return web.json_response({"reason": "NotFound"}, status=404)
+        if method == "POST":
+            body = json.loads(await request.text())
+            name = body["metadata"]["name"]
+            self.objects[path + "/" + name] = body
+            return web.json_response(body, status=201)
+        if method == "PUT":
+            body = json.loads(await request.text())
+            self.objects[path] = body
+            return web.json_response(body)
+        return web.json_response({}, status=405)
+
+
+def _run_operator(api_url: str):
+    binary = os.path.join(BUILD_DIR, "tpu-stack-operator")
+    return subprocess.run(
+        [binary, "--api-base", api_url, "--namespace", "default", "--once"],
+        capture_output=True, timeout=60,
+    )
+
+
+def test_operator_reconciles_tpuruntime():
+    fake = FakeK8s()
+    fake.crs["tpuruntimes"] = [{
+        "metadata": {"name": "llama8b", "uid": "uid-1"},
+        "spec": {
+            "model": "meta-llama/Llama-3-8B",
+            "replicas": 2,
+            "port": 8000,
+            "tensorParallelSize": 8,
+            "maxModelLen": 4096,
+            "tpu": {"chips": 8, "accelerator": "tpu-v5-lite-podslice",
+                    "topology": "2x4"},
+        },
+    }]
+
+    async def run():
+        runner = web.AppRunner(fake.make_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        url = f"http://127.0.0.1:{port}"
+        proc = await asyncio.get_running_loop().run_in_executor(
+            None, _run_operator, url)
+        await runner.cleanup()
+        return proc
+
+    proc = asyncio.run(run())
+    assert proc.returncode == 0, proc.stderr
+
+    dep_key = "/apis/apps/v1/namespaces/default/deployments/llama8b-engine"
+    assert dep_key in fake.objects, list(fake.objects)
+    dep = fake.objects[dep_key]
+    assert dep["spec"]["replicas"] == 2
+    container = dep["spec"]["template"]["spec"]["containers"][0]
+    cmd = container["command"]
+    assert "production_stack_tpu.engine.server" in cmd
+    assert "meta-llama/Llama-3-8B" in cmd
+    assert "--tensor-parallel-size" in cmd and "8" in cmd
+    # TPU resources, not nvidia.com/gpu.
+    assert container["resources"]["limits"] == {"google.com/tpu": 8}
+    sel = dep["spec"]["template"]["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x4"
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == \
+        "tpu-v5-lite-podslice"
+    # Service + status update happened.
+    svc_key = "/api/v1/namespaces/default/services/llama8b-engine-service"
+    assert svc_key in fake.objects
+    assert any("tpuruntimes/llama8b/status" in p
+               for p, _ in fake.status_updates)
+
+
+def test_operator_reconciles_router_and_cache():
+    fake = FakeK8s()
+    fake.crs["tpurouters"] = [{
+        "metadata": {"name": "rt", "uid": "uid-2"},
+        "spec": {"replicas": 1, "port": 8080, "routingLogic": "roundrobin",
+                 "serviceDiscovery": "k8s"},
+    }]
+    fake.crs["cacheservers"] = [{
+        "metadata": {"name": "kvc", "uid": "uid-3"},
+        "spec": {"replicas": 1, "port": 8200, "capacityGb": 16},
+    }]
+
+    async def run():
+        runner = web.AppRunner(fake.make_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        proc = await asyncio.get_running_loop().run_in_executor(
+            None, _run_operator, f"http://127.0.0.1:{port}")
+        await runner.cleanup()
+        return proc
+
+    proc = asyncio.run(run())
+    assert proc.returncode == 0, proc.stderr
+
+    router_dep = fake.objects[
+        "/apis/apps/v1/namespaces/default/deployments/rt-router"]
+    cmd = router_dep["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "production_stack_tpu.router.app" in cmd
+    assert "--routing-logic" in cmd and "roundrobin" in cmd
+    assert "/api/v1/namespaces/default/serviceaccounts/rt-sa" in fake.objects
+
+    cache_dep = fake.objects[
+        "/apis/apps/v1/namespaces/default/deployments/kvc-cache"]
+    ccmd = cache_dep["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "production_stack_tpu.kv.cache_server" in ccmd
+
+
+def test_operator_detects_drift():
+    fake = FakeK8s()
+    fake.crs["tpuruntimes"] = [{
+        "metadata": {"name": "m", "uid": "u"},
+        "spec": {"model": "tiny-llama", "replicas": 3, "port": 8000},
+    }]
+    # Pre-existing deployment with stale replicas.
+    dep_key = "/apis/apps/v1/namespaces/default/deployments/m-engine"
+    fake.objects[dep_key] = {
+        "metadata": {"name": "m-engine", "resourceVersion": "42"},
+        "spec": {
+            "replicas": 1,
+            "template": {"spec": {"containers": [{
+                "name": "engine", "image": "production-stack-tpu:latest",
+                "command": ["stale"],
+            }]}},
+        },
+    }
+
+    async def run():
+        runner = web.AppRunner(fake.make_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        proc = await asyncio.get_running_loop().run_in_executor(
+            None, _run_operator, f"http://127.0.0.1:{port}")
+        await runner.cleanup()
+        return proc
+
+    proc = asyncio.run(run())
+    assert proc.returncode == 0, proc.stderr
+    dep = fake.objects[dep_key]
+    assert dep["spec"]["replicas"] == 3  # drift corrected
+    assert dep["metadata"]["resourceVersion"] == "42"  # carried over
+
+
+def test_operator_loads_lora_adapters():
+    fake = FakeK8s()
+    lora_calls = []
+
+    engine_app = web.Application()
+
+    async def load_lora(request):
+        lora_calls.append(await request.json())
+        return web.json_response({"status": "ok"})
+
+    engine_app.router.add_post("/v1/load_lora_adapter", load_lora)
+
+    async def run():
+        eng_runner = web.AppRunner(engine_app)
+        await eng_runner.setup()
+        eng_site = web.TCPSite(eng_runner, "127.0.0.1", 0)
+        await eng_site.start()
+        eng_port = eng_site._server.sockets[0].getsockname()[1]
+
+        fake.crs["loraadapters"] = [{
+            "metadata": {"name": "ad1", "uid": "u-l"},
+            "spec": {"adapterName": "sql-adapter", "runtimeName": "m",
+                     "rank": 8, "port": eng_port},
+        }]
+        fake.pods = [{
+            "metadata": {"name": "m-pod-1", "labels": {"app": "m"}},
+            "status": {"podIP": "127.0.0.1", "phase": "Running"},
+        }]
+
+        api_runner = web.AppRunner(fake.make_app())
+        await api_runner.setup()
+        api_site = web.TCPSite(api_runner, "127.0.0.1", 0)
+        await api_site.start()
+        api_port = api_site._server.sockets[0].getsockname()[1]
+
+        proc = await asyncio.get_running_loop().run_in_executor(
+            None, _run_operator, f"http://127.0.0.1:{api_port}")
+        await api_runner.cleanup()
+        await eng_runner.cleanup()
+        return proc
+
+    proc = asyncio.run(run())
+    assert proc.returncode == 0, proc.stderr
+    assert lora_calls == [{"lora_name": "sql-adapter", "lora_rank": 8}]
+    assert any("loraadapters/ad1/status" in p and
+               b["status"]["phase"] == "Loaded"
+               for p, b in fake.status_updates)

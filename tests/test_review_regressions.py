@@ -10,18 +10,29 @@ from production_stack_tpu.engine.tokenizer import (
 
 
 def test_prefix_cache_is_adapter_namespaced():
-    mgr = KVCacheManager(num_blocks=64, block_size=4)
+    mgr = KVCacheManager(num_blocks=64, block_size=4, namespace="m")
     tokens = list(range(16))
-    mgr.allocate_prompt("base", tokens, adapter_id=0)
+    mgr.allocate_prompt("base", tokens, adapter="")
     base_blocks = list(mgr.block_table("base"))
     # Same prompt under a LoRA adapter must NOT share the base KV pages.
-    mgr.allocate_prompt("lora", tokens, adapter_id=3)
+    mgr.allocate_prompt("lora", tokens, adapter="my-adapter")
     lora_blocks = list(mgr.block_table("lora"))
     assert not set(base_blocks) & set(lora_blocks)
     # But the same adapter does share (all but the final block, which is
     # recomputed to produce logits).
-    mgr.allocate_prompt("lora2", tokens, adapter_id=3)
+    mgr.allocate_prompt("lora2", tokens, adapter="my-adapter")
     assert mgr.seqs["lora2"].num_cached_tokens == 12
+
+
+def test_prefix_cache_is_model_namespaced():
+    mgr_a = KVCacheManager(num_blocks=64, block_size=4, namespace="model-a")
+    mgr_b = KVCacheManager(num_blocks=64, block_size=4, namespace="model-b")
+    tokens = list(range(16))
+    # The chain roots differ, so the hash chains (and thus anything shared
+    # through a remote cache server) cannot collide across models.
+    mgr_a.allocate_prompt("s", tokens)
+    mgr_b.allocate_prompt("s", tokens)
+    assert set(mgr_a.allocator.prefix_map) != set(mgr_b.allocator.prefix_map)
 
 
 def test_no_block_leak_on_aliased_prefix_hash():
