@@ -1,0 +1,271 @@
+#!/usr/bin/env python3
+"""Multi-round QA serving benchmark.
+
+The stack's headline load generator, shape-compatible with the reference's
+``benchmarks/multi-round-qa/multi-round-qa.py``: N concurrent users hold
+M-round conversations against an OpenAI-compatible endpoint (the router),
+each request streaming; measures TTFT (first content chunk), per-request
+latency, prompt/generation throughput, and writes a per-request CSV plus a
+summary JSON line.
+
+Example (BASELINE config 1 smoke):
+    python benchmarks/multi_round_qa.py \
+        --base-url http://localhost:8000 --model facebook/opt-125m \
+        --num-users 15 --num-rounds 20 --qps 0.5 \
+        --shared-system-prompt 1000 --user-history-prompt 20000 \
+        --answer-len 100 --time 100 --output run.csv
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import csv
+import json
+import random
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import aiohttp
+
+
+def words(n: int, tag: str, seed: int = 0) -> str:
+    rng = random.Random(seed)
+    vocab = [f"{tag}{i}" for i in range(max(16, n // 10))]
+    return " ".join(rng.choice(vocab) for _ in range(n))
+
+
+@dataclass
+class RequestRecord:
+    user_id: int
+    round_id: int
+    start: float
+    ttft: Optional[float] = None
+    end: Optional[float] = None
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    generated_text: str = ""
+    error: Optional[str] = None
+
+    @property
+    def latency(self) -> Optional[float]:
+        return (self.end - self.start) if self.end else None
+
+
+@dataclass
+class UserSession:
+    user_id: int
+    system_prompt: str
+    history: List[dict] = field(default_factory=list)
+    rounds_done: int = 0
+
+
+class MultiRoundQA:
+    def __init__(self, args):
+        self.args = args
+        self.records: List[RequestRecord] = []
+        self.start_time = 0.0
+
+    async def _one_request(self, session: aiohttp.ClientSession,
+                           user: UserSession) -> None:
+        args = self.args
+        messages = (
+            [{"role": "system", "content": user.system_prompt}]
+            + user.history
+            + [{"role": "user",
+                "content": f"user{user.user_id} round{user.rounds_done} "
+                           + words(args.question_len,
+                                   f"q{user.user_id}_{user.rounds_done}_",
+                                   seed=user.user_id * 1000
+                                        + user.rounds_done)}]
+        )
+        rec = RequestRecord(
+            user_id=user.user_id, round_id=user.rounds_done,
+            start=time.time(),
+        )
+        self.records.append(rec)
+        answer: List[str] = []
+        try:
+            async with session.post(
+                f"{args.base_url}/v1/chat/completions",
+                json={
+                    "model": args.model,
+                    "messages": messages,
+                    "max_tokens": args.answer_len,
+                    "stream": True,
+                    "temperature": 0.0,
+                    "ignore_eos": True,
+                },
+                headers={"x-user-id": str(user.user_id),
+                         **({"Authorization": f"Bearer {args.api_key}"}
+                            if args.api_key else {})},
+                timeout=aiohttp.ClientTimeout(total=args.request_timeout),
+            ) as resp:
+                if resp.status != 200:
+                    rec.error = f"http {resp.status}"
+                    rec.end = time.time()
+                    return
+                async for line in resp.content:
+                    line = line.decode().strip()
+                    if not line.startswith("data: "):
+                        continue
+                    data = line[len("data: "):]
+                    if data == "[DONE]":
+                        break
+                    try:
+                        chunk = json.loads(data)
+                    except json.JSONDecodeError:
+                        continue
+                    delta = chunk["choices"][0].get("delta", {})
+                    content = delta.get("content")
+                    if content:
+                        if rec.ttft is None:
+                            rec.ttft = time.time() - rec.start
+                        rec.completion_tokens += 1
+                        answer.append(content)
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            rec.error = type(e).__name__
+            rec.end = time.time()
+            return
+        rec.end = time.time()
+        rec.completion_tokens = self.args.answer_len
+        rec.prompt_tokens = sum(
+            len(m["content"].split()) for m in messages)
+        rec.generated_text = "".join(answer)
+        user.history.append(messages[-1])
+        user.history.append(
+            {"role": "assistant", "content": rec.generated_text})
+        user.rounds_done += 1
+
+    async def _user_loop(self, session, user: UserSession,
+                         gate: "asyncio.Semaphore") -> None:
+        args = self.args
+        while user.rounds_done < args.num_rounds:
+            if time.time() - self.start_time > args.time:
+                return
+            async with gate:
+                pass  # rate limiter tick
+            await self._one_request(session, user)
+            # Trim history to bound prompt growth at the configured size.
+            max_hist_words = args.user_history_prompt
+            total = 0
+            kept = []
+            for m in reversed(user.history):
+                total += len(m["content"].split())
+                if total > max_hist_words:
+                    break
+                kept.append(m)
+            user.history = list(reversed(kept))
+
+    async def _qps_gate_filler(self, gate: asyncio.Semaphore):
+        interval = 1.0 / self.args.qps if self.args.qps > 0 else 0.0
+        while True:
+            gate.release()
+            await asyncio.sleep(interval)
+
+    async def run(self) -> dict:
+        args = self.args
+        system_prompt = words(args.shared_system_prompt, "ctx", seed=42)
+        users = [
+            UserSession(user_id=u, system_prompt=system_prompt)
+            for u in range(args.num_users)
+        ]
+        gate = asyncio.Semaphore(0)
+        self.start_time = time.time()
+        filler = asyncio.create_task(self._qps_gate_filler(gate))
+        connector = aiohttp.TCPConnector(limit=0)
+        async with aiohttp.ClientSession(connector=connector) as session:
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*[
+                        self._user_loop(session, u, gate) for u in users
+                    ]),
+                    timeout=args.time + args.request_timeout,
+                )
+            except asyncio.TimeoutError:
+                pass
+        filler.cancel()
+        elapsed = time.time() - self.start_time
+        return self.summarize(elapsed)
+
+    def summarize(self, elapsed: float) -> dict:
+        done = [r for r in self.records if r.end and not r.error]
+        errors = [r for r in self.records if r.error]
+        ttfts = sorted(r.ttft for r in done if r.ttft is not None)
+        lats = sorted(r.latency for r in done)
+        gen_tokens = sum(r.completion_tokens for r in done)
+        prompt_tokens = sum(r.prompt_tokens for r in done)
+
+        def pct(values, q):
+            if not values:
+                return None
+            return round(values[min(len(values) - 1,
+                                    int(q * len(values)))], 4)
+
+        return {
+            "requests_completed": len(done),
+            "requests_failed": len(errors),
+            "elapsed_s": round(elapsed, 2),
+            "qps_achieved": round(len(done) / elapsed, 3) if elapsed else 0,
+            "generation_throughput_tok_s":
+                round(gen_tokens / elapsed, 2) if elapsed else 0,
+            "prompt_throughput_tok_s":
+                round(prompt_tokens / elapsed, 2) if elapsed else 0,
+            "ttft_p50_s": pct(ttfts, 0.50),
+            "ttft_p90_s": pct(ttfts, 0.90),
+            "ttft_p99_s": pct(ttfts, 0.99),
+            "latency_p50_s": pct(lats, 0.50),
+            "latency_p90_s": pct(lats, 0.90),
+        }
+
+    def write_csv(self, path: str) -> None:
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["user_id", "round_id", "start", "ttft",
+                        "latency", "prompt_tokens", "completion_tokens",
+                        "error"])
+            for r in self.records:
+                w.writerow([r.user_id, r.round_id, round(r.start, 3),
+                            round(r.ttft, 4) if r.ttft else "",
+                            round(r.latency, 4) if r.latency else "",
+                            r.prompt_tokens, r.completion_tokens,
+                            r.error or ""])
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--base-url", default="http://localhost:8000")
+    p.add_argument("--model", required=True)
+    p.add_argument("--api-key", default=None)
+    p.add_argument("--num-users", type=int, default=15)
+    p.add_argument("--num-rounds", type=int, default=20)
+    p.add_argument("--qps", type=float, default=0.5)
+    p.add_argument("--shared-system-prompt", type=int, default=1000,
+                   help="words in the shared system prompt")
+    p.add_argument("--user-history-prompt", type=int, default=20000,
+                   help="max words of per-user history carried forward")
+    p.add_argument("--question-len", type=int, default=50,
+                   help="words per user question")
+    p.add_argument("--answer-len", type=int, default=100,
+                   help="max_tokens per answer")
+    p.add_argument("--time", type=float, default=100.0,
+                   help="benchmark duration (seconds)")
+    p.add_argument("--request-timeout", type=float, default=120.0)
+    p.add_argument("--output", default="summary.csv")
+    return p
+
+
+def main() -> None:
+    args = build_parser().parse_args()
+    bench = MultiRoundQA(args)
+    summary = asyncio.run(bench.run())
+    bench.write_csv(args.output)
+    print(json.dumps(summary))
+    if summary["requests_completed"] == 0:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

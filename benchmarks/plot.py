@@ -1,0 +1,61 @@
+#!/usr/bin/env python3
+"""Plot QPS-sweep results produced by run_single.sh (reference plot.py).
+
+Reads single_qps*.json summaries and renders throughput + TTFT curves.
+"""
+
+import glob
+import json
+import re
+import sys
+
+
+def load_points(pattern="single_qps*.json"):
+    points = []
+    for path in sorted(glob.glob(pattern)):
+        m = re.search(r"qps([0-9.]+)\.json", path)
+        if not m:
+            continue
+        with open(path) as f:
+            data = json.loads(f.read().strip().splitlines()[-1])
+        points.append((float(m.group(1)), data))
+    return points
+
+
+def main():
+    points = load_points(sys.argv[1] if len(sys.argv) > 1
+                         else "single_qps*.json")
+    if not points:
+        print("no single_qps*.json files found", file=sys.stderr)
+        sys.exit(1)
+    qps = [p[0] for p in points]
+    gen = [p[1]["generation_throughput_tok_s"] for p in points]
+    ttft = [p[1]["ttft_p50_s"] for p in points]
+
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(10, 4))
+        ax1.plot(qps, gen, marker="o")
+        ax1.set_xlabel("offered QPS")
+        ax1.set_ylabel("generation tok/s")
+        ax1.set_title("Throughput")
+        ax2.plot(qps, ttft, marker="o", color="tab:orange")
+        ax2.set_xlabel("offered QPS")
+        ax2.set_ylabel("p50 TTFT (s)")
+        ax2.set_title("TTFT")
+        fig.tight_layout()
+        fig.savefig("benchmark.png", dpi=120)
+        print("wrote benchmark.png")
+    except ImportError:
+        print("matplotlib unavailable; table only")
+    print(f"{'QPS':>6} {'gen tok/s':>10} {'p50 TTFT':>9}")
+    for q, g, t in zip(qps, gen, ttft):
+        print(f"{q:>6} {g:>10} {t:>9}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Multi-engine benchmark (reference run.sh: 320 users x 10 rounds, warmup
+# first). Point BASE_URL at the router in front of the engine fleet.
+set -e
+BASE_URL="${1:-http://localhost:8000}"
+MODEL="${2:-meta-llama/Llama-3-8B}"
+KEY="${3:-}"
+
+# Warmup with more users than the measurement run.
+python "$(dirname "$0")/multi_round_qa.py" \
+  --base-url "$BASE_URL" --model "$MODEL" \
+  ${KEY:+--api-key "$KEY"} \
+  --num-users 400 --num-rounds 1 \
+  --shared-system-prompt 1000 --user-history-prompt 20000 \
+  --answer-len 16 --qps 20 --time 120 --output /dev/null
+
+python "$(dirname "$0")/multi_round_qa.py" \
+  --base-url "$BASE_URL" --model "$MODEL" \
+  ${KEY:+--api-key "$KEY"} \
+  --num-users 320 --num-rounds 10 \
+  --shared-system-prompt 1000 --user-history-prompt 20000 \
+  --answer-len 100 --qps 10 --time 600 \
+  --output multi.csv | tee multi.json

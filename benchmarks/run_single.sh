@@ -1,0 +1,22 @@
+#!/bin/bash
+# Single-engine QPS sweep (reference benchmarks/multi-round-qa/run_single.sh:
+# Llama-3.1-8B, 15 users x 20 rounds, sys prompt 1000 words, history
+# 20000 words, answer 100 tok, QPS in {0.1..1.1}, 100 s per point).
+set -e
+
+BASE_URL="${1:-http://localhost:8000}"
+MODEL="${2:-meta-llama/Llama-3-8B}"
+KEY="${3:-}"
+
+bash "$(dirname "$0")/warmup_single.sh" "$BASE_URL" "$MODEL" "$KEY"
+
+for qps in 0.1 0.3 0.5 0.7 0.9 1.1; do
+  out="single_qps${qps}.csv"
+  python "$(dirname "$0")/multi_round_qa.py" \
+    --base-url "$BASE_URL" --model "$MODEL" \
+    ${KEY:+--api-key "$KEY"} \
+    --num-users 15 --num-rounds 20 \
+    --shared-system-prompt 1000 --user-history-prompt 20000 \
+    --answer-len 100 --qps "$qps" --time 100 \
+    --output "$out" | tee "single_qps${qps}.json"
+done

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Warm the engine (compile caches + KV prefix cache) before measuring
+# (reference benchmarks/multi-round-qa/warmup_single.sh).
+set -e
+BASE_URL="${1:-http://localhost:8000}"
+MODEL="${2:-meta-llama/Llama-3-8B}"
+KEY="${3:-}"
+
+python "$(dirname "$0")/multi_round_qa.py" \
+  --base-url "$BASE_URL" --model "$MODEL" \
+  ${KEY:+--api-key "$KEY"} \
+  --num-users 5 --num-rounds 2 \
+  --shared-system-prompt 1000 --user-history-prompt 2000 \
+  --answer-len 16 --qps 2 --time 60 \
+  --output /dev/null

@@ -1,0 +1,79 @@
+#!/usr/bin/env python3
+"""Endpoint-picker sidecar: HTTP front for the native picker library.
+
+Gateway deployments that cannot link the C ABI directly run this next to
+the gateway; it answers pick requests using libtpu_stack_pickers.so
+(prefix-aware / kv-aware / round robin — the reference's Go EPP plugin
+logic, reference src/gateway_inference_extension/prefix_aware_picker.go).
+
+API:
+  POST /pick      {"prompt": ..., "endpoints": [...], "algorithm": "prefix"}
+                  -> {"endpoint": ...}
+  POST /kv/admit  {"endpoint": ..., "hashes": [...]}
+  GET  /health
+"""
+
+import argparse
+import asyncio
+
+from aiohttp import web
+
+from production_stack_tpu.native import NativePicker, available
+
+
+def make_app() -> web.Application:
+    picker = NativePicker()
+    app = web.Application()
+
+    async def pick(request: web.Request) -> web.Response:
+        body = await request.json()
+        endpoints = body.get("endpoints") or []
+        picker.set_endpoints(endpoints)
+        algorithm = body.get("algorithm", "prefix")
+        prompt = body.get("prompt", "")
+        if algorithm == "roundrobin" or not prompt:
+            chosen = picker.pick_roundrobin()
+        elif algorithm == "kv":
+            chosen, _matched = picker.pick_kv(prompt)
+            chosen = chosen or picker.pick_roundrobin()
+        else:
+            chosen = picker.pick_prefix(prompt)
+        return web.json_response({"endpoint": chosen})
+
+    async def kv_admit(request: web.Request) -> web.Response:
+        body = await request.json()
+        picker.kv_admit(body["endpoint"],
+                        [int(h) for h in body.get("hashes", [])])
+        return web.json_response({"status": "ok"})
+
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "native": True})
+
+    app.router.add_post("/pick", pick)
+    app.router.add_post("/kv/admit", kv_admit)
+    app.router.add_get("/health", health)
+    return app
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=9002)
+    args = parser.parse_args()
+    if not available():
+        raise SystemExit(
+            "native picker library not built: "
+            "cmake -S native -B native/build && cmake --build native/build")
+
+    async def _run():
+        runner = web.AppRunner(make_app())
+        await runner.setup()
+        await web.TCPSite(runner, args.host, args.port).start()
+        while True:
+            await asyncio.sleep(3600)
+
+    asyncio.run(_run())
+
+
+if __name__ == "__main__":
+    main()

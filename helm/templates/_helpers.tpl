@@ -1,0 +1,34 @@
+{{- define "chart.fullname" -}}
+{{- .Release.Name -}}
+{{- end -}}
+
+{{- define "chart.engineLabels" -}}
+{{- with .Values.servingEngineSpec.labels }}
+{{- toYaml . }}
+{{- end }}
+{{- end -}}
+
+{{- define "chart.routerLabels" -}}
+{{- with .Values.routerSpec.labels }}
+{{- toYaml . }}
+{{- end }}
+{{- end -}}
+
+{{/* TPU resources block for a modelSpec entry. The reference's
+     requestGPU/nvidia.com/gpu swap point (_helpers.tpl:108-150). */}}
+{{- define "chart.engineResources" -}}
+requests:
+{{- if .model.requestCPU }}
+  cpu: {{ .model.requestCPU | quote }}
+{{- end }}
+{{- if .model.requestMemory }}
+  memory: {{ .model.requestMemory | quote }}
+{{- end }}
+{{- if and .model.tpu .model.tpu.chips }}
+  google.com/tpu: {{ .model.tpu.chips }}
+{{- end }}
+limits:
+{{- if and .model.tpu .model.tpu.chips }}
+  google.com/tpu: {{ .model.tpu.chips }}
+{{- end }}
+{{- end -}}

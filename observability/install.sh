@@ -1,0 +1,28 @@
+#!/bin/bash
+# Install the observability stack (kube-prometheus-stack + TPU dashboard +
+# prometheus-adapter), mirroring reference observability/install.sh.
+set -e
+
+helm repo add prometheus-community \
+  https://prometheus-community.github.io/helm-charts
+helm repo update
+
+helm upgrade --install kube-prom-stack \
+  prometheus-community/kube-prometheus-stack \
+  --namespace monitoring --create-namespace \
+  -f kube-prom-stack.yaml
+
+helm upgrade --install prometheus-adapter \
+  prometheus-community/prometheus-adapter \
+  --namespace monitoring \
+  -f prom-adapter.yaml
+
+kubectl create configmap tpu-stack-dashboard \
+  --from-file=tpu-stack-dashboard.json \
+  --namespace monitoring \
+  --dry-run=client -o yaml | kubectl apply -f -
+kubectl label configmap tpu-stack-dashboard \
+  grafana_dashboard=1 --namespace monitoring --overwrite
+
+echo "Observability stack installed. Port-forward Grafana with:"
+echo "  kubectl -n monitoring port-forward svc/kube-prom-stack-grafana 3000:80"

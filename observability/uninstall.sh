@@ -1,0 +1,5 @@
+#!/bin/bash
+set -e
+helm uninstall prometheus-adapter --namespace monitoring || true
+helm uninstall kube-prom-stack --namespace monitoring || true
+kubectl delete configmap tpu-stack-dashboard --namespace monitoring || true

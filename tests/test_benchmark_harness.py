@@ -1,0 +1,69 @@
+"""Benchmark harness test: multi_round_qa against the fake engine
+(hermetic — mirrors the reference's perftest fixture pattern)."""
+
+import asyncio
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+from aiohttp import web
+
+from production_stack_tpu.testing.fake_engine import FakeEngine
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_multi_round_qa_against_fake_engine(tmp_path):
+    async def run():
+        engine = FakeEngine(model="bench-model", tokens_per_sec=200)
+        runner = web.AppRunner(engine.make_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        url = f"http://127.0.0.1:{port}"
+
+        out_csv = tmp_path / "run.csv"
+        proc = await asyncio.get_running_loop().run_in_executor(
+            None,
+            lambda: subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "benchmarks", "multi_round_qa.py"),
+                 "--base-url", url, "--model", "bench-model",
+                 "--num-users", "3", "--num-rounds", "2",
+                 "--qps", "20", "--answer-len", "8",
+                 "--shared-system-prompt", "30",
+                 "--question-len", "5", "--time", "30",
+                 "--output", str(out_csv)],
+                capture_output=True, timeout=90,
+            ),
+        )
+        await runner.cleanup()
+        return proc, out_csv
+
+    proc, out_csv = asyncio.run(run())
+    assert proc.returncode == 0, proc.stderr.decode()
+    summary = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert summary["requests_completed"] == 6  # 3 users x 2 rounds
+    assert summary["requests_failed"] == 0
+    assert summary["generation_throughput_tok_s"] > 0
+    assert summary["ttft_p50_s"] is not None
+    # Per-request CSV written with one row per request.
+    lines = out_csv.read_text().strip().splitlines()
+    assert len(lines) == 1 + 6
+
+
+def test_plot_table(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "bench_plot", os.path.join(REPO, "benchmarks", "plot.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    (tmp_path / "single_qps0.5.json").write_text(json.dumps({
+        "generation_throughput_tok_s": 100.0, "ttft_p50_s": 0.2,
+    }))
+    monkeypatch.chdir(tmp_path)
+    points = mod.load_points()
+    assert points == [(0.5, {"generation_throughput_tok_s": 100.0,
+                             "ttft_p50_s": 0.2})]
