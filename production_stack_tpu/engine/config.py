@@ -27,6 +27,12 @@ class EngineConfig:
     # KV offload (HBM -> host RAM -> remote cache server). 0 disables.
     kv_offload_bytes: int = 0
     kv_remote_url: Optional[str] = None
+    # Long prompts prefill in chunks of at most this many tokens (attention
+    # memory stays O(chunk * context) instead of O(len^2)); 0 disables.
+    prefill_chunk_size: int = 1024
+    # Sequence-parallel degree for ring-attention long-context prefill
+    # (parallel/ring_attention.py); 1 = off.
+    sequence_parallel_size: int = 1
     # Sampling safety cap
     max_top_k: int = 64
     seed: int = 0

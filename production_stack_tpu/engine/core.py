@@ -556,39 +556,18 @@ class EngineCore:
             block_ids, cached, _ = alloc
 
         # Only the un-cached suffix runs through the model; its queries
-        # attend to the cached prefix via the HBM pages (prefill_cached).
-        ns = n - cached
-        bucket = cfg.bucket_for(ns)
-        # Bucket the block-table width too (power of two): cached prefill
-        # attention gathers the whole table, so its cost must scale with the
-        # real context, not max_model_len.
-        maxb = 1
-        while maxb < len(block_ids):
-            maxb *= 2
-        maxb = min(maxb, cfg.max_blocks_per_seq)
-
-        token_arr = np.zeros((1, bucket), np.int32)
-        token_arr[0, :ns] = tokens[cached:]
-        positions = np.zeros((1, bucket), np.int32)
-        positions[0, :bucket] = cached + np.arange(bucket)
-        slot_mapping = np.full((1, bucket), -1, np.int64)
-        pos_idx = cached + np.arange(ns)
-        blocks = np.asarray(block_ids, np.int64)
-        slot_mapping[0, :ns] = (
-            blocks[pos_idx // cfg.block_size] * cfg.block_size
-            + pos_idx % cfg.block_size
-        )
-        block_table = np.zeros((1, maxb), np.int32)
-        block_table[0, : len(block_ids)] = block_ids
-        context_lens = np.asarray([n], np.int32)
-        seq_lens = np.asarray([ns], np.int32)
-        adapter_ids = np.asarray([req.adapter_id], np.int32)
-
-        fn = self._prefill_cached_fn if cached > 0 else self._prefill_fn
-        last_logits, self.kv = fn(
-            self.params, self.kv, token_arr, positions, slot_mapping,
-            block_table, context_lens, seq_lens, adapter_ids,
-        )
+        # attend to the prefix via the HBM pages (prefill_cached). Long
+        # suffixes run in chunks so attention memory stays
+        # O(chunk * context) instead of O(len^2) — the engine-level
+        # long-context path (single chip; ring attention covers multi-chip).
+        chunk = cfg.prefill_chunk_size or (n - cached)
+        last_logits = None
+        start = cached
+        while start < n:
+            end = min(start + chunk, n)
+            last_logits = self._prefill_span(
+                req, tokens, block_ids, start, end)
+            start = end
         token = self._sample(
             last_logits, [req], np.asarray([n], np.int64)
         )[0]
@@ -599,6 +578,48 @@ class EngineCore:
             slot = self.scheduler._free_slot()
             seq = self.scheduler.start_running(req, slot)
         self._emit_token(seq, int(token))
+
+    def _prefill_span(self, req: EngineRequest, tokens, block_ids,
+                      start: int, end: int):
+        """Run one prefill chunk (tokens[start:end]) and return its last
+        logits. Spans after the first attend to earlier tokens through the
+        pages (prefill_cached); the span's own K/V is written first, so
+        attention over the block table sees the full prefix."""
+        cfg = self.config
+        take = end - start
+        bucket = cfg.bucket_for(take)
+        # Bucket the block-table width (power of two) so cached-prefill
+        # attention cost scales with the real context, not max_model_len.
+        blocks_needed = (end + cfg.block_size - 1) // cfg.block_size
+        maxb = 1
+        while maxb < blocks_needed:
+            maxb *= 2
+        maxb = min(maxb, cfg.max_blocks_per_seq)
+
+        token_arr = np.zeros((1, bucket), np.int32)
+        token_arr[0, :take] = tokens[start:end]
+        positions = np.zeros((1, bucket), np.int32)
+        positions[0, :bucket] = start + np.arange(bucket)
+        slot_mapping = np.full((1, bucket), -1, np.int64)
+        pos_idx = start + np.arange(take)
+        blocks = np.asarray(block_ids, np.int64)
+        slot_mapping[0, :take] = (
+            blocks[pos_idx // cfg.block_size] * cfg.block_size
+            + pos_idx % cfg.block_size
+        )
+        block_table = np.zeros((1, maxb), np.int32)
+        use = min(len(block_ids), maxb)
+        block_table[0, :use] = block_ids[:use]
+        context_lens = np.asarray([end], np.int32)
+        seq_lens = np.asarray([take], np.int32)
+        adapter_ids = np.asarray([req.adapter_id], np.int32)
+
+        fn = self._prefill_cached_fn if start > 0 else self._prefill_fn
+        last_logits, self.kv = fn(
+            self.params, self.kv, token_arr, positions, slot_mapping,
+            block_table, context_lens, seq_lens, adapter_ids,
+        )
+        return last_logits
 
     # -- decode ------------------------------------------------------------
     def _do_decode(self) -> None:
