@@ -30,7 +30,7 @@ def _env_int(name: str, default: int) -> int:
 MODEL = os.environ.get("BENCH_MODEL", "facebook/opt-125m")
 USERS = _env_int("BENCH_USERS", 8)
 ROUNDS = _env_int("BENCH_ROUNDS", 3)
-ANSWER_TOKENS = _env_int("BENCH_ANSWER_TOKENS", 64)
+ANSWER_TOKENS = _env_int("BENCH_ANSWER_TOKENS", 128)
 SYS_PROMPT_TOKENS = _env_int("BENCH_SYS_PROMPT_TOKENS", 128)
 MAX_NUM_SEQS = _env_int("BENCH_MAX_NUM_SEQS", 16)
 MAX_MODEL_LEN = _env_int("BENCH_MAX_MODEL_LEN", 2048)
@@ -147,8 +147,9 @@ async def _main() -> dict:
         max_model_len=MAX_MODEL_LEN,
         max_num_seqs=MAX_NUM_SEQS,
         max_loras=0,
+        decode_steps=_env_int("BENCH_DECODE_STEPS", 16),
     )
-    server = EngineServer(config)
+    server = EngineServer(config, warmup=True)
     engine_runner = await run_engine_server(server, "127.0.0.1", 0)
     engine_port = (
         list(engine_runner.sites)[0]._server.sockets[0].getsockname()[1]

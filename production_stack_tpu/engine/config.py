@@ -30,9 +30,11 @@ class EngineConfig:
     # Long prompts prefill in chunks of at most this many tokens (attention
     # memory stays O(chunk * context) instead of O(len^2)); 0 disables.
     prefill_chunk_size: int = 1024
-    # Sequence-parallel degree for ring-attention long-context prefill
-    # (parallel/ring_attention.py); 1 = off.
-    sequence_parallel_size: int = 1
+    # Fused multi-step decode: exactly this many decode iterations
+    # (forward + sampling + token feedback) run inside one compiled
+    # lax.scan per dispatch; sequences that cannot use the full burst are
+    # masked per step. 1 disables fusion.
+    decode_steps: int = 8
     # Sampling safety cap
     max_top_k: int = 64
     seed: int = 0

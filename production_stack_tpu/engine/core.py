@@ -113,7 +113,9 @@ class EngineCore:
         # -- compiled programs --------------------------------------------
         self._prefill_fn = self._make_forward("prefill")
         self._prefill_cached_fn = self._make_forward("prefill_cached")
-        self._decode_fn = self._make_forward("decode")
+        # Decode always runs through the fused burst program (K ==
+        # decode_steps; K=1 degenerates to single-step).
+        self._multi_decode_fns: Dict[int, Callable] = {}
         self._write_block_fn = self._make_write_block()
 
         # -- LoRA slot registry -------------------------------------------
@@ -128,6 +130,10 @@ class EngineCore:
         self._sleeping = False
         self._sleep_level = 1
         self._host_params = None
+
+        # In-flight speculative decode burst: dispatched to the device but
+        # not yet read back (see _do_decode pipelining).
+        self._pending_burst: Optional[dict] = None
 
         # -- engine thread -------------------------------------------------
         self._lock = threading.Condition()
@@ -200,6 +206,63 @@ class EngineCore:
             return last, kv
 
         return jax.jit(fwd, donate_argnums=(1,))
+
+    def _make_multi_decode(self, K: int):
+        """Fused K-step decode: forward + on-device sampling (keys derived
+        on device) + next-token feedback run in one compiled lax.scan — one
+        host round-trip (and one [B, K] token transfer) per K generated
+        tokens, instead of a dispatch + logits sync per token. The
+        serving-throughput analog of vLLM's multi-step scheduling, shaped
+        for XLA. Per-sequence early exit is handled by the caller: steps a
+        sequence cannot use carry slot id -1 (the page write drops) and
+        their sampled tokens are discarded at emission."""
+        apply = self._apply
+        cfg = self.model_config
+        max_top_k = self.config.max_top_k
+        seed = self.config.seed
+
+        def fwd(params, kv, tokens_prev, tok_idx, host_tokens, use_host,
+                positions0, slot_mat, block_tables, context0, adapter_ids,
+                temperature, top_k, top_p, seed_base):
+            # tokens_prev: [B, K] the PREVIOUS burst's sampled tokens (device
+            # array — the feedback token never round-trips to the host, which
+            # is what lets the engine dispatch burst N+1 before reading
+            # burst N); tok_idx selects each sequence's last valid step;
+            # host_tokens/use_host override rows for sequences that just
+            # prefilled. Other args: [B] or [B, K] as before.
+            tokens0 = jnp.where(
+                use_host, host_tokens,
+                jnp.take_along_axis(tokens_prev, tok_idx[:, None], 1)[:, 0],
+            )
+
+            def body(carry, step_slots):
+                tokens, kv, s = carry
+                logits, kv = apply(
+                    params, cfg, tokens[:, None], (positions0 + s)[:, None],
+                    kv, step_slots[:, None], block_tables, context0 + s,
+                    jnp.ones_like(context0), mode="decode",
+                    adapter_ids=adapter_ids,
+                )
+                keys = make_rng_keys(seed, 0, seed_base + s)
+                sampled = sample_tokens(
+                    logits[:, 0], keys, temperature, top_k, top_p,
+                    max_top_k=max_top_k,
+                )
+                return (sampled, kv, s + 1), sampled
+
+            (_, kv, _), out = jax.lax.scan(
+                body, (tokens0, kv, jnp.int32(0)), slot_mat.T, length=K,
+            )
+            return out.T, kv  # [B, K]
+
+        return jax.jit(fwd, donate_argnums=(1,))
+
+    def _multi_decode_fn(self, K: int):
+        fn = self._multi_decode_fns.get(K)
+        if fn is None:
+            fn = self._make_multi_decode(K)
+            self._multi_decode_fns[K] = fn
+        return fn
 
     def _make_write_block(self):
         """Jitted single-block page write (offload restore / KV inject)."""
@@ -322,6 +385,86 @@ class EngineCore:
     def start(self) -> None:
         self._thread.start()
 
+    def warmup(self) -> None:
+        """Precompile the serving programs (every prefill bucket, the
+        cached-prefill variants, and each decode burst width) so no XLA
+        compile lands inside a user request. Dummy inputs use negative
+        slot ids, so the scatter writes drop and no real KV page or
+        allocator state is touched."""
+        cfg = self.config
+        t0 = time.time()
+        with self._step_lock:
+            buckets = cfg.prefill_buckets()
+            if cfg.prefill_chunk_size:
+                buckets = [
+                    b for b in buckets
+                    if b <= cfg.bucket_for(
+                        min(cfg.prefill_chunk_size, cfg.max_model_len))
+                ]
+            n_prefill = 0
+            for bucket in buckets:
+                blocks_needed = (bucket + cfg.block_size - 1) // cfg.block_size
+                tight = 4
+                while tight < blocks_needed:
+                    tight *= 2
+                tight = min(tight, cfg.max_blocks_per_seq)
+                token_arr = np.zeros((1, bucket), np.int32)
+                positions = np.tile(
+                    np.arange(bucket, dtype=np.int32), (1, 1))
+                slot_mapping = np.full((1, bucket), -1, np.int64)
+                context_lens = np.asarray([min(bucket, 2)], np.int32)
+                seq_lens = np.asarray([min(bucket, 2)], np.int32)
+                adapter_ids = np.zeros((1,), np.int32)
+                # Plain prefill only ever sees context == span -> one tight
+                # table width per bucket.
+                _, self.kv = self._prefill_fn(
+                    self.params, self.kv, token_arr, positions,
+                    slot_mapping, np.zeros((1, tight), np.int32),
+                    context_lens, seq_lens, adapter_ids,
+                )
+                n_prefill += 1
+                # Cached prefill: context (and so the table bucket) can be
+                # anything >= the span; compile every reachable width.
+                maxb = tight
+                while True:
+                    _, self.kv = self._prefill_cached_fn(
+                        self.params, self.kv, token_arr, positions,
+                        slot_mapping, np.zeros((1, maxb), np.int32),
+                        context_lens, seq_lens, adapter_ids,
+                    )
+                    n_prefill += 1
+                    if maxb >= cfg.max_blocks_per_seq:
+                        break
+                    maxb *= 2
+            # Decode: one burst width (decode_steps), one variant per
+            # block-table bucket (4 doubling to max_blocks_per_seq).
+            B = cfg.max_num_seqs
+            K = max(cfg.decode_steps, 1)
+            fn = self._multi_decode_fn(K)
+            maxb_w = 4
+            n_decode = 0
+            while True:
+                maxb_w = min(maxb_w, cfg.max_blocks_per_seq)
+                _, self.kv = fn(
+                    self.params, self.kv,
+                    np.zeros((B, K), np.int32),  # tokens_prev
+                    np.zeros((B,), np.int32),    # tok_idx
+                    np.zeros((B,), np.int32),    # host_tokens
+                    np.ones((B,), bool),         # use_host
+                    np.zeros((B,), np.int32),    # positions0
+                    np.full((B, K), -1, np.int64),
+                    np.zeros((B, maxb_w), np.int32),
+                    np.ones((B,), np.int32), np.zeros((B,), np.int32),
+                    np.zeros((B,), np.float32), np.zeros((B,), np.int32),
+                    np.ones((B,), np.float32), np.zeros((B,), np.int64),
+                )
+                n_decode += 1
+                if maxb_w >= cfg.max_blocks_per_seq:
+                    break
+                maxb_w *= 2
+        logger.info("Warmup compiled %d prefill + %d decode variants "
+                    "in %.1f s", n_prefill, n_decode, time.time() - t0)
+
     def add_request(
         self,
         request_id: str,
@@ -357,6 +500,7 @@ class EngineCore:
     def sleep(self, level: int = 1) -> None:
         """Free HBM: discard KV, move weights to host RAM."""
         with self._step_lock:  # wait out any in-flight forward step
+            self._flush_pending_burst()
             with self._lock:
                 if self._sleeping:
                     return
@@ -484,7 +628,7 @@ class EngineCore:
     def _loop(self) -> None:
         while True:
             with self._lock:
-                while self._running and (
+                while self._running and not self._pending_burst and (
                     self._sleeping or not self.scheduler.has_work()
                 ):
                     self._lock.wait(timeout=0.1)
@@ -494,6 +638,7 @@ class EngineCore:
             try:
                 with self._step_lock:
                     if self._sleeping or self.params is None:
+                        self._flush_pending_burst()
                         # sleep() won the race after next_action popped a
                         # request: requeue it for wake-up instead of failing.
                         if req is not None:
@@ -505,6 +650,7 @@ class EngineCore:
                     elif action == "decode":
                         self._do_decode()
                     else:
+                        self._flush_pending_burst()
                         time.sleep(0.001)
             except Exception as e:  # noqa: BLE001
                 logger.exception("Engine step failed: %s", e)
@@ -514,6 +660,9 @@ class EngineCore:
 
     # -- prefill -----------------------------------------------------------
     def _do_prefill(self, req: EngineRequest) -> None:
+        # Settle the in-flight burst first: its emission may finish
+        # sequences and free the pages this prompt needs.
+        self._flush_pending_burst()
         cfg = self.config
         tokens = req.all_token_ids
         n = len(tokens)
@@ -578,6 +727,9 @@ class EngineCore:
             slot = self.scheduler._free_slot()
             seq = self.scheduler.start_running(req, slot)
         self._emit_token(seq, int(token))
+        # Decode position bookkeeping starts from the emitted tokens (a
+        # re-prefill after preemption carries prior outputs forward).
+        req.scheduled_steps = len(req.output_token_ids)
 
     def _prefill_span(self, req: EngineRequest, tokens, block_ids,
                       start: int, end: int):
@@ -588,10 +740,11 @@ class EngineCore:
         cfg = self.config
         take = end - start
         bucket = cfg.bucket_for(take)
-        # Bucket the block-table width (power of two) so cached-prefill
-        # attention cost scales with the real context, not max_model_len.
+        # Bucket the block-table width (power of two, min 4) so
+        # cached-prefill attention cost scales with the real context, not
+        # max_model_len — and so warmup() can precompile every variant.
         blocks_needed = (end + cfg.block_size - 1) // cfg.block_size
-        maxb = 1
+        maxb = 4
         while maxb < blocks_needed:
             maxb *= 2
         maxb = min(maxb, cfg.max_blocks_per_seq)
@@ -623,67 +776,165 @@ class EngineCore:
 
     # -- decode ------------------------------------------------------------
     def _do_decode(self) -> None:
+        """Dispatch one fused decode burst, pipelined: burst N+1 is sent to
+        the device (feedback token selected on device from burst N's output)
+        BEFORE burst N's tokens are read back, so the host<->device round
+        trip overlaps device execution. Sequences whose burst-N tokens turn
+        out to finish the request are covered speculatively in burst N+1;
+        their extra tokens are discarded at emission and their stray page
+        writes are overwritten before ever becoming readable (pages freed by
+        the finish are re-written by any later owner before its attention
+        can read them — device dispatch order guarantees it)."""
         cfg = self.config
         B = cfg.max_num_seqs
-        maxb = cfg.max_blocks_per_seq
+        K = max(cfg.decode_steps, 1)
+
+        # Per-seq usable burst width (bounded by max_tokens/max_model_len);
+        # a fixed K with per-seq masking keeps ONE compiled program per
+        # block-table width instead of one per burst-width combination.
+        # Bounds use all_token_ids which may lag the in-flight burst, so
+        # this over-schedules at most one extra burst near the end caps.
+        def seq_allow(r: EngineRequest) -> int:
+            return max(1, min(
+                K,
+                r.sampling.max_tokens - len(r.output_token_ids),
+                cfg.max_model_len - len(r.all_token_ids) + 1,
+            ))
+
+        prev = self._pending_burst
+        prev_slots = (
+            {id(s): prev["allows"].get(s.req.request_id, 1)
+             for s in prev["active"]} if prev else {}
+        )
 
         with self._lock:
-            # Account the about-to-be-written token; preempt on OOM.
+            active0 = self.scheduler.running()
+            allows: Dict[str, int] = {}
+            # Account the about-to-be-written tokens; preempt on OOM.
             for seq in list(self.scheduler.running()):
                 if self.scheduler.slots[seq.slot] is not seq:
                     continue  # already preempted this pass
-                ok = self.kv_mgr.append_token(
-                    seq.req.request_id, seq.req.all_token_ids[-1]
-                )
-                while not ok:
-                    victim = self.scheduler.preempt_youngest()
-                    if victim is None or victim.req is seq.req:
-                        break
+                need = seq_allow(seq.req)
+                allows[seq.req.request_id] = need
+                while need > 0:
                     ok = self.kv_mgr.append_token(
                         seq.req.request_id, seq.req.all_token_ids[-1]
                     )
-            active = self.scheduler.running()
+                    if ok:
+                        need -= 1
+                        continue
+                    victim = self.scheduler.preempt_youngest()
+                    if victim is None or victim.req is seq.req:
+                        break
+                    # (victim's pages are back; retry this append)
+            active0_ids = {id(s) for s in active0}
+            active = [
+                s for s in self.scheduler.running() if id(s) in active0_ids
+            ]
         self._drain_offload()  # spill pages evicted during block accounting
         if not active:
+            self._flush_pending_burst()
             return
 
-        token_arr = np.zeros((B, 1), np.int32)
-        positions = np.zeros((B, 1), np.int32)
-        slot_mapping = np.full((B, 1), -1, np.int64)
+        # Bucket the block-table width (power of two over the widest live
+        # sequence) so the gather in paged attention scales with real
+        # context, not max_model_len.
+        max_blocks = max(
+            (len(self.kv_mgr.block_table(s.req.request_id)) for s in active),
+        )
+        maxb = 4
+        while maxb < max_blocks:
+            maxb *= 2
+        maxb = min(maxb, cfg.max_blocks_per_seq)
+
+        host_tokens = np.zeros((B,), np.int32)
+        use_host = np.ones((B,), bool)
+        tok_idx = np.zeros((B,), np.int32)
+        positions0 = np.zeros((B,), np.int32)
+        slot_mat = np.full((B, K), -1, np.int64)
         block_table = np.zeros((B, maxb), np.int32)
-        context_lens = np.zeros((B,), np.int32)
-        seq_lens = np.ones((B,), np.int32)
+        context0 = np.ones((B,), np.int32)
         adapter_ids = np.zeros((B,), np.int32)
+        temperature = np.zeros((B,), np.float32)
+        top_k = np.zeros((B,), np.int32)
+        top_p = np.ones((B,), np.float32)
+        seed_base = np.zeros((B,), np.int64)
 
         for seq in active:
             i = seq.slot
-            toks = seq.req.all_token_ids
-            pos = len(toks) - 1
-            token_arr[i, 0] = toks[-1]
-            positions[i, 0] = pos
-            bids = self.kv_mgr.block_table(seq.req.request_id)
-            block_table[i, : len(bids)] = bids
-            slot_mapping[i, 0] = (
-                bids[pos // cfg.block_size] * cfg.block_size
+            r = seq.req
+            # Position/context bookkeeping counts *scheduled* tokens: with a
+            # burst in flight the host hasn't seen its tokens yet, but their
+            # pages and positions are committed.
+            sched_ahead = id(seq) in prev_slots
+            if sched_ahead:
+                # Feedback token comes from the in-flight burst's output, on
+                # device.
+                use_host[i] = False
+                tok_idx[i] = prev_slots[id(seq)] - 1
+            else:
+                host_tokens[i] = r.all_token_ids[-1]
+            base = len(r.prompt_token_ids) + r.scheduled_steps
+            allow = allows.get(r.request_id, 1)
+            positions0[i] = base - 1
+            context0[i] = base
+            bids = self.kv_mgr.block_table(r.request_id)
+            use = min(len(bids), maxb)
+            block_table[i, :use] = bids[:use]
+            bid_arr = np.asarray(bids, np.int64)
+            pos = base - 1 + np.arange(allow)
+            slot_mat[i, :allow] = (
+                bid_arr[pos // cfg.block_size] * cfg.block_size
                 + pos % cfg.block_size
             )
-            context_lens[i] = len(toks)
-            adapter_ids[i] = seq.req.adapter_id
+            adapter_ids[i] = r.adapter_id
+            t, k_, p_, seed = self._sampling_for(r)
+            temperature[i] = t
+            top_k[i] = k_
+            top_p[i] = p_
+            seed_base[i] = seed + r.scheduled_steps
+            r.scheduled_steps += allow
 
-        logits, self.kv = self._decode_fn(
-            self.params, self.kv, token_arr, positions, slot_mapping,
-            block_table, context_lens, seq_lens, adapter_ids,
+        tokens_prev = (
+            prev["out"] if prev is not None else np.zeros((B, K), np.int32)
         )
-        reqs = [None] * B
-        for seq in active:
-            reqs[seq.slot] = seq.req
-        steps = np.asarray(
-            [len(r.output_token_ids) if r else 0 for r in reqs], np.int64
+        fn = self._multi_decode_fn(K)
+        sampled, self.kv = fn(
+            self.params, self.kv, tokens_prev, tok_idx, host_tokens,
+            use_host, positions0, slot_mat, block_table, context0,
+            adapter_ids, temperature, top_k, top_p, seed_base,
         )
-        sampled = self._sample(logits, reqs, steps)
-        self.generation_tokens_total += len(active)
-        for seq in active:
-            self._emit_token(seq, int(sampled[seq.slot]))
+        # Read back the PREVIOUS burst (overlaps this burst's execution).
+        self._flush_pending_burst()
+        self._pending_burst = {
+            "out": sampled, "active": active, "allows": allows,
+        }
+
+    def _flush_pending_burst(self) -> None:
+        """Read back and emit the in-flight decode burst, if any."""
+        pending = self._pending_burst
+        if pending is None:
+            return
+        self._pending_burst = None
+        sampled = np.asarray(jax.device_get(pending["out"]))  # [B, K]
+        for seq in pending["active"]:
+            allow = pending["allows"].get(seq.req.request_id, 1)
+            emitted = 0
+            for s in range(allow):
+                if self.scheduler.slots[seq.slot] is not seq:
+                    break  # finished / aborted / preempted mid-burst
+                self._emit_token(seq, int(sampled[seq.slot, s]))
+                emitted += 1
+            self.generation_tokens_total += emitted
+
+    def _sampling_for(self, r: EngineRequest):
+        """Per-request sampling knobs (shared by prefill and burst decode):
+        (temperature, clamped top_k, top_p, seed)."""
+        seed = (r.sampling.seed if r.sampling.seed is not None
+                else hash(r.request_id) % (2**31))
+        return (r.sampling.temperature,
+                min(r.sampling.top_k, self.config.max_top_k),
+                r.sampling.top_p, seed)
 
     def _sample(self, logits, reqs, steps) -> np.ndarray:
         """Batched on-device sampling; per-request params are data."""
@@ -695,12 +946,8 @@ class EngineCore:
         for i, r in enumerate(reqs):
             if r is None:
                 continue
-            temperature[i] = r.sampling.temperature
-            top_k[i] = min(r.sampling.top_k, self.config.max_top_k)
-            top_p[i] = r.sampling.top_p
-            seq_seeds[i] = (
-                r.sampling.seed if r.sampling.seed is not None
-                else hash(r.request_id) % (2**31)
+            temperature[i], top_k[i], top_p[i], seq_seeds[i] = (
+                self._sampling_for(r)
             )
         keys = make_rng_keys(
             self.config.seed, int(steps.max() if len(steps) else 0),

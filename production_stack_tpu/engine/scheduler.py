@@ -49,6 +49,9 @@ class EngineRequest:
     output_token_ids: List[int] = field(default_factory=list)
     status: RequestStatus = RequestStatus.WAITING
     num_preemptions: int = 0
+    # Decode steps scheduled so far (may run ahead of emitted tokens while
+    # a speculative burst is in flight); engine-thread only.
+    scheduled_steps: int = 0
 
     @property
     def all_token_ids(self) -> List[int]:

@@ -65,9 +65,13 @@ class _TokenStream:
 
 
 class EngineServer:
-    def __init__(self, config: EngineConfig, served_model_names: Optional[List[str]] = None):
+    def __init__(self, config: EngineConfig,
+                 served_model_names: Optional[List[str]] = None,
+                 warmup: bool = False):
         self.config = config
         self.core = EngineCore(config)
+        if warmup:
+            self.core.warmup()
         self.core.start()
         self.served_models = served_model_names or [config.model]
         self.start_time = time.time()
@@ -605,6 +609,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="host-RAM KV offload budget (0 disables)")
     p.add_argument("--kv-remote-url", default=None,
                    help="remote cache server URL (second offload tier)")
+    p.add_argument("--no-warmup", dest="warmup", action="store_false",
+                   default=True,
+                   help="skip precompiling serving programs at startup")
     return p
 
 
@@ -627,7 +634,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         kv_offload_bytes=int(args.kv_offload_gb * (1 << 30)),
         kv_remote_url=args.kv_remote_url,
     )
-    server = EngineServer(config, args.served_model_name)
+    server = EngineServer(config, args.served_model_name,
+                          warmup=args.warmup)
 
     async def _run():
         await run_engine_server(server, args.host, args.port)

@@ -129,7 +129,8 @@ def _layer(
     x: jax.Array,  # [B, T, Hd]
     layer_params: Dict,  # un-stacked (one layer's leaves)
     lora: Dict | None,  # un-stacked per-layer LoRA leaves, or None
-    kv: Tuple[jax.Array, jax.Array],  # k_pages, v_pages [NB, bs, KVH, D]
+    kv: Tuple[jax.Array, jax.Array],  # STACKED pages [L, NB, bs, KVH, D]
+    layer: jax.Array,  # scalar layer index
     positions: jax.Array,
     slot_mapping: jax.Array,
     block_tables: jax.Array,
@@ -160,7 +161,8 @@ def _layer(
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
 
-    k_pages, v_pages = write_kv_pages(k_pages, v_pages, k, v, slot_mapping)
+    k_pages, v_pages = write_kv_pages(
+        k_pages, v_pages, k, v, slot_mapping, layer)
 
     if mode == "prefill":
         attn = prefill_attention(q, k, v, scale=scale, seq_lens=seq_lens)
@@ -169,11 +171,12 @@ def _layer(
         # (cached prefix + just-written suffix).
         attn = context_prefill_attention(
             q, k_pages, v_pages, block_tables, positions, context_lens,
-            scale=scale,
+            layer, scale=scale,
         )
     else:
         attn = paged_decode_attention(
-            q[:, 0], k_pages, v_pages, block_tables, context_lens, scale=scale
+            q[:, 0], k_pages, v_pages, block_tables, context_lens, layer,
+            scale=scale,
         )[:, None]
     x = x + attn.reshape(B, T, H * D) @ p["wo"]
 
@@ -216,27 +219,37 @@ def apply(
         seq_lens=seq_lens, lora_scaling=lora_scaling, adapter_ids=adapter_ids,
     )
 
-    if lora_layers is not None:
-        def scan_body(x, per_layer):
-            layer_params, lora_p, k_pages, v_pages = per_layer
-            x, (k_pages, v_pages) = layer_fn(
-                x, layer_params, lora_p, (k_pages, v_pages)
-            )
-            return x, (k_pages, v_pages)
+    # The STACKED KV pages ride the scan carry whole; every op addresses
+    # them through the scalar layer index (flat scatter / page-level
+    # gather). Loop carries alias in place under XLA, so only the touched
+    # pages move — per-layer slices (or pages in the scan ys) would copy
+    # the entire pool every forward step.
+    L = k_all.shape[0]
 
-        x, (k_all, v_all) = jax.lax.scan(
-            scan_body, x, (params["layers"], lora_layers, k_all, v_all)
+    if lora_layers is not None:
+        def scan_body(carry, per_layer):
+            x, k_all, v_all, l = carry
+            layer_params, lora_p = per_layer
+            x, (k_all, v_all) = layer_fn(
+                x, layer_params, lora_p, (k_all, v_all), l
+            )
+            return (x, k_all, v_all, l + 1), None
+
+        (x, k_all, v_all, _), _ = jax.lax.scan(
+            scan_body, (x, k_all, v_all, jnp.int32(0)),
+            (params["layers"], lora_layers), length=L,
         )
     else:
-        def scan_body(x, per_layer):
-            layer_params, k_pages, v_pages = per_layer
-            x, (k_pages, v_pages) = layer_fn(
-                x, layer_params, None, (k_pages, v_pages)
+        def scan_body(carry, layer_params):
+            x, k_all, v_all, l = carry
+            x, (k_all, v_all) = layer_fn(
+                x, layer_params, None, (k_all, v_all), l
             )
-            return x, (k_pages, v_pages)
+            return (x, k_all, v_all, l + 1), None
 
-        x, (k_all, v_all) = jax.lax.scan(
-            scan_body, x, (params["layers"], k_all, v_all)
+        (x, k_all, v_all, _), _ = jax.lax.scan(
+            scan_body, (x, k_all, v_all, jnp.int32(0)),
+            params["layers"], length=L,
         )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params.get("lm_head")

@@ -78,19 +78,20 @@ def moe_mlp(cfg: ModelConfig, p: Dict, h: jax.Array) -> jax.Array:
 
 
 def _layer(
-    cfg: ModelConfig, mode: str, x, p, kv,
+    cfg: ModelConfig, mode: str, x, p, kv, layer,
     positions, slot_mapping, block_tables, context_lens, seq_lens,
 ):
     B, T, Hd = x.shape
     H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     scale = 1.0 / (D ** 0.5)
-    k_pages, v_pages = kv
+    k_pages, v_pages = kv  # stacked [L, NB, bs, KVH, D]
 
     h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
     q = rope((h @ p["wq"]).reshape(B, T, H, D), positions, cfg.rope_theta)
     k = rope((h @ p["wk"]).reshape(B, T, KVH, D), positions, cfg.rope_theta)
     v = (h @ p["wv"]).reshape(B, T, KVH, D)
-    k_pages, v_pages = write_kv_pages(k_pages, v_pages, k, v, slot_mapping)
+    k_pages, v_pages = write_kv_pages(
+        k_pages, v_pages, k, v, slot_mapping, layer)
     if mode == "prefill":
         attn = prefill_attention(q, k, v, scale=scale, seq_lens=seq_lens)
     elif mode == "prefill_cached":
@@ -98,11 +99,12 @@ def _layer(
         # (cached prefix + just-written suffix).
         attn = context_prefill_attention(
             q, k_pages, v_pages, block_tables, positions, context_lens,
-            scale=scale,
+            layer, scale=scale,
         )
     else:
         attn = paged_decode_attention(
-            q[:, 0], k_pages, v_pages, block_tables, context_lens, scale=scale
+            q[:, 0], k_pages, v_pages, block_tables, context_lens, layer,
+            scale=scale,
         )[:, None]
     x = x + attn.reshape(B, T, H * D) @ p["wo"]
 
@@ -126,13 +128,18 @@ def apply(
         block_tables=block_tables, context_lens=context_lens, seq_lens=seq_lens,
     )
 
-    def scan_body(x, per_layer):
-        layer_params, k_pages, v_pages = per_layer
-        x, (k_pages, v_pages) = layer_fn(x, layer_params, (k_pages, v_pages))
-        return x, (k_pages, v_pages)
+    # Stacked KV pages ride the scan carry whole (in-place under XLA);
+    # see llama.apply.
+    L = k_all.shape[0]
 
-    x, (k_all, v_all) = jax.lax.scan(
-        scan_body, x, (params["layers"], k_all, v_all)
+    def scan_body(carry, layer_params):
+        x, k_all, v_all, l = carry
+        x, (k_all, v_all) = layer_fn(x, layer_params, (k_all, v_all), l)
+        return (x, k_all, v_all, l + 1), None
+
+    (x, k_all, v_all, _), _ = jax.lax.scan(
+        scan_body, (x, k_all, v_all, jnp.int32(0)), params["layers"],
+        length=L,
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)

@@ -63,13 +63,26 @@ def prefill_attention(
     return out.reshape(B, T, H, D)
 
 
+def _gather_ctx(pages: jax.Array, block_tables: jax.Array,
+                layer: jax.Array):
+    """Gather a batch's context from stacked pages [L, NB, bs, KVH, D]
+    without materializing a whole layer: page-level indices into the
+    (L*NB)-page flat view."""
+    L, NB, bs, KVH, D = pages.shape
+    B, MAXB = block_tables.shape
+    flat = pages.reshape(L * NB, bs, KVH, D)
+    idx = layer * NB + block_tables  # [B, MAXB]
+    return flat[idx].reshape(B, MAXB * bs, KVH, D)
+
+
 def context_prefill_attention(
     q: jax.Array,  # [B, T, H, D] suffix queries
-    k_pages: jax.Array,  # [NB, bs, KVH, D]
-    v_pages: jax.Array,  # [NB, bs, KVH, D]
+    k_pages: jax.Array,  # [L, NB, bs, KVH, D] stacked pages
+    v_pages: jax.Array,  # [L, NB, bs, KVH, D]
     block_tables: jax.Array,  # [B, MAXB]
     positions: jax.Array,  # [B, T] absolute positions of the queries
     total_lens: jax.Array,  # [B] full context length (cached + suffix)
+    layer: jax.Array,  # scalar layer index
     *,
     scale: float,
 ) -> jax.Array:
@@ -80,11 +93,12 @@ def context_prefill_attention(
     (reference buys this from vLLM ``--enable-prefix-caching`` +
     LMCache offload; here it is native). Returns [B, T, H, D]."""
     B, T, H, D = q.shape
-    NB, bs, KVH, _ = k_pages.shape
+    bs = k_pages.shape[2]
+    KVH = k_pages.shape[3]
     MAXB = block_tables.shape[1]
     group = H // KVH
-    k_ctx = k_pages[block_tables].reshape(B, MAXB * bs, KVH, D)
-    v_ctx = v_pages[block_tables].reshape(B, MAXB * bs, KVH, D)
+    k_ctx = _gather_ctx(k_pages, block_tables, layer)
+    v_ctx = _gather_ctx(v_pages, block_tables, layer)
     qg = q.reshape(B, T, KVH, group, D)
     scores = jnp.einsum(
         "btkgd,bskd->bkgts", qg, k_ctx, preferred_element_type=jnp.float32
@@ -100,45 +114,52 @@ def context_prefill_attention(
 
 
 def write_kv_pages(
-    k_pages: jax.Array,  # [NB, bs, KVH, D]
-    v_pages: jax.Array,  # [NB, bs, KVH, D]
+    k_pages: jax.Array,  # [L, NB, bs, KVH, D] stacked pages
+    v_pages: jax.Array,  # [L, NB, bs, KVH, D]
     k_new: jax.Array,  # [B, T, KVH, D]
     v_new: jax.Array,  # [B, T, KVH, D]
-    slot_mapping: jax.Array,  # [B, T] flat slot ids; negative = skip
+    slot_mapping: jax.Array,  # [B, T] flat slot ids (layer 0); negative = skip
+    layer: jax.Array,  # scalar layer index
 ):
-    """Scatter fresh K/V into their HBM page slots."""
-    NB, bs, KVH, D = k_pages.shape
-    flat_k = k_pages.reshape(NB * bs, KVH, D)
-    flat_v = v_pages.reshape(NB * bs, KVH, D)
+    """Scatter fresh K/V into their HBM page slots.
+
+    Operates on the FULL stacked array through a flat reshape (a bitcast):
+    when the stacked pages are threaded as a loop carry, XLA performs this
+    scatter in place — slicing out a per-layer view first would copy the
+    layer every step."""
+    L, NB, bs, KVH, D = k_pages.shape
+    flat_k = k_pages.reshape(L * NB * bs, KVH, D)
+    flat_v = v_pages.reshape(L * NB * bs, KVH, D)
     slots = slot_mapping.reshape(-1)
-    # Out-of-range slots are dropped by scatter mode="drop".
-    slots = jnp.where(slots < 0, NB * bs, slots)
+    # Layer offset; out-of-range slots are dropped by scatter mode="drop".
+    slots = jnp.where(slots < 0, L * NB * bs, slots + layer * NB * bs)
     flat_k = flat_k.at[slots].set(
         k_new.reshape(-1, KVH, D).astype(k_pages.dtype), mode="drop"
     )
     flat_v = flat_v.at[slots].set(
         v_new.reshape(-1, KVH, D).astype(v_pages.dtype), mode="drop"
     )
-    return flat_k.reshape(NB, bs, KVH, D), flat_v.reshape(NB, bs, KVH, D)
+    return (flat_k.reshape(L, NB, bs, KVH, D),
+            flat_v.reshape(L, NB, bs, KVH, D))
 
 
 def paged_attention_reference(
     q: jax.Array,  # [B, H, D]
-    k_pages: jax.Array,  # [NB, bs, KVH, D]
-    v_pages: jax.Array,  # [NB, bs, KVH, D]
+    k_pages: jax.Array,  # [L, NB, bs, KVH, D]
+    v_pages: jax.Array,  # [L, NB, bs, KVH, D]
     block_tables: jax.Array,  # [B, MAXB] page ids
     context_lens: jax.Array,  # [B]
+    layer: jax.Array,  # scalar layer index
     *,
     scale: float,
 ) -> jax.Array:
     """XLA fallback: gather the padded context, mask, soft-max. [B, H, D]."""
     B, H, D = q.shape
-    NB, bs, KVH, _ = k_pages.shape
+    bs, KVH = k_pages.shape[2], k_pages.shape[3]
     MAXB = block_tables.shape[1]
     group = H // KVH
-    # Gather pages -> [B, MAXB*bs, KVH, D]
-    k_ctx = k_pages[block_tables].reshape(B, MAXB * bs, KVH, D)
-    v_ctx = v_pages[block_tables].reshape(B, MAXB * bs, KVH, D)
+    k_ctx = _gather_ctx(k_pages, block_tables, layer)
+    v_ctx = _gather_ctx(v_pages, block_tables, layer)
     qg = q.reshape(B, KVH, group, D)
     scores = jnp.einsum(
         "bkgd,bskd->bkgs", qg, k_ctx, preferred_element_type=jnp.float32
@@ -152,18 +173,20 @@ def paged_attention_reference(
 
 
 def paged_decode_attention(
-    q: jax.Array,
-    k_pages: jax.Array,
-    v_pages: jax.Array,
+    q: jax.Array,  # [B, H, D]
+    k_pages: jax.Array,  # [L, NB, bs, KVH, D]
+    v_pages: jax.Array,  # [L, NB, bs, KVH, D]
     block_tables: jax.Array,
     context_lens: jax.Array,
+    layer: jax.Array,  # scalar layer index
     *,
     scale: float,
 ) -> jax.Array:
     """Dispatch to the pallas kernel on TPU, XLA reference elsewhere."""
-    head_dim = q.shape[-1]
-    block_size = k_pages.shape[1]
-    tile_ok = head_dim % 128 == 0 and block_size % 8 == 0
+    block_size = k_pages.shape[2]
+    # Full K/V pages are DMA'd per grid step, so any head_dim/KVH works;
+    # only the page's token rows must respect the sublane tile.
+    tile_ok = block_size % 8 == 0
     if tile_ok and _use_pallas():
         from production_stack_tpu.ops.pallas_paged_attention import (
             pallas_paged_attention,
@@ -171,10 +194,11 @@ def paged_decode_attention(
 
         try:
             return pallas_paged_attention(
-                q, k_pages, v_pages, block_tables, context_lens, scale=scale
+                q, k_pages, v_pages, block_tables, context_lens, layer,
+                scale=scale,
             )
         except Exception:  # noqa: BLE001 - fall back rather than fail serving
             pass
     return paged_attention_reference(
-        q, k_pages, v_pages, block_tables, context_lens, scale=scale
+        q, k_pages, v_pages, block_tables, context_lens, layer, scale=scale
     )

@@ -2,13 +2,16 @@
 
 One query token per sequence attends over that sequence's KV pages scattered
 in HBM. The kernel walks only the pages named in the block table (scalar-
-prefetched so the DMA pipeline can start before compute), keeping an online
-softmax in VMEM scratch — the TPU equivalent of vLLM's CUDA PagedAttention
-kernel, which the reference stack consumes via engine images.
+prefetched so the page DMA can be issued from the block-table entry before
+compute), keeping an online softmax in VMEM scratch — the TPU equivalent of
+vLLM's CUDA PagedAttention kernel, which the reference stack consumes via
+engine images.
 
-Grid: (batch, kv_head, max_blocks). Each step DMAs one K page and one V page
-([block_size, head_dim] slices) into VMEM and folds them into the running
-softmax for the query-head group of that kv head (GQA).
+Grid: (batch, max_blocks), page-sequential per sequence. Each step DMAs one
+whole K page and one whole V page ([block_size, KVH, D] — full pages keep
+the block shape legal for Mosaic: the trailing (KVH, D) dims match the
+array) and folds them into the running softmax for every query-head group
+(GQA) in one pass.
 """
 
 from __future__ import annotations
@@ -26,20 +29,23 @@ NEG_INF = -1e30
 def _decode_kernel(
     block_tables_ref,  # scalar prefetch [B, MAXB]
     context_lens_ref,  # scalar prefetch [B]
-    q_ref,  # [1, 1, G, D]
-    k_ref,  # [1, bs, 1, D]
-    v_ref,  # [1, bs, 1, D]
-    o_ref,  # [1, 1, G, D]
-    acc_ref,  # [G, D] f32
-    m_ref,  # [G, 128] f32
-    l_ref,  # [G, 128] f32
+    layer_ref,  # scalar prefetch [1]
+    q_ref,  # [1, KVH * g_pad, D]
+    k_ref,  # [1, 1, bs, KVH, D]
+    v_ref,  # [1, 1, bs, KVH, D]
+    o_ref,  # [1, KVH * g_pad, D]
+    acc_ref,  # [KVH * g_pad, D] f32
+    m_ref,  # [KVH * g_pad, 128] f32
+    l_ref,  # [KVH * g_pad, 128] f32
     *,
     scale: float,
     block_size: int,
+    kvh: int,
+    g_pad: int,
 ):
     b = pl.program_id(0)
-    i = pl.program_id(2)
-    nb = pl.num_programs(2)
+    i = pl.program_id(1)
+    nb = pl.num_programs(1)
     ctx = context_lens_ref[b]
 
     @pl.when(i == 0)
@@ -52,91 +58,99 @@ def _decode_kernel(
 
     @pl.when(block_start < ctx)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)  # [G, D]
-        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [bs, D]
-        v = v_ref[0, :, 0, :].astype(jnp.float32)  # [bs, D]
-        s = (
-            jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            * scale
-        )  # [G, bs]
         span = block_start + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_size), 1
         )
-        s = jnp.where(span < ctx, s, NEG_INF)
-        m_prev = m_ref[:, :1]  # [G, 1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)  # [G, bs]
-        l_ref[...] = jnp.broadcast_to(
-            alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True),
-            l_ref.shape,
-        )
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(
-            p.astype(jnp.float32), v, preferred_element_type=jnp.float32
-        )
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        valid = span < ctx  # [1, bs]
+        for h in range(kvh):  # static unroll over kv heads
+            rows = slice(h * g_pad, (h + 1) * g_pad)
+            q = q_ref[0, rows, :].astype(jnp.float32)  # [g_pad, D]
+            k = k_ref[0, 0, :, h, :].astype(jnp.float32)  # [bs, D]
+            v = v_ref[0, 0, :, h, :].astype(jnp.float32)  # [bs, D]
+            s = (
+                jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )  # [g_pad, bs]
+            s = jnp.where(valid, s, NEG_INF)
+            m_prev = m_ref[rows, :1]  # [g_pad, 1]
+            m_cur = jnp.max(s, axis=1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)  # [g_pad, bs]
+            l_ref[rows, :] = jnp.broadcast_to(
+                alpha * l_ref[rows, :1] + jnp.sum(p, axis=1, keepdims=True),
+                (g_pad, l_ref.shape[1]),
+            )
+            acc_ref[rows, :] = acc_ref[rows, :] * alpha + jax.lax.dot(
+                p, v, preferred_element_type=jnp.float32
+            )
+            m_ref[rows, :] = jnp.broadcast_to(m_new, (g_pad, m_ref.shape[1]))
 
     @pl.when(i == nb - 1)
     def _finalize():
         denom = jnp.maximum(l_ref[:, :1], 1e-30)
-        o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("scale",))
 def pallas_paged_attention(
     q: jax.Array,  # [B, H, D]
-    k_pages: jax.Array,  # [NB, bs, KVH, D]
-    v_pages: jax.Array,  # [NB, bs, KVH, D]
+    k_pages: jax.Array,  # [L, NB, bs, KVH, D] stacked pages
+    v_pages: jax.Array,  # [L, NB, bs, KVH, D]
     block_tables: jax.Array,  # [B, MAXB] int32
     context_lens: jax.Array,  # [B] int32
+    layer,  # scalar layer index (traced)
     *,
     scale: float,
 ) -> jax.Array:
     B, H, D = q.shape
-    NB, bs, KVH, _ = k_pages.shape
+    L, NB, bs, KVH, _ = k_pages.shape
     MAXB = block_tables.shape[1]
     group = H // KVH
-    # Pad the query-head group to the float32 sublane tile (8).
+    # Pad each query-head group to the float32 sublane tile (8 rows).
     g_pad = max(group, 8)
     qg = q.reshape(B, KVH, group, D)
     if g_pad != group:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, g_pad - group), (0, 0)))
+    qg = qg.reshape(B, KVH * g_pad, D)
 
-    grid = (B, KVH, MAXB)
+    grid = (B, MAXB)
     kernel = functools.partial(
-        _decode_kernel, scale=scale, block_size=bs
+        _decode_kernel, scale=scale, block_size=bs, kvh=KVH, g_pad=g_pad
     )
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=3,
             grid=grid,
             in_specs=[
                 pl.BlockSpec(
-                    (1, 1, g_pad, D), lambda b, h, i, bt, cl: (b, h, 0, 0)
+                    (1, KVH * g_pad, D), lambda b, i, bt, cl, lr: (b, 0, 0)
                 ),
                 pl.BlockSpec(
-                    (1, bs, 1, D), lambda b, h, i, bt, cl: (bt[b, i], 0, h, 0)
+                    (1, 1, bs, KVH, D),
+                    lambda b, i, bt, cl, lr: (lr[0], bt[b, i], 0, 0, 0),
                 ),
                 pl.BlockSpec(
-                    (1, bs, 1, D), lambda b, h, i, bt, cl: (bt[b, i], 0, h, 0)
+                    (1, 1, bs, KVH, D),
+                    lambda b, i, bt, cl, lr: (lr[0], bt[b, i], 0, 0, 0),
                 ),
             ],
             out_specs=pl.BlockSpec(
-                (1, 1, g_pad, D), lambda b, h, i, bt, cl: (b, h, 0, 0)
+                (1, KVH * g_pad, D), lambda b, i, bt, cl, lr: (b, 0, 0)
             ),
             scratch_shapes=[
-                pltpu.VMEM((g_pad, D), jnp.float32),
-                pltpu.VMEM((g_pad, 128), jnp.float32),
-                pltpu.VMEM((g_pad, 128), jnp.float32),
+                pltpu.VMEM((KVH * g_pad, D), jnp.float32),
+                pltpu.VMEM((KVH * g_pad, 128), jnp.float32),
+                pltpu.VMEM((KVH * g_pad, 128), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((B, KVH, g_pad, D), q.dtype),
-    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32), qg,
-      k_pages, v_pages)
-    out = out[:, :, :group, :]
+        out_shape=jax.ShapeDtypeStruct((B, KVH * g_pad, D), q.dtype),
+    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
+      layer_arr, qg, k_pages, v_pages)
+    out = out.reshape(B, KVH, g_pad, D)[:, :, :group, :]
     return out.reshape(B, H, D)

@@ -93,18 +93,20 @@ def test_paged_reference_matches_prefill_attention():
     v = jnp.asarray(rng.normal(size=(B, T, KVH, D)), jnp.float32)
     dense = prefill_attention(q, k, v, scale=0.25)
 
-    # Scatter k/v into pages and decode the last position of each sequence.
-    k_pages = jnp.zeros((NB, bs, KVH, D), jnp.float32)
-    v_pages = jnp.zeros((NB, bs, KVH, D), jnp.float32)
+    # Scatter k/v into stacked pages (layer axis first) and decode the last
+    # position of each sequence.
+    L = 1
+    k_pages = jnp.zeros((L, NB, bs, KVH, D), jnp.float32)
+    v_pages = jnp.zeros((L, NB, bs, KVH, D), jnp.float32)
     bt = np.asarray([[1, 2, 0, 0], [3, 9, 0, 0]], np.int32)
     for b in range(B):
         for t in range(T):
             blk, off = bt[b][t // bs], t % bs
-            k_pages = k_pages.at[blk, off].set(k[b, t])
-            v_pages = v_pages.at[blk, off].set(v[b, t])
+            k_pages = k_pages.at[0, blk, off].set(k[b, t])
+            v_pages = v_pages.at[0, blk, off].set(v[b, t])
     out = paged_attention_reference(
         q[:, T - 1], k_pages, v_pages, jnp.asarray(bt),
-        jnp.asarray([T, T], np.int32), scale=0.25,
+        jnp.asarray([T, T], np.int32), jnp.int32(0), scale=0.25,
     )
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(dense[:, T - 1]), atol=1e-5, rtol=1e-5

@@ -1,0 +1,115 @@
+"""Fused multi-step decode: burst generation must match step-by-step
+generation exactly (greedy), and finish conditions mid-burst must trim."""
+
+import threading
+
+import numpy as np
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.core import EngineCore
+from production_stack_tpu.engine.sampling import SamplingParams
+
+
+def _run(core, prompt_ids, max_tokens=16, rid="r", ignore_eos=True):
+    done = threading.Event()
+    out = []
+
+    def on_token(tok, finish):
+        if tok is not None:
+            out.append(tok)
+        if finish is not None:
+            out.append(("finish", finish))
+            done.set()
+
+    core.add_request(
+        rid, list(prompt_ids),
+        SamplingParams(temperature=0.0, max_tokens=max_tokens,
+                       ignore_eos=ignore_eos),
+        on_token,
+    )
+    assert done.wait(timeout=180), "generation timed out"
+    return out
+
+
+def _config(**kw):
+    base = dict(
+        model="tiny-llama", max_model_len=256, max_num_seqs=4,
+        block_size=8, num_blocks=128, max_loras=0,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def test_burst_matches_single_step():
+    rng = np.random.default_rng(11)
+    prompt = [int(t) for t in rng.integers(0, 500, size=30)]
+
+    single = EngineCore(_config(decode_steps=1))
+    single.start()
+    try:
+        out_single = _run(single, prompt, max_tokens=17)
+    finally:
+        single.stop()
+
+    burst = EngineCore(_config(decode_steps=8))
+    burst.start()
+    try:
+        out_burst = _run(burst, prompt, max_tokens=17)
+    finally:
+        burst.stop()
+
+    assert out_burst == out_single
+
+
+def test_burst_respects_max_tokens():
+    core = EngineCore(_config(decode_steps=8))
+    core.start()
+    try:
+        out = _run(core, list(range(20)), max_tokens=5)
+        tokens = [t for t in out if not isinstance(t, tuple)]
+        assert len(tokens) == 5
+        assert out[-1] == ("finish", "length")
+    finally:
+        core.stop()
+
+
+def test_burst_concurrent_sequences():
+    core = EngineCore(_config(decode_steps=8))
+    core.start()
+    try:
+        outs = {}
+        events = {}
+
+        def make_cb(key):
+            ev = threading.Event()
+            events[key] = ev
+            outs[key] = []
+
+            def cb(tok, finish):
+                if tok is not None:
+                    outs[key].append(tok)
+                if finish is not None:
+                    ev.set()
+            return cb
+
+        rng = np.random.default_rng(13)
+        prompts = {
+            f"s{i}": [int(t) for t in rng.integers(0, 500, size=10 + i)]
+            for i in range(4)
+        }
+        for i, (key, prompt) in enumerate(prompts.items()):
+            core.add_request(
+                key, prompt,
+                SamplingParams(temperature=0.0, max_tokens=9 + i,
+                               ignore_eos=True),
+                make_cb(key),
+            )
+        for key, ev in events.items():
+            assert ev.wait(timeout=180), f"{key} timed out"
+        # Every sequence got exactly its max_tokens — budgets differ per
+        # sequence, so per-seq burst-width clamping (allow masking) is
+        # actually exercised within shared bursts.
+        for i in range(4):
+            assert len(outs[f"s{i}"]) == 9 + i
+    finally:
+        core.stop()
