@@ -101,6 +101,7 @@ class EngineServer:
         r.add_post("/kv/extract", self.handle_kv_extract)
         r.add_post("/kv/inject", self.handle_kv_inject)
         r.add_post("/kv/pull", self.handle_kv_pull)
+        r.add_post("/v1/audio/transcriptions", self.handle_transcriptions)
         app["engine_server"] = self
         return app
 
@@ -369,6 +370,22 @@ class EngineServer:
         body = await request.json()
         return web.json_response(
             {"prompt": self.core.tokenizer.decode(body.get("tokens", []))})
+
+    async def handle_transcriptions(self, request: web.Request) -> web.Response:
+        """Audio transcription is part of the OpenAI surface the router
+        proxies (multipart); the model zoo has no ASR family yet, so this
+        answers 501 explicitly rather than 404 (the reference gets Whisper
+        via vLLM images)."""
+        await request.post()  # drain the multipart body
+        return web.json_response(
+            {"error": {
+                "message": "audio transcription requires an ASR model; "
+                           "no whisper-class model is in the TPU model zoo"
+                           " yet",
+                "type": "NotImplementedError",
+            }},
+            status=501,
+        )
 
     # ------------------------------------------------------------------ #
     # lifecycle / metrics

@@ -1,0 +1,98 @@
+#!/usr/bin/env python3
+"""Model/adapter downloader sidecar.
+
+Runs beside an engine container (shared volume) and downloads model weights
+or LoRA adapters on request — the TPU stack's counterpart of the
+reference's ``scripts/huggingface_downloader.py`` sidecar, which the
+LoraAdapter controller calls at ``/model/download`` on port 30090
+(reference ``operator/internal/controller/loraadapter_controller.go:334-390``).
+
+API:
+    POST /model/download {"model": "<hf-id-or-uri>", "target": "<subdir>"}
+        -> {"status": "ok", "path": ...}  (202 while in progress)
+    GET  /model/status?model=<id>
+    GET  /health
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import threading
+
+from aiohttp import web
+
+_state = {}  # model id -> {"status": downloading|done|error, "path"/"error"}
+_lock = threading.Lock()
+
+
+def _download(model: str, base_dir: str, target: str) -> None:
+    dest = os.path.join(base_dir, target or model.replace("/", "--"))
+    try:
+        from huggingface_hub import snapshot_download
+
+        path = snapshot_download(repo_id=model, local_dir=dest)
+        with _lock:
+            _state[model] = {"status": "done", "path": path}
+    except Exception as e:  # noqa: BLE001
+        with _lock:
+            _state[model] = {"status": "error", "error": str(e)}
+
+
+def make_app(base_dir: str) -> web.Application:
+    app = web.Application()
+
+    async def download(request: web.Request) -> web.Response:
+        body = await request.json()
+        model = body.get("model")
+        if not model:
+            return web.json_response({"error": "model required"}, status=400)
+        with _lock:
+            cur = _state.get(model)
+            if cur and cur["status"] == "done":
+                return web.json_response({"status": "ok", **cur})
+            if cur and cur["status"] == "downloading":
+                return web.json_response({"status": "downloading"},
+                                         status=202)
+            _state[model] = {"status": "downloading"}
+        threading.Thread(
+            target=_download, args=(model, base_dir, body.get("target", "")),
+            daemon=True,
+        ).start()
+        return web.json_response({"status": "downloading"}, status=202)
+
+    async def status(request: web.Request) -> web.Response:
+        model = request.query.get("model", "")
+        with _lock:
+            return web.json_response(
+                _state.get(model, {"status": "unknown"}))
+
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    app.router.add_post("/model/download", download)
+    app.router.add_get("/model/status", status)
+    app.router.add_get("/health", health)
+    return app
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=30090)
+    p.add_argument("--base-dir", default="/models")
+    args = p.parse_args()
+
+    async def _run():
+        runner = web.AppRunner(make_app(args.base_dir))
+        await runner.setup()
+        await web.TCPSite(runner, args.host, args.port).start()
+        while True:
+            await asyncio.sleep(3600)
+
+    asyncio.run(_run())
+
+
+if __name__ == "__main__":
+    main()

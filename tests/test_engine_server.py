@@ -238,6 +238,20 @@ def test_stop_string(server_url):
     asyncio.run(run())
 
 
+def test_transcriptions_explicit_501(server_url):
+    async def run():
+        async with aiohttp.ClientSession() as s:
+            form = aiohttp.FormData()
+            form.add_field("file", b"RIFF....WAVE", filename="a.wav")
+            form.add_field("model", "tiny-llama")
+            async with s.post(server_url + "/v1/audio/transcriptions",
+                              data=form) as r:
+                assert r.status == 501
+                body = await r.json()
+        assert body["error"]["type"] == "NotImplementedError"
+    asyncio.run(run())
+
+
 def test_concurrent_requests(server_url):
     async def run():
         async def one(i):
