@@ -85,6 +85,7 @@ class EngineCore:
             self.model_config, self.mesh, shapes
         )
         self.params = jax.jit(_init, out_shardings=self._param_shardings)()
+        self._maybe_load_checkpoint()
 
         # -- KV pages ------------------------------------------------------
         self.num_blocks = config.num_blocks or self._auto_num_blocks()
@@ -149,6 +150,42 @@ class EngineCore:
     # ------------------------------------------------------------------ #
     # setup helpers
     # ------------------------------------------------------------------ #
+    def _maybe_load_checkpoint(self) -> None:
+        """If the model points at a local HF checkpoint directory, replace
+        the random-init leaves with the loaded weights (device_put with the
+        leaf's mesh sharding). Leaves the checkpoint doesn't carry — LoRA
+        slots — keep their init values."""
+        from production_stack_tpu.models.weights import (
+            has_checkpoint,
+            load_checkpoint,
+        )
+
+        if not has_checkpoint(self.config.model):
+            return
+        loaded = load_checkpoint(self.model_config, self.config.model)
+
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        replicated = NamedSharding(self.mesh, PartitionSpec())
+
+        def merge(dst: dict, src: dict, shard: dict) -> None:
+            for key, val in src.items():
+                if isinstance(val, dict):
+                    merge(dst.setdefault(key, {}), val, shard.get(key, {}))
+                else:
+                    dst[key] = jax.device_put(
+                        val, shard.get(key, replicated))
+
+        params = dict(self.params)
+        params["layers"] = dict(params["layers"])
+        merge(params, loaded, self._param_shardings)
+        if self.model_config.arch == "llama" and "lm_head" not in loaded:
+            # Tied-embedding checkpoint: drop the random head so apply()
+            # falls back to embed.T.
+            params.pop("lm_head", None)
+        self.params = params
+        logger.info("Loaded checkpoint weights from %s", self.config.model)
+
     def _kv_bytes_per_block(self) -> int:
         mc = self.model_config
         itemsize = jnp.dtype(mc.dtype).itemsize

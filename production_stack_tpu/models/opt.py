@@ -54,9 +54,13 @@ def init_params(cfg: ModelConfig, rng: jax.Array, **_unused) -> Dict:
             "ln1_w": jnp.ones((L, Hd), dtype),
             "ln1_b": jnp.zeros((L, Hd), dtype),
             "wq": stack(keys[2], (Hd, H * D), Hd),
+            "wq_b": jnp.zeros((L, H * D), dtype),
             "wk": stack(keys[3], (Hd, H * D), Hd),
+            "wk_b": jnp.zeros((L, H * D), dtype),
             "wv": stack(keys[4], (Hd, H * D), Hd),
+            "wv_b": jnp.zeros((L, H * D), dtype),
             "wo": stack(keys[5], (H * D, Hd), H * D),
+            "wo_b": jnp.zeros((L, Hd), dtype),
             "ln2_w": jnp.ones((L, Hd), dtype),
             "ln2_b": jnp.zeros((L, Hd), dtype),
             "fc1": stack(keys[6], (Hd, I), Hd),
@@ -79,9 +83,9 @@ def _layer(
     k_pages, v_pages = kv  # stacked [L, NB, bs, KVH, D]
 
     h = layer_norm(x, p["ln1_w"], p["ln1_b"])
-    q = (h @ p["wq"]).reshape(B, T, H, D)
-    k = (h @ p["wk"]).reshape(B, T, H, D)
-    v = (h @ p["wv"]).reshape(B, T, H, D)
+    q = (h @ p["wq"] + p["wq_b"]).reshape(B, T, H, D)
+    k = (h @ p["wk"] + p["wk_b"]).reshape(B, T, H, D)
+    v = (h @ p["wv"] + p["wv_b"]).reshape(B, T, H, D)
     k_pages, v_pages = write_kv_pages(
         k_pages, v_pages, k, v, slot_mapping, layer)
     if mode == "prefill":
@@ -98,7 +102,7 @@ def _layer(
             q[:, 0], k_pages, v_pages, block_tables, context_lens, layer,
             scale=scale,
         )[:, None]
-    x = x + attn.reshape(B, T, H * D) @ p["wo"]
+    x = x + attn.reshape(B, T, H * D) @ p["wo"] + p["wo_b"]
 
     h = layer_norm(x, p["ln2_w"], p["ln2_b"])
     h = jax.nn.relu(h @ p["fc1"] + p["fc1_b"])

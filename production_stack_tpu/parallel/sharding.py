@@ -59,9 +59,13 @@ _OPT_SPECS = {
     ("layers", "ln2_w"): P(None, None),
     ("layers", "ln2_b"): P(None, None),
     ("layers", "wq"): P(None, None, "tp"),
+    ("layers", "wq_b"): P(None, "tp"),
     ("layers", "wk"): P(None, None, "tp"),
+    ("layers", "wk_b"): P(None, "tp"),
     ("layers", "wv"): P(None, None, "tp"),
+    ("layers", "wv_b"): P(None, "tp"),
     ("layers", "wo"): P(None, "tp", None),
+    ("layers", "wo_b"): P(None, None),
     ("layers", "fc1"): P(None, None, "tp"),
     ("layers", "fc1_b"): P(None, "tp"),
     ("layers", "fc2"): P(None, "tp", None),
