@@ -576,19 +576,21 @@ class EngineCore:
         weights: Optional[dict] = None, alpha: float = 16.0,
     ) -> bool:
         """Install an adapter into a free slot without recompiling."""
-        if "lora" not in (self.params or {}):
-            return False
-        if name in self.lora_slots:
-            return True
-        used = set(self.lora_slots.values())
-        free = [
-            s for s in range(1, self.config.max_loras) if s not in used
-        ]
-        if not free:
-            return False
-        slot = free[0]
         rank = min(rank or self.config.max_lora_rank, self.config.max_lora_rank)
         with self._lock:
+            # All state checks under the lock: sleep() can null self.params
+            # between an outside check and the mutation (stress-test race).
+            if self.params is None or "lora" not in self.params:
+                return False
+            if name in self.lora_slots:
+                return True
+            used = set(self.lora_slots.values())
+            free = [
+                s for s in range(1, self.config.max_loras) if s not in used
+            ]
+            if not free:
+                return False
+            slot = free[0]
             lora = dict(self.params["lora"])
             if weights is not None:
                 for key in ("wq_a", "wq_b", "wv_a", "wv_b"):
@@ -612,12 +614,12 @@ class EngineCore:
         return True
 
     def unload_lora_adapter(self, name: str) -> bool:
-        if name not in self.lora_slots:
-            return False
-        if self.params is None:  # sleeping: weights are on the host
-            return False
-        slot = self.lora_slots.pop(name)
         with self._lock:
+            if name not in self.lora_slots:
+                return False
+            if self.params is None:  # sleeping: weights are on the host
+                return False
+            slot = self.lora_slots.pop(name)
             lora = dict(self.params["lora"])
             lora["scaling"] = lora["scaling"].at[slot].set(0.0)
             self.params = {**self.params, "lora": lora}

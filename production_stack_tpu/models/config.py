@@ -127,7 +127,8 @@ def _from_hf_config_json(path: str, name: str) -> ModelConfig:
         num_layers=cfg.get("num_hidden_layers", cfg.get("num_layers", 32)),
         num_heads=heads,
         num_kv_heads=cfg.get("num_key_value_heads", heads),
-        head_dim=cfg.get("head_dim", hidden // heads),
+        # some configs carry an explicit null head_dim
+        head_dim=cfg.get("head_dim") or hidden // heads,
         intermediate_size=cfg.get("intermediate_size", cfg.get("ffn_dim", 4 * hidden)),
         max_position=cfg.get("max_position_embeddings", 8192),
         rope_theta=cfg.get("rope_theta", 10000.0),

@@ -180,6 +180,52 @@ def test_opt_logits_parity_with_transformers(tmp_path):
         np.asarray(logits)[:, :T], hf_logits, rtol=2e-4, atol=2e-4)
 
 
+def test_mixtral_logits_parity_with_transformers(tmp_path):
+    """MoE: expert weights, router, and top-k weighting must match HF."""
+    import jax.numpy as jnp
+    import torch
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    torch.manual_seed(2)
+    hf_cfg = MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+    )
+    hf_model = MixtralForCausalLM(hf_cfg)
+    hf_model.eval()
+    path = str(tmp_path / "mixtral-ckpt")
+    hf_model.save_pretrained(path, safe_serialization=True)
+
+    cfg = get_model_config(path).replace(dtype="float32")
+    assert cfg.arch == "mixtral" and cfg.num_experts == 4
+    _, apply = build_model(cfg)
+    params = load_checkpoint(cfg, path)
+
+    T = 9
+    rng = np.random.default_rng(6)
+    tokens = rng.integers(0, cfg.vocab_size, size=(1, T))
+    with torch.no_grad():
+        hf_logits = hf_model(
+            torch.asarray(tokens, dtype=torch.long)
+        ).logits.numpy()
+
+    bs, NB, maxb = 4, 16, 8
+    kv_shape = (cfg.num_layers, NB, bs, cfg.num_kv_heads, cfg.head_dim)
+    kv = (jnp.zeros(kv_shape, jnp.float32), jnp.zeros(kv_shape, jnp.float32))
+    positions = np.arange(T)[None, :].astype(np.int32)
+    logits, _ = apply(
+        params, cfg, jnp.asarray(tokens, jnp.int32), jnp.asarray(positions),
+        kv, jnp.asarray(positions.astype(np.int64)),
+        jnp.asarray(np.arange(maxb)[None, :].astype(np.int32)),
+        jnp.asarray([T], jnp.int32), jnp.asarray([T], jnp.int32),
+        mode="prefill",
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits)[:, :T], hf_logits, rtol=5e-4, atol=5e-4)
+
+
 def test_missing_tensor_fails_loudly(tmp_path):
     """A checkpoint missing layers must raise, not serve garbage."""
     import numpy as np_
