@@ -67,7 +67,10 @@ class _TokenStream:
 class EngineServer:
     def __init__(self, config: EngineConfig,
                  served_model_names: Optional[List[str]] = None,
-                 warmup: bool = False):
+                 warmup: bool = False,
+                 kv_controller_url: Optional[str] = None,
+                 instance_id: Optional[str] = None,
+                 advertise_url: Optional[str] = None):
         self.config = config
         self.core = EngineCore(config)
         if warmup:
@@ -75,6 +78,64 @@ class EngineServer:
         self.core.start()
         self.served_models = served_model_names or [config.model]
         self.start_time = time.time()
+        # KV-aware routing: this engine reports its prefix admissions to
+        # the router's KV controller (the reference's LMCache worker ->
+        # controller channel, deployment-vllm-multi.yaml:324-339).
+        self.kv_controller_url = (
+            kv_controller_url.rstrip("/") if kv_controller_url else None
+        )
+        self.instance_id = instance_id or f"engine-{uuid.uuid4().hex[:8]}"
+        self.advertise_url = advertise_url
+        self._kv_registered = False
+
+    async def start_kv_reporting(self, own_url: str) -> None:
+        """Register with the router's KV controller (retried lazily on
+        each admission until it succeeds)."""
+        if self.kv_controller_url is None:
+            return
+        if self.advertise_url is None:
+            self.advertise_url = own_url
+        await self._kv_register()
+
+    async def _kv_register(self) -> bool:
+        import aiohttp
+
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"{self.kv_controller_url}/kv/register",
+                    json={"instance_id": self.instance_id,
+                          "url": self.advertise_url},
+                    timeout=aiohttp.ClientTimeout(total=5),
+                ) as resp:
+                    self._kv_registered = resp.status == 200
+        except aiohttp.ClientError as e:
+            logger.debug("KV controller register failed: %s", e)
+            self._kv_registered = False
+        return self._kv_registered
+
+    def _report_kv_admission(self, prompt_text: str) -> None:
+        """Fire-and-forget admission report (prompt text chunk hashes)."""
+        if self.kv_controller_url is None or not prompt_text:
+            return
+
+        async def _send():
+            import aiohttp
+
+            if not self._kv_registered and not await self._kv_register():
+                return
+            try:
+                async with aiohttp.ClientSession() as s:
+                    await s.post(
+                        f"{self.kv_controller_url}/kv/admit",
+                        json={"instance_id": self.instance_id,
+                              "text": prompt_text},
+                        timeout=aiohttp.ClientTimeout(total=5),
+                    )
+            except aiohttp.ClientError as e:
+                logger.debug("KV admit report failed: %s", e)
+
+        asyncio.get_running_loop().create_task(_send())
 
     # ------------------------------------------------------------------ #
     # app assembly
@@ -171,6 +232,7 @@ class EngineServer:
         messages = body.get("messages", [])
         prompt = self.core.tokenizer.apply_chat_template(messages)
         prompt_ids = self.core.tokenizer.encode(prompt)
+        self._report_kv_admission(prompt)
         sampling = SamplingParams.from_request(body, default_max_tokens=128)
         rid = request.headers.get("X-Request-Id") or f"chatcmpl-{uuid.uuid4().hex[:16]}"
         adapter = self._resolve_adapter(model)
@@ -202,6 +264,7 @@ class EngineServer:
             if isinstance(prompt, list):
                 prompt = prompt[0] if prompt else ""
             prompt_ids = self.core.tokenizer.encode(str(prompt))
+            self._report_kv_admission(str(prompt))
         sampling = SamplingParams.from_request(body, default_max_tokens=16)
         rid = request.headers.get("X-Request-Id") or f"cmpl-{uuid.uuid4().hex[:16]}"
         adapter = self._resolve_adapter(model)
@@ -283,6 +346,18 @@ class EngineServer:
         text_so_far = ""
         async for token_id, finish in stream:
             if token_id is None:
+                if finish == "length" and n_generated == 0:
+                    # Scheduler rejection: the prompt itself exceeds
+                    # max_model_len. Surface as a client error, not an
+                    # empty completion.
+                    return web.json_response(
+                        {"error": {
+                            "message": (
+                                f"prompt ({len(prompt_ids)} tokens) "
+                                f"exceeds max_model_len "
+                                f"{self.config.max_model_len}"),
+                            "type": "BadRequestError",
+                        }}, status=400)
                 if finish in ("stop", "length", "abort"):
                     finish_reason = finish
                 break
@@ -597,7 +672,9 @@ async def run_engine_server(server: EngineServer, host: str, port: int) -> web.A
     await runner.setup()
     site = web.TCPSite(runner, host, port)
     await site.start()
-    logger.info("Engine server on %s:%d (model=%s)", host, port,
+    real_port = site._server.sockets[0].getsockname()[1]
+    await server.start_kv_reporting(f"http://{host}:{real_port}")
+    logger.info("Engine server on %s:%d (model=%s)", host, real_port,
                 server.config.model)
     return runner
 
@@ -629,6 +706,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-warmup", dest="warmup", action="store_false",
                    default=True,
                    help="skip precompiling serving programs at startup")
+    p.add_argument("--kv-controller-url", default=None,
+                   help="router URL to report KV admissions to "
+                        "(enables kv-aware routing against this engine)")
+    p.add_argument("--instance-id", default=None)
+    p.add_argument("--advertise-url", default=None,
+                   help="URL the router should route to for this instance")
     return p
 
 
@@ -652,7 +735,10 @@ def main(argv: Optional[List[str]] = None) -> None:
         kv_remote_url=args.kv_remote_url,
     )
     server = EngineServer(config, args.served_model_name,
-                          warmup=args.warmup)
+                          warmup=args.warmup,
+                          kv_controller_url=args.kv_controller_url,
+                          instance_id=args.instance_id,
+                          advertise_url=args.advertise_url)
 
     async def _run():
         await run_engine_server(server, args.host, args.port)

@@ -238,6 +238,17 @@ def test_stop_string(server_url):
     asyncio.run(run())
 
 
+def test_overlong_prompt_rejected_400(server_url):
+    async def run():
+        status, body = await _post(server_url, "/v1/completions", {
+            "model": "tiny-llama", "prompt": "x" * 400,  # > max_model_len 256
+            "max_tokens": 4,
+        })
+        assert status == 400
+        assert "max_model_len" in body["error"]["message"]
+    asyncio.run(run())
+
+
 def test_transcriptions_explicit_501(server_url):
     async def run():
         async with aiohttp.ClientSession() as s:

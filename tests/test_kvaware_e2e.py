@@ -1,0 +1,138 @@
+"""KV-aware routing end-to-end with REAL engines: engines report prefix
+admissions to the router's KV controller, and same-prefix requests from
+different sessions route to the engine that already holds the KV."""
+
+import asyncio
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.server import EngineServer, run_engine_server
+from production_stack_tpu.router import routing_logic as rl
+from production_stack_tpu.router.app import build_app
+from production_stack_tpu.router.engine_stats import EngineStatsScraper
+from production_stack_tpu.router.parser import build_parser
+from production_stack_tpu.router.request_stats import RequestStatsMonitor
+from production_stack_tpu.utils.misc import SingletonABCMeta, SingletonMeta
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    classes = (
+        rl.RoundRobinRouter, rl.SessionRouter, rl.PrefixAwareRouter,
+        rl.KvawareRouter, rl.DisaggregatedPrefillRouter,
+    )
+    for cls in classes:
+        SingletonABCMeta._reset_instance(cls)
+    SingletonMeta._reset_instance(RequestStatsMonitor)
+    SingletonMeta._reset_instance(EngineStatsScraper)
+    yield
+    for cls in classes:
+        SingletonABCMeta._reset_instance(cls)
+    SingletonMeta._reset_instance(RequestStatsMonitor)
+    SingletonMeta._reset_instance(EngineStatsScraper)
+
+
+async def _start_site(app):
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+def test_kvaware_routes_to_reporting_engine():
+    servers = [
+        EngineServer(
+            EngineConfig(model="tiny-llama", max_model_len=1024,
+                         max_num_seqs=2, block_size=8, num_blocks=128,
+                         max_loras=0),
+        )
+        for _ in range(2)
+    ]
+
+    async def run():
+        # Router first (engines need its URL to report to).
+        args = build_parser().parse_args([])
+        args.static_backends = "http://placeholder"  # replaced below
+        args.static_models = "tiny-llama"
+        args.routing_logic = "kvaware"
+        args.session_key = "x-user-id"
+        args.engine_stats_interval = 5
+
+        # Engines come up first so their URLs are known, reporting to the
+        # router once it exists — register is retried lazily on admission.
+        runners, urls = [], []
+        for srv in servers:
+            r = await run_engine_server(srv, "127.0.0.1", 0)
+            runners.append(r)
+            urls.append(srv.advertise_url or "")
+
+        # Engine URLs are assigned during run_engine_server; fetch actual.
+        urls = []
+        for r in runners:
+            port = list(r.sites)[0]._server.sockets[0].getsockname()[1]
+            urls.append(f"http://127.0.0.1:{port}")
+
+        args.static_backends = ",".join(urls)
+        args.static_models = ",".join(["tiny-llama"] * 2)
+        router_app = build_app(args)
+        r_runner, r_url = await _start_site(router_app)
+
+        # Point both engines' reporting at the live router.
+        for srv, url in zip(servers, urls):
+            srv.kv_controller_url = r_url
+            srv.advertise_url = url
+
+        shared_prefix = ("context " * 80).strip()  # ~640 chars, >4 chunks
+        try:
+            async with aiohttp.ClientSession() as s:
+                async def completion(user, suffix):
+                    async with s.post(r_url + "/v1/completions", json={
+                        "model": "tiny-llama",
+                        "prompt": shared_prefix + " " + suffix,
+                        "max_tokens": 2, "temperature": 0.0,
+                        "ignore_eos": True,
+                    }, headers={"x-user-id": user},
+                       timeout=aiohttp.ClientTimeout(total=300)) as resp:
+                        assert resp.status == 200, await resp.text()
+                        return await resp.json()
+
+                # First request: session fallback; the serving engine
+                # reports the admission.
+                await completion("alice", "first question")
+                await asyncio.sleep(0.3)  # let the admit report land
+
+                first_served = [
+                    i for i, srv in enumerate(servers)
+                    if srv.core.prompt_tokens_total > 0
+                ]
+                assert len(first_served) == 1
+                target = first_served[0]
+
+                # Different users, same long prefix: kv-aware routing must
+                # send them all to the engine that holds the KV.
+                for user in ("bob", "carol", "dave"):
+                    await completion(user, f"question from {user}")
+                    await asyncio.sleep(0.2)
+
+                other = 1 - target
+                assert servers[other].core.prompt_tokens_total == 0, (
+                    "kv-aware routing sent a same-prefix request to the "
+                    "cold engine"
+                )
+                # And the hot engine served them from its prefix cache.
+                assert servers[target].core.cached_tokens_total > 0
+        finally:
+            await r_runner.cleanup()
+            for r in runners:
+                await r.cleanup()
+
+    try:
+        asyncio.run(run())
+    finally:
+        for srv in servers:
+            srv.core.stop()
