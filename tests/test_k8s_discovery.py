@@ -1,0 +1,175 @@
+"""K8s service discovery: the REST client against a fake API server
+(list/watch/patch), and the watch-driven discovery wiring pod events to
+live endpoints (reference service_discovery.py:344-760)."""
+
+import asyncio
+import json
+import threading
+import time
+
+import pytest
+from aiohttp import web
+
+from production_stack_tpu.router.k8s_client import K8sClient
+from production_stack_tpu.router.service_discovery import (
+    K8sPodIPServiceDiscovery,
+)
+from production_stack_tpu.testing.fake_engine import FakeEngine
+
+
+class FakeK8sApi:
+    """Serves /api/v1 pods list + a chunked watch stream + label patch."""
+
+    def __init__(self):
+        self.pods = []
+        self.patches = []
+        self._watch_queue: "asyncio.Queue[dict]" = None
+        self._loop = None
+
+    def make_app(self):
+        app = web.Application()
+        app.router.add_get(
+            "/api/v1/namespaces/{ns}/pods", self.handle_pods)
+        app.router.add_patch(
+            "/api/v1/namespaces/{ns}/pods/{name}", self.handle_patch)
+        return app
+
+    def push_event(self, event: dict):
+        self._loop.call_soon_threadsafe(
+            self._watch_queue.put_nowait, event)
+
+    async def handle_pods(self, request: web.Request):
+        if request.query.get("watch") != "true":
+            return web.json_response({"items": self.pods})
+        self._loop = asyncio.get_running_loop()
+        self._watch_queue = asyncio.Queue()
+        resp = web.StreamResponse()
+        resp.content_type = "application/json"
+        await resp.prepare(request)
+        try:
+            while True:
+                event = await self._watch_queue.get()
+                await resp.write((json.dumps(event) + "\n").encode())
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        return resp
+
+    async def handle_patch(self, request: web.Request):
+        self.patches.append((request.match_info["name"],
+                             await request.json()))
+        return web.json_response({})
+
+
+def _pod(name, ip, ready=True, labels=None, deleting=False):
+    return {
+        "metadata": {
+            "name": name,
+            "labels": labels or {},
+            **({"deletionTimestamp": "2026-01-01T00:00:00Z"}
+               if deleting else {}),
+        },
+        "status": {
+            "phase": "Running" if ready else "Pending",
+            "podIP": ip,
+            "conditions": [
+                {"type": "Ready", "status": "True" if ready else "False"}
+            ],
+        },
+    }
+
+
+@pytest.fixture()
+def fake_cluster():
+    """Fake K8s API + one fake engine acting as the pod's server."""
+    api = FakeK8sApi()
+    engine = FakeEngine(model="k8s-model")
+    holder = {}
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    async def boot():
+        for key, app in (("api", api.make_app()),
+                         ("engine", engine.make_app())):
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            holder[key] = site._server.sockets[0].getsockname()[1]
+            holder[key + "_runner"] = runner
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(boot())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    started.wait(10)
+    yield api, holder["api"], holder["engine"]
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=5)
+
+
+def test_k8s_client_list_and_patch(fake_cluster):
+    api, api_port, _ = fake_cluster
+    api.pods = [_pod("p1", "10.0.0.1")]
+    client = K8sClient(host=f"http://127.0.0.1:{api_port}", token="t")
+    pods = client.list_pods("default")
+    assert pods["items"][0]["metadata"]["name"] == "p1"
+    client.patch_pod_labels("default", "p1", {"sleeping": "true"})
+    assert api.patches and api.patches[0][0] == "p1"
+
+
+def test_k8s_discovery_tracks_pod_lifecycle(fake_cluster):
+    api, api_port, engine_port = fake_cluster
+    client = K8sClient(host=f"http://127.0.0.1:{api_port}", token="t")
+    disco = K8sPodIPServiceDiscovery(
+        namespace="default", port=engine_port, k8s_client=client,
+    )
+    try:
+        # Watch stream connects; push an ADDED ready pod whose IP is
+        # loopback so the model probe hits the fake engine.
+        deadline = time.time() + 10
+        while api._watch_queue is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert api._watch_queue is not None, "watch never connected"
+
+        api.push_event({"type": "ADDED",
+                        "object": _pod("engine-0", "127.0.0.1",
+                                       labels={"model": "unit-a"})})
+        deadline = time.time() + 10
+        while not disco.get_endpoint_info() and time.time() < deadline:
+            time.sleep(0.05)
+        eps = disco.get_endpoint_info()
+        assert len(eps) == 1
+        assert eps[0].model_names == ["k8s-model"]
+        assert eps[0].model_label == "unit-a"
+        assert eps[0].url == f"http://127.0.0.1:{engine_port}"
+
+        # Not-ready update removes it from routing.
+        api.push_event({"type": "MODIFIED",
+                        "object": _pod("engine-0", "127.0.0.1",
+                                       ready=False)})
+        deadline = time.time() + 10
+        while disco.get_endpoint_info() and time.time() < deadline:
+            time.sleep(0.05)
+        assert disco.get_endpoint_info() == []
+
+        # Ready again -> back; DELETED -> gone.
+        api.push_event({"type": "MODIFIED",
+                        "object": _pod("engine-0", "127.0.0.1")})
+        deadline = time.time() + 10
+        while not disco.get_endpoint_info() and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(disco.get_endpoint_info()) == 1
+
+        api.push_event({"type": "DELETED",
+                        "object": _pod("engine-0", "127.0.0.1")})
+        deadline = time.time() + 10
+        while disco.get_endpoint_info() and time.time() < deadline:
+            time.sleep(0.05)
+        assert disco.get_endpoint_info() == []
+        assert disco.get_health()
+    finally:
+        disco.close()
