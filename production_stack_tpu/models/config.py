@@ -75,6 +75,15 @@ _PRESETS = {
         hidden_size=768, num_layers=12, num_heads=12, num_kv_heads=12,
         head_dim=64, intermediate_size=3072, max_position=2048,
     ),
+    # ~0.9B Llama-family preset sized to fit one v5e chip with KV headroom:
+    # the flagship architecture class (GQA 16q/8kv, head_dim 128) at a scale
+    # a single-chip bench can serve.
+    "tpu-llama-1b": ModelConfig(
+        name="tpu-llama-1b", arch="llama", vocab_size=32000,
+        hidden_size=2048, num_layers=16, num_heads=16, num_kv_heads=8,
+        head_dim=128, intermediate_size=7168, max_position=8192,
+        rope_theta=500000.0,
+    ),
     "meta-llama/Llama-3-8B": ModelConfig(
         name="meta-llama/Llama-3-8B", arch="llama", vocab_size=128256,
         hidden_size=4096, num_layers=32, num_heads=32, num_kv_heads=8,
