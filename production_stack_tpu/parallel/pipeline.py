@@ -14,7 +14,6 @@ stage-sharded serving of models too large for one slice's HBM.
 
 from __future__ import annotations
 
-import functools
 from typing import Callable
 
 import jax
@@ -37,10 +36,6 @@ def pipeline_forward(
     """
     pp = mesh.shape[axis_name]
 
-    param_spec = jax.tree_util.tree_map(
-        lambda _: P(axis_name), object(), is_leaf=lambda _: True)
-    del param_spec  # specs are built per-pytree below
-
     def run(params, x):
         M = x.shape[0]
         T = M + pp - 1  # total pipeline ticks
@@ -60,20 +55,23 @@ def pipeline_forward(
                 h, _ = jax.lax.scan(body, x, local_params)
                 return h
 
-            # pvary: carries mix with per-stage (varying) values inside the
-            # loop, so their types must be varying over the pp axis too.
-            zero = jax.lax.pvary(jnp.zeros_like(x_all[0]), (axis_name,))
-            outputs = jax.lax.pvary(jnp.zeros_like(x_all), (axis_name,))
+            # pcast-to-varying: carries mix with per-stage (varying) values
+            # inside the loop, so their types must be varying over the pp
+            # axis too.
+            zero = jax.lax.pcast(
+                jnp.zeros_like(x_all[0]), (axis_name,), to="varying")
+            outputs = jax.lax.pcast(
+                jnp.zeros_like(x_all), (axis_name,), to="varying")
 
             def tick(t, carry):
                 inflow, outputs = carry
                 # Stage 0 injects microbatch t (when in range); others take
                 # the activation handed over from the previous stage.
                 m_for_stage0 = jnp.clip(t, 0, M - 1)
-                injected = jax.lax.pvary(
+                injected = jax.lax.pcast(
                     jax.lax.dynamic_index_in_dim(
                         x_all, m_for_stage0, 0, False),
-                    (axis_name,),
+                    (axis_name,), to="varying",
                 )
                 x_in = jnp.where(idx == 0, injected, inflow)
                 y = apply_local(x_in)

@@ -58,15 +58,17 @@ def ring_attention_fwd(
     qg = q.reshape(B, C, KVH, G, D)
     pos_q = my_idx * C + jnp.arange(C)  # global positions of local queries
 
-    # Online-softmax accumulators (float32). pvary marks them as varying
+    # Online-softmax accumulators (float32). pcast marks them as varying
     # over the ring axis so the fori_loop carry types line up with the
     # per-device outputs.
-    m = jax.lax.pvary(
-        jnp.full((B, KVH, G, C), -jnp.inf, jnp.float32), (axis_name,))
-    l = jax.lax.pvary(
-        jnp.zeros((B, KVH, G, C), jnp.float32), (axis_name,))
-    o = jax.lax.pvary(
-        jnp.zeros((B, KVH, G, C, D), jnp.float32), (axis_name,))
+    m = jax.lax.pcast(
+        jnp.full((B, KVH, G, C), -jnp.inf, jnp.float32), (axis_name,),
+        to="varying")
+    l = jax.lax.pcast(
+        jnp.zeros((B, KVH, G, C), jnp.float32), (axis_name,), to="varying")
+    o = jax.lax.pcast(
+        jnp.zeros((B, KVH, G, C, D), jnp.float32), (axis_name,),
+        to="varying")
 
     def step(s, carry):
         m, l, o, k_cur, v_cur = carry
