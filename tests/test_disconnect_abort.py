@@ -1,0 +1,61 @@
+"""Client disconnect mid-stream must abort the engine request and free its
+pages (router -> engine cancellation propagation)."""
+
+import asyncio
+import time
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.server import EngineServer, run_engine_server
+
+
+@pytest.mark.timeout(180)
+def test_disconnect_aborts_engine_request():
+    server = EngineServer(EngineConfig(
+        model="tiny-llama", max_model_len=256, max_num_seqs=2,
+        block_size=8, num_blocks=64, max_loras=0, decode_steps=1,
+    ))
+
+    async def run():
+        runner = await run_engine_server(server, "127.0.0.1", 0)
+        port = list(runner.sites)[0]._server.sockets[0].getsockname()[1]
+        url = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                resp = await s.post(url + "/v1/chat/completions", json={
+                    "model": "tiny-llama",
+                    "messages": [{"role": "user", "content": "stream me"}],
+                    "max_tokens": 100000, "stream": True,
+                    "temperature": 0.0, "ignore_eos": True,
+                }, timeout=aiohttp.ClientTimeout(total=120))
+                # Read a couple of chunks, then hang up mid-generation.
+                got = 0
+                async for _ in resp.content:
+                    got += 1
+                    if got >= 3:
+                        break
+                resp.close()
+            # The engine must notice the disconnect and abort: running
+            # count drains and the request's pages free.
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                stats = server.core.stats()
+                if (stats["num_requests_running"] == 0
+                        and stats["num_requests_waiting"] == 0):
+                    break
+                await asyncio.sleep(0.2)
+            stats = server.core.stats()
+            assert stats["num_requests_running"] == 0, stats
+            alloc = server.core.kv_mgr.allocator
+            held = sum(1 for b in alloc.blocks if b.ref_count > 0)
+            assert held == 0, f"{held} pages still referenced after abort"
+        finally:
+            await runner.cleanup()
+
+    try:
+        asyncio.run(run())
+    finally:
+        server.core.stop()
