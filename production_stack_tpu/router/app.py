@@ -503,7 +503,8 @@ def initialize_all(args) -> RouterState:
         )
 
         state.dynamic_config_watcher = initialize_dynamic_config_watcher(
-            args.dynamic_config_json, state
+            args.dynamic_config_json, state,
+            poll_interval=getattr(args, "dynamic_config_interval", 10.0)
         )
 
     # Periodic stats logger (reference stats/log_stats.py:37-115, app.py:287-295).

@@ -61,6 +61,8 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--batch-processor", default="local")
     # Dynamic config
     parser.add_argument("--dynamic-config-json", type=str, default=None)
+    parser.add_argument("--dynamic-config-interval", type=float, default=10.0,
+                        help="seconds between dynamic-config file polls")
     # Callbacks / rewriter / feature gates
     parser.add_argument("--callbacks", type=str, default=None,
                         help="Import path `module.object` with pre/post_request")
@@ -105,6 +107,12 @@ def expand_static_models_config(config: dict) -> dict:
     (reference parsers/yaml_utils.py:39-56)."""
     static_models = config.pop("static_models", None)
     if not static_models:
+        return config
+    if not isinstance(static_models, list) or not all(
+        isinstance(e, dict) for e in static_models
+    ):
+        # Plain comma-separated string form (flag style): nothing to expand.
+        config["static_models"] = static_models
         return config
     urls, models, labels, types = [], [], [], []
     aliases = {}

@@ -1,0 +1,188 @@
+"""Experimental features + dynamic config through the live router: semantic
+cache serving repeats, PII blocking, and hot reconfiguration from a watched
+file (reference experimental/* and dynamic_config.py behaviors)."""
+
+import argparse
+import asyncio
+import json
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from production_stack_tpu.router import routing_logic as rl
+from production_stack_tpu.router.app import build_app
+from production_stack_tpu.router.engine_stats import EngineStatsScraper
+from production_stack_tpu.router.parser import build_parser
+from production_stack_tpu.router.request_stats import RequestStatsMonitor
+from production_stack_tpu.testing.fake_engine import FakeEngine
+from production_stack_tpu.utils.misc import SingletonABCMeta, SingletonMeta
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    classes = (
+        rl.RoundRobinRouter, rl.SessionRouter, rl.PrefixAwareRouter,
+        rl.KvawareRouter, rl.DisaggregatedPrefillRouter,
+    )
+    for cls in classes:
+        SingletonABCMeta._reset_instance(cls)
+    SingletonMeta._reset_instance(RequestStatsMonitor)
+    SingletonMeta._reset_instance(EngineStatsScraper)
+    yield
+    for cls in classes:
+        SingletonABCMeta._reset_instance(cls)
+    SingletonMeta._reset_instance(RequestStatsMonitor)
+    SingletonMeta._reset_instance(EngineStatsScraper)
+
+
+async def _start(app):
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, f"http://127.0.0.1:{site._server.sockets[0].getsockname()[1]}"
+
+
+def _args(**over):
+    args = build_parser().parse_args([])
+    for k, v in over.items():
+        setattr(args, k, v)
+    return args
+
+
+def test_semantic_cache_serves_repeat_from_cache():
+    async def run():
+        engine = FakeEngine(model="m")
+        e_runner, e_url = await _start(engine.make_app())
+        router_app = build_app(_args(
+            static_backends=e_url, static_models="m",
+            routing_logic="roundrobin", engine_stats_interval=5,
+            feature_gates="SemanticCache=true",
+            semantic_cache_threshold=0.95,
+        ))
+        r_runner, r_url = await _start(router_app)
+        body = {"model": "m",
+                "messages": [{"role": "user", "content": "what is a tpu?"}],
+                "max_tokens": 8}
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(r_url + "/v1/chat/completions",
+                                  json=body) as resp:
+                    assert resp.status == 200
+                    first = await resp.json()
+                n_backend = len(engine.requests_seen)
+                assert n_backend == 1
+                # Identical request: served from the semantic cache, engine
+                # sees nothing new.
+                async with s.post(r_url + "/v1/chat/completions",
+                                  json=body) as resp:
+                    assert resp.status == 200
+                    second = await resp.json()
+                assert len(engine.requests_seen) == n_backend
+                assert (second["choices"][0]["message"]["content"]
+                        == first["choices"][0]["message"]["content"])
+        finally:
+            await r_runner.cleanup()
+            await e_runner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_pii_detection_blocks_request():
+    async def run():
+        engine = FakeEngine(model="m")
+        e_runner, e_url = await _start(engine.make_app())
+        router_app = build_app(_args(
+            static_backends=e_url, static_models="m",
+            routing_logic="roundrobin", engine_stats_interval=5,
+            feature_gates="PIIDetection=true",
+        ))
+        r_runner, r_url = await _start(router_app)
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(r_url + "/v1/chat/completions", json={
+                    "model": "m",
+                    "messages": [{
+                        "role": "user",
+                        "content": "my card is 4111 1111 1111 1111 thanks",
+                    }],
+                    "max_tokens": 4,
+                }) as resp:
+                    assert resp.status == 400
+                    body = await resp.json()
+                    assert "pii" in json.dumps(body).lower()
+                assert engine.requests_seen == []
+                # Clean requests still flow.
+                async with s.post(r_url + "/v1/chat/completions", json={
+                    "model": "m",
+                    "messages": [{"role": "user", "content": "hello"}],
+                    "max_tokens": 4,
+                }) as resp:
+                    assert resp.status == 200
+        finally:
+            await r_runner.cleanup()
+            await e_runner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_dynamic_config_hot_swaps_backends(tmp_path):
+    async def run():
+        e1 = FakeEngine(model="m")
+        e2 = FakeEngine(model="m")
+        r1, url1 = await _start(e1.make_app())
+        r2, url2 = await _start(e2.make_app())
+
+        cfg_path = tmp_path / "dyn.json"
+        cfg_path.write_text(json.dumps({
+            "service_discovery": "static",
+            "routing_logic": "roundrobin",
+            "static_backends": url1,
+            "static_models": "m",
+        }))
+        router_app = build_app(_args(
+            static_backends=url1, static_models="m",
+            routing_logic="roundrobin", engine_stats_interval=5,
+            dynamic_config_json=str(cfg_path),
+            dynamic_config_interval=0.2,
+        ))
+        r_runner, r_url = await _start(router_app)
+        try:
+            async with aiohttp.ClientSession() as s:
+                for _ in range(2):
+                    async with s.post(r_url + "/v1/chat/completions", json={
+                        "model": "m",
+                        "messages": [{"role": "user", "content": "x"}],
+                        "max_tokens": 2,
+                    }) as resp:
+                        assert resp.status == 200
+                assert len(e1.requests_seen) == 2
+
+                # Swap the backend list in the watched file.
+                cfg_path.write_text(json.dumps({
+                    "service_discovery": "static",
+                    "routing_logic": "roundrobin",
+                    "static_backends": url2,
+                    "static_models": "m",
+                }))
+                for _ in range(30):
+                    await asyncio.sleep(0.2)
+                    async with s.get(r_url + "/dynamic_config") as resp:
+                        current = await resp.json()
+                    if url2 in json.dumps(current):
+                        break
+                async with s.post(r_url + "/v1/chat/completions", json={
+                    "model": "m",
+                    "messages": [{"role": "user", "content": "y"}],
+                    "max_tokens": 2,
+                }) as resp:
+                    assert resp.status == 200
+                assert len(e2.requests_seen) == 1
+                assert len(e1.requests_seen) == 2
+        finally:
+            await r_runner.cleanup()
+            await r1.cleanup()
+            await r2.cleanup()
+
+    asyncio.run(run())
