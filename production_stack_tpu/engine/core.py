@@ -117,6 +117,7 @@ class EngineCore:
         # Decode always runs through the fused burst program (K ==
         # decode_steps; K=1 degenerates to single-step).
         self._multi_decode_fns: Dict[int, Callable] = {}
+        self._embed_fns: Dict[int, Callable] = {}
         self._write_block_fn = self._make_write_block()
 
         # -- LoRA slot registry -------------------------------------------
@@ -531,7 +532,8 @@ class EngineCore:
         with self._lock:
             self._running = False
             self._lock.notify()
-        self._thread.join(timeout=10)
+        if self._thread.ident is not None:  # started
+            self._thread.join(timeout=10)
 
     # -- sleep mode (reference relies on vLLM --enable-sleep-mode) ---------
     def sleep(self, level: int = 1) -> None:
@@ -627,20 +629,64 @@ class EngineCore:
         return True
 
     # -- embeddings --------------------------------------------------------
+    def _embed_fn(self, bucket: int):
+        fn = self._embed_fns.get(bucket)
+        if fn is not None:
+            return fn
+        apply = self._apply
+        cfg = self.model_config
+
+        def embed_fwd(params, kv, token_ids, positions, slot_mapping,
+                      block_tables, seq_lens):
+            hidden, _ = apply(
+                params, cfg, token_ids, positions, kv, slot_mapping,
+                block_tables, seq_lens, seq_lens,
+                mode="prefill", output_hidden=True,
+            )
+            T = token_ids.shape[1]
+            mask = (jnp.arange(T)[None, :] < seq_lens[:, None]).astype(
+                jnp.float32)
+            pooled = (hidden * mask[..., None]).sum(axis=1) / jnp.maximum(
+                seq_lens.astype(jnp.float32), 1.0)[:, None]
+            norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+            return pooled / jnp.maximum(norm, 1e-12)
+
+        fn = jax.jit(embed_fwd)
+        self._embed_fns[bucket] = fn
+        return fn
+
     def embed(self, prompt_token_ids: List[int]) -> "list[float]":
-        """Mean-pooled, L2-normalised token-embedding vector (served by
-        /v1/embeddings). Runs off the scheduler path: no KV pages touched."""
-        ids = np.asarray(prompt_token_ids, np.int32)
-        ids = np.clip(ids, 0, self.model_config.vocab_size - 1)
+        """Mean-pooled, L2-normalised FINAL hidden states of a full model
+        pass (served by /v1/embeddings). Runs off the scheduler path with a
+        throwaway single-page KV pool — the serving cache is untouched."""
+        cfg = self.config
+        mc = self.model_config
+        ids = np.clip(
+            np.asarray(prompt_token_ids, np.int32), 0, mc.vocab_size - 1
+        )[: cfg.max_model_len - 1]
+        n = max(len(ids), 1)
+        bucket = cfg.bucket_for(min(n, cfg.prefill_chunk_size or n))
+        n = min(n, bucket)
+
         with self._lock:  # consistent snapshot vs sleep()/wake_up()
-            params, host_params = self.params, self._host_params
-        table = (params if params is not None else host_params)["embed"]
-        vecs = np.asarray(jax.device_get(table[ids]), np.float32)
-        pooled = vecs.mean(axis=0)
-        norm = np.linalg.norm(pooled)
-        if norm > 0:
-            pooled = pooled / norm
-        return pooled.tolist()
+            params = self.params
+        if params is None:
+            raise RuntimeError("engine is sleeping")
+
+        token_ids = np.zeros((1, bucket), np.int32)
+        token_ids[0, :n] = ids[:n]
+        positions = np.arange(bucket, dtype=np.int32)[None, :]
+        slot_mapping = np.full((1, bucket), -1, np.int64)  # writes dropped
+        block_tables = np.zeros((1, 4), np.int32)
+        seq_lens = np.asarray([n], np.int32)
+        kv_shape = (mc.num_layers, 1, cfg.block_size,
+                    mc.num_kv_heads, mc.head_dim)
+        dummy_kv = (jnp.zeros(kv_shape, mc.jnp_dtype),
+                    jnp.zeros(kv_shape, mc.jnp_dtype))
+        fn = self._embed_fn(bucket)
+        pooled = fn(params, dummy_kv, token_ids, positions, slot_mapping,
+                    block_tables, seq_lens)
+        return np.asarray(jax.device_get(pooled), np.float32)[0].tolist()
 
     # -- stats -------------------------------------------------------------
     def stats(self) -> dict:

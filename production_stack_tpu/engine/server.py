@@ -402,6 +402,10 @@ class EngineServer:
 
     async def handle_embeddings(self, request: web.Request) -> web.Response:
         """Mean-pooled final hidden state as the embedding vector."""
+        if self.core.is_sleeping:
+            return web.json_response(
+                {"error": {"message": "engine is sleeping",
+                           "type": "ServiceUnavailable"}}, status=503)
         body = await request.json()
         inputs = body.get("input", [])
         # str | [str, ...] | [int, ...] (one token array) | [[int, ...], ...]

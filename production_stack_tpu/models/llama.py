@@ -197,10 +197,13 @@ def apply(
     context_lens: jax.Array,  # [B]
     seq_lens: jax.Array,  # [B] valid prompt lengths (prefill padding mask)
     *,
-    mode: str,  # "prefill" | "decode"  (static)
+    mode: str,  # "prefill" | "prefill_cached" | "decode"  (static)
     adapter_ids: jax.Array | None = None,  # [B] LoRA slot per sequence
+    output_hidden: bool = False,  # return final hidden states, not logits
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
-    """Full forward. Returns (logits [B, T, V], updated kv_pages)."""
+    """Full forward. Returns (logits [B, T, V], updated kv_pages), or the
+    post-norm hidden states [B, T, Hd] instead of logits when
+    ``output_hidden`` (the /v1/embeddings pass)."""
     x = params["embed"][token_ids].astype(cfg.jnp_dtype)
     k_all, v_all = kv_pages
     lora = params.get("lora")
@@ -252,6 +255,8 @@ def apply(
             params["layers"], length=L,
         )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if output_hidden:
+        return x.astype(jnp.float32), (k_all, v_all)
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T

@@ -117,7 +117,7 @@ def apply(
     params: Dict,
     cfg: ModelConfig,
     token_ids, positions, kv_pages, slot_mapping, block_tables,
-    context_lens, seq_lens, *, mode: str, adapter_ids=None,
+    context_lens, seq_lens, *, mode: str, adapter_ids=None, output_hidden: bool = False,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     del adapter_ids  # LoRA slots are a Llama-family feature for now
     x = params["embed"][token_ids].astype(cfg.jnp_dtype)
@@ -142,5 +142,7 @@ def apply(
         length=L,
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if output_hidden:
+        return x.astype(jnp.float32), (k_all, v_all)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, (k_all, v_all)

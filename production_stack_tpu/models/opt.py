@@ -114,7 +114,7 @@ def apply(
     params: Dict,
     cfg: ModelConfig,
     token_ids, positions, kv_pages, slot_mapping, block_tables,
-    context_lens, seq_lens, *, mode: str, adapter_ids=None,
+    context_lens, seq_lens, *, mode: str, adapter_ids=None, output_hidden: bool = False,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     del adapter_ids  # LoRA slots are a Llama-family feature for now
     x = params["embed"][token_ids].astype(cfg.jnp_dtype)
@@ -140,5 +140,7 @@ def apply(
         length=L,
     )
     x = layer_norm(x, params["final_ln_w"], params["final_ln_b"])
+    if output_hidden:
+        return x.astype(jnp.float32), (k_all, v_all)
     logits = (x @ params["embed"].T).astype(jnp.float32)
     return logits, (k_all, v_all)

@@ -180,6 +180,30 @@ def test_opt_logits_parity_with_transformers(tmp_path):
         np.asarray(logits)[:, :T], hf_logits, rtol=2e-4, atol=2e-4)
 
 
+def test_embeddings_parity_with_transformers(llama_ckpt):
+    """/v1/embeddings vectors = mean-pooled post-norm hidden states."""
+    import torch
+
+    path, hf_model = llama_ckpt
+    prompt = [5, 9, 22, 87, 54, 33]
+    with torch.no_grad():
+        hidden = hf_model.model(
+            torch.asarray([prompt], dtype=torch.long)
+        ).last_hidden_state[0].numpy()
+    ref = hidden.mean(axis=0)
+    ref = ref / np.linalg.norm(ref)
+
+    core = EngineCore(EngineConfig(
+        model=path, dtype="float32", max_model_len=128, max_num_seqs=2,
+        block_size=8, num_blocks=32, max_loras=0,
+    ))
+    try:
+        ours = np.asarray(core.embed(prompt), np.float32)
+    finally:
+        core.stop()
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
 def test_mixtral_logits_parity_with_transformers(tmp_path):
     """MoE: expert weights, router, and top-k weighting must match HF."""
     import jax.numpy as jnp
