@@ -47,17 +47,32 @@ class _Node:
 
     def __init__(self):
         self.children: Dict[int, "_Node"] = {}
-        self.instances: Set[str] = set()
+        # instance id -> timestamp of its most recent admission report for
+        # this chunk. Engines cannot report block-level evictions exactly
+        # (their caches are token-chain keyed), so staleness is bounded by
+        # a TTL instead: claims older than ``admit_ttl`` are ignored at
+        # lookup. Live prefixes stay fresh because engines re-admit on
+        # every served request.
+        self.instances: Dict[str, float] = {}
 
 
 class KVController:
-    """In-process KV index. All methods are coroutine-safe via one lock."""
+    """In-process KV index. All methods are coroutine-safe via one lock.
 
-    def __init__(self, chunk_size: int = CHUNK_SIZE):
+    ``admit_ttl``: seconds an admission claim stays routable without being
+    re-reported (0 disables expiry).
+    """
+
+    def __init__(self, chunk_size: int = CHUNK_SIZE,
+                 admit_ttl: float = 600.0):
         self.chunk_size = chunk_size
+        self.admit_ttl = admit_ttl
         self._root = _Node()
         self._instances: Dict[str, dict] = {}  # id -> {url, last_seen}
         self._lock = asyncio.Lock()
+
+    def _fresh(self, ts: float, now: float) -> bool:
+        return self.admit_ttl <= 0 or (now - ts) <= self.admit_ttl
 
     # -- instance registry (reference QueryInstMsg / instance-id→URL map) --
     async def register_instance(self, instance_id: str, url: str) -> None:
@@ -70,7 +85,7 @@ class KVController:
             stack = [self._root]
             while stack:
                 node = stack.pop()
-                node.instances.discard(instance_id)
+                node.instances.pop(instance_id, None)
                 stack.extend(node.children.values())
 
     async def instance_url(self, instance_id: str) -> Optional[str]:
@@ -84,16 +99,17 @@ class KVController:
 
     # -- admission/eviction reports from engines ---------------------------
     async def admit(self, instance_id: str, hashes: List[int]) -> None:
+        now = time.time()
         async with self._lock:
             if instance_id in self._instances:
-                self._instances[instance_id]["last_seen"] = time.time()
+                self._instances[instance_id]["last_seen"] = now
             node = self._root
             for h in hashes:
                 nxt = node.children.get(h)
                 if nxt is None:
                     nxt = _Node()
                     node.children[h] = nxt
-                nxt.instances.add(instance_id)
+                nxt.instances[instance_id] = now
                 node = nxt
 
     async def admit_text(self, instance_id: str, text: str) -> None:
@@ -114,13 +130,14 @@ class KVController:
             stack = [node]
             while stack:
                 n = stack.pop()
-                n.instances.discard(instance_id)
+                n.instances.pop(instance_id, None)
                 stack.extend(n.children.values())
 
     # -- lookup (reference LookupMsg) --------------------------------------
     async def lookup(self, text: str) -> Optional[Tuple[int, str]]:
         """Longest stored prefix of ``text`` → (matched_chars, instance_id)."""
         hashes = chunk_hashes(text, self.chunk_size)
+        now = time.time()
         async with self._lock:
             node = self._root
             matched = 0
@@ -129,7 +146,10 @@ class KVController:
                 nxt = node.children.get(h)
                 if nxt is None or not nxt.instances:
                     break
-                live = nxt.instances & set(self._instances)
+                live = {
+                    i for i, ts in nxt.instances.items()
+                    if i in self._instances and self._fresh(ts, now)
+                }
                 if not live:
                     break
                 matched += 1

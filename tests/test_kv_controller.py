@@ -45,6 +45,24 @@ async def test_evict_subtree():
     assert match[0] == 8  # only the first chunk survives
 
 
+async def test_stale_admissions_expire():
+    """Claims older than admit_ttl stop routing (engines re-admit live
+    prefixes on every request, so only dead claims age out)."""
+    from production_stack_tpu.kv.controller import KVController
+
+    c = KVController(chunk_size=4, admit_ttl=0.2)
+    await c.register_instance("i1", "http://e1")
+    await c.admit_text("i1", "abcdefgh")
+    assert await c.lookup("abcdefgh") is not None
+    import asyncio as _a
+
+    await _a.sleep(0.3)
+    assert await c.lookup("abcdefgh") is None  # aged out
+    # Re-admission refreshes the claim.
+    await c.admit_text("i1", "abcdefgh")
+    assert await c.lookup("abcdefgh") is not None
+
+
 async def test_recency_tiebreak():
     ctrl = KVController(chunk_size=8)
     await ctrl.register_instance("i1", "http://e1:8000")
