@@ -165,9 +165,10 @@ class KVController:
             return matched_chars, inst
 
 
-def initialize_kv_controller(chunk_size: int = CHUNK_SIZE) -> KVController:
+def initialize_kv_controller(chunk_size: int = CHUNK_SIZE,
+                             admit_ttl: float = 600.0) -> KVController:
     global _global_kv_controller
-    _global_kv_controller = KVController(chunk_size)
+    _global_kv_controller = KVController(chunk_size, admit_ttl=admit_ttl)
     return _global_kv_controller
 
 

@@ -430,7 +430,9 @@ def initialize_all(args) -> RouterState:
     # KV controller (in-process, as the reference embeds LMCache's).
     from production_stack_tpu.kv.controller import initialize_kv_controller
 
-    state.kv_controller = initialize_kv_controller()
+    state.kv_controller = initialize_kv_controller(
+        admit_ttl=getattr(args, "kv_admit_ttl", 600.0)
+    )
 
     # Routing.
     state.router = initialize_routing_logic(

@@ -60,6 +60,9 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--file-storage-path", default="/tmp/tpu_stack_files")
     parser.add_argument("--batch-processor", default="local")
     # Dynamic config
+    parser.add_argument("--kv-admit-ttl", type=float, default=600.0,
+                        help="seconds a KV admission claim stays routable "
+                             "without re-report (0 disables expiry)")
     parser.add_argument("--dynamic-config-json", type=str, default=None)
     parser.add_argument("--dynamic-config-interval", type=float, default=10.0,
                         help="seconds between dynamic-config file polls")
