@@ -7,7 +7,8 @@ reference launches in every engine pod
 surface is exactly what the stack's router and operator need:
 
 - OpenAI API: ``/v1/chat/completions``, ``/v1/completions``,
-  ``/v1/embeddings``, ``/v1/models``, ``/tokenize``, ``/detokenize``
+  ``/v1/embeddings``, ``/v1/score``, ``/v1/rerank``, ``/v1/models``,
+  ``/tokenize``, ``/detokenize``
 - lifecycle: ``/health``, ``/sleep``, ``/wake_up``, ``/is_sleeping``
   (sleep mode semantics of vLLM ``--enable-sleep-mode``,
   ``service_discovery.py:443-460``)
@@ -147,6 +148,10 @@ class EngineServer:
         r.add_post("/v1/chat/completions", self.handle_chat)
         r.add_post("/v1/completions", self.handle_completion)
         r.add_post("/v1/embeddings", self.handle_embeddings)
+        r.add_post("/v1/score", self.handle_score)
+        r.add_post("/score", self.handle_score)
+        r.add_post("/v1/rerank", self.handle_rerank)
+        r.add_post("/rerank", self.handle_rerank)
         r.add_post("/tokenize", self.handle_tokenize)
         r.add_post("/detokenize", self.handle_detokenize)
         r.add_get("/metrics", self.handle_metrics)
@@ -432,6 +437,127 @@ class EngineServer:
             "data": data,
             "usage": {"prompt_tokens": total_tokens,
                       "total_tokens": total_tokens},
+        })
+
+    async def _embed_texts(self, texts: List[str]):
+        """Embeddings for texts (model forward deduplicated across repeats),
+        plus the total token count over all occurrences (vLLM counts usage
+        per pair, so duplicates still count)."""
+        loop = asyncio.get_running_loop()
+        cache: dict = {}
+        total_tokens = 0
+        out = []
+        for text in texts:
+            if text not in cache:
+                ids = self.core.tokenizer.encode(text)
+                emb = await loop.run_in_executor(None, self.core.embed, ids)
+                cache[text] = (emb, len(ids))
+            emb, n_tokens = cache[text]
+            total_tokens += n_tokens
+            out.append(emb)
+        return out, total_tokens
+
+    @staticmethod
+    def _as_text_list(value) -> Optional[List[str]]:
+        """str | [str, ...] -> list of texts; anything else is invalid."""
+        if isinstance(value, str):
+            return [value]
+        if isinstance(value, list) and all(isinstance(t, str) for t in value):
+            return list(value)
+        return None
+
+    @staticmethod
+    def _dot(a: List[float], b: List[float]) -> float:
+        # embed() L2-normalises, so the dot product IS cosine similarity.
+        return float(sum(x * y for x, y in zip(a, b)))
+
+    async def handle_score(self, request: web.Request) -> web.Response:
+        """Similarity scores for text pairs (vLLM ``/v1/score`` surface the
+        router proxies; ref ``src/vllm_router/routers/main_router.py:117-170``).
+
+        Embedding-based scorer: cosine similarity of the pooled hidden-state
+        embeddings (the path vLLM uses for embedding models). ``text_1`` may
+        be a single text (broadcast over ``text_2``) or a list pairing
+        element-wise with ``text_2``.
+        """
+        if self.core.is_sleeping:
+            return web.json_response(
+                {"error": {"message": "engine is sleeping",
+                           "type": "ServiceUnavailable"}}, status=503)
+        body = await request.json()
+        list_1 = self._as_text_list(body.get("text_1"))
+        list_2 = self._as_text_list(body.get("text_2"))
+        if list_1 is None or list_2 is None:
+            return web.json_response(
+                {"error": {"message": "text_1 and text_2 are required and "
+                           "must each be a string or a list of strings",
+                           "type": "BadRequestError"}}, status=400)
+        if len(list_1) == 1:
+            list_1 = list_1 * len(list_2)
+        if len(list_1) != len(list_2):
+            return web.json_response(
+                {"error": {"message": (
+                    f"text_1 ({len(list_1)}) and text_2 ({len(list_2)}) "
+                    "must pair up (or text_1 must be a single text)"),
+                    "type": "BadRequestError"}}, status=400)
+        # One call so repeats across the two lists share a model forward.
+        embs, total = await self._embed_texts(list_1 + list_2)
+        emb_1, emb_2 = embs[: len(list_1)], embs[len(list_1):]
+        data = [
+            {"index": i, "object": "score", "score": self._dot(a, b)}
+            for i, (a, b) in enumerate(zip(emb_1, emb_2))
+        ]
+        return web.json_response({
+            "id": f"score-{uuid.uuid4().hex[:16]}",
+            "object": "list",
+            "created": int(time.time()),
+            "model": body.get("model", self.config.model),
+            "data": data,
+            "usage": {"prompt_tokens": total, "total_tokens": total},
+        })
+
+    async def handle_rerank(self, request: web.Request) -> web.Response:
+        """Jina/Cohere-compatible rerank (vLLM ``/v1/rerank`` surface):
+        score ``query`` against each document, return the top_n sorted by
+        descending relevance."""
+        if self.core.is_sleeping:
+            return web.json_response(
+                {"error": {"message": "engine is sleeping",
+                           "type": "ServiceUnavailable"}}, status=503)
+        body = await request.json()
+        query = body.get("query")
+        documents = body.get("documents")
+        if not query or not isinstance(documents, list) or not documents:
+            return web.json_response(
+                {"error": {"message":
+                           "query and a non-empty documents list are required",
+                           "type": "BadRequestError"}}, status=400)
+        documents = [
+            d.get("text", "") if isinstance(d, dict) else str(d)
+            for d in documents
+        ]
+        try:
+            top_n = int(body.get("top_n", len(documents)))
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": {"message": "top_n must be an integer",
+                           "type": "BadRequestError"}}, status=400)
+        embs, total_tokens = await self._embed_texts(
+            [str(query)] + documents)
+        q_emb, d_embs = embs[0], embs[1:]
+        ranked = sorted(
+            (
+                {"index": i, "document": {"text": doc},
+                 "relevance_score": self._dot(q_emb, emb)}
+                for i, (doc, emb) in enumerate(zip(documents, d_embs))
+            ),
+            key=lambda r: r["relevance_score"], reverse=True,
+        )[: max(top_n, 0)]
+        return web.json_response({
+            "id": f"rerank-{uuid.uuid4().hex[:16]}",
+            "model": body.get("model", self.config.model),
+            "usage": {"total_tokens": total_tokens},
+            "results": ranked,
         })
 
     async def handle_tokenize(self, request: web.Request) -> web.Response:
