@@ -21,6 +21,11 @@ class EngineConfig:
     # Parallelism (within this engine replica).
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
+    # Stage-shard the layer stack (and its KV pages) over a pp mesh axis;
+    # activations hand over via ppermute (GPipe schedule). Llama family.
+    pipeline_parallel_size: int = 1
+    # GPipe microbatches per forward (bounded by the batch size; 0 -> pp).
+    pp_microbatches: int = 0
     # LoRA slots (always compiled in; slot 0 is the zero/no-op adapter).
     max_loras: int = 8
     max_lora_rank: int = 16

@@ -59,14 +59,38 @@ class EngineCore:
         )
 
         all_devices = list(devices if devices is not None else jax.devices())
-        n_needed = config.tensor_parallel_size * max(config.data_parallel_size, 1)
+        pp = max(config.pipeline_parallel_size, 1)
+        n_needed = (
+            config.tensor_parallel_size * max(config.data_parallel_size, 1) * pp
+        )
         self.mesh = build_mesh(
             tensor_parallel_size=config.tensor_parallel_size,
             data_parallel_size=max(config.data_parallel_size, 1),
+            pipeline_parallel_size=pp,
             devices=all_devices[:n_needed],
         )
 
         self._init_fn, self._apply = build_model(self.model_config)
+        if pp > 1:
+            # Stage-sharded serving: swap the layer stack for the GPipe
+            # pipeline over the pp mesh axis. Same signature, so prefill /
+            # cached prefill / fused decode bursts / embeddings all run on
+            # top of it unchanged.
+            from production_stack_tpu.parallel.pp_serving import make_pp_apply
+
+            if self.model_config.arch != "llama":
+                raise ValueError(
+                    "pipeline_parallel_size > 1 is supported for the Llama "
+                    f"family (model arch {self.model_config.arch!r})"
+                )
+            if self.model_config.num_layers % pp != 0:
+                raise ValueError(
+                    f"num_layers {self.model_config.num_layers} is not "
+                    f"divisible by pipeline_parallel_size {pp}"
+                )
+            self._apply = make_pp_apply(
+                self.mesh, microbatches=config.pp_microbatches or pp
+            )
 
         # -- parameters (sharded over the mesh) ----------------------------
         lora_kwargs = {}
@@ -200,8 +224,17 @@ class EngineCore:
         try:
             stats = self.mesh.devices.flat[0].memory_stats()
             free = stats["bytes_limit"] - stats["bytes_in_use"]
+            # Pages shard over tp (kv-head axis) and pp (layer axis) ONLY
+            # when the dims divide (kv_pages_sharding falls back to
+            # replicated otherwise) — scale the budget by the factors that
+            # actually engage, or a replicated pool would be sized x-fold
+            # over per-device capacity and OOM HBM at startup.
+            mc = self.model_config
             tp = self.mesh.shape.get("tp", 1)
-            budget = free * self.config.hbm_utilization * tp
+            pp = self.mesh.shape.get("pp", 1)
+            tp_factor = tp if tp > 1 and mc.num_kv_heads % tp == 0 else 1
+            pp_factor = pp if pp > 1 and mc.num_layers % pp == 0 else 1
+            budget = free * self.config.hbm_utilization * tp_factor * pp_factor
             num = int(budget // self._kv_bytes_per_block())
         except Exception:  # noqa: BLE001 - CPU backend has no memory_stats
             num = 0

@@ -823,6 +823,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-blocks", type=int, default=None)
     p.add_argument("--hbm-utilization", type=float, default=0.7)
     p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--pipeline-parallel-size", type=int, default=1,
+                   help="stage-shard the layer stack over a pp mesh axis")
+    p.add_argument("--pp-microbatches", type=int, default=0,
+                   help="GPipe microbatches per forward (0 -> pp)")
     p.add_argument("--enable-prefix-caching", action="store_true", default=True)
     p.add_argument("--no-enable-prefix-caching", dest="enable_prefix_caching",
                    action="store_false")
@@ -846,6 +850,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[List[str]] = None) -> None:
+    # Make JAX_PLATFORMS authoritative: plugin backends registered by
+    # sitecustomize (the tunneled TPU) otherwise win over the env var, so
+    # "JAX_PLATFORMS=cpu python -m ...server" would silently grab the TPU.
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax_config_platforms = os.environ["JAX_PLATFORMS"]
+        import jax
+
+        jax.config.update("jax_platforms", jax_config_platforms)
     args = build_arg_parser().parse_args(argv)
     model = args.model_flag or args.model or "tiny-llama"
     config = EngineConfig(
@@ -857,6 +871,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         num_blocks=args.num_blocks,
         hbm_utilization=args.hbm_utilization,
         tensor_parallel_size=args.tensor_parallel_size,
+        pipeline_parallel_size=args.pipeline_parallel_size,
+        pp_microbatches=args.pp_microbatches,
         enable_prefix_caching=args.enable_prefix_caching,
         max_loras=args.max_loras,
         max_lora_rank=args.max_lora_rank,

@@ -186,6 +186,38 @@ def _layer(
     return x, (k_pages, v_pages)
 
 
+def embed_tokens(params: Dict, cfg: ModelConfig, token_ids: jax.Array,
+                 adapter_ids: jax.Array | None):
+    """Shared forward preamble: input embeddings + LoRA leaf plumbing.
+
+    Used by both the single-program ``apply`` and the pipeline-parallel
+    wrapper (``parallel/pp_serving.py``) so the two paths cannot diverge.
+    Returns (x, lora_layers, lora_scaling, adapter_ids).
+    """
+    x = params["embed"][token_ids].astype(cfg.jnp_dtype)
+    lora = params.get("lora")
+    lora_scaling = lora["scaling"] if lora is not None else None
+    if lora is not None and adapter_ids is None:
+        adapter_ids = jnp.zeros((token_ids.shape[0],), jnp.int32)
+    lora_layers = (
+        {k: v for k, v in lora.items() if k != "scaling"}
+        if lora is not None else None
+    )
+    return x, lora_layers, lora_scaling, adapter_ids
+
+
+def project_out(params: Dict, cfg: ModelConfig, x: jax.Array,
+                output_hidden: bool) -> jax.Array:
+    """Shared forward tail: final norm, then hidden states or logits."""
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if output_hidden:
+        return x.astype(jnp.float32)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return (x @ head).astype(jnp.float32)
+
+
 def apply(
     params: Dict,
     cfg: ModelConfig,
@@ -204,16 +236,9 @@ def apply(
     """Full forward. Returns (logits [B, T, V], updated kv_pages), or the
     post-norm hidden states [B, T, Hd] instead of logits when
     ``output_hidden`` (the /v1/embeddings pass)."""
-    x = params["embed"][token_ids].astype(cfg.jnp_dtype)
+    x, lora_layers, lora_scaling, adapter_ids = embed_tokens(
+        params, cfg, token_ids, adapter_ids)
     k_all, v_all = kv_pages
-    lora = params.get("lora")
-    lora_scaling = lora["scaling"] if lora is not None else None
-    if lora is not None and adapter_ids is None:
-        adapter_ids = jnp.zeros((token_ids.shape[0],), jnp.int32)
-    lora_layers = (
-        {k: v for k, v in lora.items() if k != "scaling"}
-        if lora is not None else None
-    )
 
     layer_fn = functools.partial(
         _layer, cfg, mode,
@@ -254,11 +279,4 @@ def apply(
             scan_body, (x, k_all, v_all, jnp.int32(0)),
             params["layers"], length=L,
         )
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    if output_hidden:
-        return x.astype(jnp.float32), (k_all, v_all)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-    logits = (x @ head).astype(jnp.float32)
-    return logits, (k_all, v_all)
+    return project_out(params, cfg, x, output_hidden), (k_all, v_all)

@@ -1,4 +1,4 @@
-"""Device mesh construction (dp × tp, extensible to pp/sp/ep)."""
+"""Device mesh construction (dp × pp × tp, extensible to sp/ep)."""
 
 from __future__ import annotations
 
@@ -10,29 +10,41 @@ from jax.sharding import Mesh
 
 
 def mesh_shape_for(
-    n_devices: int, tensor_parallel_size: int = 1, data_parallel_size: int = 0
-) -> "tuple[int, int]":
-    """Resolve (dp, tp) from requested sizes and available devices."""
+    n_devices: int,
+    tensor_parallel_size: int = 1,
+    data_parallel_size: int = 0,
+    pipeline_parallel_size: int = 1,
+) -> "tuple[int, int, int]":
+    """Resolve (dp, pp, tp) from requested sizes and available devices."""
     tp = max(tensor_parallel_size, 1)
-    if n_devices % tp != 0:
+    pp = max(pipeline_parallel_size, 1)
+    if n_devices % (tp * pp) != 0:
         raise ValueError(
-            f"tensor_parallel_size {tp} does not divide device count {n_devices}"
+            f"tensor_parallel_size {tp} x pipeline_parallel_size {pp} "
+            f"does not divide device count {n_devices}"
         )
-    dp = data_parallel_size or n_devices // tp
-    if dp * tp != n_devices:
+    dp = data_parallel_size or n_devices // (tp * pp)
+    if dp * pp * tp != n_devices:
         raise ValueError(
-            f"dp*tp = {dp}*{tp} != available devices {n_devices}"
+            f"dp*pp*tp = {dp}*{pp}*{tp} != available devices {n_devices}"
         )
-    return dp, tp
+    return dp, pp, tp
 
 
 def build_mesh(
     tensor_parallel_size: int = 1,
     data_parallel_size: int = 0,
+    pipeline_parallel_size: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
-    axis_names: "tuple[str, str]" = ("dp", "tp"),
+    axis_names: "tuple[str, str, str]" = ("dp", "pp", "tp"),
 ) -> Mesh:
+    """dp outermost (replicas ride DCN), pp in the middle (stage handoffs
+    are one activation tensor per tick), tp innermost (all-reduces every
+    layer -> the fastest ICI links)."""
     devices = list(devices if devices is not None else jax.devices())
-    dp, tp = mesh_shape_for(len(devices), tensor_parallel_size, data_parallel_size)
-    arr = np.asarray(devices).reshape(dp, tp)
+    dp, pp, tp = mesh_shape_for(
+        len(devices), tensor_parallel_size, data_parallel_size,
+        pipeline_parallel_size,
+    )
+    arr = np.asarray(devices).reshape(dp, pp, tp)
     return Mesh(arr, axis_names)

@@ -106,6 +106,24 @@ def _divisible(shape, spec: P, mesh: Mesh) -> bool:
     return True
 
 
+def _with_pp(key, spec: P, leaf_shape, cfg: ModelConfig, mesh: Mesh) -> P:
+    """Pipeline parallelism: layer-stacked leaves additionally shard their
+    leading (layer) axis over the ``pp`` mesh axis, so each stage holds only
+    its own layers' weights (the memory point of PP)."""
+    pp = mesh.shape.get("pp", 1)
+    if (
+        pp > 1
+        and key[0] in ("layers", "lora")
+        and len(leaf_shape) == len(spec)
+        and len(leaf_shape) >= 2  # excludes ("lora","scaling"): [S] per-slot
+        and spec[0] is None
+        and leaf_shape[0] == cfg.num_layers
+        and cfg.num_layers % pp == 0
+    ):
+        return P(*(("pp",) + tuple(spec)[1:]))
+    return spec
+
+
 def param_shardings(
     cfg: ModelConfig, mesh: Mesh, params_shape: Any
 ) -> Any:
@@ -123,6 +141,8 @@ def param_shardings(
             p.key if hasattr(p, "key") else p.idx for p in path
         )
         spec = specs.get(key)
+        if spec is not None:
+            spec = _with_pp(key, spec, leaf.shape, cfg, mesh)
         if spec is not None and _divisible(leaf.shape, spec, mesh):
             out.append(NamedSharding(mesh, spec))
         else:
@@ -131,10 +151,16 @@ def param_shardings(
 
 
 def kv_pages_sharding(cfg: ModelConfig, mesh: Mesh) -> NamedSharding:
-    """KV pages [L, NB, bs, KVH, D]: shard the kv-head axis on tp."""
+    """KV pages [L, NB, bs, KVH, D]: shard the kv-head axis on tp, and the
+    layer axis on pp (each pipeline stage's HBM holds only its own layers'
+    pages)."""
     tp = mesh.shape.get("tp", 1)
+    pp = mesh.shape.get("pp", 1)
+    layer_axis = "pp" if pp > 1 and cfg.num_layers % pp == 0 else None
     if cfg.num_kv_heads % tp == 0 and tp > 1:
-        return NamedSharding(mesh, P(None, None, None, "tp", None))
+        return NamedSharding(mesh, P(layer_axis, None, None, "tp", None))
+    if layer_axis:
+        return NamedSharding(mesh, P(layer_axis, None, None, None, None))
     return NamedSharding(mesh, P())
 
 
