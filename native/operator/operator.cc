@@ -416,6 +416,58 @@ Json cache_service(const Config& cfg, const Json& cr) {
 // Generic ensure/drift helpers
 // ---------------------------------------------------------------------- //
 
+// Resource quantities come back from a real API server normalized to
+// strings ("4", "4Gi"), while the desired object carries ints — compare
+// values (including the unit suffix: "4096Mi" == "4Gi", "1Gi" != "1Mi"),
+// not serializations, or reconcile would loop forever / never.
+double quantity_value(const Json& q) {
+  if (!q.is_string()) return q.as_number(-1.0);
+  const std::string& s = q.as_string();
+  size_t pos = 0;
+  double base;
+  try {
+    base = std::stod(s, &pos);
+  } catch (...) {
+    return -1.0;
+  }
+  std::string suffix = s.substr(pos);
+  // Kubernetes quantity suffixes (resource.Quantity): binary Ki..Ei,
+  // decimal m/k/M/G/T/P/E.
+  static const std::map<std::string, double> kScale = {
+      {"", 1.0},
+      {"Ki", 1024.0}, {"Mi", 1024.0 * 1024}, {"Gi", 1024.0 * 1024 * 1024},
+      {"Ti", 1099511627776.0}, {"Pi", 1125899906842624.0},
+      {"Ei", 1152921504606846976.0},
+      {"m", 1e-3}, {"k", 1e3}, {"M", 1e6}, {"G", 1e9},
+      {"T", 1e12}, {"P", 1e15}, {"E", 1e18},
+  };
+  auto it = kScale.find(suffix);
+  if (it == kScale.end()) return -1.0;  // unknown suffix: treat as drift
+  return base * it->second;
+}
+
+bool resources_differ(const Json& ex, const Json& ds) {
+  for (const char* section : {"requests", "limits"}) {
+    const Json& ex_s = ex.get(section);
+    const Json& ds_s = ds.get(section);
+    const auto& ex_o = ex_s.as_object();
+    const auto& ds_o = ds_s.as_object();
+    if (ex_o.size() != ds_o.size()) return true;
+    for (const auto& [key, val] : ds_o) {
+      auto it = ex_o.find(key);
+      if (it == ex_o.end()) return true;
+      if (quantity_value(it->second) != quantity_value(val)) return true;
+    }
+  }
+  return false;
+}
+
+bool env_differs(const Json& ex, const Json& ds) {
+  // Order-sensitive compare of the env we manage; a real API server echoes
+  // the list as-sent (it does not reorder or inject entries here).
+  return ex.get("env").dump() != ds.get("env").dump();
+}
+
 bool needs_update(const Json& existing, const Json& desired) {
   const Json& ex_spec = existing.get("spec");
   const Json& ds_spec = desired.get("spec");
@@ -433,6 +485,12 @@ bool needs_update(const Json& existing, const Json& desired) {
       return true;
     if (ex_cs[i].get("command").dump() != ds_cs[i].get("command").dump())
       return true;
+    // A TPU-chips or env edit on the CR must reconcile too (the reference
+    // compares resources/env in vllmruntime_controller.go:624-706).
+    if (resources_differ(ex_cs[i].get("resources"),
+                         ds_cs[i].get("resources")))
+      return true;
+    if (env_differs(ex_cs[i], ds_cs[i])) return true;
   }
   return false;
 }

@@ -381,9 +381,42 @@ def build_app(args) -> web.Application:
     return app
 
 
+def _init_sentry(args) -> None:
+    """Error reporting/profiling (reference app.py:123-130). sentry-sdk is
+    an optional dependency of the serving image; a DSN without the SDK
+    warns instead of crashing the router."""
+    if not getattr(args, "sentry_dsn", None):
+        return
+    try:
+        import sentry_sdk
+    except ImportError:
+        logger.warning(
+            "--sentry-dsn was given but sentry-sdk is not installed; "
+            "error reporting disabled")
+        return
+    try:
+        sentry_sdk.init(
+            dsn=args.sentry_dsn,
+            send_default_pii=True,
+            profile_lifecycle="trace",
+            traces_sample_rate=args.sentry_traces_sample_rate,
+            profile_session_sample_rate=args.sentry_profile_session_sample_rate,
+        )
+    except TypeError:
+        # Older SDKs (< 2.24) reject the profiling options; error
+        # reporting still beats crashing the router at startup.
+        sentry_sdk.init(
+            dsn=args.sentry_dsn,
+            send_default_pii=True,
+            traces_sample_rate=args.sentry_traces_sample_rate,
+        )
+    logger.info("Sentry initialized")
+
+
 def initialize_all(args) -> RouterState:
     """Wire all singletons (reference app.py:112-272)."""
     state = RouterState()
+    _init_sentry(args)
 
     # Service discovery.
     if args.service_discovery == "static":

@@ -79,7 +79,13 @@ def build_parser() -> argparse.ArgumentParser:
     # Logging / tracing
     parser.add_argument("--log-level", default="info",
                         choices=["debug", "info", "warning", "error", "critical"])
-    parser.add_argument("--sentry-dsn", default=None)
+    parser.add_argument("--sentry-dsn", default=None,
+                        help="enable Sentry error reporting/profiling "
+                             "(requires sentry-sdk in the image)")
+    parser.add_argument("--sentry-traces-sample-rate", type=float,
+                        default=0.1)
+    parser.add_argument("--sentry-profile-session-sample-rate", type=float,
+                        default=0.1)
     parser.add_argument("--otel-endpoint", default=None,
                         help="OTLP endpoint for request span export")
     return parser
@@ -103,6 +109,11 @@ def validate_args(args: argparse.Namespace) -> None:
             "disaggregated_prefill routing requires --prefill-model-labels "
             "and --decode-model-labels"
         )
+    if not 0.0 <= args.sentry_traces_sample_rate <= 1.0:
+        raise ValueError("--sentry-traces-sample-rate must be in [0, 1]")
+    if not 0.0 <= args.sentry_profile_session_sample_rate <= 1.0:
+        raise ValueError(
+            "--sentry-profile-session-sample-rate must be in [0, 1]")
 
 
 def expand_static_models_config(config: dict) -> dict:

@@ -292,6 +292,48 @@ def test_operator_detects_drift():
     assert dep["metadata"]["resourceVersion"] == "42"  # carried over
 
 
+def test_operator_detects_resource_drift():
+    """A TPU-chips edit on the CR must reconcile even when replicas, image
+    and command all match (the reference compares resources/env too,
+    vllmruntime_controller.go:624-706)."""
+    fake = FakeK8s()
+    fake.crs["tpuruntimes"] = [{
+        "metadata": {"name": "m", "uid": "u"},
+        "spec": {"model": "tiny-llama", "replicas": 1, "port": 8000,
+                 "tpu": {"chips": 8}},
+    }]
+
+    async def boot(expected_chips):
+        runner = web.AppRunner(fake.make_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        proc = await asyncio.get_running_loop().run_in_executor(
+            None, _run_operator, f"http://127.0.0.1:{port}")
+        await runner.cleanup()
+        assert proc.returncode == 0, proc.stderr
+        dep_key = "/apis/apps/v1/namespaces/default/deployments/m-engine"
+        c = fake.objects[dep_key]["spec"]["template"]["spec"]["containers"][0]
+        limits = c["resources"]["limits"]
+        assert float(limits["google.com/tpu"]) == expected_chips
+
+    asyncio.run(boot(8))
+    # The API server normalizes quantities to strings; same value must NOT
+    # count as drift (no infinite update loop) ...
+    dep_key = "/apis/apps/v1/namespaces/default/deployments/m-engine"
+    c = fake.objects[dep_key]["spec"]["template"]["spec"]["containers"][0]
+    c["resources"] = {"requests": {"google.com/tpu": "8"},
+                      "limits": {"google.com/tpu": "8"}}
+    before = json.dumps(fake.objects[dep_key], sort_keys=True)
+    asyncio.run(boot(8))
+    assert json.dumps(fake.objects[dep_key], sort_keys=True) == before
+
+    # ... but a chips edit is drift and must be corrected.
+    fake.crs["tpuruntimes"][0]["spec"]["tpu"]["chips"] = 4
+    asyncio.run(boot(4))
+
+
 def test_operator_loads_lora_adapters():
     fake = FakeK8s()
     lora_calls = []
