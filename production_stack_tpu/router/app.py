@@ -439,8 +439,13 @@ def initialize_all(args) -> RouterState:
             ),
         )
     else:
+        sd_type = (
+            ServiceDiscoveryType.K8S_SERVICE_NAME
+            if args.service_discovery == "k8s_service_name"
+            else ServiceDiscoveryType.K8S_POD_IP
+        )
         state.service_discovery = initialize_service_discovery(
-            ServiceDiscoveryType.K8S_POD_IP,
+            sd_type,
             namespace=args.k8s_namespace,
             port=args.k8s_port,
             label_selector=args.k8s_label_selector,

@@ -50,12 +50,14 @@ class K8sClient:
         if self.token:
             self.session.headers["Authorization"] = f"Bearer {self.token}"
 
-    def list_pods(self, namespace: str, label_selector: Optional[str] = None) -> dict:
+    # -- generic core-v1 resource operations ------------------------------
+    def _list(self, namespace: str, plural: str,
+              label_selector: Optional[str]) -> dict:
         params = {}
         if label_selector:
             params["labelSelector"] = label_selector
         resp = self.session.get(
-            f"{self.host}/api/v1/namespaces/{namespace}/pods",
+            f"{self.host}/api/v1/namespaces/{namespace}/{plural}",
             params=params,
             verify=self.verify,
             timeout=30,
@@ -63,17 +65,26 @@ class K8sClient:
         resp.raise_for_status()
         return resp.json()
 
-    def watch_pods(
-        self,
-        namespace: str,
-        label_selector: Optional[str] = None,
-        timeout_seconds: int = 300,
-    ) -> Iterator[dict]:
-        """Stream pod watch events. Replays current pods as ADDED first."""
-        current = self.list_pods(namespace, label_selector)
+    def _watch(self, namespace: str, plural: str,
+               label_selector: Optional[str],
+               timeout_seconds: int) -> Iterator[dict]:
+        """Stream watch events. Yields a synthetic SNAPSHOT event naming the
+        currently live objects first (so consumers can purge state for
+        objects deleted while the stream was down), then replays the current
+        objects as ADDED, then streams."""
+        current = self._list(namespace, plural, label_selector)
         resource_version = current.get("metadata", {}).get("resourceVersion")
-        for pod in current.get("items", []):
-            yield {"type": "ADDED", "object": pod}
+        items = current.get("items", [])
+        yield {
+            "type": "SNAPSHOT",
+            "names": [
+                o.get("metadata", {}).get("name")
+                for o in items
+                if o.get("metadata", {}).get("name")
+            ],
+        }
+        for obj in items:
+            yield {"type": "ADDED", "object": obj}
         params = {
             "watch": "true",
             "timeoutSeconds": str(timeout_seconds),
@@ -83,7 +94,7 @@ class K8sClient:
         if resource_version:
             params["resourceVersion"] = resource_version
         resp = self.session.get(
-            f"{self.host}/api/v1/namespaces/{namespace}/pods",
+            f"{self.host}/api/v1/namespaces/{namespace}/{plural}",
             params=params,
             verify=self.verify,
             stream=True,
@@ -97,13 +108,62 @@ class K8sClient:
                 except json.JSONDecodeError:
                     logger.warning("Malformed watch line: %r", line[:200])
 
-    def patch_pod_labels(self, namespace: str, pod_name: str, labels: dict) -> None:
-        """Merge-patch labels on a pod (reference labels pods sleeping=true)."""
+    def _patch_labels(self, namespace: str, plural: str, name: str,
+                      labels: dict) -> None:
         resp = self.session.patch(
-            f"{self.host}/api/v1/namespaces/{namespace}/pods/{pod_name}",
+            f"{self.host}/api/v1/namespaces/{namespace}/{plural}/{name}",
             json={"metadata": {"labels": labels}},
             headers={"Content-Type": "application/merge-patch+json"},
             verify=self.verify,
             timeout=30,
         )
         resp.raise_for_status()
+
+    # -- pods --------------------------------------------------------------
+    def list_pods(self, namespace: str, label_selector: Optional[str] = None) -> dict:
+        return self._list(namespace, "pods", label_selector)
+
+    def watch_pods(
+        self,
+        namespace: str,
+        label_selector: Optional[str] = None,
+        timeout_seconds: int = 300,
+    ) -> Iterator[dict]:
+        """Stream pod watch events. Replays current pods as ADDED first."""
+        return self._watch(namespace, "pods", label_selector, timeout_seconds)
+
+    def patch_pod_labels(self, namespace: str, pod_name: str, labels: dict) -> None:
+        """Merge-patch labels on a pod (reference labels pods sleeping=true)."""
+        self._patch_labels(namespace, "pods", pod_name, labels)
+
+    # -- services / endpoints (service-name discovery) ---------------------
+    def list_services(
+        self, namespace: str, label_selector: Optional[str] = None
+    ) -> dict:
+        return self._list(namespace, "services", label_selector)
+
+    def watch_services(
+        self,
+        namespace: str,
+        label_selector: Optional[str] = None,
+        timeout_seconds: int = 300,
+    ) -> Iterator[dict]:
+        """Stream service watch events (current services replay as ADDED)."""
+        return self._watch(
+            namespace, "services", label_selector, timeout_seconds)
+
+    def read_endpoints(self, namespace: str, name: str) -> dict:
+        """The Endpoints object backing a service (readiness signal)."""
+        resp = self.session.get(
+            f"{self.host}/api/v1/namespaces/{namespace}/endpoints/{name}",
+            verify=self.verify,
+            timeout=30,
+        )
+        resp.raise_for_status()
+        return resp.json()
+
+    def patch_service_labels(
+        self, namespace: str, name: str, labels: dict
+    ) -> None:
+        """Merge-patch labels on a service (sleeping=true marker)."""
+        self._patch_labels(namespace, "services", name, labels)

@@ -24,7 +24,8 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--port", type=int, default=8001)
     # Service discovery
     parser.add_argument(
-        "--service-discovery", choices=["static", "k8s"], default="static"
+        "--service-discovery",
+        choices=["static", "k8s", "k8s_service_name"], default="static"
     )
     parser.add_argument("--static-backends", type=str, default=None,
                         help="Comma-separated engine URLs")

@@ -203,11 +203,11 @@ class StaticServiceDiscovery(ServiceDiscovery):
         self._running = False
 
 
-class K8sPodIPServiceDiscovery(ServiceDiscovery):
-    """Watch engine pods via the K8s API, route to pod IPs.
-
-    Reference service_discovery.py:344-760 (_watch_engines:579-630).
-    """
+class _K8sWatchDiscoveryBase(ServiceDiscovery):
+    """Shared machinery for watch-driven K8s discovery: the retry loop,
+    endpoint bookkeeping under a lock, reconnect reconciliation (a SNAPSHOT
+    event from the client purges endpoints for objects deleted while the
+    watch stream was down), and lifecycle."""
 
     def __init__(
         self,
@@ -217,6 +217,7 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
         prefill_model_labels: Optional[List[str]] = None,
         decode_model_labels: Optional[List[str]] = None,
         k8s_client=None,
+        thread_name: str = "k8s-watch",
     ):
         from production_stack_tpu.router.k8s_client import K8sClient
 
@@ -227,25 +228,62 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
         self.decode_model_labels = decode_model_labels or []
         self._k8s = k8s_client or K8sClient()
         self._lock = threading.Lock()
-        self._endpoints: Dict[str, EndpointInfo] = {}  # pod name -> info
+        self._endpoints: Dict[str, EndpointInfo] = {}  # object name -> info
         self._running = True
         self._thread = threading.Thread(
-            target=self._watch_engines, daemon=True, name="k8s-watch"
+            target=self._watch_engines, daemon=True, name=thread_name
         )
         self._thread.start()
+
+    def _watch_stream(self):
+        """Yield watch events for the watched resource."""
+        raise NotImplementedError
+
+    def _handle_event(self, event: dict) -> None:
+        raise NotImplementedError
 
     def _watch_engines(self) -> None:
         while self._running:
             try:
-                for event in self._k8s.watch_pods(
-                    self.namespace, self.label_selector
-                ):
+                for event in self._watch_stream():
                     if not self._running:
                         return
-                    self._handle_event(event)
+                    if event.get("type") == "SNAPSHOT":
+                        self._reconcile(event.get("names") or [])
+                    else:
+                        self._handle_event(event)
             except Exception as e:  # noqa: BLE001
                 logger.warning("K8s watch error (retrying in 2s): %s", e)
                 time.sleep(2)
+
+    def _reconcile(self, live_names: List[str]) -> None:
+        """Purge endpoints whose objects disappeared during a stream gap."""
+        live = set(live_names)
+        with self._lock:
+            for stale in [n for n in self._endpoints if n not in live]:
+                logger.info(
+                    "Engine %s gone after watch reconnect, removed", stale)
+                del self._endpoints[stale]
+
+    def get_endpoint_info(self) -> List[EndpointInfo]:
+        with self._lock:
+            return list(self._endpoints.values())
+
+    def get_health(self) -> bool:
+        return self._thread.is_alive()
+
+    def close(self) -> None:
+        self._running = False
+
+
+class K8sPodIPServiceDiscovery(_K8sWatchDiscoveryBase):
+    """Watch engine pods via the K8s API, route to pod IPs.
+
+    Reference service_discovery.py:344-760 (_watch_engines:579-630).
+    """
+
+    def _watch_stream(self):
+        return self._k8s.watch_pods(self.namespace, self.label_selector)
 
     def _handle_event(self, event: dict) -> None:
         etype = event.get("type")
@@ -281,15 +319,133 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
                 namespace=self.namespace,
             )
 
-    def get_endpoint_info(self) -> List[EndpointInfo]:
+
+class K8sServiceNameServiceDiscovery(_K8sWatchDiscoveryBase):
+    """Watch engine *services* via the K8s API, route to service names.
+
+    Reference ``service_discovery.py:762-1176``. Routing goes through the
+    cluster's service DNS (namespace-qualified,
+    ``http://<service>.<namespace>.svc:<port>``, so cross-namespace
+    discovery resolves; the reference uses bare service names), and
+    Kubernetes does the pod-level load balancing; advanced per-pod
+    strategies (kvaware, PD) and per-pod metrics need 1:1 service-to-pod
+    deployments — same caveat as the reference documents. An engine service
+    is routable once its Endpoints object has ready addresses;
+    ``sleeping=true`` labels (or a live ``/is_sleeping`` probe) exclude it
+    from routing.
+    """
+
+    def __init__(
+        self,
+        namespace: str = "default",
+        port: int = 8000,
+        label_selector: Optional[str] = None,
+        prefill_model_labels: Optional[List[str]] = None,
+        decode_model_labels: Optional[List[str]] = None,
+        k8s_client=None,
+        service_url_for=None,
+    ):
+        # Resolves a service name to its routing URL (injectable for tests
+        # and non-standard DNS setups).
+        self._url_for = service_url_for or (
+            lambda name: f"http://{name}.{namespace}.svc:{port}"
+        )
+        super().__init__(
+            namespace=namespace,
+            port=port,
+            label_selector=label_selector,
+            prefill_model_labels=prefill_model_labels,
+            decode_model_labels=decode_model_labels,
+            k8s_client=k8s_client,
+            thread_name="k8s-svc-watch",
+        )
+
+    def _watch_stream(self):
+        return self._k8s.watch_services(self.namespace, self.label_selector)
+
+    def _service_ready(self, name: str) -> bool:
+        """Ready iff the service's Endpoints carry addresses (reference
+        ``_check_service_ready``, :829-837)."""
+        try:
+            endpoints = self._k8s.read_endpoints(self.namespace, name)
+        except Exception as e:  # noqa: BLE001
+            logger.debug("Endpoints read failed for %s: %s", name, e)
+            return False
+        for subset in endpoints.get("subsets") or []:
+            if subset.get("addresses"):
+                return True
+        return False
+
+    def _handle_event(self, event: dict) -> None:
+        etype = event.get("type")
+        service = event.get("object", {})
+        meta = service.get("metadata", {})
+        name = meta.get("name")
+        if not name:
+            return
+        if etype == "DELETED" or meta.get("deletionTimestamp") is not None:
+            with self._lock:
+                if name in self._endpoints:
+                    logger.info("Engine service %s removed from routing", name)
+                    del self._endpoints[name]
+            return
+        if not self._service_ready(name):
+            with self._lock:
+                self._endpoints.pop(name, None)
+            return
+        url = self._url_for(name)
+        labels = meta.get("labels", {}) or {}
+        selector = (service.get("spec", {}) or {}).get("selector") or {}
+        model_label = selector.get("model")
+        sleeping = labels.get("sleeping") == "true" or _probe_sleep(url)
+        models = _probe_models(url)
+        if not models:
+            return
         with self._lock:
-            return list(self._endpoints.values())
+            self._endpoints[name] = EndpointInfo(
+                url=url,
+                model_names=models,
+                model_label=model_label,
+                sleep=sleeping,
+                pod_name=name,
+                namespace=self.namespace,
+            )
 
-    def get_health(self) -> bool:
-        return self._thread.is_alive()
+    # Sleep labels live on the service (reference :899-933).
+    def add_sleep_label(self, name: str) -> None:
+        try:
+            self._k8s.patch_service_labels(
+                self.namespace, name, {"sleeping": "true"})
+        except Exception as e:  # noqa: BLE001
+            logger.error("Could not label service %s sleeping: %s", name, e)
 
-    def close(self) -> None:
-        self._running = False
+    def remove_sleep_label(self, name: str) -> None:
+        try:
+            self._k8s.patch_service_labels(
+                self.namespace, name, {"sleeping": None})
+        except Exception as e:  # noqa: BLE001
+            logger.error("Could not unlabel service %s: %s", name, e)
+
+    def set_sleep_status(self, url: str, sleep: bool) -> None:
+        """Router-observed sleep flip: update routing now; persist the label
+        on the service from a worker thread (this is called from async
+        handlers — a slow API server must not stall the event loop)."""
+        with self._lock:
+            names = [n for n, ep in self._endpoints.items() if ep.url == url]
+            for n in names:
+                self._endpoints[n].sleep = sleep
+        if names:
+            threading.Thread(
+                target=self._apply_sleep_labels, args=(names, sleep),
+                daemon=True, name="k8s-sleep-label",
+            ).start()
+
+    def _apply_sleep_labels(self, names: List[str], sleep: bool) -> None:
+        for n in names:
+            if sleep:
+                self.add_sleep_label(n)
+            else:
+                self.remove_sleep_label(n)
 
 
 def _pod_is_ready(status: dict) -> bool:
@@ -309,6 +465,8 @@ def initialize_service_discovery(
         _global_service_discovery = StaticServiceDiscovery(**kwargs)
     elif sd_type == ServiceDiscoveryType.K8S_POD_IP:
         _global_service_discovery = K8sPodIPServiceDiscovery(**kwargs)
+    elif sd_type == ServiceDiscoveryType.K8S_SERVICE_NAME:
+        _global_service_discovery = K8sServiceNameServiceDiscovery(**kwargs)
     else:
         raise ValueError(f"Unsupported service discovery type: {sd_type}")
     return _global_service_discovery

@@ -18,12 +18,16 @@ from production_stack_tpu.testing.fake_engine import FakeEngine
 
 
 class FakeK8sApi:
-    """Serves /api/v1 pods list + a chunked watch stream + label patch."""
+    """Serves /api/v1 pods + services lists, chunked watch streams, label
+    patches, and endpoints reads."""
 
     def __init__(self):
         self.pods = []
+        self.services = []
+        self.endpoints = {}  # service name -> endpoints object
         self.patches = []
         self._watch_queue: "asyncio.Queue[dict]" = None
+        self._svc_watch_queue: "asyncio.Queue[dict]" = None
         self._loop = None
 
     def make_app(self):
@@ -32,27 +36,51 @@ class FakeK8sApi:
             "/api/v1/namespaces/{ns}/pods", self.handle_pods)
         app.router.add_patch(
             "/api/v1/namespaces/{ns}/pods/{name}", self.handle_patch)
+        app.router.add_get(
+            "/api/v1/namespaces/{ns}/services", self.handle_services)
+        app.router.add_patch(
+            "/api/v1/namespaces/{ns}/services/{name}", self.handle_patch)
+        app.router.add_get(
+            "/api/v1/namespaces/{ns}/endpoints/{name}",
+            self.handle_endpoints)
         return app
 
     def push_event(self, event: dict):
         self._loop.call_soon_threadsafe(
             self._watch_queue.put_nowait, event)
 
-    async def handle_pods(self, request: web.Request):
+    def push_service_event(self, event: dict):
+        self._loop.call_soon_threadsafe(
+            self._svc_watch_queue.put_nowait, event)
+
+    async def _stream(self, request, queue_attr, items):
         if request.query.get("watch") != "true":
-            return web.json_response({"items": self.pods})
+            return web.json_response({"items": items})
         self._loop = asyncio.get_running_loop()
-        self._watch_queue = asyncio.Queue()
+        setattr(self, queue_attr, asyncio.Queue())
         resp = web.StreamResponse()
         resp.content_type = "application/json"
         await resp.prepare(request)
         try:
             while True:
-                event = await self._watch_queue.get()
+                event = await getattr(self, queue_attr).get()
                 await resp.write((json.dumps(event) + "\n").encode())
         except (ConnectionResetError, asyncio.CancelledError):
             pass
         return resp
+
+    async def handle_pods(self, request: web.Request):
+        return await self._stream(request, "_watch_queue", self.pods)
+
+    async def handle_services(self, request: web.Request):
+        return await self._stream(
+            request, "_svc_watch_queue", self.services)
+
+    async def handle_endpoints(self, request: web.Request):
+        name = request.match_info["name"]
+        if name in self.endpoints:
+            return web.json_response(self.endpoints[name])
+        return web.json_response({"reason": "NotFound"}, status=404)
 
     async def handle_patch(self, request: web.Request):
         self.patches.append((request.match_info["name"],
@@ -171,5 +199,149 @@ def test_k8s_discovery_tracks_pod_lifecycle(fake_cluster):
             time.sleep(0.05)
         assert disco.get_endpoint_info() == []
         assert disco.get_health()
+    finally:
+        disco.close()
+
+
+def _service(name, selector=None, labels=None):
+    return {
+        "metadata": {"name": name, "labels": labels or {}},
+        "spec": {"selector": selector or {}},
+    }
+
+
+def _endpoints_obj(ready: bool):
+    return {"subsets": [{"addresses": [{"ip": "10.0.0.9"}]}] if ready else []}
+
+
+def test_k8s_service_name_discovery_lifecycle(fake_cluster):
+    """K8sServiceNameServiceDiscovery (reference service_discovery.py:762-
+    1176): services become routable when their Endpoints carry addresses,
+    sleep labels persist on the service, DELETED removes them."""
+    from production_stack_tpu.router.service_discovery import (
+        K8sServiceNameServiceDiscovery,
+    )
+
+    api, api_port, engine_port = fake_cluster
+    client = K8sClient(host=f"http://127.0.0.1:{api_port}", token="t")
+    svc_name = "engine-svc"
+    api.endpoints[svc_name] = _endpoints_obj(ready=True)
+    disco = K8sServiceNameServiceDiscovery(
+        namespace="default", port=engine_port, k8s_client=client,
+        # In-cluster this defaults to http://<name>.<ns>.svc:<port>; the
+        # test resolves every service to the loopback fake engine.
+        service_url_for=lambda name: f"http://127.0.0.1:{engine_port}",
+    )
+    try:
+        deadline = time.time() + 10
+        while api._svc_watch_queue is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert api._svc_watch_queue is not None, "watch never connected"
+
+        api.push_service_event({
+            "type": "ADDED",
+            "object": _service(svc_name, selector={"model": "unit-b"}),
+        })
+        deadline = time.time() + 10
+        while not disco.get_endpoint_info() and time.time() < deadline:
+            time.sleep(0.05)
+        eps = disco.get_endpoint_info()
+        assert len(eps) == 1
+        assert eps[0].url == f"http://127.0.0.1:{engine_port}"
+        assert eps[0].model_names == ["k8s-model"]
+        assert eps[0].model_label == "unit-b"
+
+        # No ready Endpoints addresses -> not routable.
+        api.endpoints[svc_name] = _endpoints_obj(ready=False)
+        api.push_service_event(
+            {"type": "MODIFIED", "object": _service(svc_name)})
+        deadline = time.time() + 10
+        while disco.get_endpoint_info() and time.time() < deadline:
+            time.sleep(0.05)
+        assert disco.get_endpoint_info() == []
+
+        # Ready again; then the router flips sleep -> label patched on the
+        # service and endpoint excluded from model routing.
+        api.endpoints[svc_name] = _endpoints_obj(ready=True)
+        api.push_service_event(
+            {"type": "MODIFIED", "object": _service(svc_name)})
+        deadline = time.time() + 10
+        while not disco.get_endpoint_info() and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(disco.get_endpoint_info()) == 1
+
+        disco.set_sleep_status(f"http://127.0.0.1:{engine_port}", True)
+        assert disco.get_endpoints_for_model("k8s-model") == []
+        # The label patch runs on a worker thread (never the event loop).
+        expect = (svc_name, {"metadata": {"labels": {"sleeping": "true"}}})
+        deadline = time.time() + 10
+        while expect not in api.patches and time.time() < deadline:
+            time.sleep(0.05)
+        assert expect in api.patches
+
+        # A sleeping-labelled service event keeps it excluded.
+        api.push_service_event({
+            "type": "MODIFIED",
+            "object": _service(svc_name, labels={"sleeping": "true"}),
+        })
+        time.sleep(0.3)
+        assert disco.get_endpoints_for_model("k8s-model") == []
+
+        api.push_service_event(
+            {"type": "DELETED", "object": _service(svc_name)})
+        deadline = time.time() + 10
+        while disco.get_endpoint_info() and time.time() < deadline:
+            time.sleep(0.05)
+        assert disco.get_endpoint_info() == []
+        assert disco.get_health()
+    finally:
+        disco.close()
+
+
+def test_k8s_watch_reconnect_purges_deleted(fake_cluster):
+    """Objects deleted while the watch stream is down must be purged on
+    reconnect: the client prepends a SNAPSHOT event naming the live
+    objects, and the discovery loop reconciles its endpoints against it."""
+    from production_stack_tpu.router.service_discovery import (
+        K8sServiceNameServiceDiscovery,
+    )
+
+    api, api_port, engine_port = fake_cluster
+    client = K8sClient(host=f"http://127.0.0.1:{api_port}", token="t")
+
+    # The watch stream leads with a SNAPSHOT of currently live names.
+    api.services = [_service("live-1"), _service("live-2")]
+    stream = client.watch_services("default")
+    first = next(stream)
+    assert first == {"type": "SNAPSHOT", "names": ["live-1", "live-2"]}
+    assert next(stream)["type"] == "ADDED"
+    stream.close()
+
+    # A discovery instance that routed to a since-deleted service purges it
+    # when the reconnect SNAPSHOT arrives through the watch loop.
+    api.services = []
+    api.endpoints["ghost"] = _endpoints_obj(ready=True)
+    disco = K8sServiceNameServiceDiscovery(
+        namespace="default", port=engine_port, k8s_client=client,
+        service_url_for=lambda name: f"http://127.0.0.1:{engine_port}",
+    )
+    try:
+        deadline = time.time() + 10
+        while api._svc_watch_queue is None and time.time() < deadline:
+            time.sleep(0.05)
+        api.push_service_event(
+            {"type": "ADDED", "object": _service("ghost")})
+        deadline = time.time() + 10
+        while not disco.get_endpoint_info() and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(disco.get_endpoint_info()) == 1
+
+        # "ghost" was deleted while the stream was down; the next stream's
+        # SNAPSHOT (empty cluster) must remove it from routing.
+        api.push_service_event({"type": "SNAPSHOT", "names": []})
+        deadline = time.time() + 10
+        while disco.get_endpoint_info() and time.time() < deadline:
+            time.sleep(0.05)
+        assert disco.get_endpoint_info() == []
     finally:
         disco.close()
