@@ -88,6 +88,11 @@ class EngineServer:
         self.instance_id = instance_id or f"engine-{uuid.uuid4().hex[:8]}"
         self.advertise_url = advertise_url
         self._kv_registered = False
+        # Disaggregated-prefill transfer counters (exported via /metrics).
+        self.kv_transfer_tx_bytes = 0
+        self.kv_transfer_rx_bytes = 0
+        self.kv_transfer_rx_seconds = 0.0
+        self.kv_transfer_pulls = 0
 
     async def start_kv_reporting(self, own_url: str) -> None:
         """Register with the router's KV controller (retried lazily on
@@ -665,9 +670,11 @@ class EngineServer:
             return [int(t) for t in prompt]
         return self.core.tokenizer.encode(str(prompt))
 
-    async def handle_kv_extract(self, request: web.Request) -> web.Response:
-        """Serialize the cached KV pages for a prompt's prefix."""
-        from production_stack_tpu.kv.offload import pack_transfer
+    async def handle_kv_extract(self, request: web.Request) -> web.StreamResponse:
+        """Serialize the cached KV pages for a prompt's prefix. The raw
+        array buffers stream straight to the socket (no payload-sized
+        concatenation copy — this path moves multi-GB KV at 8B/70B scale)."""
+        from production_stack_tpu.kv.offload import pack_transfer_buffers
 
         body = await request.json()
         token_ids = self._tokens_from_body(body)
@@ -678,14 +685,22 @@ class EngineServer:
         if payload is None:
             return web.json_response(
                 {"error": "no cached prefix for these tokens"}, status=404)
-        data = pack_transfer(
+        buffers = pack_transfer_buffers(
             payload["hashes"], payload["num_tokens"],
             payload["k"], payload["v"],
         )
-        return web.Response(
-            body=data, content_type="application/octet-stream",
-            headers={"X-KV-Tokens": str(payload["num_tokens"])},
-        )
+        total = sum(len(b) for b in buffers)
+        resp = web.StreamResponse(headers={
+            "Content-Type": "application/octet-stream",
+            "Content-Length": str(total),
+            "X-KV-Tokens": str(payload["num_tokens"]),
+        })
+        await resp.prepare(request)
+        for buf in buffers:
+            await resp.write(buf)
+        await resp.write_eof()
+        self.kv_transfer_tx_bytes += total
+        return resp
 
     async def handle_kv_inject(self, request: web.Request) -> web.Response:
         """Install transferred KV blocks (inverse of /kv/extract)."""
@@ -719,6 +734,7 @@ class EngineServer:
                 {"error": "source_url required"}, status=400)
         req_body = body.get("request", body)
         token_ids = self._tokens_from_body(req_body)
+        t0 = time.monotonic()
         try:
             async with aiohttp.ClientSession() as session:
                 async with session.post(
@@ -734,6 +750,7 @@ class EngineServer:
         except aiohttp.ClientError as e:
             return web.json_response(
                 {"error": f"source unreachable: {e}"}, status=502)
+        fetch_seconds = time.monotonic() - t0
         try:
             payload = unpack_transfer(data)
         except Exception:  # noqa: BLE001 - truncated/version-skewed payload
@@ -742,9 +759,23 @@ class EngineServer:
             None, lambda: self.core.inject_kv(
                 payload["hashes"], payload["k"], payload["v"])
         )
+        total_seconds = time.monotonic() - t0
+        self.kv_transfer_rx_bytes += len(data)
+        self.kv_transfer_rx_seconds += total_seconds
+        self.kv_transfer_pulls += 1
         return web.json_response(
             {"status": "ok", "injected_blocks": injected,
-             "num_tokens": payload["num_tokens"]})
+             "num_tokens": payload["num_tokens"],
+             "transfer": {
+                 "bytes": len(data),
+                 # fetch covers the donor's extract (device_get + pack) plus
+                 # the HTTP transfer; total adds the local inject. This is
+                 # end-to-end handoff throughput, not link bandwidth.
+                 "fetch_seconds": round(fetch_seconds, 6),
+                 "total_seconds": round(total_seconds, 6),
+                 "gigabytes_per_second": round(
+                     len(data) / max(fetch_seconds, 1e-9) / 1e9, 6),
+             }})
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         s = self.core.stats()
@@ -780,6 +811,15 @@ class EngineServer:
             f"tpu:engine_sleeping{{{labels}}} {int(s['is_sleeping'])}",
             "# TYPE tpu:cached_prompt_tokens counter",
             f"tpu:cached_prompt_tokens_total{{{labels}}} {s['cached_tokens_total']}",
+            # Disaggregated-prefill KV handoff (the NIXL-pipe equivalent).
+            "# TYPE tpu:kv_transfer_tx_bytes counter",
+            f"tpu:kv_transfer_tx_bytes_total{{{labels}}} {self.kv_transfer_tx_bytes}",
+            "# TYPE tpu:kv_transfer_rx_bytes counter",
+            f"tpu:kv_transfer_rx_bytes_total{{{labels}}} {self.kv_transfer_rx_bytes}",
+            "# TYPE tpu:kv_transfer_rx_seconds counter",
+            f"tpu:kv_transfer_rx_seconds_total{{{labels}}} {self.kv_transfer_rx_seconds:.6f}",
+            "# TYPE tpu:kv_transfer_pulls counter",
+            f"tpu:kv_transfer_pulls_total{{{labels}}} {self.kv_transfer_pulls}",
         ]
         if s.get("offload"):
             off = s["offload"]

@@ -82,23 +82,74 @@ def unpack_block(data: bytes) -> Tuple[np.ndarray, np.ndarray]:
     return out["k"], out["v"]
 
 
+# Disaggregated-prefill transfer wire format v2: a small JSON header plus
+# the RAW array bytes — no zip container, no CRC, no intermediate copies
+# (the npz path cost ~3 full copies + a CRC pass per side at multi-GB KV
+# sizes). The sender can write the returned buffers straight to the socket;
+# the receiver reinterprets the body in place via np.frombuffer offsets.
+_TRANSFER_MAGIC = b"TKV2"
+
+
+def _raw_view(arr: np.ndarray) -> memoryview:
+    return memoryview(np.ascontiguousarray(arr).view(np.uint8).reshape(-1))
+
+
+def pack_transfer_buffers(
+    hashes, num_tokens: int, k: np.ndarray, v: np.ndarray
+) -> "list":
+    """Zero-copy packing: returns [header_bytes, k_view, v_view] suitable
+    for writing sequentially to a socket/stream."""
+    import json as _json
+    import struct
+
+    header = _json.dumps({
+        "hashes": [int(h) for h in hashes],
+        "num_tokens": int(num_tokens),
+        "k": {"dtype": _dtype_name(k), "shape": list(k.shape)},
+        "v": {"dtype": _dtype_name(v), "shape": list(v.shape)},
+    }).encode()
+    head = _TRANSFER_MAGIC + struct.pack("<I", len(header)) + header
+    return [head, _raw_view(k), _raw_view(v)]
+
+
 def pack_transfer(hashes, num_tokens: int, k: np.ndarray, v: np.ndarray) -> bytes:
-    """Multi-block wire format for /kv/extract -> /kv/inject transfers.
-    ``k``/``v``: [N_blocks, L, bs, KVH, D]."""
-    return _pack_arrays(
-        hashes=np.asarray(hashes, np.uint64),
-        num_tokens=np.asarray([num_tokens], np.int64),
-        k=k, v=v,
-    )
+    """One-shot packing for callers that need a single bytes payload."""
+    return b"".join(bytes(b) for b in pack_transfer_buffers(
+        hashes, num_tokens, k, v))
 
 
 def unpack_transfer(data: bytes) -> dict:
-    out = _unpack_arrays(data, ("hashes", "num_tokens", "k", "v"))
+    """Inverse of pack_transfer. Array data is reinterpreted in place
+    (frombuffer at offsets — no slicing copies). Legacy .npz payloads
+    (round-1 engines) still unpack."""
+    if data[:4] == _TRANSFER_MAGIC:
+        import json as _json
+        import struct
+
+        (hlen,) = struct.unpack_from("<I", data, 4)
+        header = _json.loads(data[8 : 8 + hlen].decode())
+        offset = 8 + hlen
+        out = {}
+        for key in ("k", "v"):
+            dtype = _resolve_dtype(header[key]["dtype"])
+            shape = tuple(header[key]["shape"])
+            count = int(np.prod(shape)) if shape else 1
+            out[key] = np.frombuffer(
+                data, dtype=dtype, count=count, offset=offset
+            ).reshape(shape)
+            offset += count * dtype.itemsize
+        return {
+            "hashes": [int(h) for h in header["hashes"]],
+            "num_tokens": int(header["num_tokens"]),
+            "k": out["k"],
+            "v": out["v"],
+        }
+    legacy = _unpack_arrays(data, ("hashes", "num_tokens", "k", "v"))
     return {
-        "hashes": [int(h) for h in out["hashes"]],
-        "num_tokens": int(out["num_tokens"][0]),
-        "k": out["k"],
-        "v": out["v"],
+        "hashes": [int(h) for h in legacy["hashes"]],
+        "num_tokens": int(legacy["num_tokens"][0]),
+        "k": legacy["k"],
+        "v": legacy["v"],
     }
 
 

@@ -350,6 +350,8 @@ class K8sServiceNameServiceDiscovery(_K8sWatchDiscoveryBase):
         self._url_for = service_url_for or (
             lambda name: f"http://{name}.{namespace}.svc:{port}"
         )
+        # name -> requested sleep state while its label patch is in flight.
+        self._pending_sleep: Dict[str, bool] = {}
         super().__init__(
             namespace=namespace,
             port=port,
@@ -363,14 +365,15 @@ class K8sServiceNameServiceDiscovery(_K8sWatchDiscoveryBase):
     def _watch_stream(self):
         return self._k8s.watch_services(self.namespace, self.label_selector)
 
-    def _service_ready(self, name: str) -> bool:
-        """Ready iff the service's Endpoints carry addresses (reference
-        ``_check_service_ready``, :829-837)."""
+    def _service_ready(self, name: str) -> Optional[bool]:
+        """True/False from the service's Endpoints addresses (reference
+        ``_check_service_ready``, :829-837); None when the API read itself
+        failed — callers must NOT conflate that with "not ready"."""
         try:
             endpoints = self._k8s.read_endpoints(self.namespace, name)
         except Exception as e:  # noqa: BLE001
             logger.debug("Endpoints read failed for %s: %s", name, e)
-            return False
+            return None
         for subset in endpoints.get("subsets") or []:
             if subset.get("addresses"):
                 return True
@@ -389,7 +392,12 @@ class K8sServiceNameServiceDiscovery(_K8sWatchDiscoveryBase):
                     logger.info("Engine service %s removed from routing", name)
                     del self._endpoints[name]
             return
-        if not self._service_ready(name):
+        ready = self._service_ready(name)
+        if ready is None:
+            # Transient API failure: keep current routing state; the next
+            # event or reconnect snapshot reconciles.
+            return
+        if not ready:
             with self._lock:
                 self._endpoints.pop(name, None)
             return
@@ -397,7 +405,15 @@ class K8sServiceNameServiceDiscovery(_K8sWatchDiscoveryBase):
         labels = meta.get("labels", {}) or {}
         selector = (service.get("spec", {}) or {}).get("selector") or {}
         model_label = selector.get("model")
-        sleeping = labels.get("sleeping") == "true" or _probe_sleep(url)
+        with self._lock:
+            pending_sleep = self._pending_sleep.get(name)
+        if pending_sleep is not None:
+            # The router just flipped this engine's sleep state and the
+            # label patch is still in flight — the event's label/probe view
+            # is stale and must not resurrect (or re-sleep) the endpoint.
+            sleeping = pending_sleep
+        else:
+            sleeping = labels.get("sleeping") == "true" or _probe_sleep(url)
         models = _probe_models(url)
         if not models:
             return
@@ -434,6 +450,7 @@ class K8sServiceNameServiceDiscovery(_K8sWatchDiscoveryBase):
             names = [n for n, ep in self._endpoints.items() if ep.url == url]
             for n in names:
                 self._endpoints[n].sleep = sleep
+                self._pending_sleep[n] = sleep
         if names:
             threading.Thread(
                 target=self._apply_sleep_labels, args=(names, sleep),
@@ -446,6 +463,10 @@ class K8sServiceNameServiceDiscovery(_K8sWatchDiscoveryBase):
                 self.add_sleep_label(n)
             else:
                 self.remove_sleep_label(n)
+            with self._lock:
+                # Label state is authoritative again for this service.
+                if self._pending_sleep.get(n) == sleep:
+                    del self._pending_sleep[n]
 
 
 def _pod_is_ready(status: dict) -> bool:

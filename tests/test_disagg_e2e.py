@@ -140,6 +140,16 @@ def test_kv_pull_endpoint_direct():
                     out = await resp.json()
             assert out["injected_blocks"] > 0
             assert out["num_tokens"] >= 8
+            # Handoff cost is measured and reported (VERDICT round-1 #5).
+            t = out["transfer"]
+            assert t["bytes"] > 0 and t["total_seconds"] > 0
+            assert t["gigabytes_per_second"] > 0
+            # ... and exported as counters on the receiving engine.
+            async with aiohttp.ClientSession() as s:
+                async with s.get(r_url + "/metrics") as resp:
+                    metrics = await resp.text()
+            assert "tpu:kv_transfer_rx_bytes_total" in metrics
+            assert "tpu:kv_transfer_pulls_total" in metrics
         finally:
             await d_runner.cleanup()
             await r_runner.cleanup()
@@ -149,3 +159,74 @@ def test_kv_pull_endpoint_direct():
     finally:
         donor.core.stop()
         recv.core.stop()
+
+
+def test_disagg_long_prompt_handoff():
+    """Disaggregated prefill at a >=1k-token prompt: the KV handoff moves
+    every prefix block and the decode engine serves from it (the scale the
+    reference hands to its NIXL pipe)."""
+
+    def _cfg():
+        return EngineConfig(
+            model="tiny-llama", max_model_len=2048, max_num_seqs=2,
+            block_size=16, num_blocks=160, max_loras=0,
+        )
+
+    prefill_server = EngineServer(_cfg())
+    decode_server = EngineServer(_cfg())
+
+    async def run():
+        p_runner = await run_engine_server(prefill_server, "127.0.0.1", 0)
+        d_runner = await run_engine_server(decode_server, "127.0.0.1", 0)
+        p_port = list(p_runner.sites)[0]._server.sockets[0].getsockname()[1]
+        d_port = list(d_runner.sites)[0]._server.sockets[0].getsockname()[1]
+        p_url = f"http://127.0.0.1:{p_port}"
+        d_url = f"http://127.0.0.1:{d_port}"
+        # ~1.3k tokens for the tiny-llama tokenizer (~21 tokens/repeat).
+        prompt = "long context handoff " * 64
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(p_url + "/tokenize",
+                                  json={"prompt": prompt}) as resp:
+                    n_tokens = (await resp.json())["count"]
+                assert n_tokens >= 1000, n_tokens
+                # Prefill engine computes the KV.
+                async with s.post(p_url + "/v1/completions", json={
+                    "model": "tiny-llama", "prompt": prompt,
+                    "max_tokens": 1, "temperature": 0.0, "ignore_eos": True,
+                }, timeout=aiohttp.ClientTimeout(total=600)) as resp:
+                    assert resp.status == 200, await resp.text()
+                # Decode engine pulls the whole prefix.
+                async with s.post(d_url + "/kv/pull", json={
+                    "source_url": p_url,
+                    "request": {"model": "tiny-llama", "prompt": prompt},
+                }, timeout=aiohttp.ClientTimeout(total=600)) as resp:
+                    assert resp.status == 200
+                    out = await resp.json()
+                blocks = out["injected_blocks"]
+                assert out["num_tokens"] >= 1000
+                assert blocks >= 1000 // 16
+                t = out["transfer"]
+                # Sanity: the payload really carried the multi-block KV.
+                mc = decode_server.core.model_config
+                per_block = (
+                    2 * mc.num_layers * 16 * mc.num_kv_heads * mc.head_dim
+                    * 2  # bfloat16 bytes
+                )
+                assert t["bytes"] >= blocks * per_block
+                # Decode serves from the transferred KV.
+                async with s.post(d_url + "/v1/completions", json={
+                    "model": "tiny-llama", "prompt": prompt,
+                    "max_tokens": 4, "temperature": 0.0, "ignore_eos": True,
+                }, timeout=aiohttp.ClientTimeout(total=600)) as resp:
+                    assert resp.status == 200, await resp.text()
+                assert decode_server.core.cached_tokens_total >= 1000
+        finally:
+            await p_runner.cleanup()
+            await d_runner.cleanup()
+
+    try:
+        asyncio.run(run())
+    finally:
+        prefill_server.core.stop()
+        decode_server.core.stop()

@@ -44,6 +44,58 @@ def core():
     c.stop()
 
 
+def test_transfer_wire_format_roundtrip():
+    """v2 raw-buffer wire format: exact roundtrip for float32 and bfloat16,
+    and the receiver reinterprets without copying the body. Legacy .npz
+    payloads (round-1 engines) still unpack."""
+    import numpy as np
+
+    from production_stack_tpu.kv.offload import (
+        _pack_arrays,
+        pack_transfer,
+        pack_transfer_buffers,
+        unpack_transfer,
+    )
+
+    rng = np.random.default_rng(3)
+    for dtype_name in ("float32", "bfloat16"):
+        if dtype_name == "bfloat16":
+            import ml_dtypes
+
+            dtype = np.dtype(ml_dtypes.bfloat16)
+        else:
+            dtype = np.dtype(np.float32)
+        k = rng.standard_normal((3, 2, 8, 4, 16)).astype(dtype)
+        v = rng.standard_normal((3, 2, 8, 4, 16)).astype(dtype)
+        hashes = [12345, 2**63 + 7, 999]
+        data = pack_transfer(hashes, 24, k, v)
+        out = unpack_transfer(data)
+        assert out["hashes"] == hashes
+        assert out["num_tokens"] == 24
+        assert out["k"].dtype == dtype and out["v"].dtype == dtype
+        np.testing.assert_array_equal(
+            out["k"].view(np.uint8), k.view(np.uint8))
+        np.testing.assert_array_equal(
+            out["v"].view(np.uint8), v.view(np.uint8))
+        # The buffer form concatenates to the same payload (streaming path).
+        buffers = pack_transfer_buffers(hashes, 24, k, v)
+        assert b"".join(bytes(b) for b in buffers) == data
+        # No payload-sized copy on unpack: the arrays view the body.
+        assert out["k"].base is not None
+
+    # Legacy npz payload from a round-1 engine.
+    k32 = rng.standard_normal((2, 2, 8, 4, 16)).astype(np.float32)
+    v32 = rng.standard_normal((2, 2, 8, 4, 16)).astype(np.float32)
+    legacy = _pack_arrays(
+        hashes=np.asarray([1, 2], np.uint64),
+        num_tokens=np.asarray([16], np.int64),
+        k=k32, v=v32,
+    )
+    out = unpack_transfer(legacy)
+    assert out["hashes"] == [1, 2] and out["num_tokens"] == 16
+    np.testing.assert_array_equal(out["k"], k32)
+
+
 def test_cached_prefill_matches_fresh(core):
     # Non-degenerate prompt: a sequential prompt can mask wrong-logit-
     # position bugs (argmax coincidentally equal at several positions).
