@@ -24,6 +24,7 @@
 
 #include <unistd.h>
 
+#include <algorithm>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -548,12 +549,146 @@ void update_status(const HttpClient& api, const Config& cfg,
 }
 
 // ---------------------------------------------------------------------- //
-// LoraAdapter reconciler: drive engine pods' LoRA HTTP API
+// LoraAdapter reconciler: drive engine pods' LoRA HTTP API.
+//
+// Full lifecycle, matching the reference controller
+// (loraadapter_controller.go): finalizer add/remove with unload-on-delete
+// (:94-110, :869-900), current-vs-desired registration reconciliation
+// (:160-205, :582-610), placement algorithms default/ordered/equalized
+// (loraadapter_types.go:70-79), and the huggingface sidecar download flow
+// (:334-390, sidecar `/model/download` on port 30090).
 // ---------------------------------------------------------------------- //
+
+const char* kLoraFinalizer = "loraadapter.production-stack.tpu/finalizer";
 
 void update_status_raw(const HttpClient& api, const Config& cfg,
                        const std::string& plural, const Json& cr,
                        const Json& patch);
+
+struct LoraPod {
+  std::string name;
+  std::string ip;
+  int n_loaded = 0;        // adapters currently registered on this pod
+  bool has_adapter = false;  // this CR's adapter among them
+  bool list_ok = false;    // GET /v1/lora_adapters answered
+};
+
+// Ready pods for the adapter's runtime, each annotated with its current
+// adapter registrations (GET /v1/lora_adapters — the controller's
+// getAdapterRegistrations, loraadapter_controller.go:160-178).
+// `*list_ok` reports whether the pod LIST itself succeeded, so callers can
+// tell "no pods" apart from "apiserver unreachable".
+std::vector<LoraPod> lora_ready_pods(const HttpClient& api,
+                                     const Config& cfg,
+                                     const std::string& app,
+                                     const std::string& adapter, int port,
+                                     bool* list_ok) {
+  std::vector<LoraPod> out;
+  *list_ok = false;
+  HttpResponse pods = api.get("/api/v1/namespaces/" + cfg.ns +
+                              "/pods?labelSelector=app%3D" + app);
+  if (!pods.ok()) return out;
+  Json pod_list;
+  if (!Json::try_parse(pods.body, &pod_list)) return out;
+  *list_ok = true;
+  for (const auto& pod : pod_list.get("items").as_array()) {
+    LoraPod p;
+    p.name = pod.get("metadata").get("name").as_string();
+    p.ip = pod.get("status").get("podIP").as_string();
+    std::string pod_phase = pod.get("status").get("phase").as_string();
+    if (p.ip.empty() || pod_phase != "Running") continue;
+    HttpClient engine("http://" + p.ip + ":" + std::to_string(port), 5);
+    HttpResponse r = engine.get("/v1/lora_adapters");
+    Json listing;
+    if (r.ok() && Json::try_parse(r.body, &listing)) {
+      p.list_ok = true;
+      for (const auto& a : listing.get("adapters").as_array()) {
+        ++p.n_loaded;
+        if (a.get("lora_name").as_string() == adapter) p.has_adapter = true;
+      }
+    }
+    out.push_back(std::move(p));
+  }
+  return out;
+}
+
+// Desired placement. `algorithm` comes from
+// spec.deploymentConfig.algorithm (enum default|ordered|equalized,
+// ref loraadapter_types.go:70-79):
+//   default   — ready pods in API order, first N
+//   ordered   — pods sorted by name, first N (deterministic across passes)
+//   equalized — pods with the fewest adapters already loaded first, so
+//               adapters spread evenly across the fleet
+std::vector<size_t> lora_placement(const std::vector<LoraPod>& pods,
+                                   const std::string& algorithm,
+                                   int64_t replicas) {
+  std::vector<size_t> idx(pods.size());
+  for (size_t i = 0; i < idx.size(); ++i) idx[i] = i;
+  if (algorithm == "ordered") {
+    std::sort(idx.begin(), idx.end(), [&](size_t a, size_t b) {
+      return pods[a].name < pods[b].name;
+    });
+  } else if (algorithm == "equalized") {
+    std::sort(idx.begin(), idx.end(), [&](size_t a, size_t b) {
+      // A pod that already holds this adapter costs nothing extra to
+      // keep — count only *other* adapters, then break ties by name.
+      int la = pods[a].n_loaded - (pods[a].has_adapter ? 1 : 0);
+      int lb = pods[b].n_loaded - (pods[b].has_adapter ? 1 : 0);
+      if (la != lb) return la < lb;
+      return pods[a].name < pods[b].name;
+    });
+  }
+  size_t n = pods.size();
+  if (replicas >= 0 && static_cast<size_t>(replicas) < n)
+    n = static_cast<size_t>(replicas);
+  idx.resize(n);
+  return idx;
+}
+
+// Resolve the adapter artifact. For source.type=huggingface with no
+// adapterPath yet, drive the downloader sidecar on the first ready pod
+// (POST /model/download on port 30090 — ref :334-390) and persist the
+// returned path back onto the CR spec so later passes skip the download.
+// `live` is the current server-side CR and is updated in place after the
+// persisting PUT.
+std::string lora_resolve_path(const HttpClient& api, const Config& cfg,
+                              Json& live,
+                              const std::vector<LoraPod>& pods) {
+  const Json& src = live.get("spec").get("source");
+  if (!src.is_object()) return "";
+  std::string path = src.get("adapterPath").as_string();
+  if (!path.empty() || src.get("type").as_string() != "huggingface")
+    return path;
+  std::string repo = src.get("repository").as_string();
+  if (repo.empty() || pods.empty()) return "";
+  int sidecar_port =
+      static_cast<int>(src.get("sidecarPort").as_int(30090));
+  HttpClient sidecar(
+      "http://" + pods[0].ip + ":" + std::to_string(sidecar_port), 30);
+  JsonObject req;
+  req["model_id"] = repo;
+  HttpResponse r = sidecar.post("/model/download", Json(req).dump());
+  Json body;
+  if (!r.ok() || !Json::try_parse(r.body, &body)) return "";
+  path = body.get("path").as_string();
+  if (path.empty()) return "";
+  // Persist the discovered path on the CR (ref updates adapter.Spec, :380).
+  Json updated = live;
+  updated.object()["spec"].object()["source"].object()["adapterPath"] = path;
+  std::string name = live.get("metadata").get("name").as_string();
+  HttpResponse pr =
+      api.put(cr_path(cfg, "loraadapters", name), updated.dump());
+  Json fresh;
+  if (pr.ok() && Json::try_parse(pr.body, &fresh)) live = fresh;
+  else if (pr.ok()) live = updated;
+  return path;
+}
+
+bool lora_has_finalizer(const Json& cr) {
+  for (const auto& f : cr.get("metadata").get("finalizers").as_array())
+    if (f.as_string() == kLoraFinalizer) return true;
+  return false;
+}
 
 void reconcile_lora(const HttpClient& api, const Config& cfg,
                     const Json& cr) {
@@ -562,34 +697,117 @@ void reconcile_lora(const HttpClient& api, const Config& cfg,
   std::string app = spec.get("runtimeName").as_string();
   if (adapter.empty() || app.empty()) return;
   int port = static_cast<int>(spec.get("port").as_int(8000));
+  std::string name = cr.get("metadata").get("name").as_string();
 
-  HttpResponse pods = api.get("/api/v1/namespaces/" + cfg.ns +
-                              "/pods?labelSelector=app%3D" + app);
-  if (!pods.ok()) return;
-  Json pod_list;
-  if (!Json::try_parse(pods.body, &pod_list)) return;
+  bool pods_listed = false;
+  std::vector<LoraPod> pods =
+      lora_ready_pods(api, cfg, app, adapter, port, &pods_listed);
 
-  int loaded = 0;
-  for (const auto& pod : pod_list.get("items").as_array()) {
-    std::string ip = pod.get("status").get("podIP").as_string();
-    std::string pod_phase = pod.get("status").get("phase").as_string();
-    if (ip.empty() || pod_phase != "Running") continue;
-    HttpClient engine("http://" + ip + ":" + std::to_string(port), 5);
-    JsonObject body;
-    body["lora_name"] = adapter;
-    if (spec.has("rank"))
-      body["lora_rank"] = static_cast<int>(spec.get("rank").as_int(16));
-    HttpResponse r = engine.post("/v1/load_lora_adapter",
-                                 Json(body).dump());
-    if (r.ok()) ++loaded;
+  bool deleting = cr.get("metadata").has("deletionTimestamp") &&
+                  !cr.get("metadata").get("deletionTimestamp")
+                       .as_string().empty();
+  if (deleting) {
+    // Unload everywhere, then drop our finalizer so the API server can
+    // garbage-collect the CR (ref handleDeletion, :869-900). The
+    // finalizer is the unload-on-delete guarantee, so keep it (and retry
+    // next pass) unless every unload provably happened: the pod LIST
+    // answered, every pod's registration listing answered, and each
+    // unload POST succeeded.
+    bool all_unloaded = pods_listed;
+    for (const auto& p : pods) {
+      if (!p.list_ok) { all_unloaded = false; continue; }
+      if (!p.has_adapter) continue;
+      HttpClient engine("http://" + p.ip + ":" + std::to_string(port), 5);
+      JsonObject body;
+      body["lora_name"] = adapter;
+      HttpResponse r =
+          engine.post("/v1/unload_lora_adapter", Json(body).dump());
+      if (!r.ok()) all_unloaded = false;
+    }
+    if (!all_unloaded) {
+      log_line("loraadapter " + name +
+               ": deferring finalizer removal, unload incomplete");
+      return;
+    }
+    if (lora_has_finalizer(cr)) {
+      Json updated = cr;
+      JsonArray kept;
+      for (const auto& f :
+           cr.get("metadata").get("finalizers").as_array())
+        if (f.as_string() != kLoraFinalizer) kept.push_back(f);
+      updated.object()["metadata"].object()["finalizers"] = Json(kept);
+      api.put(cr_path(cfg, "loraadapters", name), updated.dump());
+    }
+    return;
   }
 
-  Json patch = cr;
+  // `live` tracks the server-side CR as this pass mutates it, so later
+  // spec updates (adapterPath persistence) never PUT a stale copy that
+  // would clobber the finalizer or 409 on resourceVersion.
+  Json live = cr;
+  if (!lora_has_finalizer(cr)) {
+    Json updated = cr;
+    JsonObject& meta = updated.object()["metadata"].object();
+    JsonArray fins = cr.get("metadata").get("finalizers").as_array();
+    fins.push_back(std::string(kLoraFinalizer));
+    meta["finalizers"] = Json(fins);
+    HttpResponse r =
+        api.put(cr_path(cfg, "loraadapters", name), updated.dump());
+    Json fresh;
+    if (r.ok() && Json::try_parse(r.body, &fresh)) live = fresh;
+    else if (r.ok()) live = updated;
+    else return;  // couldn't install the finalizer; retry next pass
+  }
+
+  const Json& dc = spec.get("deploymentConfig");
+  std::string algorithm = dc.get("algorithm").as_string();
+  if (algorithm.empty()) algorithm = "default";
+  int64_t replicas = dc.has("replicas") ? dc.get("replicas").as_int(-1) : -1;
+  std::vector<size_t> desired = lora_placement(pods, algorithm, replicas);
+
+  std::string lora_path = lora_resolve_path(api, cfg, live, pods);
+
+  std::vector<bool> is_desired(pods.size(), false);
+  for (size_t i : desired) is_desired[i] = true;
+
+  int loaded = 0;
+  JsonArray loaded_on;
+  for (size_t i = 0; i < pods.size(); ++i) {
+    const LoraPod& p = pods[i];
+    HttpClient engine("http://" + p.ip + ":" + std::to_string(port), 5);
+    if (is_desired[i]) {
+      if (!p.has_adapter) {
+        JsonObject body;
+        body["lora_name"] = adapter;
+        if (spec.has("rank"))
+          body["lora_rank"] =
+              static_cast<int>(spec.get("rank").as_int(16));
+        if (!lora_path.empty()) body["lora_path"] = lora_path;
+        HttpResponse r =
+            engine.post("/v1/load_lora_adapter", Json(body).dump());
+        if (!r.ok()) continue;
+      }
+      ++loaded;
+      loaded_on.push_back(p.name);
+    } else if (p.has_adapter) {
+      // Scaled down / repositioned: drop stale registrations
+      // (ref reconcileToDesiredState, :582-610).
+      JsonObject body;
+      body["lora_name"] = adapter;
+      engine.post("/v1/unload_lora_adapter", Json(body).dump());
+    }
+  }
+
+  Json patch = live;
   JsonObject status;
   status["loadedOn"] = loaded;
-  status["phase"] = loaded > 0 ? "Loaded" : "Pending";
+  status["loadedAdapters"] = Json(loaded_on);
+  status["phase"] = loaded > 0
+                        ? std::string("Loaded")
+                        : (pods.empty() ? std::string("WaitingForPods")
+                                        : std::string("Pending"));
   patch["status"] = Json(status);
-  update_status_raw(api, cfg, "loraadapters", cr, patch);
+  update_status_raw(api, cfg, "loraadapters", live, patch);
 }
 
 void update_status_raw(const HttpClient& api, const Config& cfg,

@@ -351,7 +351,15 @@ class K8sServiceNameServiceDiscovery(_K8sWatchDiscoveryBase):
             lambda name: f"http://{name}.{namespace}.svc:{port}"
         )
         # name -> requested sleep state while its label patch is in flight.
+        # The entry survives patch *failure* so routing stays correct and a
+        # stale persisted label can't re-sleep an awake endpoint.
         self._pending_sleep: Dict[str, bool] = {}
+        # Monotonic per-service flip counter: a label-patch thread only
+        # writes if its flip is still the newest, so two rapid opposite
+        # flips can't land out of order.
+        self._sleep_gen: Dict[str, int] = {}
+        # Serializes the check-then-patch sequence across patch threads.
+        self._label_lock = threading.Lock()
         super().__init__(
             namespace=namespace,
             port=port,
@@ -428,19 +436,23 @@ class K8sServiceNameServiceDiscovery(_K8sWatchDiscoveryBase):
             )
 
     # Sleep labels live on the service (reference :899-933).
-    def add_sleep_label(self, name: str) -> None:
+    def add_sleep_label(self, name: str) -> bool:
         try:
             self._k8s.patch_service_labels(
                 self.namespace, name, {"sleeping": "true"})
+            return True
         except Exception as e:  # noqa: BLE001
             logger.error("Could not label service %s sleeping: %s", name, e)
+            return False
 
-    def remove_sleep_label(self, name: str) -> None:
+    def remove_sleep_label(self, name: str) -> bool:
         try:
             self._k8s.patch_service_labels(
                 self.namespace, name, {"sleeping": None})
+            return True
         except Exception as e:  # noqa: BLE001
             logger.error("Could not unlabel service %s: %s", name, e)
+            return False
 
     def set_sleep_status(self, url: str, sleep: bool) -> None:
         """Router-observed sleep flip: update routing now; persist the label
@@ -448,25 +460,42 @@ class K8sServiceNameServiceDiscovery(_K8sWatchDiscoveryBase):
         handlers — a slow API server must not stall the event loop)."""
         with self._lock:
             names = [n for n, ep in self._endpoints.items() if ep.url == url]
+            gen = {}
             for n in names:
                 self._endpoints[n].sleep = sleep
                 self._pending_sleep[n] = sleep
+                self._sleep_gen[n] = self._sleep_gen.get(n, 0) + 1
+                gen[n] = self._sleep_gen[n]
         if names:
             threading.Thread(
-                target=self._apply_sleep_labels, args=(names, sleep),
+                target=self._apply_sleep_labels, args=(names, sleep, gen),
                 daemon=True, name="k8s-sleep-label",
             ).start()
 
-    def _apply_sleep_labels(self, names: List[str], sleep: bool) -> None:
+    def _apply_sleep_labels(
+        self, names: List[str], sleep: bool, gen: Dict[str, int]
+    ) -> None:
         for n in names:
-            if sleep:
-                self.add_sleep_label(n)
-            else:
-                self.remove_sleep_label(n)
-            with self._lock:
-                # Label state is authoritative again for this service.
-                if self._pending_sleep.get(n) == sleep:
-                    del self._pending_sleep[n]
+            for attempt in range(3):
+                with self._label_lock:
+                    with self._lock:
+                        if self._sleep_gen.get(n) != gen[n]:
+                            # A newer flip superseded this one; it owns the
+                            # label (and the pending entry) now.
+                            break
+                    ok = (self.add_sleep_label(n) if sleep
+                          else self.remove_sleep_label(n))
+                    if ok:
+                        with self._lock:
+                            # Label state is authoritative again for this
+                            # service — unless a newer flip started.
+                            if self._sleep_gen.get(n) == gen[n]:
+                                self._pending_sleep.pop(n, None)
+                        break
+                time.sleep(1.0)
+            # After exhausted retries the pending override stays: routing
+            # keeps the requested state and the stale persisted label is
+            # ignored by _handle_event until a later flip rewrites it.
 
 
 def _pod_is_ready(status: dict) -> bool:

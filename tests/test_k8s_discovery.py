@@ -345,3 +345,96 @@ def test_k8s_watch_reconnect_purges_deleted(fake_cluster):
         assert disco.get_endpoint_info() == []
     finally:
         disco.close()
+
+
+class _StubLabelK8s:
+    """Stub client: watch blocks forever; label patches are scripted to
+    fail or block so the patch-thread races are reproducible."""
+
+    def __init__(self):
+        self.fail = False
+        self.hold = threading.Event()  # set -> patches proceed
+        self.hold.set()
+        self.calls = []
+
+    def watch_services(self, ns, selector=None):
+        while True:
+            time.sleep(3600)
+            yield {}
+
+    def read_endpoints(self, ns, name):
+        return {"subsets": [{"addresses": [{"ip": "10.0.0.1"}]}]}
+
+    def patch_service_labels(self, ns, name, labels):
+        self.hold.wait(timeout=10)
+        self.calls.append(dict(labels))
+        if self.fail:
+            raise RuntimeError("apiserver down")
+
+
+def _svc_discovery(stub):
+    from production_stack_tpu.router.service_discovery import (
+        EndpointInfo,
+        K8sServiceNameServiceDiscovery,
+    )
+
+    disco = K8sServiceNameServiceDiscovery(
+        namespace="default", port=9000, k8s_client=stub,
+        service_url_for=lambda name: "http://10.0.0.1:9000",
+    )
+    disco._endpoints["svc-a"] = EndpointInfo(
+        url="http://10.0.0.1:9000", model_names=["m"], model_label=None,
+        sleep=False, pod_name="svc-a", namespace="default",
+    )
+    return disco
+
+
+def test_sleep_label_patch_failure_keeps_pending_override():
+    """If the label patch fails, the pending override must survive so a
+    stale persisted label can't flip routing back (review regression)."""
+    stub = _StubLabelK8s()
+    stub.fail = True
+    disco = _svc_discovery(stub)
+    try:
+        disco.set_sleep_status("http://10.0.0.1:9000", True)
+        deadline = time.time() + 10
+        while len(stub.calls) < 3 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(stub.calls) == 3  # bounded retries
+        # Override retained: routing keeps the requested state...
+        assert disco._pending_sleep.get("svc-a") is True
+        # ...and a watch event carrying the stale label cannot wake it.
+        disco._handle_event({
+            "type": "MODIFIED",
+            "object": {"metadata": {"name": "svc-a", "labels": {}},
+                       "spec": {"selector": {}}},
+        })
+        eps = disco.get_endpoint_info()
+        assert eps and eps[0].sleep is True
+    finally:
+        disco.close()
+
+
+def test_sleep_label_rapid_opposite_flips_last_writer_wins():
+    """sleep(True) then sleep(False) in quick succession: the stale patch
+    thread must not land after (or clear the pending entry of) the newer
+    flip, whatever the thread interleaving (review regression)."""
+    stub = _StubLabelK8s()
+    stub.hold.clear()  # park both patch threads before their first PATCH
+    disco = _svc_discovery(stub)
+    try:
+        disco.set_sleep_status("http://10.0.0.1:9000", True)
+        disco.set_sleep_status("http://10.0.0.1:9000", False)
+        stub.hold.set()  # release; generation check must discard the stale
+        deadline = time.time() + 10
+        while not stub.calls and time.time() < deadline:
+            time.sleep(0.05)
+        time.sleep(0.5)  # allow any (buggy) trailing patch to land
+        # The stale flip may legally land FIRST (patches are serialized),
+        # but the newest flip must land LAST and own the pending entry.
+        assert stub.calls[-1] == {"sleeping": None}
+        assert "svc-a" not in disco._pending_sleep
+        eps = disco.get_endpoint_info()
+        assert eps and eps[0].sleep is False
+    finally:
+        disco.close()

@@ -138,6 +138,15 @@ class FakeK8s:
             plural = parts[-1]
             if method == "GET" and plural in self.crs:
                 return web.json_response({"items": self.crs[plural]})
+            if method == "PUT" and parts[-2] in self.crs:
+                # Update of an individual CR (finalizers, spec edits).
+                body = json.loads(await request.text())
+                items = self.crs[parts[-2]]
+                for i, cr in enumerate(items):
+                    if cr["metadata"]["name"] == parts[-1]:
+                        items[i] = body
+                        return web.json_response(body)
+                return web.json_response({"reason": "NotFound"}, status=404)
             return web.json_response({"items": []})
         # Core objects (deployments/services/serviceaccounts).
         if method == "GET":
@@ -381,3 +390,350 @@ def test_operator_loads_lora_adapters():
     assert any("loraadapters/ad1/status" in p and
                b["status"]["phase"] == "Loaded"
                for p, b in fake.status_updates)
+    # A finalizer was installed so deletion can unload first
+    # (ref loraadapter_controller.go:94-110).
+    assert fake.crs["loraadapters"][0]["metadata"]["finalizers"] == \
+        ["loraadapter.production-stack.tpu/finalizer"]
+
+
+class _FakeEnginePod:
+    """In-process engine pod exposing the LoRA HTTP API the operator
+    drives, pre-seeded with already-loaded adapters."""
+
+    def __init__(self, preloaded=()):
+        self.adapters = list(preloaded)
+        self.loads = []
+        self.unloads = []
+        self.app = web.Application()
+        self.app.router.add_post("/v1/load_lora_adapter", self._load)
+        self.app.router.add_post("/v1/unload_lora_adapter", self._unload)
+        self.app.router.add_get("/v1/lora_adapters", self._list)
+        self.app.router.add_post("/model/download", self._download)
+        self.downloads = []
+        self.runner = None
+        self.port = None
+
+    async def _load(self, request):
+        body = await request.json()
+        self.loads.append(body)
+        if body["lora_name"] not in self.adapters:
+            self.adapters.append(body["lora_name"])
+        return web.json_response({"status": "ok"})
+
+    async def _unload(self, request):
+        body = await request.json()
+        self.unloads.append(body)
+        if body["lora_name"] in self.adapters:
+            self.adapters.remove(body["lora_name"])
+        return web.json_response({"status": "ok"})
+
+    async def _list(self, request):
+        return web.json_response({"adapters": [
+            {"lora_name": n, "slot": i}
+            for i, n in enumerate(self.adapters)
+        ]})
+
+    async def _download(self, request):
+        body = await request.json()
+        self.downloads.append(body)
+        return web.json_response(
+            {"path": "/models/" + body["model_id"].replace("/", "-")})
+
+    async def start(self):
+        self.runner = web.AppRunner(self.app)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        await self.runner.cleanup()
+
+
+def test_operator_lora_unload_on_delete_removes_finalizer():
+    """A deleting CR (deletionTimestamp set) unloads the adapter from the
+    pods that hold it, then drops the finalizer
+    (ref loraadapter_controller.go:869-900)."""
+    pod = _FakeEnginePod(preloaded=["sql-adapter", "other"])
+    fake = FakeK8s()
+
+    async def setup_and_run():
+        await pod.start()
+        fake.crs["loraadapters"] = [{
+            "metadata": {
+                "name": "ad1", "uid": "u-l",
+                "deletionTimestamp": "2026-07-30T00:00:00Z",
+                "finalizers": [
+                    "loraadapter.production-stack.tpu/finalizer",
+                    "someone-elses/finalizer",
+                ],
+            },
+            "spec": {"adapterName": "sql-adapter", "runtimeName": "m",
+                     "port": pod.port},
+        }]
+        fake.pods = [{
+            "metadata": {"name": "m-pod-0", "labels": {"app": "m"}},
+            "status": {"podIP": "127.0.0.1", "phase": "Running"},
+        }]
+        api_runner = web.AppRunner(fake.make_app())
+        await api_runner.setup()
+        api_site = web.TCPSite(api_runner, "127.0.0.1", 0)
+        await api_site.start()
+        api_port = api_site._server.sockets[0].getsockname()[1]
+        proc = await asyncio.get_running_loop().run_in_executor(
+            None, _run_operator, f"http://127.0.0.1:{api_port}")
+        await api_runner.cleanup()
+        await pod.stop()
+        return proc
+
+    proc = asyncio.run(setup_and_run())
+    assert proc.returncode == 0, proc.stderr
+    assert pod.unloads == [{"lora_name": "sql-adapter"}]
+    assert pod.adapters == ["other"]
+    # Our finalizer gone, foreign finalizer untouched.
+    assert fake.crs["loraadapters"][0]["metadata"]["finalizers"] == \
+        ["someone-elses/finalizer"]
+    assert pod.loads == []
+
+
+def test_operator_lora_equalized_placement_and_unload():
+    """algorithm=equalized with replicas=2 must target the two pods with
+    the fewest other adapters and unload from a stale third pod
+    (ref placement enum loraadapter_types.go:70-79 +
+    reconcileToDesiredState :582-610)."""
+    # pod0 is busy (2 other adapters), pod1 empty, pod2 holds a stale copy.
+    pods = [
+        _FakeEnginePod(preloaded=["a1", "a2"]),
+        _FakeEnginePod(),
+        _FakeEnginePod(preloaded=["x1", "x2", "x3", "sql-adapter"]),
+    ]
+    fake = FakeK8s()
+
+    # The CR carries ONE port while pods differ by IP, so each fake pod
+    # binds the same port on its own loopback alias (127.0.0.2/.3 bind on
+    # Linux without setup).
+    async def run():
+        addrs = ["127.0.0.1", "127.0.0.2", "127.0.0.3"]
+        runners = []
+        port = None
+        for addr, p in zip(addrs, pods):
+            runner = web.AppRunner(p.app)
+            await runner.setup()
+            site = web.TCPSite(runner, addr, port or 0)
+            await site.start()
+            if port is None:
+                port = site._server.sockets[0].getsockname()[1]
+            p.port = port
+            runners.append(runner)
+        fake.crs["loraadapters"] = [{
+            "metadata": {"name": "ad1", "uid": "u-l",
+                         "finalizers": [
+                             "loraadapter.production-stack.tpu/finalizer"]},
+            "spec": {"adapterName": "sql-adapter", "runtimeName": "m",
+                     "port": port,
+                     "deploymentConfig": {"algorithm": "equalized",
+                                          "replicas": 2}},
+        }]
+        fake.pods = [{
+            "metadata": {"name": f"m-pod-{i}", "labels": {"app": "m"}},
+            "status": {"podIP": addr, "phase": "Running"},
+        } for i, addr in enumerate(addrs)]
+        api_runner = web.AppRunner(fake.make_app())
+        await api_runner.setup()
+        api_site = web.TCPSite(api_runner, "127.0.0.1", 0)
+        await api_site.start()
+        api_port = api_site._server.sockets[0].getsockname()[1]
+        proc = await asyncio.get_running_loop().run_in_executor(
+            None, _run_operator, f"http://127.0.0.1:{api_port}")
+        await api_runner.cleanup()
+        for r in runners:
+            await r.cleanup()
+        return proc
+
+    proc = asyncio.run(run())
+    assert proc.returncode == 0, proc.stderr
+    # pod1 (0 adapters) and pod2 (3 other adapters but already holding the
+    # adapter -> effective load 3) vs pod0 (2 others): equalized order is
+    # pod1(0), pod0(2), pod2(3) -> desired = {pod1, pod0}.
+    assert [c["lora_name"] for c in pods[1].loads] == ["sql-adapter"]
+    assert [c["lora_name"] for c in pods[0].loads] == ["sql-adapter"]
+    # Stale copy on pod2 dropped.
+    assert pods[2].unloads == [{"lora_name": "sql-adapter"}]
+    assert "sql-adapter" not in pods[2].adapters
+    st = [b for p, b in fake.status_updates
+          if "loraadapters/ad1/status" in p][-1]
+    assert st["status"]["loadedOn"] == 2
+    assert sorted(st["status"]["loadedAdapters"]) == ["m-pod-0", "m-pod-1"]
+
+
+def test_operator_lora_ordered_placement_is_deterministic():
+    """algorithm=ordered picks the lexicographically-first N pod names."""
+    pods = [_FakeEnginePod(), _FakeEnginePod()]
+    fake = FakeK8s()
+
+    async def run():
+        addrs = ["127.0.0.2", "127.0.0.1"]  # API order != name order
+        runners = []
+        port = None
+        for addr, p in zip(addrs, pods):
+            runner = web.AppRunner(p.app)
+            await runner.setup()
+            site = web.TCPSite(runner, addr, port or 0)
+            await site.start()
+            if port is None:
+                port = site._server.sockets[0].getsockname()[1]
+            runners.append(runner)
+        fake.crs["loraadapters"] = [{
+            "metadata": {"name": "ad1", "uid": "u-l",
+                         "finalizers": [
+                             "loraadapter.production-stack.tpu/finalizer"]},
+            "spec": {"adapterName": "sql-adapter", "runtimeName": "m",
+                     "port": port,
+                     "deploymentConfig": {"algorithm": "ordered",
+                                          "replicas": 1}},
+        }]
+        # API returns m-pod-9 first; ordered placement must pick m-pod-1.
+        fake.pods = [
+            {"metadata": {"name": "m-pod-9", "labels": {"app": "m"}},
+             "status": {"podIP": addrs[0], "phase": "Running"}},
+            {"metadata": {"name": "m-pod-1", "labels": {"app": "m"}},
+             "status": {"podIP": addrs[1], "phase": "Running"}},
+        ]
+        api_runner = web.AppRunner(fake.make_app())
+        await api_runner.setup()
+        api_site = web.TCPSite(api_runner, "127.0.0.1", 0)
+        await api_site.start()
+        api_port = api_site._server.sockets[0].getsockname()[1]
+        proc = await asyncio.get_running_loop().run_in_executor(
+            None, _run_operator, f"http://127.0.0.1:{api_port}")
+        await api_runner.cleanup()
+        for r in runners:
+            await r.cleanup()
+        return proc
+
+    proc = asyncio.run(run())
+    assert proc.returncode == 0, proc.stderr
+    assert [c["lora_name"] for c in pods[1].loads] == ["sql-adapter"]
+    assert pods[0].loads == []
+
+
+def test_operator_lora_huggingface_download_flow():
+    """source.type=huggingface drives the downloader sidecar and persists
+    adapterPath on the CR spec (ref loraadapter_controller.go:334-390)."""
+    pod = _FakeEnginePod()
+    fake = FakeK8s()
+
+    async def run():
+        await pod.start()
+        fake.crs["loraadapters"] = [{
+            "metadata": {"name": "ad1", "uid": "u-l",
+                         "finalizers": [
+                             "loraadapter.production-stack.tpu/finalizer"]},
+            "spec": {"adapterName": "sql-adapter", "runtimeName": "m",
+                     "port": pod.port,
+                     "source": {"type": "huggingface",
+                                "repository": "org/sql-lora",
+                                "sidecarPort": pod.port}},
+        }]
+        fake.pods = [{
+            "metadata": {"name": "m-pod-0", "labels": {"app": "m"}},
+            "status": {"podIP": "127.0.0.1", "phase": "Running"},
+        }]
+        api_runner = web.AppRunner(fake.make_app())
+        await api_runner.setup()
+        api_site = web.TCPSite(api_runner, "127.0.0.1", 0)
+        await api_site.start()
+        api_port = api_site._server.sockets[0].getsockname()[1]
+        proc = await asyncio.get_running_loop().run_in_executor(
+            None, _run_operator, f"http://127.0.0.1:{api_port}")
+        await api_runner.cleanup()
+        await pod.stop()
+        return proc
+
+    proc = asyncio.run(run())
+    assert proc.returncode == 0, proc.stderr
+    assert pod.downloads == [{"model_id": "org/sql-lora"}]
+    # The discovered path is passed to the engine and persisted on the CR.
+    assert pod.loads[0]["lora_path"] == "/models/org-sql-lora"
+    assert fake.crs["loraadapters"][0]["spec"]["source"]["adapterPath"] == \
+        "/models/org-sql-lora"
+
+
+def test_operator_lora_hf_download_preserves_fresh_finalizer():
+    """The adapterPath-persisting PUT must build on the CR as updated by
+    the same pass's finalizer PUT — a stale copy would strip the finalizer
+    just installed (regression: review finding on lora_resolve_path)."""
+    pod = _FakeEnginePod()
+    fake = FakeK8s()
+
+    async def run():
+        await pod.start()
+        # CR starts with NO finalizer: the operator adds one, then the
+        # download flow persists adapterPath; both must survive.
+        fake.crs["loraadapters"] = [{
+            "metadata": {"name": "ad1", "uid": "u-l"},
+            "spec": {"adapterName": "sql-adapter", "runtimeName": "m",
+                     "port": pod.port,
+                     "source": {"type": "huggingface",
+                                "repository": "org/sql-lora",
+                                "sidecarPort": pod.port}},
+        }]
+        fake.pods = [{
+            "metadata": {"name": "m-pod-0", "labels": {"app": "m"}},
+            "status": {"podIP": "127.0.0.1", "phase": "Running"},
+        }]
+        api_runner = web.AppRunner(fake.make_app())
+        await api_runner.setup()
+        api_site = web.TCPSite(api_runner, "127.0.0.1", 0)
+        await api_site.start()
+        api_port = api_site._server.sockets[0].getsockname()[1]
+        proc = await asyncio.get_running_loop().run_in_executor(
+            None, _run_operator, f"http://127.0.0.1:{api_port}")
+        await api_runner.cleanup()
+        await pod.stop()
+        return proc
+
+    proc = asyncio.run(run())
+    assert proc.returncode == 0, proc.stderr
+    cr = fake.crs["loraadapters"][0]
+    assert cr["metadata"]["finalizers"] == \
+        ["loraadapter.production-stack.tpu/finalizer"]
+    assert cr["spec"]["source"]["adapterPath"] == "/models/org-sql-lora"
+
+
+def test_operator_lora_defers_finalizer_when_unload_fails():
+    """A deleting CR whose engine pod is unreachable must KEEP the
+    finalizer (unload-on-delete is the finalizer's whole guarantee);
+    removal happens only once every unload provably succeeded."""
+    fake = FakeK8s()
+
+    async def run():
+        fake.crs["loraadapters"] = [{
+            "metadata": {
+                "name": "ad1", "uid": "u-l",
+                "deletionTimestamp": "2026-07-30T00:00:00Z",
+                "finalizers": [
+                    "loraadapter.production-stack.tpu/finalizer"],
+            },
+            # Port 1 is never listening -> unload cannot be confirmed.
+            "spec": {"adapterName": "sql-adapter", "runtimeName": "m",
+                     "port": 1},
+        }]
+        fake.pods = [{
+            "metadata": {"name": "m-pod-0", "labels": {"app": "m"}},
+            "status": {"podIP": "127.0.0.1", "phase": "Running"},
+        }]
+        api_runner = web.AppRunner(fake.make_app())
+        await api_runner.setup()
+        api_site = web.TCPSite(api_runner, "127.0.0.1", 0)
+        await api_site.start()
+        api_port = api_site._server.sockets[0].getsockname()[1]
+        proc = await asyncio.get_running_loop().run_in_executor(
+            None, _run_operator, f"http://127.0.0.1:{api_port}")
+        await api_runner.cleanup()
+        return proc
+
+    proc = asyncio.run(run())
+    assert proc.returncode == 0, proc.stderr
+    assert fake.crs["loraadapters"][0]["metadata"]["finalizers"] == \
+        ["loraadapter.production-stack.tpu/finalizer"]
