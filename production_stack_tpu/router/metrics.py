@@ -12,11 +12,40 @@ from __future__ import annotations
 from typing import Dict
 
 import psutil
-from prometheus_client import CollectorRegistry, Gauge, generate_latest
+from prometheus_client import (
+    CollectorRegistry,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
 
 REGISTRY = CollectorRegistry()
 
 _L = ["server"]
+
+# Distribution histograms backing the dashboard's latency/TTFT/ITL
+# distribution panels (the reference dashboard reads
+# ``vllm:e2e_request_latency_seconds_bucket`` etc. from vLLM; here the
+# router observes them itself at proxy level, so they exist even for
+# engines scraped through a service mesh). Buckets mirror vLLM's.
+hist_ttft = Histogram(
+    "vllm_router:time_to_first_token_seconds",
+    "Time to first streamed token (s)", _L,
+    buckets=(0.001, 0.005, 0.01, 0.02, 0.04, 0.06, 0.08, 0.1, 0.25, 0.5,
+             0.75, 1.0, 2.5, 5.0, 7.5, 10.0, 20.0, 40.0),
+    registry=REGISTRY)
+hist_latency = Histogram(
+    "vllm_router:e2e_request_latency_seconds",
+    "End-to-end request latency (s)", _L,
+    buckets=(0.3, 0.5, 0.8, 1.0, 1.5, 2.0, 2.5, 5.0, 10.0, 15.0, 20.0,
+             30.0, 40.0, 50.0, 60.0),
+    registry=REGISTRY)
+hist_itl = Histogram(
+    "vllm_router:time_per_output_token_seconds",
+    "Inter-token latency (s)", _L,
+    buckets=(0.01, 0.025, 0.05, 0.075, 0.1, 0.15, 0.2, 0.3, 0.4, 0.5,
+             0.75, 1.0, 2.5),
+    registry=REGISTRY)
 
 current_qps = Gauge("vllm_router:current_qps", "Sliding-window QPS", _L, registry=REGISTRY)
 avg_ttft = Gauge("vllm_router:avg_ttft", "Average time to first token (s)", _L, registry=REGISTRY)

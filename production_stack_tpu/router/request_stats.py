@@ -17,6 +17,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, Optional, Tuple
 
+from production_stack_tpu.router import metrics
 from production_stack_tpu.utils.misc import SingletonMeta
 
 
@@ -94,6 +95,19 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
         self.in_decoding: Dict[str, int] = {}
         self.finished: Dict[str, int] = {}
         self.swapped: Dict[str, int] = {}
+        # Cached histogram children: labels() takes the metric-wide lock
+        # and rebuilds the label tuple; on_token runs per streamed token,
+        # so resolve each engine's child once.
+        self._hists: Dict[str, Tuple] = {}
+
+    def _hist(self, engine_url: str) -> Tuple:
+        h = self._hists.get(engine_url)
+        if h is None:
+            h = (metrics.hist_ttft.labels(server=engine_url),
+                 metrics.hist_latency.labels(server=engine_url),
+                 metrics.hist_itl.labels(server=engine_url))
+            self._hists[engine_url] = h
+        return h
 
     # -- lifecycle hooks ----------------------------------------------------
     def on_new_request(self, engine_url: str, request_id: str, timestamp: float) -> None:
@@ -118,6 +132,7 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
             self.ttft_monitors.setdefault(
                 engine_url, MovingAverageMonitor(self.sliding_window_size)
             ).update(timestamp, ttft)
+            self._hist(engine_url)[0].observe(ttft)
             self.in_prefill[engine_url] = max(
                 0, self.in_prefill.get(engine_url, 0) - 1
             )
@@ -132,6 +147,7 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
                 self.itl_monitors.setdefault(
                     engine_url, MovingAverageMonitor(self.sliding_window_size)
                 ).update(timestamp, timestamp - last)
+                self._hist(engine_url)[2].observe(timestamp - last)
             self.last_token_time[key] = timestamp
             self.tokens_seen[key] = self.tokens_seen.get(key, 0) + 1
 
@@ -157,6 +173,7 @@ class RequestStatsMonitor(metaclass=SingletonMeta):
                 self.latency_monitors.setdefault(
                     engine_url, MovingAverageMonitor(self.sliding_window_size)
                 ).update(timestamp, timestamp - start)
+                self._hist(engine_url)[1].observe(timestamp - start)
             self.finished[engine_url] = self.finished.get(engine_url, 0) + 1
 
     def on_request_swapped(self, engine_url: str, request_id: str, timestamp: float) -> None:

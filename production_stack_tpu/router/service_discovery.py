@@ -373,6 +373,15 @@ class K8sServiceNameServiceDiscovery(_K8sWatchDiscoveryBase):
     def _watch_stream(self):
         return self._k8s.watch_services(self.namespace, self.label_selector)
 
+    def _reconcile(self, live_names: List[str]) -> None:
+        super()._reconcile(live_names)
+        live = set(live_names)
+        with self._lock:
+            for stale in [n for n in self._pending_sleep if n not in live]:
+                del self._pending_sleep[stale]
+            for stale in [n for n in self._sleep_gen if n not in live]:
+                del self._sleep_gen[stale]
+
     def _service_ready(self, name: str) -> Optional[bool]:
         """True/False from the service's Endpoints addresses (reference
         ``_check_service_ready``, :829-837); None when the API read itself
@@ -399,6 +408,11 @@ class K8sServiceNameServiceDiscovery(_K8sWatchDiscoveryBase):
                 if name in self._endpoints:
                     logger.info("Engine service %s removed from routing", name)
                     del self._endpoints[name]
+                # A retained pending override (kept after patch failures)
+                # belongs to THIS incarnation of the service; a recreated
+                # namesake must start from its own label/probe state.
+                self._pending_sleep.pop(name, None)
+                self._sleep_gen.pop(name, None)
             return
         ready = self._service_ready(name)
         if ready is None:

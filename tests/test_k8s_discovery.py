@@ -411,6 +411,21 @@ def test_sleep_label_patch_failure_keeps_pending_override():
         })
         eps = disco.get_endpoint_info()
         assert eps and eps[0].sleep is True
+        # But the override dies with the service: a DELETE clears it, so a
+        # recreated namesake starts from its own label/probe state instead
+        # of inheriting a stale forced-sleep.
+        disco._handle_event({
+            "type": "DELETED",
+            "object": {"metadata": {"name": "svc-a"}},
+        })
+        assert "svc-a" not in disco._pending_sleep
+        assert "svc-a" not in disco._sleep_gen
+        assert disco.get_endpoint_info() == []
+        # Reconnect reconciliation purges pending state the same way.
+        disco._pending_sleep["ghost"] = True
+        disco._sleep_gen["ghost"] = 7
+        disco._reconcile([])
+        assert disco._pending_sleep == {} and disco._sleep_gen == {}
     finally:
         disco.close()
 
