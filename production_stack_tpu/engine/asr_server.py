@@ -1,0 +1,194 @@
+"""ASR engine server: OpenAI-compatible audio transcription on TPU.
+
+The reference serves Whisper through dedicated vLLM pods (model label
+``transcription``) that the router proxies multipart audio to
+(``src/vllm_router/services/request_service/request.py:513-689``,
+``docs/source/use_cases/transcription.rst``). This is that pod's server for
+the TPU stack: a thin aiohttp app around
+:class:`production_stack_tpu.models.whisper.WhisperModel`.
+
+Surface:
+- ``POST /v1/audio/transcriptions`` — multipart (file, model, optional
+  response_format json|text|verbose_json, language, temperature). WAV in;
+  other containers 400 (no ffmpeg in-image).
+- ``GET /v1/models`` — advertises the model so the router's discovery
+  probe picks it up.
+- ``GET /health``, ``GET /is_sleeping``, ``GET /metrics`` — the probe trio
+  every engine exposes.
+
+Run: ``python -m production_stack_tpu.engine.asr_server tiny-whisper
+--port 8000``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+from typing import Optional
+
+import numpy as np
+from aiohttp import web
+
+from production_stack_tpu.engine.tokenizer import ByteTokenizer
+from production_stack_tpu.models.whisper import (
+    SAMPLE_RATE,
+    WhisperModel,
+    decode_wav_bytes,
+    get_whisper_config,
+)
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+class ASRServer:
+    def __init__(self, model_name: str, seed: int = 0,
+                 max_tokens: int = 64):
+        self.model_name = model_name
+        self.cfg = get_whisper_config(model_name)
+        self.model = WhisperModel(self.cfg, seed=seed)
+        self.tokenizer = ByteTokenizer(self.cfg.vocab_size)
+        self.max_tokens = max_tokens
+        self.requests_total = 0
+        self.audio_seconds_total = 0.0
+        self.started = time.time()
+
+    def make_app(self) -> web.Application:
+        app = web.Application(client_max_size=64 * 1024 * 1024)
+        r = app.router
+        r.add_post("/v1/audio/transcriptions", self.handle_transcription)
+        r.add_get("/v1/models", self.handle_models)
+        r.add_get("/health", self.handle_health)
+        r.add_get("/is_sleeping", self.handle_is_sleeping)
+        r.add_get("/metrics", self.handle_metrics)
+        return app
+
+    # ------------------------------------------------------------------ #
+
+    def _transcribe(self, pcm: np.ndarray) -> str:
+        tokens = self.model.transcribe_tokens(
+            pcm, sot=self.tokenizer.bos_token_id,
+            eot=self.tokenizer.eos_token_id, max_tokens=self.max_tokens)
+        return self.tokenizer.decode(tokens)
+
+    async def handle_transcription(
+            self, request: web.Request) -> web.Response:
+        form = await request.post()
+        upload = form.get("file")
+        if upload is None or not hasattr(upload, "file"):
+            return web.json_response(
+                {"error": "missing 'file' form field"}, status=400)
+        model = form.get("model") or self.model_name
+        if model not in (self.model_name, self.cfg.name):
+            return web.json_response(
+                {"error": f"model {model!r} not served here"}, status=400)
+        response_format = form.get("response_format") or "json"
+        if response_format not in ("json", "text", "verbose_json"):
+            return web.json_response(
+                {"error": f"unsupported response_format "
+                          f"{response_format!r}"}, status=400)
+        data = upload.file.read()
+        try:
+            pcm = decode_wav_bytes(data)
+        except Exception as e:  # noqa: BLE001 - bad container/encoding
+            return web.json_response(
+                {"error": f"could not decode audio (WAV/PCM required, "
+                          f"no ffmpeg in image): {e}"}, status=400)
+        duration = len(pcm) / SAMPLE_RATE
+        t0 = time.perf_counter()
+        loop = asyncio.get_running_loop()
+        text = await loop.run_in_executor(None, self._transcribe, pcm)
+        elapsed = time.perf_counter() - t0
+        self.requests_total += 1
+        self.audio_seconds_total += duration
+        logger.info("transcribed %.2fs audio in %.2fs", duration, elapsed)
+        if response_format == "text":
+            return web.Response(text=text, content_type="text/plain")
+        body = {"text": text}
+        if response_format == "verbose_json":
+            body.update({
+                "task": "transcribe",
+                "language": form.get("language") or "en",
+                "duration": round(duration, 3),
+                "segments": [{
+                    "id": 0, "start": 0.0,
+                    "end": round(duration, 3), "text": text,
+                }],
+            })
+        return web.json_response(body)
+
+    async def handle_models(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "object": "list",
+            "data": [{
+                "id": self.model_name, "object": "model",
+                "created": int(self.started),
+                "owned_by": "production-stack-tpu",
+                "task": "transcription",
+            }],
+        })
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def handle_is_sleeping(
+            self, request: web.Request) -> web.Response:
+        return web.json_response({"is_sleeping": False})
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        labels = f'model_name="{self.model_name}"'
+        lines = [
+            "# TYPE tpu:asr_requests counter",
+            f"tpu:asr_requests_total{{{labels}}} {self.requests_total}",
+            "# TYPE tpu:asr_audio_seconds counter",
+            f"tpu:asr_audio_seconds_total{{{labels}}} "
+            f"{self.audio_seconds_total:.3f}",
+            # The scraper's generic gauges, so the router's engine-stats
+            # loop parses ASR pods without special cases.
+            "# TYPE vllm:num_requests_running gauge",
+            f"vllm:num_requests_running{{{labels}}} 0",
+            "# TYPE vllm:num_requests_waiting gauge",
+            f"vllm:num_requests_waiting{{{labels}}} 0",
+        ]
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
+
+
+async def run_asr_server(server: ASRServer, host: str,
+                         port: int) -> web.AppRunner:
+    runner = web.AppRunner(server.make_app())
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    actual = list(runner.sites)[0]._server.sockets[0].getsockname()[1]
+    logger.info("ASR server on %s:%s (model=%s)", host, actual,
+                server.model_name)
+    return runner
+
+
+def main(argv: Optional[list] = None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("model", nargs="?", default="tiny-whisper")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--max-tokens", type=int, default=64)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    async def _run():
+        server = ASRServer(args.model, seed=args.seed,
+                           max_tokens=args.max_tokens)
+        await run_asr_server(server, args.host, args.port)
+        while True:
+            await asyncio.sleep(3600)
+
+    try:
+        asyncio.run(_run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -84,6 +84,15 @@ _PRESETS = {
         head_dim=128, intermediate_size=7168, max_position=8192,
         rope_theta=500000.0,
     ),
+    # ~3.2B Llama-family preset (Llama-3.2-3B card dimensions): the largest
+    # Llama-class architecture that fits a single 16 GB v5e chip in bf16
+    # with KV headroom (weights ~6.4 GB).
+    "tpu-llama-3b": ModelConfig(
+        name="tpu-llama-3b", arch="llama", vocab_size=128256,
+        hidden_size=3072, num_layers=28, num_heads=24, num_kv_heads=8,
+        head_dim=128, intermediate_size=8192, max_position=8192,
+        rope_theta=500000.0,
+    ),
     "meta-llama/Llama-3-8B": ModelConfig(
         name="meta-llama/Llama-3-8B", arch="llama", vocab_size=128256,
         hidden_size=4096, num_layers=32, num_heads=32, num_kv_heads=8,

@@ -1,0 +1,356 @@
+"""Whisper-class speech-to-text model (JAX), TPU-first.
+
+Fills the ASR slot of the reference stack: the reference serves Whisper
+through dedicated vLLM pods labeled ``transcription`` and proxies multipart
+audio from the router (``src/vllm_router/services/request_service/
+request.py:513-689``); here the model itself is in the zoo and is served by
+:mod:`production_stack_tpu.engine.asr_server`.
+
+Architecture = standard Whisper encoder-decoder:
+
+- log-mel frontend (numpy, stdlib-only audio path): 16 kHz PCM -> 80 mel
+  bins, n_fft 400, hop 160, 30 s window -> 3000 frames.
+- audio encoder: two 1-D convs (second stride 2) + GELU, sinusoidal
+  positions, pre-LN transformer stack.
+- text decoder: learned positions, causal self-attention + cross-attention
+  over encoder states, tied embedding logits.
+
+TPU notes: all shapes are static (audio is padded/trimmed to the 30 s
+window before tracing; decode scores a fixed ``max_target_len`` buffer with
+position masking), so the whole transcribe step jits once and reuses the
+compiled program for every request. Matmuls run in bf16 on the MXU via the
+param dtype; the mel frontend stays on host (numpy) where the byte
+wrangling lives.
+
+Weights are randomly initialized for named presets (zero-egress image —
+see models/config.py) or loaded from a local HF checkpoint directory.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SAMPLE_RATE = 16000
+N_FFT = 400
+HOP_LENGTH = 160
+N_MELS = 80
+CHUNK_SECONDS = 30
+N_FRAMES = SAMPLE_RATE * CHUNK_SECONDS // HOP_LENGTH  # 3000
+
+
+@dataclasses.dataclass(frozen=True)
+class WhisperConfig:
+    name: str = "tiny-whisper"
+    vocab_size: int = 512           # ByteTokenizer-compatible default
+    d_model: int = 64
+    encoder_layers: int = 2
+    decoder_layers: int = 2
+    num_heads: int = 2
+    max_target_len: int = 448
+    n_mels: int = N_MELS
+    n_audio_ctx: int = N_FRAMES // 2  # after stride-2 conv
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+
+WHISPER_PRESETS = {
+    # Test-scale preset: exercises every code path in seconds on CPU.
+    "tiny-whisper": WhisperConfig(),
+    # openai/whisper-small card dimensions (12+12 layers, d_model 768).
+    "whisper-small": WhisperConfig(
+        name="whisper-small", vocab_size=51865, d_model=768,
+        encoder_layers=12, decoder_layers=12, num_heads=12,
+    ),
+}
+
+
+# --------------------------------------------------------------------- #
+# Mel frontend (host-side numpy; no librosa/soundfile in the image)
+# --------------------------------------------------------------------- #
+
+def _mel_filterbank(n_mels: int = N_MELS, n_fft: int = N_FFT,
+                    sr: int = SAMPLE_RATE) -> np.ndarray:
+    """Slaney-style triangular mel filterbank, (n_mels, n_fft//2+1)."""
+
+    def hz_to_mel(f):
+        return 2595.0 * np.log10(1.0 + np.asarray(f) / 700.0)
+
+    def mel_to_hz(m):
+        return 700.0 * (10.0 ** (np.asarray(m) / 2595.0) - 1.0)
+
+    fft_freqs = np.linspace(0, sr / 2, n_fft // 2 + 1)
+    mel_pts = mel_to_hz(np.linspace(hz_to_mel(0.0), hz_to_mel(sr / 2),
+                                    n_mels + 2))
+    fb = np.zeros((n_mels, len(fft_freqs)), dtype=np.float32)
+    for i in range(n_mels):
+        lo, ctr, hi = mel_pts[i], mel_pts[i + 1], mel_pts[i + 2]
+        up = (fft_freqs - lo) / max(ctr - lo, 1e-8)
+        down = (hi - fft_freqs) / max(hi - ctr, 1e-8)
+        fb[i] = np.maximum(0.0, np.minimum(up, down))
+    # Area-normalize each filter.
+    enorm = 2.0 / (mel_pts[2:] - mel_pts[:-2])
+    fb *= enorm[:, None]
+    return fb
+
+
+_FILTERBANK: Optional[np.ndarray] = None
+
+
+def log_mel_spectrogram(audio: np.ndarray) -> np.ndarray:
+    """float32 PCM [-1, 1] -> (n_mels, N_FRAMES) log-mel features,
+    padded/trimmed to the 30 s window (whisper's audio.py contract)."""
+    global _FILTERBANK
+    if _FILTERBANK is None:
+        _FILTERBANK = _mel_filterbank()
+    target = SAMPLE_RATE * CHUNK_SECONDS
+    audio = np.asarray(audio, dtype=np.float32)[:target]
+    if len(audio) < target:
+        audio = np.pad(audio, (0, target - len(audio)))
+    window = np.hanning(N_FFT + 1)[:-1].astype(np.float32)
+    n_frames = 1 + (len(audio) - N_FFT) // HOP_LENGTH
+    idx = (np.arange(N_FFT)[None, :]
+           + HOP_LENGTH * np.arange(n_frames)[:, None])
+    frames = audio[idx] * window
+    spec = np.abs(np.fft.rfft(frames, axis=-1)) ** 2  # (T, n_fft//2+1)
+    mel = _FILTERBANK @ spec.T                        # (n_mels, T)
+    log_mel = np.log10(np.maximum(mel, 1e-10))
+    log_mel = np.maximum(log_mel, log_mel.max() - 8.0)
+    log_mel = (log_mel + 4.0) / 4.0
+    return log_mel[:, :N_FRAMES].astype(np.float32)
+
+
+def decode_wav_bytes(data: bytes) -> np.ndarray:
+    """WAV bytes -> mono float32 PCM at 16 kHz (stdlib ``wave`` only;
+    non-16k inputs are linearly resampled)."""
+    import io
+    import wave
+
+    with wave.open(io.BytesIO(data)) as w:
+        n = w.getnframes()
+        raw = w.readframes(n)
+        width = w.getsampwidth()
+        channels = w.getnchannels()
+        rate = w.getframerate()
+    if width == 2:
+        pcm = np.frombuffer(raw, dtype="<i2").astype(np.float32) / 32768.0
+    elif width == 1:
+        pcm = (np.frombuffer(raw, dtype=np.uint8).astype(np.float32)
+               - 128.0) / 128.0
+    elif width == 4:
+        pcm = (np.frombuffer(raw, dtype="<i4").astype(np.float32)
+               / 2147483648.0)
+    else:
+        raise ValueError(f"unsupported WAV sample width {width}")
+    if channels > 1:
+        pcm = pcm.reshape(-1, channels).mean(axis=1)
+    if rate != SAMPLE_RATE and len(pcm):
+        t_new = np.linspace(0, len(pcm) - 1,
+                            int(len(pcm) * SAMPLE_RATE / rate))
+        pcm = np.interp(t_new, np.arange(len(pcm)), pcm).astype(np.float32)
+    return pcm
+
+
+# --------------------------------------------------------------------- #
+# Model
+# --------------------------------------------------------------------- #
+
+def _sinusoids(length: int, channels: int) -> np.ndarray:
+    log_timescale = math.log(10000.0) / (channels // 2 - 1)
+    inv = np.exp(-log_timescale * np.arange(channels // 2))
+    scaled = np.arange(length)[:, None] * inv[None, :]
+    return np.concatenate([np.sin(scaled), np.cos(scaled)],
+                          axis=1).astype(np.float32)
+
+
+def _dense(key, shape, dtype, scale=0.02):
+    return (jax.random.normal(key, shape) * scale).astype(dtype)
+
+
+def init_whisper_params(cfg: WhisperConfig, seed: int = 0) -> Dict:
+    dt = jnp.dtype(cfg.dtype)
+    key = jax.random.PRNGKey(seed)
+    ks = iter(jax.random.split(key, 16 + 12 * (cfg.encoder_layers
+                                               + cfg.decoder_layers)))
+    d = cfg.d_model
+
+    def block():
+        return {
+            "ln1_g": jnp.ones((d,), dt), "ln1_b": jnp.zeros((d,), dt),
+            "q": _dense(next(ks), (d, d), dt),
+            "k": _dense(next(ks), (d, d), dt),
+            "v": _dense(next(ks), (d, d), dt),
+            "o": _dense(next(ks), (d, d), dt),
+            "ln2_g": jnp.ones((d,), dt), "ln2_b": jnp.zeros((d,), dt),
+            "fc1": _dense(next(ks), (d, 4 * d), dt),
+            "fc1_b": jnp.zeros((4 * d,), dt),
+            "fc2": _dense(next(ks), (4 * d, d), dt),
+            "fc2_b": jnp.zeros((d,), dt),
+        }
+
+    def cross():
+        return {
+            "lnx_g": jnp.ones((d,), dt), "lnx_b": jnp.zeros((d,), dt),
+            "xq": _dense(next(ks), (d, d), dt),
+            "xk": _dense(next(ks), (d, d), dt),
+            "xv": _dense(next(ks), (d, d), dt),
+            "xo": _dense(next(ks), (d, d), dt),
+        }
+
+    params = {
+        "conv1": _dense(next(ks), (3, cfg.n_mels, d), dt),
+        "conv1_b": jnp.zeros((d,), dt),
+        "conv2": _dense(next(ks), (3, d, d), dt),
+        "conv2_b": jnp.zeros((d,), dt),
+        "enc_pos": jnp.asarray(_sinusoids(cfg.n_audio_ctx, d), dt),
+        "enc_blocks": [block() for _ in range(cfg.encoder_layers)],
+        "enc_ln_g": jnp.ones((d,), dt), "enc_ln_b": jnp.zeros((d,), dt),
+        "tok_emb": _dense(next(ks), (cfg.vocab_size, d), dt),
+        "dec_pos": _dense(next(ks), (cfg.max_target_len, d), dt),
+        "dec_blocks": [{**block(), **cross()}
+                       for _ in range(cfg.decoder_layers)],
+        "dec_ln_g": jnp.ones((d,), dt), "dec_ln_b": jnp.zeros((d,), dt),
+    }
+    return params
+
+
+def _ln(x, g, b, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * g + b
+
+
+def _mha(q, k, v, heads: int, mask=None):
+    """(Tq,d),(Tk,d),(Tk,d) -> (Tq,d) multi-head attention."""
+    tq, d = q.shape
+    tk = k.shape[0]
+    hd = d // heads
+    qh = q.reshape(tq, heads, hd).transpose(1, 0, 2)
+    kh = k.reshape(tk, heads, hd).transpose(1, 0, 2)
+    vh = v.reshape(tk, heads, hd).transpose(1, 0, 2)
+    scores = jnp.einsum("hqd,hkd->hqk", qh, kh).astype(jnp.float32)
+    scores = scores / math.sqrt(hd)
+    if mask is not None:
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("hqk,hkd->hqd", probs, vh)
+    return out.transpose(1, 0, 2).reshape(tq, d)
+
+
+def _self_block(x, blk, heads, mask=None):
+    h = _ln(x, blk["ln1_g"], blk["ln1_b"])
+    att = _mha(h @ blk["q"], h @ blk["k"], h @ blk["v"], heads, mask)
+    x = x + att @ blk["o"]
+    h = _ln(x, blk["ln2_g"], blk["ln2_b"])
+    x = x + (jax.nn.gelu(h @ blk["fc1"] + blk["fc1_b"])
+             @ blk["fc2"] + blk["fc2_b"])
+    return x
+
+
+def encode_audio(params: Dict, cfg: WhisperConfig,
+                 mel: jnp.ndarray) -> jnp.ndarray:
+    """(n_mels, N_FRAMES) log-mel -> (n_audio_ctx, d_model) states."""
+    x = mel.T.astype(params["conv1"].dtype)  # (T, n_mels)
+    # conv1: k=3 stride 1 same-pad; conv2: k=3 stride 2.
+    x = jax.lax.conv_general_dilated(
+        x[None], params["conv1"], window_strides=(1,), padding="SAME",
+        dimension_numbers=("NWC", "WIO", "NWC"))[0] + params["conv1_b"]
+    x = jax.nn.gelu(x)
+    x = jax.lax.conv_general_dilated(
+        x[None], params["conv2"], window_strides=(2,), padding="SAME",
+        dimension_numbers=("NWC", "WIO", "NWC"))[0] + params["conv2_b"]
+    x = jax.nn.gelu(x)
+    x = x + params["enc_pos"]
+    for blk in params["enc_blocks"]:
+        x = _self_block(x, blk, cfg.num_heads)
+    return _ln(x, params["enc_ln_g"], params["enc_ln_b"])
+
+
+def decoder_logits(params: Dict, cfg: WhisperConfig, tokens: jnp.ndarray,
+                   n_tokens: jnp.ndarray,
+                   enc: jnp.ndarray) -> jnp.ndarray:
+    """Fixed-size decode: ``tokens`` is the (max_target_len,) buffer with
+    ``n_tokens`` valid entries; returns logits at the last valid position.
+
+    Static shapes keep this a single compiled XLA program per model — the
+    greedy loop re-invokes it with an updated buffer (O(n^2) attention,
+    bounded by max_target_len=448; fine for the 30 s ASR window).
+    """
+    t = cfg.max_target_len
+    x = params["tok_emb"][tokens] + params["dec_pos"]
+    positions = jnp.arange(t)
+    valid = positions < n_tokens
+    causal = (positions[None, :] <= positions[:, None]) & valid[None, :]
+    for blk in params["dec_blocks"]:
+        h = _ln(x, blk["ln1_g"], blk["ln1_b"])
+        att = _mha(h @ blk["q"], h @ blk["k"], h @ blk["v"],
+                   cfg.num_heads, causal[None])
+        x = x + att @ blk["o"]
+        h = _ln(x, blk["lnx_g"], blk["lnx_b"])
+        xatt = _mha(h @ blk["xq"], enc @ blk["xk"], enc @ blk["xv"],
+                    cfg.num_heads)
+        x = x + xatt @ blk["xo"]
+        h = _ln(x, blk["ln2_g"], blk["ln2_b"])
+        x = x + (jax.nn.gelu(h @ blk["fc1"] + blk["fc1_b"])
+                 @ blk["fc2"] + blk["fc2_b"])
+    x = _ln(x, params["dec_ln_g"], params["dec_ln_b"])
+    last = x[n_tokens - 1]
+    return (last @ params["tok_emb"].T.astype(last.dtype)).astype(
+        jnp.float32)
+
+
+class WhisperModel:
+    """Greedy transcriber wrapping the pure functions above with jit."""
+
+    def __init__(self, cfg: WhisperConfig, seed: int = 0):
+        self.cfg = cfg
+        self.params = init_whisper_params(cfg, seed)
+        self._encode = jax.jit(
+            lambda mel: encode_audio(self.params, cfg, mel))
+        self._step = jax.jit(
+            lambda tokens, n, enc: jnp.argmax(
+                decoder_logits(self.params, cfg, tokens, n, enc)))
+
+    def transcribe_tokens(self, audio: np.ndarray, sot: int, eot: int,
+                          max_tokens: int = 64) -> List[int]:
+        """float32 PCM -> generated token ids (greedy, until EOT)."""
+        mel = jnp.asarray(log_mel_spectrogram(audio))
+        enc = self._encode(mel)
+        buf = np.zeros((self.cfg.max_target_len,), dtype=np.int32)
+        buf[0] = sot
+        n = 1
+        out: List[int] = []
+        limit = min(max_tokens, self.cfg.max_target_len - 1)
+        for _ in range(limit):
+            nxt = int(self._step(jnp.asarray(buf), jnp.int32(n), enc))
+            if nxt == eot:
+                break
+            out.append(nxt)
+            buf[n] = nxt
+            n += 1
+        return out
+
+
+def get_whisper_config(model: str) -> WhisperConfig:
+    key = model.split("/")[-1].lower()
+    aliases = {"whisper-small": "whisper-small",
+               "whisper-tiny": "tiny-whisper",
+               "tiny-whisper": "tiny-whisper"}
+    if key in aliases:
+        return WHISPER_PRESETS[aliases[key]]
+    raise ValueError(
+        f"Unknown whisper model {model!r}; presets: "
+        f"{sorted(WHISPER_PRESETS)}")
+
+
+def is_whisper_model(model: str) -> bool:
+    return "whisper" in model.split("/")[-1].lower()
