@@ -280,6 +280,7 @@ async def _main() -> dict:
     try:
         (tokens, elapsed, ttfts, latencies, failures, rounds_done,
          prompt_tokens) = await _drive(router_url)
+        core_stats = server.core.stats()
     finally:
         await router_runner.cleanup()
         await engine_runner.cleanup()
@@ -313,6 +314,20 @@ async def _main() -> dict:
         "sys_prompt_tokens": SYS_PROMPT_TOKENS,
         "history_tokens": HISTORY_TOKENS,
         "elapsed_s": round(elapsed, 1),
+        # Engine-side accounting: how much prefill the prefix cache skipped,
+        # and whether block pressure caused preemption churn.
+        "engine_prompt_tokens": core_stats["prompt_tokens_total"],
+        "engine_cached_tokens": core_stats["cached_tokens_total"],
+        "engine_prefix_hit_rate": round(
+            core_stats["prefix_cache_hits"]
+            / max(core_stats["prefix_cache_queries"], 1), 4),
+        "engine_preemptions": core_stats["num_preempted_total"],
+        "engine_num_blocks": core_stats["num_blocks"],
+        "engine_prefill_s": core_stats["prefill_time_total"],
+        "engine_decode_s": core_stats["decode_time_total"],
+        "engine_flush_s": core_stats["flush_time_total"],
+        "engine_prefills": core_stats["prefill_count"],
+        "engine_bursts": core_stats["decode_burst_count"],
         "backend": None,  # filled below
     }
     return result

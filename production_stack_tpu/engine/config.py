@@ -12,7 +12,10 @@ class EngineConfig:
     dtype: str = "bfloat16"
     max_model_len: int = 2048
     max_num_seqs: int = 8           # decode batch width (static shape)
-    block_size: int = 16            # tokens per KV page
+    block_size: int = 64            # tokens per KV page (TPU-sized: one
+    #   page is one DMA in the pallas decode kernel, and the grid walks one
+    #   page per step — bigger pages mean fewer serial steps and efficient
+    #   ~256 KB transfers; 64 keeps prefix-cache granularity useful)
     num_blocks: Optional[int] = None  # None -> sized from hbm_utilization
     hbm_utilization: float = 0.7    # fraction of free HBM for KV pages
     enable_prefix_caching: bool = True

@@ -12,6 +12,7 @@ launches in each pod (``helm/templates/deployment-vllm-multi.yaml:108-199``).
 from __future__ import annotations
 
 import functools
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -153,6 +154,13 @@ class EngineCore:
         self.generation_tokens_total = 0
         self.requests_finished_total = 0
         self.step_count = 0
+        # Wall-clock split of the engine thread (perf diagnosis): prefill
+        # spans (dispatch+sync), decode-burst dispatches, burst readbacks.
+        self.prefill_time_total = 0.0
+        self.decode_time_total = 0.0
+        self.flush_time_total = 0.0
+        self.prefill_count = 0
+        self.decode_burst_count = 0
         self._sleeping = False
         self._sleep_level = 1
         self._host_params = None
@@ -219,11 +227,53 @@ class EngineCore:
             * mc.num_kv_heads * mc.head_dim * itemsize
         )
 
+    # Known per-chip HBM capacities, used when the runtime does not expose
+    # memory_stats (e.g. tunneled/experimental platforms return None).
+    _HBM_BY_KIND = (
+        ("v5 lite", 16 << 30), ("v5e", 16 << 30),
+        ("v5p", 95 << 30), ("v5", 95 << 30),
+        ("v6", 32 << 30), ("v4", 32 << 30),
+        ("v3", 32 << 30), ("v2", 16 << 30),
+    )
+
+    def _free_hbm_bytes(self) -> Optional[int]:
+        """Free device memory, from memory_stats when available, otherwise
+        (TPU only) from the chip's known capacity minus the bytes the
+        resident parameters actually occupy on this device, minus a fixed
+        workspace reserve for XLA temporaries (prefill activations, f32
+        score buffers, compile-time scratch)."""
+        dev = self.mesh.devices.flat[0]
+        try:
+            stats = dev.memory_stats()
+            if stats:
+                return stats["bytes_limit"] - stats["bytes_in_use"]
+        except Exception:  # noqa: BLE001 - stats absent or keys
+            pass                # platform-dependent: fall through
+        if dev.platform != "tpu":
+            return None  # CPU/GPU test meshes: keep the minimal pool
+        hbm = int(os.environ.get("TPU_STACK_HBM_BYTES", 0))
+        if not hbm:
+            kind = getattr(dev, "device_kind", "").lower()
+            hbm = next(
+                (cap for tag, cap in self._HBM_BY_KIND if tag in kind),
+                16 << 30,
+            )
+        param_bytes = 0
+        for leaf in jax.tree_util.tree_leaves(self.params):
+            try:
+                param_bytes += sum(
+                    s.data.nbytes for s in leaf.addressable_shards
+                    if s.device == dev
+                )
+            except Exception:  # noqa: BLE001
+                param_bytes += getattr(leaf, "nbytes", 0)
+        workspace = 2 << 30
+        return max(hbm - param_bytes - workspace, 0)
+
     def _auto_num_blocks(self) -> int:
         """Size the KV pool from free device memory (hbm_utilization)."""
-        try:
-            stats = self.mesh.devices.flat[0].memory_stats()
-            free = stats["bytes_limit"] - stats["bytes_in_use"]
+        free = self._free_hbm_bytes()
+        if free is not None:
             # Pages shard over tp (kv-head axis) and pp (layer axis) ONLY
             # when the dims divide (kv_pages_sharding falls back to
             # replicated otherwise) — scale the budget by the factors that
@@ -236,7 +286,7 @@ class EngineCore:
             pp_factor = pp if pp > 1 and mc.num_layers % pp == 0 else 1
             budget = free * self.config.hbm_utilization * tp_factor * pp_factor
             num = int(budget // self._kv_bytes_per_block())
-        except Exception:  # noqa: BLE001 - CPU backend has no memory_stats
+        else:
             num = 0
         min_blocks = self.config.max_blocks_per_seq * 2
         num = max(num, min_blocks)
@@ -738,6 +788,11 @@ class EngineCore:
             "num_preempted_total": self.scheduler.num_preempted_total,
             "num_blocks": self.num_blocks,
             "is_sleeping": self._sleeping,
+            "prefill_time_total": round(self.prefill_time_total, 3),
+            "decode_time_total": round(self.decode_time_total, 3),
+            "flush_time_total": round(self.flush_time_total, 3),
+            "prefill_count": self.prefill_count,
+            "decode_burst_count": self.decode_burst_count,
         }
 
     # ------------------------------------------------------------------ #
@@ -764,9 +819,15 @@ class EngineCore:
                                 self.scheduler.waiting.appendleft(req)
                         continue
                     if action == "prefill":
+                        t0 = time.perf_counter()
                         self._do_prefill(req)
+                        self.prefill_time_total += time.perf_counter() - t0
+                        self.prefill_count += 1
                     elif action == "decode":
+                        t0 = time.perf_counter()
                         self._do_decode()
+                        self.decode_time_total += time.perf_counter() - t0
+                        self.decode_burst_count += 1
                     else:
                         self._flush_pending_burst()
                         time.sleep(0.001)
@@ -1034,7 +1095,10 @@ class EngineCore:
         if pending is None:
             return
         self._pending_burst = None
+        t0 = time.perf_counter()
         sampled = np.asarray(jax.device_get(pending["out"]))  # [B, K]
+        self.flush_time_total += time.perf_counter() - t0
+        emitted_seqs = []
         for seq in pending["active"]:
             allow = pending["allows"].get(seq.req.request_id, 1)
             emitted = 0
@@ -1044,6 +1108,17 @@ class EngineCore:
                 self._emit_token(seq, int(sampled[seq.slot, s]))
                 emitted += 1
             self.generation_tokens_total += emitted
+            if emitted and self.scheduler.slots[seq.slot] is seq:
+                emitted_seqs.append(seq)
+        if emitted_seqs:
+            # Token values are now known: extend the prefix-hash chain over
+            # any decode-completed blocks so follow-up prompts that extend
+            # this output hit the cache.
+            with self._lock:
+                for seq in emitted_seqs:
+                    self.kv_mgr.register_decode_blocks(
+                        seq.req.request_id, seq.req.all_token_ids
+                    )
 
     def _sampling_for(self, r: EngineRequest):
         """Per-request sampling knobs (shared by prefill and burst decode):

@@ -152,6 +152,12 @@ class SequenceBlocks:
     # Hash of the last *full* block's prefix chain.
     last_full_hash: Optional[int] = None
     num_tokens: int = 0
+    # Prefix-chain registration frontier: leading tokens whose full blocks
+    # carry a registered chain hash, and the hash to chain the next block
+    # onto (vLLM-style: generated tokens hash like prompt tokens, so a
+    # follow-up request extending this output reuses the pages).
+    num_registered: int = 0
+    chain_parent: object = None
 
 
 class KVCacheManager:
@@ -262,8 +268,37 @@ class KVCacheManager:
                 seq.last_full_hash = h
                 parent = h
                 j += bs
+        seq.num_registered = j
+        seq.chain_parent = parent
         self.seqs[seq_id] = seq
         return seq.block_ids, seq.num_cached_tokens, restores
+
+    def register_decode_blocks(self, seq_id: str, all_tokens: List[int]) -> None:
+        """Extend the prefix-hash chain over blocks completed by generated
+        tokens (called after burst emission, when token values are known).
+        A multi-round conversation whose next prompt extends this output
+        then reuses the pages instead of re-prefilling them — the same
+        property vLLM gets by hashing generated blocks
+        (reference toggle: ``helm/values.yaml`` --enable-prefix-caching)."""
+        seq = self.seqs.get(seq_id)
+        if seq is None or not self.allocator.enable_prefix_caching:
+            return
+        bs = self.block_size
+        # Strictly behind the written-KV frontier: the newest sampled token's
+        # KV page is only written when that token is *fed* to the next burst,
+        # so a block ending exactly at len(all_tokens) could still have an
+        # unwritten final slot (flush without a successor burst in flight).
+        while seq.num_registered + bs < len(all_tokens):
+            start = seq.num_registered
+            blk = start // bs
+            if blk >= len(seq.block_ids):
+                break
+            chunk = tuple(all_tokens[start : start + bs])
+            h = BlockAllocator.chain_hash(seq.chain_parent, chunk)
+            self.allocator.register_full_block(seq.block_ids[blk], h)
+            seq.last_full_hash = h
+            seq.chain_parent = h
+            seq.num_registered = start + bs
 
     def append_token(self, seq_id: str, token: int) -> bool:
         """Account for one generated token; allocates a page on boundary.

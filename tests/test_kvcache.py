@@ -121,3 +121,44 @@ def test_eviction_callback_fires():
     # Exhaust the pool so cold cache gets recycled.
     mgr.allocate_prompt("s2", list(range(100, 116)))
     assert evicted, "eviction hook did not fire"
+
+
+def test_register_decode_blocks_extends_chain():
+    """Generated tokens hash into the prefix chain (multi-round reuse)."""
+    mgr = KVCacheManager(num_blocks=16, block_size=4)
+    prompt = list(range(6))  # 1 full block + partial
+    mgr.allocate_prompt("s1", prompt)
+    all_tokens = list(prompt)
+    # Emit 7 generated tokens: completes block 1 (tokens 4..7) and block 2
+    # (tokens 8..11); token 12 is the unwritten-KV frontier.
+    for tok in [100, 101, 102, 103, 104, 105, 106]:
+        mgr.append_token("s1", tok)
+        all_tokens.append(tok)
+        mgr.register_decode_blocks("s1", all_tokens)
+    mgr.free("s1")
+    # Follow-up prompt extending the output reuses prompt AND decode blocks.
+    nxt = all_tokens + [7, 8, 9]
+    _, cached, _ = mgr.allocate_prompt("s2", nxt)
+    assert cached == 12  # blocks 0,1,2 (12 tokens) all hit
+
+
+def test_register_decode_blocks_respects_kv_frontier():
+    """A block ending exactly at the newest sampled token must NOT be
+    registered: that token's KV page is unwritten until it is fed to the
+    next burst."""
+    mgr = KVCacheManager(num_blocks=16, block_size=4)
+    prompt = list(range(4))  # exactly 1 full block
+    mgr.allocate_prompt("s1", prompt)
+    all_tokens = list(prompt)
+    for tok in [100, 101, 102, 103]:  # fills block 1 exactly
+        mgr.append_token("s1", tok)
+        all_tokens.append(tok)
+    mgr.register_decode_blocks("s1", all_tokens)
+    seq = mgr.seqs["s1"]
+    # Block 1 ends at the frontier token (103) -> not registered yet.
+    assert seq.num_registered == 4
+    # One more token moves the frontier; block 1 becomes registrable.
+    mgr.append_token("s1", 104)
+    all_tokens.append(104)
+    mgr.register_decode_blocks("s1", all_tokens)
+    assert seq.num_registered == 8
