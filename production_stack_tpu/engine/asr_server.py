@@ -48,12 +48,59 @@ class ASRServer:
                  max_tokens: int = 64):
         self.model_name = model_name
         self.cfg = get_whisper_config(model_name)
-        self.model = WhisperModel(self.cfg, seed=seed)
+        params = None
+        self.hf_tok = None
+        # Default (preset / random-init) decode contract: ByteTokenizer ids.
         self.tokenizer = ByteTokenizer(self.cfg.vocab_size)
+        self.sot = [self.tokenizer.bos_token_id]
+        self.eot = self.tokenizer.eos_token_id
+        self.suppress: tuple = ()
+        self.begin_suppress: tuple = ()
+        from production_stack_tpu.models.weights import (
+            has_checkpoint,
+            load_whisper_checkpoint,
+        )
+
+        if has_checkpoint(model_name):
+            params = load_whisper_checkpoint(self.cfg, model_name)
+            self._load_hf_decoding(model_name)
+        self.model = WhisperModel(self.cfg, seed=seed, params=params)
         self.max_tokens = max_tokens
         self.requests_total = 0
         self.audio_seconds_total = 0.0
+        self.in_flight = 0
         self.started = time.time()
+
+    def _load_hf_decoding(self, path: str) -> None:
+        """Real checkpoint: HF tokenizer + the forced decoder prefix
+        ([startoftranscript, language, task, notimestamps]) from
+        generation_config.json."""
+        import json
+        import os
+
+        from transformers import AutoTokenizer
+
+        self.hf_tok = AutoTokenizer.from_pretrained(path)
+        gen: dict = {}
+        for fname in ("generation_config.json", "config.json"):
+            fpath = os.path.join(path, fname)
+            if os.path.exists(fpath):
+                try:
+                    with open(fpath) as f:
+                        gen = {**json.load(f), **gen}  # generation wins
+                except (OSError, ValueError):
+                    pass
+        start = gen.get("decoder_start_token_id")
+        if start is None:
+            start = self.hf_tok.convert_tokens_to_ids("<|startoftranscript|>")
+        forced = gen.get("forced_decoder_ids") or []
+        self.sot = [int(start)] + [
+            int(tok) for _, tok in sorted(forced) if tok is not None
+        ]
+        eot = gen.get("eos_token_id")
+        self.eot = int(eot if eot is not None else self.hf_tok.eos_token_id)
+        self.suppress = tuple(gen.get("suppress_tokens") or ())
+        self.begin_suppress = tuple(gen.get("begin_suppress_tokens") or ())
 
     def make_app(self) -> web.Application:
         app = web.Application(client_max_size=64 * 1024 * 1024)
@@ -69,8 +116,10 @@ class ASRServer:
 
     def _transcribe(self, pcm: np.ndarray) -> str:
         tokens = self.model.transcribe_tokens(
-            pcm, sot=self.tokenizer.bos_token_id,
-            eot=self.tokenizer.eos_token_id, max_tokens=self.max_tokens)
+            pcm, sot=self.sot, eot=self.eot, max_tokens=self.max_tokens,
+            suppress=self.suppress, begin_suppress=self.begin_suppress)
+        if self.hf_tok is not None:
+            return self.hf_tok.decode(tokens, skip_special_tokens=True)
         return self.tokenizer.decode(tokens)
 
     async def handle_transcription(
@@ -99,7 +148,11 @@ class ASRServer:
         duration = len(pcm) / SAMPLE_RATE
         t0 = time.perf_counter()
         loop = asyncio.get_running_loop()
-        text = await loop.run_in_executor(None, self._transcribe, pcm)
+        self.in_flight += 1
+        try:
+            text = await loop.run_in_executor(None, self._transcribe, pcm)
+        finally:
+            self.in_flight -= 1
         elapsed = time.perf_counter() - t0
         self.requests_total += 1
         self.audio_seconds_total += duration
@@ -140,15 +193,17 @@ class ASRServer:
     async def handle_metrics(self, request: web.Request) -> web.Response:
         labels = f'model_name="{self.model_name}"'
         lines = [
-            "# TYPE tpu:asr_requests counter",
+            # TYPE family names must match the sample names (classic
+            # exposition format): the samples carry the _total suffix.
+            "# TYPE tpu:asr_requests_total counter",
             f"tpu:asr_requests_total{{{labels}}} {self.requests_total}",
-            "# TYPE tpu:asr_audio_seconds counter",
+            "# TYPE tpu:asr_audio_seconds_total counter",
             f"tpu:asr_audio_seconds_total{{{labels}}} "
             f"{self.audio_seconds_total:.3f}",
             # The scraper's generic gauges, so the router's engine-stats
-            # loop parses ASR pods without special cases.
+            # loop (and queue-depth autoscaling) see in-flight ASR work.
             "# TYPE vllm:num_requests_running gauge",
-            f"vllm:num_requests_running{{{labels}}} 0",
+            f"vllm:num_requests_running{{{labels}}} {self.in_flight}",
             "# TYPE vllm:num_requests_waiting gauge",
             f"vllm:num_requests_waiting{{{labels}}} 0",
         ]

@@ -309,11 +309,18 @@ class EngineCore:
         return zeros()
 
     def _make_forward(self, mode: str):
+        """Prefill program: forward + on-device sampling of the last real
+        token's logits fused into ONE dispatch (the token is the only value
+        the host ever reads back — fusing removes a logits round-trip and a
+        separate sampling dispatch per prefill)."""
         apply = self._apply
         cfg = self.model_config
+        max_top_k = self.config.max_top_k
+        seed_static = self.config.seed
 
         def fwd(params, kv, token_ids, positions, slot_mapping,
-                block_tables, context_lens, seq_lens, adapter_ids):
+                block_tables, context_lens, seq_lens, adapter_ids,
+                temperature, top_k, top_p, seq_seeds, steps):
             logits, kv = apply(
                 params, cfg, token_ids, positions, kv, slot_mapping,
                 block_tables, context_lens, seq_lens,
@@ -324,7 +331,11 @@ class EngineCore:
             else:  # prefill / prefill_cached: logits of the last real token
                 idx = jnp.maximum(seq_lens - 1, 0)[:, None, None]
                 last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
-            return last, kv
+            keys = make_rng_keys(seed_static, steps.max(), seq_seeds + steps)
+            sampled = sample_tokens(
+                last, keys, temperature, top_k, top_p, max_top_k=max_top_k
+            )
+            return sampled, kv
 
         return jax.jit(fwd, donate_argnums=(1,))
 
@@ -536,12 +547,15 @@ class EngineCore:
                 context_lens = np.asarray([min(bucket, 2)], np.int32)
                 seq_lens = np.asarray([min(bucket, 2)], np.int32)
                 adapter_ids = np.zeros((1,), np.int32)
+                samp = (np.zeros((1,), np.float32), np.zeros((1,), np.int32),
+                        np.ones((1,), np.float32), np.zeros((1,), np.int64),
+                        np.ones((1,), np.int64))
                 # Plain prefill only ever sees context == span -> one tight
                 # table width per bucket.
                 _, self.kv = self._prefill_fn(
                     self.params, self.kv, token_arr, positions,
                     slot_mapping, np.zeros((1, tight), np.int32),
-                    context_lens, seq_lens, adapter_ids,
+                    context_lens, seq_lens, adapter_ids, *samp,
                 )
                 n_prefill += 1
                 # Cached prefill: context (and so the table bucket) can be
@@ -551,7 +565,7 @@ class EngineCore:
                     _, self.kv = self._prefill_cached_fn(
                         self.params, self.kv, token_arr, positions,
                         slot_mapping, np.zeros((1, maxb), np.int32),
-                        context_lens, seq_lens, adapter_ids,
+                        context_lens, seq_lens, adapter_ids, *samp,
                     )
                     n_prefill += 1
                     if maxb >= cfg.max_blocks_per_seq:
@@ -839,15 +853,26 @@ class EngineCore:
 
     # -- prefill -----------------------------------------------------------
     def _do_prefill(self, req: EngineRequest) -> None:
-        # Settle the in-flight burst first: its emission may finish
-        # sequences and free the pages this prompt needs.
-        self._flush_pending_burst()
+        """Block accounting is host-only, so the prompt's chunk forwards are
+        dispatched BEFORE the in-flight decode burst is read back: XLA
+        orders them after the burst via the kv dependency, and the burst's
+        host readback then overlaps the chunks' device execution. (A page
+        freed by a finished sequence may still receive the burst's
+        speculative write, but the burst was dispatched first, so the
+        prefill's own writes land after it — device order.)"""
         cfg = self.config
         tokens = req.all_token_ids
         n = len(tokens)
         alloc = self.kv_mgr.allocate_prompt(
             req.request_id, tokens, adapter=req.adapter_name
         )
+        if alloc is None:
+            # Pool tight: settle the in-flight burst (its emission may
+            # finish sequences and free pages), then retry once.
+            self._flush_pending_burst()
+            alloc = self.kv_mgr.allocate_prompt(
+                req.request_id, tokens, adapter=req.adapter_name
+            )
         self._drain_offload()
         if alloc is None:
             # Raced out of blocks; requeue.
@@ -889,16 +914,16 @@ class EngineCore:
         # O(chunk * context) instead of O(len^2) — the engine-level
         # long-context path (single chip; ring attention covers multi-chip).
         chunk = cfg.prefill_chunk_size or (n - cached)
-        last_logits = None
+        sampled = None
         start = cached
         while start < n:
             end = min(start + chunk, n)
-            last_logits = self._prefill_span(
+            sampled = self._prefill_span(
                 req, tokens, block_ids, start, end)
             start = end
-        token = self._sample(
-            last_logits, [req], np.asarray([n], np.int64)
-        )[0]
+        # Read back the in-flight burst while the chunks execute on device.
+        self._flush_pending_burst()
+        token = int(np.asarray(jax.device_get(sampled))[0])
         self.prompt_tokens_total += n
         self.cached_tokens_total += cached
 
@@ -912,8 +937,9 @@ class EngineCore:
 
     def _prefill_span(self, req: EngineRequest, tokens, block_ids,
                       start: int, end: int):
-        """Run one prefill chunk (tokens[start:end]) and return its last
-        logits. Spans after the first attend to earlier tokens through the
+        """Dispatch one prefill chunk (tokens[start:end]) and return its
+        on-device sampled next token (only the LAST chunk's sample is read
+        back). Spans after the first attend to earlier tokens through the
         pages (prefill_cached); the span's own K/V is written first, so
         attention over the block table sees the full prefix."""
         cfg = self.config
@@ -945,13 +971,17 @@ class EngineCore:
         context_lens = np.asarray([end], np.int32)
         seq_lens = np.asarray([take], np.int32)
         adapter_ids = np.asarray([req.adapter_id], np.int32)
+        t, k_, p_, seed = self._sampling_for(req)
 
         fn = self._prefill_cached_fn if start > 0 else self._prefill_fn
-        last_logits, self.kv = fn(
+        sampled, self.kv = fn(
             self.params, self.kv, token_arr, positions, slot_mapping,
             block_table, context_lens, seq_lens, adapter_ids,
+            np.asarray([t], np.float32), np.asarray([k_], np.int32),
+            np.asarray([p_], np.float32), np.asarray([seed], np.int64),
+            np.asarray([len(tokens)], np.int64),
         )
-        return last_logits
+        return sampled
 
     # -- decode ------------------------------------------------------------
     def _do_decode(self) -> None:
@@ -1128,29 +1158,6 @@ class EngineCore:
         return (r.sampling.temperature,
                 min(r.sampling.top_k, self.config.max_top_k),
                 r.sampling.top_p, seed)
-
-    def _sample(self, logits, reqs, steps) -> np.ndarray:
-        """Batched on-device sampling; per-request params are data."""
-        B = logits.shape[0]
-        temperature = np.zeros((B,), np.float32)
-        top_k = np.zeros((B,), np.int32)
-        top_p = np.ones((B,), np.float32)
-        seq_seeds = np.zeros((B,), np.int64)
-        for i, r in enumerate(reqs):
-            if r is None:
-                continue
-            temperature[i], top_k[i], top_p[i], seq_seeds[i] = (
-                self._sampling_for(r)
-            )
-        keys = make_rng_keys(
-            self.config.seed, int(steps.max() if len(steps) else 0),
-            jnp.asarray(seq_seeds + steps),
-        )
-        out = sample_tokens(
-            logits, keys, jnp.asarray(temperature), jnp.asarray(top_k),
-            jnp.asarray(top_p), max_top_k=self.config.max_top_k,
-        )
-        return np.asarray(jax.device_get(out))
 
     def _emit_token(self, seq: RunningSeq, token: int) -> None:
         req = seq.req

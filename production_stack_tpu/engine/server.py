@@ -582,16 +582,20 @@ class EngineServer:
             {"prompt": self.core.tokenizer.decode(body.get("tokens", []))})
 
     async def handle_transcriptions(self, request: web.Request) -> web.Response:
-        """Audio transcription is part of the OpenAI surface the router
-        proxies (multipart); the model zoo has no ASR family yet, so this
-        answers 501 explicitly rather than 404 (the reference gets Whisper
-        via vLLM images)."""
+        """Audio transcription is served by dedicated ASR pods
+        (:mod:`production_stack_tpu.engine.asr_server`, helm
+        ``modelType: transcription``) that the router proxies multipart
+        audio to — mirroring the reference's separate Whisper vLLM pods.
+        This text-generation engine answers 501 with a pointer rather than
+        404 so misrouted clients get a diagnosis."""
         await request.post()  # drain the multipart body
         return web.json_response(
             {"error": {
-                "message": "audio transcription requires an ASR model; "
-                           "no whisper-class model is in the TPU model zoo"
-                           " yet",
+                "message": "this pod serves text generation; deploy a "
+                           "whisper-class ASR pod (python -m production_"
+                           "stack_tpu.engine.asr_server, or a helm "
+                           "modelSpec with modelType: transcription) and "
+                           "route audio there",
                 "type": "NotImplementedError",
             }},
             status=501,

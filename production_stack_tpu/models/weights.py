@@ -316,6 +316,84 @@ def load_checkpoint(cfg: ModelConfig, path: str) -> Dict:
     return loader(cfg, path)
 
 
+def load_whisper_checkpoint(cfg, path: str) -> Dict:
+    """HF WhisperForConditionalGeneration safetensors -> the param tree of
+    :mod:`production_stack_tpu.models.whisper` (reference serves Whisper via
+    vLLM images; ``src/vllm_router/services/request_service/request.py:513-689``).
+
+    torch Linear weights are [out, in] and our layout is ``x @ W`` =
+    [in, out], so every projection transposes; conv1d weights go
+    [out, in, k] -> [k, in, out] (WIO); k_proj carries no bias in Whisper.
+    """
+    dt = jnp.dtype(cfg.dtype)
+    sd = {name: arr for name, arr in _iter_checkpoint_tensors(path)}
+
+    def t(name):  # [out, in] -> [in, out]
+        return _to_dtype(np.ascontiguousarray(sd[name].T), dt)
+
+    def raw(name):
+        return _to_dtype(sd[name], dt)
+
+    def conv(name):  # [out, in, k] -> [k, in, out]
+        return _to_dtype(
+            np.ascontiguousarray(sd[name].transpose(2, 1, 0)), dt)
+
+    def block(prefix: str, cross: bool) -> Dict:
+        p = {
+            "ln1_g": raw(f"{prefix}.self_attn_layer_norm.weight"),
+            "ln1_b": raw(f"{prefix}.self_attn_layer_norm.bias"),
+            "q": t(f"{prefix}.self_attn.q_proj.weight"),
+            "q_b": raw(f"{prefix}.self_attn.q_proj.bias"),
+            "k": t(f"{prefix}.self_attn.k_proj.weight"),
+            "v": t(f"{prefix}.self_attn.v_proj.weight"),
+            "v_b": raw(f"{prefix}.self_attn.v_proj.bias"),
+            "o": t(f"{prefix}.self_attn.out_proj.weight"),
+            "o_b": raw(f"{prefix}.self_attn.out_proj.bias"),
+            "ln2_g": raw(f"{prefix}.final_layer_norm.weight"),
+            "ln2_b": raw(f"{prefix}.final_layer_norm.bias"),
+            "fc1": t(f"{prefix}.fc1.weight"),
+            "fc1_b": raw(f"{prefix}.fc1.bias"),
+            "fc2": t(f"{prefix}.fc2.weight"),
+            "fc2_b": raw(f"{prefix}.fc2.bias"),
+        }
+        if cross:
+            p.update({
+                "lnx_g": raw(f"{prefix}.encoder_attn_layer_norm.weight"),
+                "lnx_b": raw(f"{prefix}.encoder_attn_layer_norm.bias"),
+                "xq": t(f"{prefix}.encoder_attn.q_proj.weight"),
+                "xq_b": raw(f"{prefix}.encoder_attn.q_proj.bias"),
+                "xk": t(f"{prefix}.encoder_attn.k_proj.weight"),
+                "xv": t(f"{prefix}.encoder_attn.v_proj.weight"),
+                "xv_b": raw(f"{prefix}.encoder_attn.v_proj.bias"),
+                "xo": t(f"{prefix}.encoder_attn.out_proj.weight"),
+                "xo_b": raw(f"{prefix}.encoder_attn.out_proj.bias"),
+            })
+        return p
+
+    logger.info("Loading whisper checkpoint from %s", path)
+    return {
+        "conv1": conv("model.encoder.conv1.weight"),
+        "conv1_b": raw("model.encoder.conv1.bias"),
+        "conv2": conv("model.encoder.conv2.weight"),
+        "conv2_b": raw("model.encoder.conv2.bias"),
+        "enc_pos": raw("model.encoder.embed_positions.weight"),
+        "enc_blocks": [
+            block(f"model.encoder.layers.{i}", cross=False)
+            for i in range(cfg.encoder_layers)
+        ],
+        "enc_ln_g": raw("model.encoder.layer_norm.weight"),
+        "enc_ln_b": raw("model.encoder.layer_norm.bias"),
+        "tok_emb": raw("model.decoder.embed_tokens.weight"),
+        "dec_pos": raw("model.decoder.embed_positions.weight"),
+        "dec_blocks": [
+            block(f"model.decoder.layers.{i}", cross=True)
+            for i in range(cfg.decoder_layers)
+        ],
+        "dec_ln_g": raw("model.decoder.layer_norm.weight"),
+        "dec_ln_b": raw("model.decoder.layer_norm.bias"),
+    }
+
+
 def has_checkpoint(path: str) -> bool:
     return os.path.isdir(path) and (
         bool(glob.glob(os.path.join(path, "*.safetensors")))

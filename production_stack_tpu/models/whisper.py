@@ -79,13 +79,28 @@ WHISPER_PRESETS = {
 
 def _mel_filterbank(n_mels: int = N_MELS, n_fft: int = N_FFT,
                     sr: int = SAMPLE_RATE) -> np.ndarray:
-    """Slaney-style triangular mel filterbank, (n_mels, n_fft//2+1)."""
+    """Slaney-scale triangular mel filterbank, (n_mels, n_fft//2+1) —
+    linear below 1 kHz, log above, matching librosa / HF's
+    WhisperFeatureExtractor so checkpoint inputs are bit-comparable."""
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / (200.0 / 3.0)  # 15.0
+    logstep = math.log(6.4) / 27.0
 
     def hz_to_mel(f):
-        return 2595.0 * np.log10(1.0 + np.asarray(f) / 700.0)
+        f = np.asarray(f, dtype=np.float64)
+        return np.where(
+            f >= min_log_hz,
+            min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep,
+            f / (200.0 / 3.0),
+        )
 
     def mel_to_hz(m):
-        return 700.0 * (10.0 ** (np.asarray(m) / 2595.0) - 1.0)
+        m = np.asarray(m, dtype=np.float64)
+        return np.where(
+            m >= min_log_mel,
+            min_log_hz * np.exp(logstep * (m - min_log_mel)),
+            m * (200.0 / 3.0),
+        )
 
     fft_freqs = np.linspace(0, sr / 2, n_fft // 2 + 1)
     mel_pts = mel_to_hz(np.linspace(hz_to_mel(0.0), hz_to_mel(sr / 2),
@@ -115,6 +130,10 @@ def log_mel_spectrogram(audio: np.ndarray) -> np.ndarray:
     audio = np.asarray(audio, dtype=np.float32)[:target]
     if len(audio) < target:
         audio = np.pad(audio, (0, target - len(audio)))
+    # Whisper's STFT contract is center=True: reflect-pad N_FFT//2 per side
+    # so exactly N_FRAMES (3000) frames come out; without it the framing
+    # yields 2998 and the stride-2 encoder conv misaligns with enc_pos.
+    audio = np.pad(audio, (N_FFT // 2, N_FFT // 2), mode="reflect")
     window = np.hanning(N_FFT + 1)[:-1].astype(np.float32)
     n_frames = 1 + (len(audio) - N_FFT) // HOP_LENGTH
     idx = (np.arange(N_FFT)[None, :]
@@ -183,12 +202,14 @@ def init_whisper_params(cfg: WhisperConfig, seed: int = 0) -> Dict:
     d = cfg.d_model
 
     def block():
+        # q/v/out projections carry biases, k does not — HF Whisper's exact
+        # parameterization, so checkpoints load without residue.
         return {
             "ln1_g": jnp.ones((d,), dt), "ln1_b": jnp.zeros((d,), dt),
-            "q": _dense(next(ks), (d, d), dt),
+            "q": _dense(next(ks), (d, d), dt), "q_b": jnp.zeros((d,), dt),
             "k": _dense(next(ks), (d, d), dt),
-            "v": _dense(next(ks), (d, d), dt),
-            "o": _dense(next(ks), (d, d), dt),
+            "v": _dense(next(ks), (d, d), dt), "v_b": jnp.zeros((d,), dt),
+            "o": _dense(next(ks), (d, d), dt), "o_b": jnp.zeros((d,), dt),
             "ln2_g": jnp.ones((d,), dt), "ln2_b": jnp.zeros((d,), dt),
             "fc1": _dense(next(ks), (d, 4 * d), dt),
             "fc1_b": jnp.zeros((4 * d,), dt),
@@ -199,10 +220,10 @@ def init_whisper_params(cfg: WhisperConfig, seed: int = 0) -> Dict:
     def cross():
         return {
             "lnx_g": jnp.ones((d,), dt), "lnx_b": jnp.zeros((d,), dt),
-            "xq": _dense(next(ks), (d, d), dt),
+            "xq": _dense(next(ks), (d, d), dt), "xq_b": jnp.zeros((d,), dt),
             "xk": _dense(next(ks), (d, d), dt),
-            "xv": _dense(next(ks), (d, d), dt),
-            "xo": _dense(next(ks), (d, d), dt),
+            "xv": _dense(next(ks), (d, d), dt), "xv_b": jnp.zeros((d,), dt),
+            "xo": _dense(next(ks), (d, d), dt), "xo_b": jnp.zeros((d,), dt),
         }
 
     params = {
@@ -248,8 +269,9 @@ def _mha(q, k, v, heads: int, mask=None):
 
 def _self_block(x, blk, heads, mask=None):
     h = _ln(x, blk["ln1_g"], blk["ln1_b"])
-    att = _mha(h @ blk["q"], h @ blk["k"], h @ blk["v"], heads, mask)
-    x = x + att @ blk["o"]
+    att = _mha(h @ blk["q"] + blk["q_b"], h @ blk["k"],
+               h @ blk["v"] + blk["v_b"], heads, mask)
+    x = x + att @ blk["o"] + blk["o_b"]
     h = _ln(x, blk["ln2_g"], blk["ln2_b"])
     x = x + (jax.nn.gelu(h @ blk["fc1"] + blk["fc1_b"])
              @ blk["fc2"] + blk["fc2_b"])
@@ -260,13 +282,15 @@ def encode_audio(params: Dict, cfg: WhisperConfig,
                  mel: jnp.ndarray) -> jnp.ndarray:
     """(n_mels, N_FRAMES) log-mel -> (n_audio_ctx, d_model) states."""
     x = mel.T.astype(params["conv1"].dtype)  # (T, n_mels)
-    # conv1: k=3 stride 1 same-pad; conv2: k=3 stride 2.
+    # conv1: k=3 stride 1; conv2: k=3 stride 2. Explicit (1, 1) padding —
+    # torch's padding=1 — NOT "SAME": with stride 2, SAME pads (0, 1) and
+    # shifts every output frame one sample against HF checkpoints.
     x = jax.lax.conv_general_dilated(
-        x[None], params["conv1"], window_strides=(1,), padding="SAME",
+        x[None], params["conv1"], window_strides=(1,), padding=[(1, 1)],
         dimension_numbers=("NWC", "WIO", "NWC"))[0] + params["conv1_b"]
     x = jax.nn.gelu(x)
     x = jax.lax.conv_general_dilated(
-        x[None], params["conv2"], window_strides=(2,), padding="SAME",
+        x[None], params["conv2"], window_strides=(2,), padding=[(1, 1)],
         dimension_numbers=("NWC", "WIO", "NWC"))[0] + params["conv2_b"]
     x = jax.nn.gelu(x)
     x = x + params["enc_pos"]
@@ -292,13 +316,13 @@ def decoder_logits(params: Dict, cfg: WhisperConfig, tokens: jnp.ndarray,
     causal = (positions[None, :] <= positions[:, None]) & valid[None, :]
     for blk in params["dec_blocks"]:
         h = _ln(x, blk["ln1_g"], blk["ln1_b"])
-        att = _mha(h @ blk["q"], h @ blk["k"], h @ blk["v"],
-                   cfg.num_heads, causal[None])
-        x = x + att @ blk["o"]
+        att = _mha(h @ blk["q"] + blk["q_b"], h @ blk["k"],
+                   h @ blk["v"] + blk["v_b"], cfg.num_heads, causal[None])
+        x = x + att @ blk["o"] + blk["o_b"]
         h = _ln(x, blk["lnx_g"], blk["lnx_b"])
-        xatt = _mha(h @ blk["xq"], enc @ blk["xk"], enc @ blk["xv"],
-                    cfg.num_heads)
-        x = x + xatt @ blk["xo"]
+        xatt = _mha(h @ blk["xq"] + blk["xq_b"], enc @ blk["xk"],
+                    enc @ blk["xv"] + blk["xv_b"], cfg.num_heads)
+        x = x + xatt @ blk["xo"] + blk["xo_b"]
         h = _ln(x, blk["ln2_g"], blk["ln2_b"])
         x = x + (jax.nn.gelu(h @ blk["fc1"] + blk["fc1_b"])
                  @ blk["fc2"] + blk["fc2_b"])
@@ -309,29 +333,58 @@ def decoder_logits(params: Dict, cfg: WhisperConfig, tokens: jnp.ndarray,
 
 
 class WhisperModel:
-    """Greedy transcriber wrapping the pure functions above with jit."""
+    """Greedy transcriber wrapping the pure functions above with jit.
 
-    def __init__(self, cfg: WhisperConfig, seed: int = 0):
+    ``params`` overrides random init (checkpoint loading lives in
+    :func:`production_stack_tpu.models.weights.load_whisper_checkpoint`).
+    """
+
+    def __init__(self, cfg: WhisperConfig, seed: int = 0,
+                 params: Optional[Dict] = None):
         self.cfg = cfg
-        self.params = init_whisper_params(cfg, seed)
+        self.params = (params if params is not None
+                       else init_whisper_params(cfg, seed))
         self._encode = jax.jit(
             lambda mel: encode_audio(self.params, cfg, mel))
+        # mask: [vocab] additive logits mask (0 / -inf) — how suppression
+        # works in HF's SuppressTokensLogitsProcessor: masked BEFORE the
+        # argmax, so a suppressed token is never selected or fed back.
         self._step = jax.jit(
-            lambda tokens, n, enc: jnp.argmax(
-                decoder_logits(self.params, cfg, tokens, n, enc)))
+            lambda tokens, n, enc, mask: jnp.argmax(
+                decoder_logits(self.params, cfg, tokens, n, enc) + mask))
 
-    def transcribe_tokens(self, audio: np.ndarray, sot: int, eot: int,
-                          max_tokens: int = 64) -> List[int]:
-        """float32 PCM -> generated token ids (greedy, until EOT)."""
+    def transcribe_tokens(self, audio: np.ndarray, sot, eot: int,
+                          max_tokens: int = 64,
+                          suppress: Tuple[int, ...] = (),
+                          begin_suppress: Tuple[int, ...] = ()) -> List[int]:
+        """float32 PCM -> generated token ids (greedy, until EOT).
+
+        ``sot`` may be a single id or a forced prefix sequence (HF
+        checkpoints force [startoftranscript, language, task,
+        notimestamps]); the prefix is not part of the returned ids.
+        ``suppress`` masks logits at every step; ``begin_suppress`` only at
+        the first generated position (HF semantics — e.g. EOT can't be the
+        whole transcript)."""
         mel = jnp.asarray(log_mel_spectrogram(audio))
         enc = self._encode(mel)
+        prefix = [int(sot)] if isinstance(sot, int) else [int(t) for t in sot]
         buf = np.zeros((self.cfg.max_target_len,), dtype=np.int32)
-        buf[0] = sot
-        n = 1
+        buf[:len(prefix)] = prefix
+        n = len(prefix)
         out: List[int] = []
-        limit = min(max_tokens, self.cfg.max_target_len - 1)
-        for _ in range(limit):
-            nxt = int(self._step(jnp.asarray(buf), jnp.int32(n), enc))
+        mask = np.zeros((self.cfg.vocab_size,), np.float32)
+        for t in suppress:
+            if 0 <= t < self.cfg.vocab_size:
+                mask[t] = -np.inf
+        begin_mask = mask.copy()
+        for t in begin_suppress:
+            if 0 <= t < self.cfg.vocab_size:
+                begin_mask[t] = -np.inf
+        limit = min(max_tokens, self.cfg.max_target_len - n)
+        for i in range(limit):
+            m = begin_mask if i == 0 else mask
+            nxt = int(self._step(
+                jnp.asarray(buf), jnp.int32(n), enc, jnp.asarray(m)))
             if nxt == eot:
                 break
             out.append(nxt)
@@ -340,7 +393,34 @@ class WhisperModel:
         return out
 
 
+def whisper_config_from_hf(path: str) -> WhisperConfig:
+    """Build a WhisperConfig from a local HF checkpoint's config.json."""
+    import json
+    import os
+
+    with open(os.path.join(path, "config.json")) as f:
+        cfg = json.load(f)
+    if cfg.get("model_type") != "whisper":
+        raise ValueError(f"{path} is not a whisper checkpoint")
+    return WhisperConfig(
+        name=path,
+        vocab_size=cfg.get("vocab_size", 51865),
+        d_model=cfg.get("d_model", 768),
+        encoder_layers=cfg.get("encoder_layers", 12),
+        decoder_layers=cfg.get("decoder_layers", 12),
+        num_heads=cfg.get("encoder_attention_heads", 12),
+        max_target_len=cfg.get("max_target_positions", 448),
+        n_mels=cfg.get("num_mel_bins", N_MELS),
+        n_audio_ctx=cfg.get("max_source_positions", N_FRAMES // 2),
+    )
+
+
 def get_whisper_config(model: str) -> WhisperConfig:
+    import os
+
+    if os.path.isdir(model) and os.path.exists(
+            os.path.join(model, "config.json")):
+        return whisper_config_from_hf(model)
     key = model.split("/")[-1].lower()
     aliases = {"whisper-small": "whisper-small",
                "whisper-tiny": "tiny-whisper",
@@ -353,4 +433,14 @@ def get_whisper_config(model: str) -> WhisperConfig:
 
 
 def is_whisper_model(model: str) -> bool:
+    import json
+    import os
+
+    cfg_path = os.path.join(model, "config.json")
+    if os.path.isdir(model) and os.path.exists(cfg_path):
+        try:
+            with open(cfg_path) as f:
+                return json.load(f).get("model_type") == "whisper"
+        except (OSError, ValueError):
+            return False
     return "whisper" in model.split("/")[-1].lower()
