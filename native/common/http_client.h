@@ -1,18 +1,26 @@
-// Minimal blocking HTTP/1.1 client over POSIX sockets (header-only).
+// Minimal blocking HTTP/1.1 client over POSIX sockets (header-only),
+// with optional TLS + bearer-token auth for direct Kubernetes API access.
 //
-// The native operator talks to the Kubernetes API through a plain-HTTP
-// base URL — in-cluster via a `kubectl proxy` sidecar (the image has no
-// TLS library), in tests via a fake API server. This mirrors how the
-// reference operator's client-go is configured with a rest.Config; the
-// transport is swappable without touching reconciler logic.
+// TLS: the image ships OpenSSL 3 runtime libraries but no dev headers, so
+// the stable libssl C ABI is declared locally and bound via dlopen
+// ("libssl.so.3") on first use. https:// base URLs get server-cert
+// verification against a CA bundle (--ca-file / in-cluster ca.crt) plus
+// hostname checking; plain http:// works as before (kubectl-proxy sidecar,
+// fake API servers in tests). Bearer tokens are re-read from the token
+// file per request, so ServiceAccount token rotation is picked up — the
+// same transport semantics the reference operator gets from client-go's
+// rest.InClusterConfig (operator/cmd/main.go:58-266).
 #pragma once
 
 #include <arpa/inet.h>
+#include <dlfcn.h>
 #include <netdb.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
 #include <cstring>
+#include <fstream>
+#include <mutex>
 #include <sstream>
 #include <string>
 
@@ -27,20 +35,37 @@ struct HttpResponse {
 struct HttpUrl {
   std::string host;
   int port = 80;
+  bool tls = false;
   std::string base_path;  // prefix prepended to request paths
 
   static HttpUrl parse(const std::string& url) {
     HttpUrl out;
     std::string rest = url;
-    const std::string scheme = "http://";
-    if (rest.rfind(scheme, 0) == 0) rest = rest.substr(scheme.size());
+    if (rest.rfind("https://", 0) == 0) {
+      rest = rest.substr(8);
+      out.tls = true;
+      out.port = 443;
+    } else if (rest.rfind("http://", 0) == 0) {
+      rest = rest.substr(7);
+    }
     auto slash = rest.find('/');
     std::string hostport = rest.substr(0, slash);
     if (slash != std::string::npos) out.base_path = rest.substr(slash);
     if (!out.base_path.empty() && out.base_path.back() == '/')
       out.base_path.pop_back();
+    if (!hostport.empty() && hostport[0] == '[') {
+      // Bracketed IPv6 literal: [fd00::1]:443
+      auto close = hostport.find(']');
+      out.host = hostport.substr(1, close - 1);
+      if (close != std::string::npos && close + 1 < hostport.size() &&
+          hostport[close + 1] == ':')
+        out.port = std::stoi(hostport.substr(close + 2));
+      return out;
+    }
     auto colon = hostport.find(':');
-    if (colon == std::string::npos) {
+    if (colon == std::string::npos || hostport.find(':', colon + 1) !=
+                                          std::string::npos) {
+      // No port, or multiple colons = bare IPv6 literal without port.
       out.host = hostport;
     } else {
       out.host = hostport.substr(0, colon);
@@ -50,10 +75,105 @@ struct HttpUrl {
   }
 };
 
+// ---------------------------------------------------------------------- //
+// libssl.so.3 runtime binding (stable OpenSSL 3 C ABI, no headers needed)
+// ---------------------------------------------------------------------- //
+
+struct TlsLib {
+  using SSL_CTX = void;
+  using SSL = void;
+  using SSL_METHOD = void;
+
+  SSL_METHOD* (*TLS_client_method)() = nullptr;
+  SSL_CTX* (*SSL_CTX_new)(const SSL_METHOD*) = nullptr;
+  void (*SSL_CTX_free)(SSL_CTX*) = nullptr;
+  int (*SSL_CTX_load_verify_locations)(SSL_CTX*, const char*, const char*) =
+      nullptr;
+  int (*SSL_CTX_set_default_verify_paths)(SSL_CTX*) = nullptr;
+  void (*SSL_CTX_set_verify)(SSL_CTX*, int, void*) = nullptr;
+  SSL* (*SSL_new)(SSL_CTX*) = nullptr;
+  void (*SSL_free)(SSL*) = nullptr;
+  int (*SSL_set_fd)(SSL*, int) = nullptr;
+  int (*SSL_connect)(SSL*) = nullptr;
+  int (*SSL_read)(SSL*, void*, int) = nullptr;
+  int (*SSL_write)(SSL*, const void*, int) = nullptr;
+  int (*SSL_shutdown)(SSL*) = nullptr;
+  int (*SSL_set1_host)(SSL*, const char*) = nullptr;
+  long (*SSL_ctrl)(SSL*, int, long, void*) = nullptr;  // SNI
+  // IP-literal peer verification (in-cluster apiservers are usually IPs;
+  // X509_check_host does not match SAN IP entries).
+  void* (*SSL_get0_param)(SSL*) = nullptr;
+  int (*X509_VERIFY_PARAM_set1_ip_asc)(void*, const char*) = nullptr;
+
+  bool loaded = false;
+
+  static const TlsLib& get() {
+    static TlsLib lib = load_();
+    return lib;
+  }
+
+ private:
+  static TlsLib load_() {
+    TlsLib l;
+    void* h = ::dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) h = ::dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) h = ::dlopen("libssl.so.1.1", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) return l;
+    auto sym = [&](const char* name) { return ::dlsym(h, name); };
+    l.TLS_client_method =
+        reinterpret_cast<SSL_METHOD* (*)()>(sym("TLS_client_method"));
+    l.SSL_CTX_new =
+        reinterpret_cast<SSL_CTX* (*)(const SSL_METHOD*)>(sym("SSL_CTX_new"));
+    l.SSL_CTX_free = reinterpret_cast<void (*)(SSL_CTX*)>(sym("SSL_CTX_free"));
+    l.SSL_CTX_load_verify_locations =
+        reinterpret_cast<int (*)(SSL_CTX*, const char*, const char*)>(
+            sym("SSL_CTX_load_verify_locations"));
+    l.SSL_CTX_set_default_verify_paths = reinterpret_cast<int (*)(SSL_CTX*)>(
+        sym("SSL_CTX_set_default_verify_paths"));
+    l.SSL_CTX_set_verify = reinterpret_cast<void (*)(SSL_CTX*, int, void*)>(
+        sym("SSL_CTX_set_verify"));
+    l.SSL_new = reinterpret_cast<SSL* (*)(SSL_CTX*)>(sym("SSL_new"));
+    l.SSL_free = reinterpret_cast<void (*)(SSL*)>(sym("SSL_free"));
+    l.SSL_set_fd = reinterpret_cast<int (*)(SSL*, int)>(sym("SSL_set_fd"));
+    l.SSL_connect = reinterpret_cast<int (*)(SSL*)>(sym("SSL_connect"));
+    l.SSL_read =
+        reinterpret_cast<int (*)(SSL*, void*, int)>(sym("SSL_read"));
+    l.SSL_write =
+        reinterpret_cast<int (*)(SSL*, const void*, int)>(sym("SSL_write"));
+    l.SSL_shutdown = reinterpret_cast<int (*)(SSL*)>(sym("SSL_shutdown"));
+    l.SSL_set1_host =
+        reinterpret_cast<int (*)(SSL*, const char*)>(sym("SSL_set1_host"));
+    l.SSL_ctrl =
+        reinterpret_cast<long (*)(SSL*, int, long, void*)>(sym("SSL_ctrl"));
+    l.SSL_get0_param =
+        reinterpret_cast<void* (*)(SSL*)>(sym("SSL_get0_param"));
+    void* hc = ::dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (!hc) hc = ::dlopen("libcrypto.so", RTLD_NOW | RTLD_GLOBAL);
+    if (hc)
+      l.X509_VERIFY_PARAM_set1_ip_asc =
+          reinterpret_cast<int (*)(void*, const char*)>(
+              ::dlsym(hc, "X509_VERIFY_PARAM_set1_ip_asc"));
+    l.loaded = l.TLS_client_method && l.SSL_CTX_new && l.SSL_new &&
+               l.SSL_connect && l.SSL_read && l.SSL_write;
+    return l;
+  }
+};
+
+struct HttpAuth {
+  // Path to a bearer-token file (re-read per request: SA tokens rotate).
+  std::string token_file;
+  // CA bundle for https:// verification; empty -> system default paths.
+  std::string ca_file;
+  // Disable server-cert verification (test/dev only).
+  bool insecure_skip_verify = false;
+};
+
 class HttpClient {
  public:
-  explicit HttpClient(const std::string& base_url, int timeout_sec = 10)
-      : url_(HttpUrl::parse(base_url)), timeout_sec_(timeout_sec) {}
+  explicit HttpClient(const std::string& base_url, int timeout_sec = 10,
+                      HttpAuth auth = {})
+      : url_(HttpUrl::parse(base_url)), timeout_sec_(timeout_sec),
+        auth_(std::move(auth)) {}
 
   HttpResponse request(const std::string& method, const std::string& path,
                        const std::string& body = "",
@@ -68,6 +188,8 @@ class HttpClient {
         << "Host: " << url_.host << ':' << url_.port << "\r\n"
         << "Connection: close\r\n"
         << "Accept: application/json\r\n";
+    std::string token = read_token_();
+    if (!token.empty()) req << "Authorization: Bearer " << token << "\r\n";
     if (!body.empty() || method == "POST" || method == "PUT" ||
         method == "PATCH") {
       req << "Content-Type: " << content_type << "\r\n"
@@ -76,18 +198,22 @@ class HttpClient {
     req << "\r\n" << body;
     std::string data = req.str();
 
-    size_t sent = 0;
-    while (sent < data.size()) {
-      ssize_t n = ::send(fd, data.data() + sent, data.size() - sent, 0);
-      if (n <= 0) { ::close(fd); return resp; }
-      sent += static_cast<size_t>(n);
-    }
-
     std::string raw;
-    char buf[8192];
-    ssize_t n;
-    while ((n = ::recv(fd, buf, sizeof(buf), 0)) > 0) {
-      raw.append(buf, static_cast<size_t>(n));
+    if (url_.tls) {
+      if (!tls_roundtrip_(fd, data, &raw)) { ::close(fd); return resp; }
+    } else {
+      size_t sent = 0;
+      while (sent < data.size()) {
+        ssize_t n = ::send(fd, data.data() + sent, data.size() - sent,
+                           MSG_NOSIGNAL);
+        if (n <= 0) { ::close(fd); return resp; }
+        sent += static_cast<size_t>(n);
+      }
+      char buf[8192];
+      ssize_t n;
+      while ((n = ::recv(fd, buf, sizeof(buf), 0)) > 0) {
+        raw.append(buf, static_cast<size_t>(n));
+      }
     }
     ::close(fd);
 
@@ -160,8 +286,109 @@ class HttpClient {
     return fd;
   }
 
+  std::string read_token_() const {
+    if (auth_.token_file.empty()) return "";
+    std::ifstream f(auth_.token_file);
+    if (!f) return "";
+    std::string token((std::istreambuf_iterator<char>(f)),
+                      std::istreambuf_iterator<char>());
+    while (!token.empty() &&
+           (token.back() == '\n' || token.back() == '\r' ||
+            token.back() == ' '))
+      token.pop_back();
+    return token;
+  }
+
+  // Lazily-built, per-client SSL_CTX (CA bundle parsed once, not per
+  // request; the bearer token — which genuinely rotates — is still
+  // re-read per request elsewhere). Fails CLOSED: when verification is
+  // requested but the resolved libssl lacks the verify/hostname symbols,
+  // no context is produced and the request errors instead of silently
+  // degrading to an unauthenticated peer.
+  TlsLib::SSL_CTX* tls_ctx_() const {
+    std::call_once(ctx_once_, [this] {
+      const TlsLib& ssl = TlsLib::get();
+      if (!ssl.loaded) return;
+      constexpr int kVerifyPeer = 1;  // SSL_VERIFY_PEER
+      if (!auth_.insecure_skip_verify) {
+        bool host_check = ssl.SSL_set1_host ||
+                          (ssl.SSL_get0_param &&
+                           ssl.X509_VERIFY_PARAM_set1_ip_asc);
+        if (!ssl.SSL_CTX_set_verify || !host_check) return;  // fail closed
+      }
+      TlsLib::SSL_CTX* ctx = ssl.SSL_CTX_new(ssl.TLS_client_method());
+      if (!ctx) return;
+      if (!auth_.insecure_skip_verify) {
+        bool ca_ok = false;
+        if (!auth_.ca_file.empty() && ssl.SSL_CTX_load_verify_locations)
+          ca_ok = ssl.SSL_CTX_load_verify_locations(
+                      ctx, auth_.ca_file.c_str(), nullptr) == 1;
+        if (!ca_ok && ssl.SSL_CTX_set_default_verify_paths)
+          ssl.SSL_CTX_set_default_verify_paths(ctx);
+        ssl.SSL_CTX_set_verify(ctx, kVerifyPeer, nullptr);
+      }
+      ctx_ = ctx;
+    });
+    return ctx_;
+  }
+
+  // One TLS request/response over an already-connected socket. Verifies
+  // the server certificate (unless insecure_skip_verify) and the hostname.
+  bool tls_roundtrip_(int fd, const std::string& data,
+                      std::string* raw) const {
+    const TlsLib& ssl = TlsLib::get();
+    if (!ssl.loaded) return false;
+    TlsLib::SSL_CTX* ctx = tls_ctx_();
+    if (!ctx) return false;
+    TlsLib::SSL* s = ssl.SSL_new(ctx);
+    if (!s) return false;
+    ssl.SSL_set_fd(s, fd);
+    if (!auth_.insecure_skip_verify) {
+      struct in_addr a4{};
+      struct in6_addr a6{};
+      bool is_ip = ::inet_pton(AF_INET, url_.host.c_str(), &a4) == 1 ||
+                   ::inet_pton(AF_INET6, url_.host.c_str(), &a6) == 1;
+      if (is_ip && ssl.SSL_get0_param &&
+          ssl.X509_VERIFY_PARAM_set1_ip_asc) {
+        ssl.X509_VERIFY_PARAM_set1_ip_asc(ssl.SSL_get0_param(s),
+                                          url_.host.c_str());
+      } else if (ssl.SSL_set1_host) {
+        ssl.SSL_set1_host(s, url_.host.c_str());
+      }
+    }
+    if (ssl.SSL_ctrl) {
+      // SSL_set_tlsext_host_name (SNI): SSL_CTRL_SET_TLSEXT_HOSTNAME=55,
+      // TLSEXT_NAMETYPE_host_name=0.
+      ssl.SSL_ctrl(s, 55, 0,
+                   const_cast<char*>(url_.host.c_str()));
+    }
+    bool ok = false;
+    if (ssl.SSL_connect(s) == 1) {
+      size_t sent = 0;
+      ok = true;
+      while (sent < data.size()) {
+        int n = ssl.SSL_write(s, data.data() + sent,
+                              static_cast<int>(data.size() - sent));
+        if (n <= 0) { ok = false; break; }
+        sent += static_cast<size_t>(n);
+      }
+      if (ok) {
+        char buf[8192];
+        int n;
+        while ((n = ssl.SSL_read(s, buf, sizeof(buf))) > 0)
+          raw->append(buf, static_cast<size_t>(n));
+      }
+    }
+    if (ssl.SSL_shutdown) ssl.SSL_shutdown(s);
+    ssl.SSL_free(s);
+    return ok;
+  }
+
   HttpUrl url_;
   int timeout_sec_;
+  HttpAuth auth_;
+  mutable TlsLib::SSL_CTX* ctx_ = nullptr;  // cached; freed with process
+  mutable std::once_flag ctx_once_;
 };
 
 }  // namespace tpustack

@@ -17,22 +17,34 @@
 //                  engine HTTP API /v1/load_lora_adapter
 //                  (loraadapter_controller.go:582-610)
 //
-// Transport: plain-HTTP Kubernetes API base (kubectl-proxy sidecar
-// in-cluster; fake API server in tests). Reconciliation is level-based
-// polling — each pass lists CRs, ensures child objects, detects drift
-// (replicas/image/args/port) and updates CR status.
+// Transport: https:// API base with ServiceAccount bearer token + CA
+// verification (in-cluster, autodetected from KUBERNETES_SERVICE_HOST and
+// /var/run/secrets/kubernetes.io/serviceaccount), or plain HTTP
+// (kubectl-proxy sidecar, fake API server in tests). Reconciliation is
+// level-based with adaptive backoff: a pass whose CR specs are unchanged
+// doubles the interval up to --max-interval; any spec change or transport
+// error resets it — the poll-based stand-in for a watch that keeps idle
+// clusters cheap (ref uses controller-runtime watches,
+// operator/cmd/main.go:58-266). A /healthz endpoint reports liveness and
+// last-reconcile age for kubelet probes.
 
+#include <csignal>
 #include <unistd.h>
 
 #include <algorithm>
+#include <atomic>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "../common/http_client.h"
 #include "../common/json.h"
+#include "../common/xxhash64.h"
+
+using tpustack::HttpAuth;
 
 using tpustack::HttpClient;
 using tpustack::HttpResponse;
@@ -48,6 +60,11 @@ struct Config {
   std::string default_engine_image = "production-stack-tpu:latest";
   std::string default_router_image = "production-stack-tpu:latest";
   int interval_sec = 5;
+  int max_interval_sec = 30;   // backoff ceiling when nothing changes
+  int health_port = 8081;      // 0 disables the /healthz listener
+  std::string token_file;      // bearer token (ServiceAccount)
+  std::string ca_file;         // CA bundle for https:// verification
+  bool insecure_tls = false;
   bool once = false;
 };
 
@@ -821,11 +838,34 @@ void update_status_raw(const HttpClient& api, const Config& cfg,
 // Reconcile pass
 // ---------------------------------------------------------------------- //
 
-void reconcile_once(const HttpClient& api, const Config& cfg) {
+// Spec fingerprint of one CR list: name + uid + generation + spec. Status
+// writes and resourceVersion churn from our own updates do NOT change it,
+// so an idle cluster fingerprints stable and the loop backs off.
+uint64_t list_fingerprint(const Json& list, uint64_t acc) {
+  for (const auto& cr : list.get("items").as_array()) {
+    std::string key =
+        cr.get("metadata").get("name").as_string() + "|" +
+        cr.get("metadata").get("uid").as_string() + "|" +
+        std::to_string(cr.get("metadata").get("generation").as_int(0)) +
+        "|" + cr.get("spec").dump() + "|" +
+        cr.get("metadata").get("deletionTimestamp").as_string();
+    acc = tpustack::xxhash64(key.data(), key.size(), acc);
+  }
+  return acc;
+}
+
+// Returns (fingerprint, all_lists_ok). fingerprint covers every CR spec
+// seen this pass; ok=false on any transport/parse error (callers reset
+// backoff so a flaky apiserver is retried promptly).
+std::pair<uint64_t, bool> reconcile_once(const HttpClient& api,
+                                         const Config& cfg) {
+  uint64_t fp = 0;
+  bool all_ok = true;
   // TPURuntime
   HttpResponse resp = api.get(cr_path(cfg, "tpuruntimes"));
   Json list;
   if (resp.ok() && Json::try_parse(resp.body, &list)) {
+    fp = list_fingerprint(list, fp);
     for (const auto& cr : list.get("items").as_array()) {
       std::string name = cr.get("metadata").get("name").as_string();
       ensure_object(api, svc_path(cfg), name + "-engine-service",
@@ -834,10 +874,13 @@ void reconcile_once(const HttpClient& api, const Config& cfg) {
                     runtime_deployment(cfg, cr), true);
       update_status(api, cfg, "tpuruntimes", cr, name + "-engine");
     }
+  } else {
+    all_ok = false;
   }
   // TPURouter
   resp = api.get(cr_path(cfg, "tpurouters"));
   if (resp.ok() && Json::try_parse(resp.body, &list)) {
+    fp = list_fingerprint(list, fp);
     for (const auto& cr : list.get("items").as_array()) {
       std::string name = cr.get("metadata").get("name").as_string();
       ensure_object(api, "/api/v1/namespaces/" + cfg.ns +
@@ -849,10 +892,13 @@ void reconcile_once(const HttpClient& api, const Config& cfg) {
                     router_deployment(cfg, cr), true);
       update_status(api, cfg, "tpurouters", cr, name + "-router");
     }
+  } else {
+    all_ok = false;
   }
   // CacheServer
   resp = api.get(cr_path(cfg, "cacheservers"));
   if (resp.ok() && Json::try_parse(resp.body, &list)) {
+    fp = list_fingerprint(list, fp);
     for (const auto& cr : list.get("items").as_array()) {
       std::string name = cr.get("metadata").get("name").as_string();
       ensure_object(api, svc_path(cfg), name + "-cache-service",
@@ -861,19 +907,80 @@ void reconcile_once(const HttpClient& api, const Config& cfg) {
                     cache_deployment(cfg, cr), true);
       update_status(api, cfg, "cacheservers", cr, name + "-cache");
     }
+  } else {
+    all_ok = false;
   }
   // LoraAdapter
   resp = api.get(cr_path(cfg, "loraadapters"));
   if (resp.ok() && Json::try_parse(resp.body, &list)) {
+    fp = list_fingerprint(list, fp);
     for (const auto& cr : list.get("items").as_array())
       reconcile_lora(api, cfg, cr);
+  } else {
+    all_ok = false;
+  }
+  return {fp, all_ok};
+}
+
+// ---------------------------------------------------------------------- //
+// /healthz listener (kubelet liveness/readiness; ref exposes :8081 via
+// controller-runtime's healthz.Ping)
+// ---------------------------------------------------------------------- //
+
+std::atomic<int64_t> g_last_reconcile_ms{0};
+std::atomic<int64_t> g_passes{0};
+
+int64_t now_ms() {
+  struct timespec ts{};
+  ::clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
+void serve_health(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<struct sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(fd, 8) != 0) {
+    ::close(fd);
+    log_line("healthz: bind failed on port " + std::to_string(port));
+    return;
+  }
+  log_line("healthz listening on :" + std::to_string(port));
+  for (;;) {
+    int c = ::accept(fd, nullptr, nullptr);
+    if (c < 0) continue;
+    char buf[1024];
+    ::recv(c, buf, sizeof(buf), 0);  // drain request line; path ignored
+    int64_t last = g_last_reconcile_ms.load();
+    int64_t age = last ? (now_ms() - last) / 1000 : -1;
+    std::string body = "{\"status\":\"ok\",\"passes\":" +
+                       std::to_string(g_passes.load()) +
+                       ",\"last_reconcile_age_sec\":" +
+                       std::to_string(age) + "}";
+    std::string resp =
+        "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+        "Content-Length: " + std::to_string(body.size()) +
+        "\r\nConnection: close\r\n\r\n" + body;
+    ::send(c, resp.data(), resp.size(), MSG_NOSIGNAL);
+    ::close(c);
   }
 }
 
 }  // namespace
 
 int main(int argc, char** argv) {
+  // A probe client (or engine pod) closing early must not kill the
+  // process: SSL_write can't take MSG_NOSIGNAL, so ignore SIGPIPE.
+  std::signal(SIGPIPE, SIG_IGN);
   Config cfg;
+  bool api_base_set = false;
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
     auto next = [&](const char* flag) -> std::string {
@@ -883,28 +990,88 @@ int main(int argc, char** argv) {
       }
       return argv[++i];
     };
-    if (a == "--api-base") cfg.api_base = next("--api-base");
+    if (a == "--api-base") { cfg.api_base = next("--api-base"); api_base_set = true; }
     else if (a == "--namespace") cfg.ns = next("--namespace");
     else if (a == "--interval") cfg.interval_sec = std::stoi(next("--interval"));
+    else if (a == "--max-interval") cfg.max_interval_sec = std::stoi(next("--max-interval"));
+    else if (a == "--health-port") cfg.health_port = std::stoi(next("--health-port"));
+    else if (a == "--token-file") cfg.token_file = next("--token-file");
+    else if (a == "--ca-file") cfg.ca_file = next("--ca-file");
+    else if (a == "--insecure-skip-tls-verify") cfg.insecure_tls = true;
     else if (a == "--once") cfg.once = true;
     else if (a == "--help" || a == "-h") {
       std::printf(
           "tpu-stack-operator: reconciles production-stack.tpu/v1alpha1 "
           "CRDs\n"
-          "  --api-base URL   plain-HTTP K8s API base "
-          "(default http://127.0.0.1:8001, e.g. kubectl proxy)\n"
+          "  --api-base URL   K8s API base: https:// (direct, verified) or\n"
+          "                   http:// (kubectl proxy). Default: in-cluster\n"
+          "                   autodetect, else http://127.0.0.1:8001\n"
           "  --namespace NS   namespace to watch (default: default)\n"
-          "  --interval SEC   reconcile interval (default 5)\n"
+          "  --token-file F   bearer token file (default: in-cluster SA)\n"
+          "  --ca-file F      CA bundle for https:// (default: in-cluster)\n"
+          "  --insecure-skip-tls-verify  disable cert verification\n"
+          "  --interval SEC   base reconcile interval (default 5)\n"
+          "  --max-interval S backoff ceiling when idle (default 30)\n"
+          "  --health-port P  /healthz listener (default 8081, 0=off)\n"
           "  --once           single reconcile pass, then exit\n");
       return 0;
     }
   }
 
-  HttpClient api(cfg.api_base);
-  log_line("watching namespace " + cfg.ns + " via " + cfg.api_base);
+  // In-cluster autodetect (the rest.InClusterConfig equivalent): when no
+  // --api-base is given and the standard env + SA mount exist, go direct
+  // to the apiserver over verified TLS with the pod's ServiceAccount.
+  const char* k8s_host = std::getenv("KUBERNETES_SERVICE_HOST");
+  const char* k8s_port = std::getenv("KUBERNETES_SERVICE_PORT");
+  const char* kSa = "/var/run/secrets/kubernetes.io/serviceaccount";
+  if (!api_base_set && k8s_host && *k8s_host) {
+    std::string host = k8s_host;
+    if (host.find(':') != std::string::npos)
+      host = "[" + host + "]";  // IPv6 apiserver: bracket for the URL
+    cfg.api_base = std::string("https://") + host + ":" +
+                   (k8s_port && *k8s_port ? k8s_port : "443");
+    if (cfg.token_file.empty())
+      cfg.token_file = std::string(kSa) + "/token";
+    if (cfg.ca_file.empty()) cfg.ca_file = std::string(kSa) + "/ca.crt";
+    std::ifstream ns_file(std::string(kSa) + "/namespace");
+    if (ns_file && cfg.ns == "default") {
+      std::string pod_ns;
+      std::getline(ns_file, pod_ns);
+      if (!pod_ns.empty()) cfg.ns = pod_ns;
+    }
+  }
+
+  HttpAuth auth;
+  auth.token_file = cfg.token_file;
+  auth.ca_file = cfg.ca_file;
+  auth.insecure_skip_verify = cfg.insecure_tls;
+  HttpClient api(cfg.api_base, 10, auth);
+  log_line("watching namespace " + cfg.ns + " via " + cfg.api_base +
+           (cfg.token_file.empty() ? "" : " (bearer auth)"));
+
+  std::thread health;
+  if (!cfg.once && cfg.health_port > 0) {
+    health = std::thread(serve_health, cfg.health_port);
+    health.detach();
+  }
+
+  uint64_t prev_fp = 0;
+  bool have_fp = false;
+  int interval = cfg.interval_sec;
   do {
-    reconcile_once(api, cfg);
-    if (!cfg.once) ::sleep(cfg.interval_sec);
+    auto [fp, ok] = reconcile_once(api, cfg);
+    g_last_reconcile_ms.store(now_ms());
+    g_passes.fetch_add(1);
+    if (!cfg.once) {
+      if (ok && have_fp && fp == prev_fp) {
+        interval = std::min(interval * 2, cfg.max_interval_sec);
+      } else {
+        interval = cfg.interval_sec;  // change or error: react fast
+      }
+      prev_fp = fp;
+      have_fp = ok;
+      ::sleep(interval);
+    }
   } while (!cfg.once);
   return 0;
 }
