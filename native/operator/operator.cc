@@ -936,7 +936,7 @@ int64_t now_ms() {
   return ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
 }
 
-void serve_health(int port) {
+void serve_health(int port, int max_interval_sec) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return;
   int one = 1;
@@ -960,12 +960,21 @@ void serve_health(int port) {
     ::recv(c, buf, sizeof(buf), 0);  // drain request line; path ignored
     int64_t last = g_last_reconcile_ms.load();
     int64_t age = last ? (now_ms() - last) / 1000 : -1;
-    std::string body = "{\"status\":\"ok\",\"passes\":" +
+    // A wedged reconcile loop must FAIL the probe or kubelet can never
+    // restart us: the loop sleeps at most max_interval between passes,
+    // so an age several multiples beyond that (plus API slack) means
+    // it is stuck, not idle.
+    int64_t stale_after = 3 * static_cast<int64_t>(max_interval_sec) + 60;
+    bool healthy = g_passes.load() == 0 || (age >= 0 && age < stale_after);
+    std::string body = std::string("{\"status\":\"") +
+                       (healthy ? "ok" : "stale") + "\",\"passes\":" +
                        std::to_string(g_passes.load()) +
                        ",\"last_reconcile_age_sec\":" +
                        std::to_string(age) + "}";
     std::string resp =
-        "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+        std::string(healthy ? "HTTP/1.1 200 OK"
+                            : "HTTP/1.1 503 Service Unavailable") +
+        "\r\nContent-Type: application/json\r\n"
         "Content-Length: " + std::to_string(body.size()) +
         "\r\nConnection: close\r\n\r\n" + body;
     ::send(c, resp.data(), resp.size(), MSG_NOSIGNAL);
@@ -1051,7 +1060,8 @@ int main(int argc, char** argv) {
 
   std::thread health;
   if (!cfg.once && cfg.health_port > 0) {
-    health = std::thread(serve_health, cfg.health_port);
+    health = std::thread(serve_health, cfg.health_port,
+                         cfg.max_interval_sec);
     health.detach();
   }
 

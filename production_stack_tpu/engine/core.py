@@ -144,6 +144,7 @@ class EngineCore:
         self._multi_decode_fns: Dict[int, Callable] = {}
         self._embed_fns: Dict[int, Callable] = {}
         self._write_block_fn = self._make_write_block()
+        self._write_blocks_fn = self._make_write_blocks()
 
         # -- LoRA slot registry -------------------------------------------
         self.lora_slots: Dict[str, int] = {}  # adapter name -> slot (1-based)
@@ -408,6 +409,21 @@ class EngineCore:
 
         return write_block
 
+    def _make_write_blocks(self):
+        """Jitted BATCHED page write: all transferred blocks land in one
+        dispatch (k/v are [L, N, bs, KVH, D], bids [N]) — the disagg
+        receive path's scatter; per-block writes would cost one dispatch
+        per page."""
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def write_blocks(kv, bids, k, v):
+            k_pages, v_pages = kv
+            k_pages = k_pages.at[:, bids].set(k.astype(k_pages.dtype))
+            v_pages = v_pages.at[:, bids].set(v.astype(v_pages.dtype))
+            return k_pages, v_pages
+
+        return write_blocks
+
     # -- KV offload / transfer helpers ------------------------------------
     def _offload_block(self, prefix_hash: int, bid: int) -> None:
         """Allocator eviction hook: queue a cached block for spill to host
@@ -483,33 +499,178 @@ class EngineCore:
             "v": v,
         }
 
-    def inject_kv(self, hashes: List[int], k_blocks, v_blocks) -> int:
-        """Install transferred KV blocks as cached (cold) prefix pages
-        (disaggregated-prefill receiver side). Returns #blocks installed."""
+    def extract_kv_device(self, token_ids: List[int], adapter: str = ""):
+        """Device-side variant of :meth:`extract_kv` for the transfer-pipe
+        handoff: the gathered prefix pages STAY on device ([L, N, bs, KVH,
+        D] arrays the KV device pipe offers for a peer pull) — no
+        device_get, no host copy. Returns dict or None."""
+        from production_stack_tpu.engine.kvcache import BlockAllocator
+
+        bs = self.config.block_size
         alloc = self.kv_mgr.allocator
-        injected = 0
+        parent = self.kv_mgr.chain_root(adapter)
+        hashes: List[int] = []
+        bids: List[int] = []
+        with self._step_lock:
+            if self.kv is None:
+                return None
+            with self._lock:
+                i = 0
+                while i + bs <= len(token_ids):
+                    h = BlockAllocator.chain_hash(
+                        parent, tuple(token_ids[i : i + bs])
+                    )
+                    bid = alloc.prefix_map.get(h)
+                    if bid is None:
+                        break
+                    hashes.append(h)
+                    bids.append(bid)
+                    parent = h
+                    i += bs
+            if not hashes:
+                return None
+            k_pages, v_pages = self.kv
+            idx = jnp.asarray(bids)
+            # Dispatched under _step_lock so the gather reads self.kv
+            # before any later engine step donates the buffer.
+            k = k_pages[:, idx]
+            v = v_pages[:, idx]
+        return {
+            "hashes": hashes,
+            "num_tokens": len(hashes) * bs,
+            "k": k,  # [L, N, bs, KVH, D] device array
+            "v": v,
+        }
+
+    def inject_kv_blocks(self, hashes: List[int], k, v) -> int:
+        """Install transferred KV pages ([L, N, bs, KVH, D] — device
+        arrays from the pipe or numpy from the HTTP relay) as cached
+        (cold) prefix pages in ONE batched scatter dispatch. Returns
+        #blocks installed (cache-hit blocks count as installed)."""
+        alloc = self.kv_mgr.allocator
         with self._step_lock:
             if self.kv is None or not alloc.enable_prefix_caching:
                 return 0
-            for h, k_b, v_b in zip(hashes, k_blocks, v_blocks):
-                with self._lock:
+            fresh_idx: List[int] = []   # positions in the payload to write
+            fresh_bids: List[int] = []
+            already = 0
+            with self._lock:
+                for n, h in enumerate(hashes):
                     if h in alloc.prefix_map:
-                        injected += 1
+                        already += 1
                         continue
                     bid = alloc.allocate()
-                if bid is None:
-                    break
-                # Spill anything evicted by the allocate before its pages
-                # are overwritten below.
-                self._drain_offload()
-                self.kv = self._write_block_fn(
-                    self.kv, bid, np.asarray(k_b), np.asarray(v_b)
-                )
+                    if bid is None:
+                        break
+                    fresh_idx.append(n)
+                    fresh_bids.append(bid)
+            # Spill anything evicted by the allocations before their pages
+            # are overwritten below.
+            self._drain_offload()
+            if fresh_bids:
+                try:
+                    k_arr = jnp.asarray(k)
+                    v_arr = jnp.asarray(v)
+                    take = np.asarray(fresh_idx)
+                    self.kv = self._write_blocks_fn(
+                        self.kv, np.asarray(fresh_bids, np.int32),
+                        k_arr[:, take], v_arr[:, take],
+                    )
+                except Exception:
+                    # Bad payload shape/dtype: give the blocks back
+                    # instead of leaking them from the pool.
+                    with self._lock:
+                        for bid in fresh_bids:
+                            alloc.release(bid)
+                    raise
                 with self._lock:
-                    alloc.register_full_block(bid, h)
-                    alloc.release(bid)  # cached, ref_count 0
-                injected += 1
-        return injected
+                    for n, bid in zip(fresh_idx, fresh_bids):
+                        alloc.register_full_block(bid, hashes[n])
+                        alloc.release(bid)  # cached, ref_count 0
+        return already + len(fresh_bids)
+
+    def inject_from_core(self, src: "EngineCore",
+                         token_ids: List[int], adapter: str = "") -> int:
+        """Same-device KV handoff: move the cached prefix pages of
+        ``token_ids`` from another engine core's pool into this one's with
+        ONE jitted HBM->HBM gather/scatter — no host transit at all. This
+        is the fast path when prefill and decode engines share a chip or
+        process (co-located multi-model pods; the dev-bench disagg
+        topology); cross-host moves go through the transfer pipe or the
+        TKV2 relay. Returns #blocks installed."""
+        from production_stack_tpu.engine.kvcache import BlockAllocator
+
+        bs = self.config.block_size
+        src_alloc = src.kv_mgr.allocator
+        # Consistent lock order for opposing concurrent pulls.
+        first, second = ((src, self) if id(src) < id(self) else (self, src))
+        with first._step_lock, second._step_lock:
+            if self.kv is None or src.kv is None:
+                return 0
+            if not self.kv_mgr.allocator.enable_prefix_caching:
+                return 0
+            parent = src.kv_mgr.chain_root(adapter)
+            hashes: List[int] = []
+            src_bids: List[int] = []
+            with src._lock:
+                i = 0
+                while i + bs <= len(token_ids):
+                    h = BlockAllocator.chain_hash(
+                        parent, tuple(token_ids[i : i + bs]))
+                    bid = src_alloc.prefix_map.get(h)
+                    if bid is None:
+                        break
+                    hashes.append(h)
+                    src_bids.append(bid)
+                    parent = h
+                    i += bs
+            if not hashes:
+                return 0
+            dst_alloc = self.kv_mgr.allocator
+            take_idx: List[int] = []
+            dst_bids: List[int] = []
+            already = 0
+            with self._lock:
+                for n, h in enumerate(hashes):
+                    if h in dst_alloc.prefix_map:
+                        already += 1
+                        continue
+                    bid = dst_alloc.allocate()
+                    if bid is None:
+                        break
+                    take_idx.append(n)
+                    dst_bids.append(bid)
+            self._drain_offload()
+            if dst_bids:
+                try:
+                    src_k, src_v = src.kv
+                    sel = np.asarray(
+                        [src_bids[n] for n in take_idx], np.int32)
+                    self.kv = self._write_blocks_fn(
+                        self.kv, np.asarray(dst_bids, np.int32),
+                        src_k[:, sel], src_v[:, sel],
+                    )
+                except Exception:
+                    with self._lock:
+                        for bid in dst_bids:
+                            dst_alloc.release(bid)
+                    raise
+                with self._lock:
+                    for n, bid in zip(take_idx, dst_bids):
+                        dst_alloc.register_full_block(bid, hashes[n])
+                        dst_alloc.release(bid)  # cached, ref_count 0
+        return already + len(dst_bids)
+
+    def inject_kv(self, hashes: List[int], k_blocks, v_blocks) -> int:
+        """Back-compat wrapper over :meth:`inject_kv_blocks` for payloads
+        shaped [N, L, bs, KVH, D] (per-block lists / the TKV2 wire layout).
+        The [N, L] -> [L, N] transpose happens on device inside the jit."""
+        if not hashes:
+            return 0
+        k = np.asarray(k_blocks)
+        v = np.asarray(v_blocks)
+        return self.inject_kv_blocks(
+            list(hashes), k.swapaxes(0, 1), v.swapaxes(0, 1))
 
     # ------------------------------------------------------------------ #
     # public API (thread-safe)

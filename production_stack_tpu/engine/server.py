@@ -93,6 +93,12 @@ class EngineServer:
         self.kv_transfer_rx_bytes = 0
         self.kv_transfer_rx_seconds = 0.0
         self.kv_transfer_pulls = 0
+        # Device-pipe (jax.experimental.transfer) counters + lazy server.
+        self.kv_transfer_device_pulls = 0
+        self.kv_transfer_device_bytes = 0
+        self.kv_transfer_device_seconds = 0.0
+        self._device_pipe = None
+        self._device_pipe_failed = False
 
     async def start_kv_reporting(self, own_url: str) -> None:
         """Register with the router's KV controller (retried lazily on
@@ -172,6 +178,8 @@ class EngineServer:
         r.add_post("/kv/extract", self.handle_kv_extract)
         r.add_post("/kv/inject", self.handle_kv_inject)
         r.add_post("/kv/pull", self.handle_kv_pull)
+        r.add_post("/kv/prepare_pull", self.handle_kv_prepare_pull)
+        r.add_post("/kv/release", self.handle_kv_release)
         r.add_post("/v1/audio/transcriptions", self.handle_transcriptions)
         app["engine_server"] = self
         return app
@@ -723,10 +731,182 @@ class EngineServer:
             {"status": "ok", "injected_blocks": injected,
              "num_tokens": payload["num_tokens"]})
 
+    # Engines served from THIS process, keyed by bound port (registered by
+    # run_engine_server): same-device KV moves skip the host entirely.
+    _local_peers: "dict[str, EngineServer]" = {}
+
+    def _resolve_local_peer(self, source_url: str) -> "EngineServer | None":
+        from urllib.parse import urlparse
+
+        parsed = urlparse(source_url)
+        if parsed.hostname not in ("127.0.0.1", "localhost", "::1"):
+            return None
+        peer = EngineServer._local_peers.get(str(parsed.port))
+        if peer is None or peer is self:
+            return None
+        # Page layout must match for an HBM->HBM move, and the peer must
+        # still be live (a stopped core's cache is frozen/stale).
+        if (peer.core.model_config != self.core.model_config
+                or peer.core.config.block_size
+                != self.core.config.block_size
+                or not peer.core._running or peer.core.kv is None):
+            return None
+        return peer
+
+    def _get_device_pipe(self):
+        """Lazy KV device pipe (jax.experimental.transfer). None when the
+        backend's transfer runtime is unavailable — callers fall back to
+        the TKV2 HTTP relay."""
+        if self._device_pipe is not None or self._device_pipe_failed:
+            return self._device_pipe
+        from production_stack_tpu.kv.device_pipe import (
+            KVDevicePipe,
+            device_pipe_available,
+        )
+
+        try:
+            if device_pipe_available():
+                self._device_pipe = KVDevicePipe()
+            else:
+                self._device_pipe_failed = True
+        except Exception as e:  # noqa: BLE001
+            logger.warning("KV device pipe init failed: %s", e)
+            self._device_pipe_failed = True
+        return self._device_pipe
+
+    async def handle_kv_prepare_pull(
+            self, request: web.Request) -> web.Response:
+        """Sender side of the device-to-device disagg handoff: gather the
+        prompt's cached prefix pages ON DEVICE and park them for the
+        decode engine to pull over the transfer runtime (the NIXL-pipe
+        equivalent; ref helm/templates/deployment-vllm-multi.yaml:267-305).
+        501 when the backend has no transfer runtime (caller falls back to
+        /kv/extract)."""
+        pipe = await asyncio.get_running_loop().run_in_executor(
+            None, self._get_device_pipe)
+        if pipe is None:
+            return web.json_response(
+                {"error": "device pipe unavailable on this backend"},
+                status=501)
+        body = await request.json()
+        token_ids = self._tokens_from_body(body)
+        adapter = self._resolve_adapter(body.get("model", "")) or ""
+        payload = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.core.extract_kv_device(token_ids, adapter)
+        )
+        if payload is None:
+            return web.json_response(
+                {"error": "no cached prefix for these tokens"}, status=404)
+        uuid_ = pipe.offer([payload["k"], payload["v"]])
+        k = payload["k"]
+        nbytes = int(k.size * k.dtype.itemsize * 2)
+        self.kv_transfer_tx_bytes += nbytes
+        # Bind address may be wildcard; the puller substitutes the host it
+        # already reaches this engine at.
+        addr = pipe.address()
+        port = addr.rsplit(":", 1)[-1]
+        return web.json_response({
+            "uuid": uuid_,
+            "transfer_port": int(port),
+            "hashes": [int(h) for h in payload["hashes"]],
+            "num_tokens": payload["num_tokens"],
+            "shape": list(k.shape),
+            "dtype": str(k.dtype),
+            "bytes": nbytes,
+        })
+
+    async def handle_kv_release(self, request: web.Request) -> web.Response:
+        """Free a parked prepare_pull offer once the peer's pull is done
+        (fallback: the pipe's TTL pruning)."""
+        body = await request.json()
+        if self._device_pipe is not None and "uuid" in body:
+            self._device_pipe.release(int(body["uuid"]))
+        return web.json_response({"status": "ok"})
+
+    async def _pull_device(self, source: str, token_ids, req_body) -> "dict | None":
+        """Try the device-to-device pull. Returns the /kv/pull response
+        dict, or None to fall back to the HTTP relay."""
+        import aiohttp
+
+        # First use runs the subprocess availability probe — keep it off
+        # the event loop or every other request on this engine stalls.
+        pipe = await asyncio.get_running_loop().run_in_executor(
+            None, self._get_device_pipe)
+        if pipe is None:
+            return None
+        t0 = time.monotonic()
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    source.rstrip("/") + "/kv/prepare_pull",
+                    json={"token_ids": token_ids,
+                          "model": req_body.get("model", "")},
+                    timeout=aiohttp.ClientTimeout(total=30),
+                ) as resp:
+                    if resp.status != 200:
+                        return None
+                    offer = await resp.json()
+        except aiohttp.ClientError:
+            return None
+
+        import jax
+        import jax.numpy as jnp
+        from urllib.parse import urlparse
+
+        host = urlparse(source).hostname
+        address = f"{host}:{offer['transfer_port']}"
+        shape = tuple(offer["shape"])
+        dtype = jnp.dtype(offer["dtype"])
+        sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        specs = [jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+                 for _ in range(2)]
+        pipe = self._device_pipe
+
+        def pull_and_inject():
+            k_dev, v_dev = pipe.pull(address, offer["uuid"], specs)
+            return self.core.inject_kv_blocks(
+                [int(h) for h in offer["hashes"]], k_dev, v_dev)
+
+        try:
+            injected = await asyncio.get_running_loop().run_in_executor(
+                None, pull_and_inject)
+        except Exception as e:  # noqa: BLE001 - peer/transport error
+            logger.warning("device pull failed, falling back: %s", e)
+            return None
+        # Tell the sender its parked device buffers can be freed now
+        # (otherwise they stay pinned in HBM until the offer TTL).
+        try:
+            async with aiohttp.ClientSession() as session:
+                await session.post(
+                    source.rstrip("/") + "/kv/release",
+                    json={"uuid": offer["uuid"]},
+                    timeout=aiohttp.ClientTimeout(total=5))
+        except aiohttp.ClientError:
+            pass  # TTL pruning covers it
+        total = time.monotonic() - t0
+        nbytes = int(offer.get("bytes", 0))
+        self.kv_transfer_device_pulls += 1
+        self.kv_transfer_device_bytes += nbytes
+        self.kv_transfer_device_seconds += total
+        self.kv_transfer_pulls += 1
+        return {
+            "status": "ok", "injected_blocks": injected,
+            "num_tokens": offer["num_tokens"],
+            "transfer": {
+                "path": "device",
+                "bytes": nbytes,
+                "total_seconds": round(total, 6),
+                "gigabytes_per_second": round(
+                    nbytes / max(total, 1e-9) / 1e9, 6),
+            }}
+
     async def handle_kv_pull(self, request: web.Request) -> web.Response:
         """Pull the KV for a prompt from another engine and install it —
         the decode-side step of disaggregated prefill. Data moves engine to
-        engine; the router only sends this control message."""
+        engine; the router only sends this control message. Path
+        negotiation: "device" (transfer runtime, device-to-device) is
+        tried first unless kv_path forces "host"; the TKV2 HTTP relay is
+        the always-available fallback."""
         import aiohttp
 
         from production_stack_tpu.kv.offload import unpack_transfer
@@ -738,6 +918,51 @@ class EngineServer:
                 {"error": "source_url required"}, status=400)
         req_body = body.get("request", body)
         token_ids = self._tokens_from_body(req_body)
+        kv_path = body.get("kv_path", "auto")
+        if kv_path == "auto":
+            # Fastest rung: the source engine shares this chip/process
+            # (co-located multi-model pods, dev-bench disagg) -> one
+            # HBM->HBM page move, no host transit. ("device" forces the
+            # transfer pipe; "host" forces the TKV2 relay.)
+            peer = self._resolve_local_peer(source)
+            if peer is not None:
+                t0 = time.monotonic()
+                adapter = self._resolve_adapter(
+                    req_body.get("model", "")) or ""
+                try:
+                    injected = await (
+                        asyncio.get_running_loop().run_in_executor(
+                            None, lambda: self.core.inject_from_core(
+                                peer.core, token_ids, adapter)))
+                except Exception as e:  # noqa: BLE001 - fall to next rung
+                    logger.warning(
+                        "local-device pull failed, falling back: %s", e)
+                    injected = 0
+                if injected > 0:
+                    total = time.monotonic() - t0
+                    bs = self.core.config.block_size
+                    nbytes = injected * self.core._kv_bytes_per_block()
+                    self.kv_transfer_device_pulls += 1
+                    self.kv_transfer_device_bytes += nbytes
+                    self.kv_transfer_device_seconds += total
+                    self.kv_transfer_pulls += 1
+                    return web.json_response({
+                        "status": "ok", "injected_blocks": injected,
+                        "num_tokens": injected * bs,
+                        "transfer": {
+                            "path": "local-device",
+                            "bytes": nbytes,
+                            "total_seconds": round(total, 6),
+                            "gigabytes_per_second": round(
+                                nbytes / max(total, 1e-9) / 1e9, 6),
+                        }})
+        if kv_path in ("auto", "device"):
+            result = await self._pull_device(source, token_ids, req_body)
+            if result is not None:
+                return web.json_response(result)
+            if kv_path == "device":
+                return web.json_response(
+                    {"error": "device path unavailable"}, status=501)
         t0 = time.monotonic()
         try:
             async with aiohttp.ClientSession() as session:
@@ -771,6 +996,7 @@ class EngineServer:
             {"status": "ok", "injected_blocks": injected,
              "num_tokens": payload["num_tokens"],
              "transfer": {
+                 "path": "host",
                  "bytes": len(data),
                  # fetch covers the donor's extract (device_get + pack) plus
                  # the HTTP transfer; total adds the local inject. This is
@@ -824,6 +1050,15 @@ class EngineServer:
             f"tpu:kv_transfer_rx_seconds_total{{{labels}}} {self.kv_transfer_rx_seconds:.6f}",
             "# TYPE tpu:kv_transfer_pulls counter",
             f"tpu:kv_transfer_pulls_total{{{labels}}} {self.kv_transfer_pulls}",
+            "# TYPE tpu:kv_transfer_device_pulls counter",
+            f"tpu:kv_transfer_device_pulls_total{{{labels}}} "
+            f"{self.kv_transfer_device_pulls}",
+            "# TYPE tpu:kv_transfer_device_bytes counter",
+            f"tpu:kv_transfer_device_bytes_total{{{labels}}} "
+            f"{self.kv_transfer_device_bytes}",
+            "# TYPE tpu:kv_transfer_device_seconds counter",
+            f"tpu:kv_transfer_device_seconds_total{{{labels}}} "
+            f"{self.kv_transfer_device_seconds:.6f}",
         ]
         if s.get("offload"):
             off = s["offload"]
@@ -842,11 +1077,24 @@ class EngineServer:
 
 
 async def run_engine_server(server: EngineServer, host: str, port: int) -> web.AppRunner:
-    runner = web.AppRunner(server.make_app())
+    app = server.make_app()
+    bound_port: "list[int]" = []
+
+    async def _unregister(app):
+        # Drop the local-peer registration so a recycled port can never
+        # resolve to this (stopped) server's frozen KV cache.
+        if bound_port and EngineServer._local_peers.get(
+                str(bound_port[0])) is server:
+            del EngineServer._local_peers[str(bound_port[0])]
+
+    app.on_cleanup.append(_unregister)  # before setup(): hooks freeze then
+    runner = web.AppRunner(app)
     await runner.setup()
     site = web.TCPSite(runner, host, port)
     await site.start()
     real_port = site._server.sockets[0].getsockname()[1]
+    bound_port.append(real_port)
+    EngineServer._local_peers[str(real_port)] = server
     await server.start_kv_reporting(f"http://{host}:{real_port}")
     logger.info("Engine server on %s:%d (model=%s)", host, real_port,
                 server.config.model)

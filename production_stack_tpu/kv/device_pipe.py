@@ -1,0 +1,130 @@
+"""Device-to-device KV pipe over ``jax.experimental.transfer`` (DCN).
+
+The reference moves disaggregated-prefill KV device-to-device through a
+NIXL/UCX side channel wired into its engine pods
+(``helm/templates/deployment-vllm-multi.yaml:267-305``,
+``examples/disaggregated_prefill/pd.yaml``). This is the TPU-native
+equivalent: each engine process runs a ``TransferServer`` bound to its
+PJRT client, the prefill side parks the gathered KV pages as *device*
+arrays awaiting pull, and the decode side pulls them straight into its own
+device memory over the transfer runtime — no host staging, no HTTP body.
+
+Availability: the transfer runtime needs
+``PJRT_Client_CreateBuffersForAsyncHostToDevice`` from the backend plugin.
+Standard TPU-VM libtpu has it; some dev runtimes (CPU emulation, tunneled
+chips) do not — and a failed pull can fatally abort the *process* (a CHECK
+in the bulk-transport layer), so availability is probed in a THROWAWAY
+SUBPROCESS once and cached. When unavailable, callers fall back to the
+zero-copy TKV2 HTTP relay (:mod:`production_stack_tpu.kv.offload`).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+_PROBE_CODE = r"""
+import jax, jax.numpy as jnp
+from jax.experimental import transfer
+client = jax.devices()[0].client
+s1 = transfer.start_transfer_server(client, "127.0.0.1:0")
+s2 = transfer.start_transfer_server(client, "127.0.0.1:0")
+x = jnp.arange(2048, dtype=jnp.bfloat16).reshape(2, 32, 32)
+s1.await_pull(1, [x])
+conn = s2.connect(s1.address())
+spec = jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+out = conn.pull(1, [spec])
+assert bool(jnp.all(out[0] == x))
+print("DEVICE_PIPE_OK")
+"""
+
+_probe_result: Optional[bool] = None
+_probe_lock = threading.Lock()
+
+
+def device_pipe_available(timeout: float = 120.0) -> bool:
+    """True when the transfer runtime round-trips on this backend.
+
+    Probed in a subprocess (a failing pull can fatally abort the process,
+    not just raise) and cached for the engine's lifetime. Overridable with
+    ``TPU_STACK_KV_DEVICE_PIPE=0|1`` (1 skips the probe — trusted envs)."""
+    global _probe_result
+    override = os.environ.get("TPU_STACK_KV_DEVICE_PIPE")
+    if override is not None:
+        return override not in ("0", "false", "off")
+    with _probe_lock:
+        if _probe_result is None:
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-c", _PROBE_CODE],
+                    capture_output=True, timeout=timeout,
+                )
+                _probe_result = b"DEVICE_PIPE_OK" in proc.stdout
+            except Exception:  # noqa: BLE001 - treat as unavailable
+                _probe_result = False
+            logger.info("KV device pipe %s",
+                        "available" if _probe_result else
+                        "unavailable (falling back to HTTP relay)")
+        return _probe_result
+
+
+class KVDevicePipe:
+    """One per engine process: offers extracted KV pages for pull and
+    pulls offered pages from peers, all as device arrays."""
+
+    # Offers not pulled within this window are dropped (the decode side
+    # re-requests through the HTTP fallback on miss).
+    OFFER_TTL_SEC = 120.0
+
+    def __init__(self, listen: str = "0.0.0.0:0"):
+        import jax
+        from jax.experimental import transfer
+
+        self._transfer = transfer
+        self._server = transfer.start_transfer_server(
+            jax.devices()[0].client, listen)
+        self._uuid = itertools.count(int(time.time() * 1000) % (1 << 30))
+        # uuid -> (arrays, deadline): keeps device buffers alive until
+        # pulled or expired.
+        self._pending: Dict[int, Tuple[Any, float]] = {}
+        self._conns: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def address(self) -> str:
+        return self._server.address()
+
+    def offer(self, arrays: List[Any]) -> int:
+        """Park device arrays for a peer to pull; returns the pull uuid."""
+        uuid = next(self._uuid)
+        now = time.monotonic()
+        with self._lock:
+            self._pending = {
+                u: (a, dl) for u, (a, dl) in self._pending.items()
+                if dl > now
+            }
+            self._pending[uuid] = (arrays, now + self.OFFER_TTL_SEC)
+        self._server.await_pull(uuid, arrays)
+        return uuid
+
+    def release(self, uuid: int) -> None:
+        with self._lock:
+            self._pending.pop(uuid, None)
+
+    def pull(self, address: str, uuid: int, specs: List[Any]) -> List[Any]:
+        """Pull device arrays matching ``specs`` (ShapeDtypeStructs with
+        shardings) from the peer transfer server at ``address``."""
+        with self._lock:
+            conn = self._conns.get(address)
+            if conn is None:
+                conn = self._server.connect(address)
+                self._conns[address] = conn
+        return conn.pull(uuid, specs)

@@ -47,3 +47,8 @@ def pytest_pyfunc_call(pyfuncitem):
         asyncio.run(func(**kwargs))
         return True
     return None
+
+# The KV device pipe (jax.experimental.transfer) probes availability in a
+# subprocess on first use; tests run against the HTTP relay by default and
+# exercise the device path through a fake pipe (test_kv_device_pipe).
+os.environ.setdefault("TPU_STACK_KV_DEVICE_PIPE", "0")
