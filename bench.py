@@ -63,6 +63,21 @@ _CONFIGS = {
                 answer_tokens=100, sys_prompt_tokens=400,
                 history_tokens=400, max_model_len=2048,
                 max_num_seqs=16),
+    # BASELINE config 3: prefix/KV-aware routing + host-RAM KV offload
+    # (the LMCache CPU-offload topology, values-07/09 equivalent).
+    "kvaware": dict(model="tpu-llama-1b", users=15, rounds=10,
+                    answer_tokens=100, sys_prompt_tokens=1000,
+                    history_tokens=2000, max_model_len=8192,
+                    max_num_seqs=16, routing="kvaware",
+                    kv_offload_gb=4.0),
+    # BASELINE config 4 at dev-chip scale: two engines (prefill + decode
+    # units) behind the two-phase disaggregated-prefill flow; the KV
+    # handoff rides the /kv/pull path negotiation.
+    "disagg": dict(model="tpu-llama-1b", users=15, rounds=6,
+                   answer_tokens=100, sys_prompt_tokens=1000,
+                   history_tokens=2000, max_model_len=8192,
+                   max_num_seqs=16, routing="disaggregated_prefill",
+                   engines=2, num_blocks=800),
 }
 
 CONFIG_KEY = os.environ.get("BENCH_CONFIG", "flagship")
@@ -254,37 +269,70 @@ async def _main() -> dict:
     from production_stack_tpu.router.app import build_app
     from production_stack_tpu.router.parser import build_parser
 
+    routing = _cfg.get("routing", "session")
+    n_engines = int(_cfg.get("engines", 1))
     config = EngineConfig(
         model=MODEL,
         max_model_len=MAX_MODEL_LEN,
         max_num_seqs=MAX_NUM_SEQS,
         max_loras=0,
         decode_steps=_env_int("BENCH_DECODE_STEPS", 16),
+        kv_offload_bytes=int(
+            float(_cfg.get("kv_offload_gb", 0)) * 1e9),
+        # Multi-engine configs size pools explicitly: the capacity
+        # fallback can't see the sibling engine's HBM footprint.
+        num_blocks=_cfg.get("num_blocks"),
     )
-    server = EngineServer(config, warmup=True)
-    engine_runner = await run_engine_server(server, "127.0.0.1", 0)
-    engine_port = (
-        list(engine_runner.sites)[0]._server.sockets[0].getsockname()[1]
-    )
-    engine_url = f"http://127.0.0.1:{engine_port}"
+    servers = [EngineServer(config, warmup=True) for _ in range(n_engines)]
+    runners, engine_urls = [], []
+    for server in servers:
+        runner = await run_engine_server(server, "127.0.0.1", 0)
+        port = list(runner.sites)[0]._server.sockets[0].getsockname()[1]
+        runners.append(runner)
+        engine_urls.append(f"http://127.0.0.1:{port}")
 
     args = build_parser().parse_args([])
-    args.static_backends = engine_url
-    args.static_models = MODEL
-    args.routing_logic = "session"
+    args.static_backends = ",".join(engine_urls)
+    args.static_models = ",".join([MODEL] * n_engines)
+    args.routing_logic = routing
     args.session_key = "x-user-id"
     args.engine_stats_interval = 5
+    if routing == "disaggregated_prefill":
+        args.static_model_labels = "prefill-unit,decode-unit"
+        args.prefill_model_labels = "prefill-unit"
+        args.decode_model_labels = "decode-unit"
     router_app = build_app(args)
     router_runner, router_url = await _start_site(router_app)
+    if routing == "kvaware":
+        # Engines report prefix admissions to the router's KV controller
+        # (registration is lazy, so wiring after router start is fine).
+        for server, url in zip(servers, engine_urls):
+            server.kv_controller_url = router_url
+            server.advertise_url = url
 
     try:
         (tokens, elapsed, ttfts, latencies, failures, rounds_done,
          prompt_tokens) = await _drive(router_url)
-        core_stats = server.core.stats()
+        core_stats = servers[0].core.stats()
+        if n_engines > 1:
+            # Aggregate across units: the prefill engine does the real
+            # prefill compute, the decode unit's injected-KV prompts count
+            # as cached — only the sum is an honest pair-level hit rate.
+            for server in servers[1:]:
+                s = server.core.stats()
+                for key in ("prompt_tokens_total", "cached_tokens_total",
+                            "generation_tokens_total", "prefix_cache_hits",
+                            "prefix_cache_queries", "num_preempted_total",
+                            "prefill_time_total", "decode_time_total",
+                            "flush_time_total", "prefill_count",
+                            "decode_burst_count"):
+                    core_stats[key] += s[key]
     finally:
         await router_runner.cleanup()
-        await engine_runner.cleanup()
-        server.core.stop()
+        for runner in runners:
+            await runner.cleanup()
+        for server in servers:
+            server.core.stop()
 
     tok_s = tokens / elapsed if elapsed > 0 else 0.0
     result = {

@@ -1,0 +1,22 @@
+#!/bin/bash
+# Tear down the EKS deployment from entry_point.sh.
+# Usage: ./clean_up.sh [CLUSTER_NAME]
+set -uo pipefail
+
+CLUSTER_NAME="${1:-${CLUSTER_NAME:-production-stack-tpu}}"
+REGION="${REGION:-us-east-2}"
+RELEASE="${RELEASE:-tpu-stack}"
+
+helm uninstall "$RELEASE" 2>/dev/null || true
+kubectl delete -f "$(dirname "$0")/../../deploy/operator/operator.yaml" \
+  --ignore-not-found 2>/dev/null || true
+# Delete LoadBalancer services first so their ELBs (billed, and they block
+# VPC deletion) are released before the cluster goes away.
+kubectl get svc --all-namespaces \
+  -o jsonpath='{range .items[?(@.spec.type=="LoadBalancer")]}{.metadata.namespace}{" "}{.metadata.name}{"\n"}{end}' 2>/dev/null |
+while read -r ns name; do
+  [ -n "$name" ] && kubectl delete svc -n "$ns" "$name"
+done
+
+eksctl delete cluster --name "$CLUSTER_NAME" --region "$REGION"
+echo ">>> EKS cleanup of $CLUSTER_NAME complete."

@@ -1,0 +1,50 @@
+#!/bin/bash
+# Deploy the production-stack-tpu CONTROL PLANE + CPU engines on EKS.
+#
+# TPUs are a Google Cloud accelerator, so the data plane (TPU engine
+# pods) cannot run on AWS; this recipe mirrors the reference's AWS story
+# (deployment_on_cloud/aws/entry_point.sh) at its CPU-demo scope: an EKS
+# cluster serving the router + opt-class CPU engines, the topology used
+# for functional testing and as the front tier for cross-cloud routing to
+# GKE TPU engines (static service discovery with the GKE router URL).
+#
+# Usage: ./entry_point.sh <VALUES_YAML>   # e.g. values-eks-cpu.yaml
+# Env: CLUSTER_NAME (production-stack-tpu), REGION (us-east-2),
+#      NODE_TYPE (m6a.2xlarge), NODES (2), RELEASE (tpu-stack)
+set -euo pipefail
+
+CLUSTER_NAME="${CLUSTER_NAME:-production-stack-tpu}"
+REGION="${REGION:-us-east-2}"
+NODE_TYPE="${NODE_TYPE:-m6a.2xlarge}"
+NODES="${NODES:-2}"
+RELEASE="${RELEASE:-tpu-stack}"
+
+if [ "$#" -ne 1 ]; then
+  echo "Usage: $0 <VALUES_YAML>" >&2
+  exit 1
+fi
+VALUES_YAML=$1
+SCRIPT_DIR="$(cd "$(dirname "$0")" && pwd)"
+REPO_ROOT="$SCRIPT_DIR/../.."
+
+command -v eksctl >/dev/null || {
+  echo "eksctl required: https://eksctl.io" >&2; exit 1; }
+
+echo ">>> Creating EKS cluster $CLUSTER_NAME in $REGION"
+eksctl create cluster \
+  --name "$CLUSTER_NAME" \
+  --region "$REGION" \
+  --node-type "$NODE_TYPE" \
+  --nodes "$NODES" \
+  --managed
+
+echo ">>> Installing CRDs + operator"
+kubectl apply -f "$REPO_ROOT/deploy/crds/production-stack.tpu_crds.yaml"
+kubectl create namespace production-stack --dry-run=client -o yaml | kubectl apply -f -
+kubectl apply -f "$REPO_ROOT/deploy/operator/operator.yaml"
+
+echo ">>> Installing helm chart ($RELEASE) with $VALUES_YAML"
+helm upgrade --install "$RELEASE" "$REPO_ROOT/helm" -f "$VALUES_YAML"
+
+echo ">>> Done."
+echo "Port-forward: kubectl port-forward svc/${RELEASE}-router-service 30080:80"

@@ -1,0 +1,12 @@
+#!/bin/bash
+# Tear down the AKS deployment from entry_point.sh (deletes the whole
+# resource group, which removes the cluster, LBs, and disks).
+# Usage: ./clean_up.sh [RESOURCE_GROUP]
+set -uo pipefail
+
+RESOURCE_GROUP="${1:-${RESOURCE_GROUP:-tpu-stack-rg}}"
+RELEASE="${RELEASE:-tpu-stack}"
+
+helm uninstall "$RELEASE" 2>/dev/null || true
+az group delete --name "$RESOURCE_GROUP" --yes --no-wait
+echo ">>> Resource group $RESOURCE_GROUP deletion started (async)."

@@ -1,0 +1,49 @@
+#!/bin/bash
+# Deploy the production-stack-tpu control plane + CPU engines on AKS
+# (reference counterpart: deployment_on_cloud/azure/entry_point.sh).
+# TPUs are Google-Cloud-only; see ../gcp for the TPU data plane and
+# ../aws/README.md for the cross-cloud front-tier pattern.
+#
+# Usage: ./entry_point.sh <VALUES_YAML>
+# Env: CLUSTER_NAME (production-stack-tpu), RESOURCE_GROUP (tpu-stack-rg),
+#      LOCATION (eastus2), NODE_TYPE (Standard_D8as_v5), NODES (2),
+#      RELEASE (tpu-stack)
+set -euo pipefail
+
+CLUSTER_NAME="${CLUSTER_NAME:-production-stack-tpu}"
+RESOURCE_GROUP="${RESOURCE_GROUP:-tpu-stack-rg}"
+LOCATION="${LOCATION:-eastus2}"
+NODE_TYPE="${NODE_TYPE:-Standard_D8as_v5}"
+NODES="${NODES:-2}"
+RELEASE="${RELEASE:-tpu-stack}"
+
+if [ "$#" -ne 1 ]; then
+  echo "Usage: $0 <VALUES_YAML>" >&2
+  exit 1
+fi
+VALUES_YAML=$1
+SCRIPT_DIR="$(cd "$(dirname "$0")" && pwd)"
+REPO_ROOT="$SCRIPT_DIR/../.."
+
+echo ">>> Creating resource group + AKS cluster"
+az group create --name "$RESOURCE_GROUP" --location "$LOCATION"
+az aks create \
+  --resource-group "$RESOURCE_GROUP" \
+  --name "$CLUSTER_NAME" \
+  --node-count "$NODES" \
+  --node-vm-size "$NODE_TYPE" \
+  --generate-ssh-keys
+
+az aks get-credentials --resource-group "$RESOURCE_GROUP" \
+  --name "$CLUSTER_NAME" --overwrite-existing
+
+echo ">>> Installing CRDs + operator"
+kubectl apply -f "$REPO_ROOT/deploy/crds/production-stack.tpu_crds.yaml"
+kubectl create namespace production-stack --dry-run=client -o yaml | kubectl apply -f -
+kubectl apply -f "$REPO_ROOT/deploy/operator/operator.yaml"
+
+echo ">>> Installing helm chart ($RELEASE) with $VALUES_YAML"
+helm upgrade --install "$RELEASE" "$REPO_ROOT/helm" -f "$VALUES_YAML"
+
+echo ">>> Done."
+echo "Port-forward: kubectl port-forward svc/${RELEASE}-router-service 30080:80"

@@ -1,0 +1,53 @@
+#!/bin/bash
+# Tear down the GKE deployment created by entry_point.sh: helm release,
+# workloads, TPU node pool, cluster, and leftover disks (reference
+# counterpart: deployment_on_cloud/gcp/clean_up_basic.sh).
+#
+# Usage: ./clean_up.sh [CLUSTER_NAME]
+set -uo pipefail
+
+CLUSTER_NAME="${1:-${CLUSTER_NAME:-production-stack-tpu}}"
+RELEASE="${RELEASE:-tpu-stack}"
+ZONE="${ZONE:-$(gcloud container clusters list \
+  --filter="name=$CLUSTER_NAME" --format="value(location)")}"
+
+if [ -z "$ZONE" ]; then
+  echo "Cluster $CLUSTER_NAME not found (nothing to clean)." >&2
+  exit 0
+fi
+
+echo ">>> Cleaning cluster $CLUSTER_NAME in $ZONE"
+STATUS=$(gcloud container clusters describe "$CLUSTER_NAME" --zone "$ZONE" \
+  --format="value(status)" 2>/dev/null)
+
+if [ "$STATUS" == "RUNNING" ]; then
+  gcloud container clusters get-credentials "$CLUSTER_NAME" --zone "$ZONE"
+  echo ">>> Uninstalling helm release + operator"
+  helm uninstall "$RELEASE" 2>/dev/null || true
+  kubectl delete -f "$(dirname "$0")/../../deploy/operator/operator.yaml" \
+    --ignore-not-found 2>/dev/null || true
+  kubectl delete crd -l app.kubernetes.io/part-of=production-stack-tpu \
+    --ignore-not-found 2>/dev/null || true
+  echo ">>> Deleting LoadBalancer services (releases GCP forwarding rules)"
+  kubectl get svc --all-namespaces \
+    -o jsonpath='{range .items[?(@.spec.type=="LoadBalancer")]}{.metadata.namespace}{" "}{.metadata.name}{"\n"}{end}' |
+  while read -r ns name; do
+    [ -n "$name" ] && kubectl delete svc -n "$ns" "$name"
+  done
+  echo ">>> Deleting TPU node pool"
+  gcloud container node-pools delete tpu-pool --cluster "$CLUSTER_NAME" \
+    --zone "$ZONE" --quiet 2>/dev/null || true
+fi
+
+echo ">>> Deleting cluster"
+gcloud container clusters delete "$CLUSTER_NAME" --zone "$ZONE" --quiet
+
+echo ">>> Deleting leftover persistent disks"
+gcloud compute disks list --filter="name~'$CLUSTER_NAME' AND status='READY'" \
+  --format="value(name,zone)" |
+while read -r disk disk_zone; do
+  [ -n "$disk" ] && gcloud compute disks delete "$disk" \
+    --zone "$disk_zone" --quiet
+done
+
+echo ">>> Cleanup of $CLUSTER_NAME complete."

@@ -1,0 +1,83 @@
+#!/bin/bash
+# Provision a GKE cluster with a TPU node pool and install the
+# production-stack-tpu helm chart on it.
+#
+# This is the TPU-first counterpart of the reference's GPU recipe
+# (deployment_on_cloud/gcp/entry_point_basic.sh): instead of GPU
+# autoprovisioning it creates an explicit TPU slice node pool
+# (ct5lp-* machine types, cloud.google.com/gke-tpu-* node labels) that the
+# chart's engine pods target via nodeSelector + google.com/tpu resources.
+#
+# Usage:
+#   ./entry_point.sh <VALUES_YAML>          # e.g. values-gke-tpu.yaml
+#
+# Env knobs (all optional):
+#   CLUSTER_NAME   (production-stack-tpu)
+#   ZONE           (us-central1-a; must offer the chosen TPU type)
+#   TPU_MACHINE    (ct5lp-hightpu-1t)  1 chip/host v5e; 4t/8t for larger hosts
+#   TPU_TOPOLOGY   (1x1)               e.g. 2x4 for a v5e-8 multi-host slice
+#   TPU_NODES      (1)                 hosts in the slice node pool
+#   RELEASE        (tpu-stack)         helm release name
+set -euo pipefail
+
+CLUSTER_NAME="${CLUSTER_NAME:-production-stack-tpu}"
+ZONE="${ZONE:-us-central1-a}"
+TPU_MACHINE="${TPU_MACHINE:-ct5lp-hightpu-1t}"
+TPU_TOPOLOGY="${TPU_TOPOLOGY:-1x1}"
+TPU_NODES="${TPU_NODES:-1}"
+RELEASE="${RELEASE:-tpu-stack}"
+
+GCP_PROJECT=$(gcloud config get-value project 2>/dev/null)
+if [ -z "$GCP_PROJECT" ]; then
+  echo "Error: no GCP project set. Run: gcloud config set project <PROJECT_ID>" >&2
+  exit 1
+fi
+if [ "$#" -ne 1 ]; then
+  echo "Usage: $0 <VALUES_YAML>" >&2
+  exit 1
+fi
+VALUES_YAML=$1
+SCRIPT_DIR="$(cd "$(dirname "$0")" && pwd)"
+REPO_ROOT="$SCRIPT_DIR/../.."
+
+echo ">>> Creating GKE cluster $CLUSTER_NAME in $ZONE (project $GCP_PROJECT)"
+# CPU default pool hosts the router, operator, and cache server.
+gcloud container clusters create "$CLUSTER_NAME" \
+  --project "$GCP_PROJECT" \
+  --zone "$ZONE" \
+  --release-channel "regular" \
+  --machine-type "n2d-standard-8" \
+  --num-nodes "1" \
+  --enable-ip-alias \
+  --enable-autoupgrade --enable-autorepair \
+  --addons HorizontalPodAutoscaling,HttpLoadBalancing,GcePersistentDiskCsiDriver \
+  --enable-managed-prometheus \
+  --enable-shielded-nodes
+
+echo ">>> Creating TPU node pool ($TPU_MACHINE, topology $TPU_TOPOLOGY, $TPU_NODES node(s))"
+# GKE labels TPU nodes with cloud.google.com/gke-tpu-accelerator and
+# gke-tpu-topology; the chart's modelSpec.tpu block selects on exactly
+# these labels and requests google.com/tpu chips.
+gcloud container node-pools create tpu-pool \
+  --project "$GCP_PROJECT" \
+  --cluster "$CLUSTER_NAME" \
+  --zone "$ZONE" \
+  --machine-type "$TPU_MACHINE" \
+  --tpu-topology "$TPU_TOPOLOGY" \
+  --num-nodes "$TPU_NODES" \
+  --enable-autoupgrade --enable-autorepair
+
+echo ">>> Fetching credentials"
+gcloud container clusters get-credentials "$CLUSTER_NAME" --zone "$ZONE"
+
+echo ">>> Installing CRDs + operator"
+kubectl apply -f "$REPO_ROOT/deploy/crds/production-stack.tpu_crds.yaml"
+kubectl create namespace production-stack --dry-run=client -o yaml | kubectl apply -f -
+kubectl apply -f "$REPO_ROOT/deploy/operator/operator.yaml"
+
+echo ">>> Installing helm chart ($RELEASE) with $VALUES_YAML"
+helm upgrade --install "$RELEASE" "$REPO_ROOT/helm" -f "$VALUES_YAML"
+
+echo ">>> Done. Router endpoint:"
+kubectl get svc -l "app.kubernetes.io/name=production-stack-tpu" -o wide || true
+echo "Port-forward: kubectl port-forward svc/${RELEASE}-router-service 30080:80"

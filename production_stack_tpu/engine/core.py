@@ -133,8 +133,25 @@ class EngineCore:
             self.offload = HostKVStore(
                 max(config.kv_offload_bytes, 0), config.kv_remote_url
             )
-            self.kv_mgr.allocator.on_evict = self._offload_block
             self.kv_mgr.external_lookup = self.offload.contains
+        # Eviction fan-out: offload spill (when configured) plus an
+        # external listener (the server's KV-controller evict reporting —
+        # closes the reference's LMCache worker->controller channel).
+        # Fired under the engine locks: listeners must not block.
+        self.prefix_evict_listener: Optional[
+            Callable[[int, int], None]] = None
+
+        def _dispatch_evict(prefix_hash: int, bid: int) -> None:
+            if self.offload is not None:
+                self._offload_block(prefix_hash, bid)
+            listener = self.prefix_evict_listener
+            if listener is not None:
+                try:
+                    listener(prefix_hash, bid)
+                except Exception:  # noqa: BLE001 - never break the allocator
+                    pass
+
+        self.kv_mgr.allocator.on_evict = _dispatch_evict
 
         # -- compiled programs --------------------------------------------
         self._prefill_fn = self._make_forward("prefill")

@@ -31,9 +31,12 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import itertools
 import json
+import threading
 import time
 import uuid
+from collections import OrderedDict
 from typing import List, Optional
 
 from aiohttp import web
@@ -88,6 +91,18 @@ class EngineServer:
         self.instance_id = instance_id or f"engine-{uuid.uuid4().hex[:8]}"
         self.advertise_url = advertise_url
         self._kv_registered = False
+        # Admission registry for eviction reporting: maps this engine's
+        # page chain-hashes back to the controller's text-chunk hashes so
+        # a dropped chain is reported with /kv/evict instead of lingering
+        # as a stale routable claim until the TTL (the exactness gap
+        # PARITY.md used to carry). Bounded; guarded by _adm_lock
+        # (admissions land on the event loop, evictions fire on the
+        # engine thread).
+        self._adm_lock = threading.Lock()
+        self._admissions: "OrderedDict[int, tuple]" = OrderedDict()
+        self._block_admissions: "dict[int, set]" = {}
+        self._adm_counter = itertools.count(1)
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         # Disaggregated-prefill transfer counters (exported via /metrics).
         self.kv_transfer_tx_bytes = 0
         self.kv_transfer_rx_bytes = 0
@@ -102,7 +117,11 @@ class EngineServer:
 
     async def start_kv_reporting(self, own_url: str) -> None:
         """Register with the router's KV controller (retried lazily on
-        each admission until it succeeds)."""
+        each admission until it succeeds) and hook eviction reporting."""
+        self._loop = asyncio.get_running_loop()
+        # Hooked unconditionally (no-ops on an empty registry): the
+        # controller URL can be wired after startup.
+        self.core.prefix_evict_listener = self._on_prefix_evict
         if self.kv_controller_url is None:
             return
         if self.advertise_url is None:
@@ -126,10 +145,119 @@ class EngineServer:
             self._kv_registered = False
         return self._kv_registered
 
-    def _report_kv_admission(self, prompt_text: str) -> None:
+    def _track_admission(self, text: str, ids: List[int],
+                         adapter: str = "") -> None:
+        """Record the mapping between this prompt's page chain-hashes and
+        its controller text-chunk hashes, so evictions can be reported.
+        The char->token alignment is proportional (exact for the byte
+        tokenizer, approximate for BPE — the controller itself is
+        approximate, erring toward over-eviction which only costs a
+        recomputable route)."""
+        from production_stack_tpu.engine.kvcache import BlockAllocator
+        from production_stack_tpu.kv.controller import (
+            CHUNK_SIZE,
+            chunk_hashes,
+        )
+
+        chunks = chunk_hashes(text)
+        n = len(ids)
+        if not chunks or n == 0:
+            return
+        bs = self.core.config.block_size
+        parent = self.core.kv_mgr.chain_root(adapter)
+        ratio = len(text) / n
+        blocks = []
+        i = 0
+        while i + bs <= n:
+            parent = BlockAllocator.chain_hash(parent, tuple(ids[i : i + bs]))
+            chunk_start = min(int(i * ratio) // CHUNK_SIZE, len(chunks) - 1)
+            blocks.append((parent, chunk_start))
+            i += bs
+        if not blocks:
+            return
+        aid = next(self._adm_counter)
+        with self._adm_lock:
+            self._admissions[aid] = (chunks, blocks)
+            for bh, _ in blocks:
+                self._block_admissions.setdefault(bh, set()).add(aid)
+            while len(self._admissions) > 1024:
+                old_aid, (_, old_blocks) = self._admissions.popitem(False)
+                for bh, _ in old_blocks:
+                    members = self._block_admissions.get(bh)
+                    if members is not None:
+                        members.discard(old_aid)
+                        if not members:
+                            del self._block_admissions[bh]
+
+    def _on_prefix_evict(self, prefix_hash: int, bid: int) -> None:
+        """Engine-thread allocator hook: a cached chain block was recycled
+        — tell the controller the chunks from that block onward are no
+        longer served here (kills the TTL staleness window).
+
+        The controller's evict takes a ROOT-ANCHORED chunk path and sweeps
+        the subtree below its last hash, so each affected admission
+        contributes ``chunks[:cut+1]`` (the path down to the first dead
+        chunk), not a bag of suffix hashes."""
+        paths: "list[list[int]]" = []
+        seen_paths: "set[tuple]" = set()
+        with self._adm_lock:
+            aids = self._block_admissions.get(prefix_hash)
+            if not aids:
+                return
+            for aid in list(aids):
+                entry = self._admissions.pop(aid, None)
+                if entry is None:
+                    continue
+                chunks, blocks = entry
+                cut = next((cs for bh, cs in blocks
+                            if bh == prefix_hash), None)
+                if cut is not None:
+                    path = tuple(int(h) for h in chunks[: cut + 1])
+                    if path and path not in seen_paths:
+                        seen_paths.add(path)
+                        paths.append(list(path))
+                for bh, _ in blocks:
+                    members = self._block_admissions.get(bh)
+                    if members is not None:
+                        members.discard(aid)
+                        if not members:
+                            del self._block_admissions[bh]
+        if not paths or self._loop is None or self.kv_controller_url is None:
+            return
+
+        async def _send():
+            import aiohttp
+
+            try:
+                async with aiohttp.ClientSession() as s:
+                    await s.post(
+                        f"{self.kv_controller_url}/kv/evict",
+                        json={"instance_id": self.instance_id,
+                              "paths": paths},
+                        timeout=aiohttp.ClientTimeout(total=5),
+                    )
+            except aiohttp.ClientError as e:
+                logger.debug("KV evict report failed: %s", e)
+
+        try:
+            self._loop.call_soon_threadsafe(
+                lambda: self._loop.create_task(_send()))
+        except RuntimeError:
+            pass  # loop closed (shutdown)
+
+    def _report_kv_admission(self, prompt_text: str,
+                             prompt_ids: Optional[List[int]] = None,
+                             adapter: str = "") -> None:
         """Fire-and-forget admission report (prompt text chunk hashes)."""
         if self.kv_controller_url is None or not prompt_text:
             return
+        if prompt_ids:
+            # Chain hashing over thousands of tokens: keep it off the
+            # event loop (registry is lock-guarded; an eviction racing
+            # ahead of its admission is benign — TTL backstops).
+            asyncio.get_running_loop().run_in_executor(
+                None, self._track_admission, prompt_text, list(prompt_ids),
+                adapter)
 
         async def _send():
             import aiohttp
@@ -250,10 +378,10 @@ class EngineServer:
         messages = body.get("messages", [])
         prompt = self.core.tokenizer.apply_chat_template(messages)
         prompt_ids = self.core.tokenizer.encode(prompt)
-        self._report_kv_admission(prompt)
+        adapter = self._resolve_adapter(model)
+        self._report_kv_admission(prompt, prompt_ids, adapter or "")
         sampling = SamplingParams.from_request(body, default_max_tokens=128)
         rid = request.headers.get("X-Request-Id") or f"chatcmpl-{uuid.uuid4().hex[:16]}"
-        adapter = self._resolve_adapter(model)
         return await self._respond(
             request, body, prompt_ids, sampling, rid, model, adapter,
             kind="chat",
@@ -274,6 +402,7 @@ class EngineServer:
         # OpenAI accepts: str | [str, ...] | [int, ...] | [[int, ...], ...].
         if isinstance(prompt, list) and prompt and isinstance(prompt[0], list):
             prompt = prompt[0]
+        adapter = self._resolve_adapter(model)
         if isinstance(prompt, list) and prompt and all(
             isinstance(t, int) for t in prompt
         ):
@@ -282,10 +411,10 @@ class EngineServer:
             if isinstance(prompt, list):
                 prompt = prompt[0] if prompt else ""
             prompt_ids = self.core.tokenizer.encode(str(prompt))
-            self._report_kv_admission(str(prompt))
+            self._report_kv_admission(
+                str(prompt), prompt_ids, adapter or "")
         sampling = SamplingParams.from_request(body, default_max_tokens=16)
         rid = request.headers.get("X-Request-Id") or f"cmpl-{uuid.uuid4().hex[:16]}"
-        adapter = self._resolve_adapter(model)
         return await self._respond(
             request, body, prompt_ids, sampling, rid, model, adapter,
             kind="completion",

@@ -283,7 +283,13 @@ async def kv_admit(request: web.Request) -> web.Response:
 async def kv_evict(request: web.Request) -> web.Response:
     state = request.app["state"]
     body = await request.json()
-    await state.kv_controller.evict(body["instance_id"], body.get("hashes", []))
+    # "hashes": one root-anchored chunk path; "paths": several (an engine
+    # evicting a block shared by multiple admitted prompts).
+    paths = body.get("paths")
+    if paths is None:
+        paths = [body.get("hashes", [])]
+    for path in paths:
+        await state.kv_controller.evict(body["instance_id"], path)
     return web.json_response({"status": "ok"})
 
 

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Install helm 3 via the official get-helm-3 script — reference
+# counterpart: utils/install-helm.sh.
+set -euo pipefail
+
+if command -v helm >/dev/null 2>&1; then
+  echo "helm already installed: $(helm version --short)"
+  exit 0
+fi
+
+curl -fsSL https://raw.githubusercontent.com/helm/helm/main/scripts/get-helm-3 |
+  bash
+helm version --short

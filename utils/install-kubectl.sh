@@ -1,0 +1,22 @@
+#!/bin/bash
+# Install kubectl (latest stable) — reference counterpart:
+# utils/install-kubectl.sh.
+set -euo pipefail
+
+if command -v kubectl >/dev/null 2>&1; then
+  echo "kubectl already installed: $(kubectl version --client --output=yaml 2>/dev/null | head -3)"
+  exit 0
+fi
+
+ARCH=$(uname -m)
+case "$ARCH" in
+  x86_64) ARCH=amd64 ;;
+  aarch64 | arm64) ARCH=arm64 ;;
+  *) echo "unsupported arch $ARCH" >&2; exit 1 ;;
+esac
+VERSION=$(curl -Ls https://dl.k8s.io/release/stable.txt)
+curl -LO "https://dl.k8s.io/release/${VERSION}/bin/linux/${ARCH}/kubectl"
+chmod +x kubectl
+sudo install -o root -g root -m 0755 kubectl /usr/local/bin/kubectl
+rm -f kubectl
+kubectl version --client

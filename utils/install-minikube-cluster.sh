@@ -1,0 +1,31 @@
+#!/bin/bash
+# Bring up a local minikube cluster ready for the CPU-engine stack —
+# reference counterpart: utils/install-minikube-cluster.sh (minus the GPU
+# operator: TPU engines need real GKE TPU node pools; local clusters run
+# the CPU XLA backend).
+set -euo pipefail
+
+CPUS="${CPUS:-8}"
+MEMORY="${MEMORY:-16g}"
+
+if ! command -v minikube >/dev/null 2>&1; then
+  ARCH=$(uname -m)
+  case "$ARCH" in
+    x86_64) ARCH=amd64 ;;
+    aarch64 | arm64) ARCH=arm64 ;;
+    *) echo "unsupported arch $ARCH" >&2; exit 1 ;;
+  esac
+  curl -LO "https://storage.googleapis.com/minikube/releases/latest/minikube-linux-${ARCH}"
+  sudo install "minikube-linux-${ARCH}" /usr/local/bin/minikube
+  rm -f "minikube-linux-${ARCH}"
+fi
+
+"$(dirname "$0")/install-kubectl.sh"
+"$(dirname "$0")/install-helm.sh"
+
+minikube start --cpus="$CPUS" --memory="$MEMORY"
+
+REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+kubectl apply -f "$REPO_ROOT/deploy/crds/production-stack.tpu_crds.yaml"
+echo ">>> Minikube ready. Install the stack with:"
+echo "  helm install tpu-stack $REPO_ROOT/helm -f $REPO_ROOT/helm/examples/values-01-minimal.yaml"
