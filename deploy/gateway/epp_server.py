@@ -1,0 +1,244 @@
+#!/usr/bin/env python3
+"""Endpoint Picker (EPP) for the Kubernetes Gateway API inference
+extension, speaking the real Envoy ext-proc gRPC protocol.
+
+The reference compiles its pickers into the gateway-api-inference-
+extension EPP in Go (``src/gateway_inference_extension/
+prefix_aware_picker.go:52-130``). Here the picking logic lives in the
+native C++ library (``native/pickers`` — prefix-aware xxhash64 trie,
+KV-aware, round robin, bit-identical chains with the router and engine),
+loaded IN-PROCESS via ctypes; this server is only the ext-proc transport:
+
+- gRPC method path ``/envoy.service.ext_proc.v3.ExternalProcessor/Process``
+  (bidirectional stream), message schema in ``protos/ext_proc.proto``
+  (field-number-faithful envoy v3 subset).
+- On ``request_headers``: CONTINUE (the model/prompt live in the body).
+- On ``request_body``: parse the OpenAI JSON, render the prompt text,
+  pick an endpoint, respond with a header mutation setting
+  ``x-gateway-destination-endpoint`` — exactly what the reference EPP
+  returns to the gateway.
+
+Endpoint state is held server-side (``--endpoints`` or a watched file —
+e.g. a mounted ConfigMap the InferencePool controller maintains), NOT
+re-sent per pick (the round-2 sidecar's weakness). Each pick inserts the
+prompt into the chosen endpoint's trie, so same-prefix requests stick.
+
+Run: ``python deploy/gateway/epp_server.py --port 9002 \
+       --endpoints 10.0.0.4:8000,10.0.0.5:8000``
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import threading
+import time
+from concurrent import futures
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, "protos"))
+sys.path.insert(0, os.path.abspath(os.path.join(_HERE, "..", "..")))
+
+logger = logging.getLogger("epp")
+
+SERVICE = "envoy.service.ext_proc.v3.ExternalProcessor"
+DEST_HEADER = "x-gateway-destination-endpoint"
+
+
+def ensure_pb2():
+    """(Re)generate ext_proc_pb2 from the .proto when missing/stale."""
+    import subprocess
+
+    proto_dir = os.path.join(_HERE, "protos")
+    proto = os.path.join(proto_dir, "ext_proc.proto")
+    out = os.path.join(proto_dir, "ext_proc_pb2.py")
+    if (not os.path.exists(out)
+            or os.path.getmtime(out) < os.path.getmtime(proto)):
+        try:
+            subprocess.run(
+                ["protoc", f"--python_out={proto_dir}", "ext_proc.proto"],
+                cwd=proto_dir, check=True)
+        except (OSError, subprocess.CalledProcessError) as e:
+            # Checkout mtimes are arbitrary; the committed pb2 is valid.
+            # Only fail if there is nothing to import at all.
+            if not os.path.exists(out):
+                raise RuntimeError(
+                    "ext_proc_pb2.py missing and protoc unavailable") from e
+            logger.warning("protoc regeneration skipped: %s", e)
+    import ext_proc_pb2  # noqa: F401
+
+    return ext_proc_pb2
+
+
+def render_prompt(body_json: dict) -> str:
+    """OpenAI request -> the text whose prefix keys the pick. Uses the
+    ENGINE's chat-template renderer so trie chains agree across tiers by
+    construction (a local copy would silently diverge if the template
+    changed)."""
+    if "messages" in body_json:
+        from production_stack_tpu.engine.tokenizer import ByteTokenizer
+
+        return ByteTokenizer.apply_chat_template(
+            None, body_json.get("messages") or [])
+    prompt = body_json.get("prompt", "")
+    if isinstance(prompt, list):
+        prompt = prompt[0] if prompt and isinstance(prompt[0], str) else ""
+    return prompt if isinstance(prompt, str) else ""
+
+
+class EndpointState:
+    """Server-side endpoint set: static list or a watched file (one
+    endpoint per line — a ConfigMap mount the pool controller updates)."""
+
+    def __init__(self, endpoints, watch_file=None, interval=5.0):
+        self._endpoints = list(endpoints)
+        self._file = watch_file
+        self._interval = interval
+        self._lock = threading.Lock()
+        if watch_file:
+            t = threading.Thread(target=self._watch, daemon=True)
+            t.start()
+
+    def endpoints(self):
+        with self._lock:
+            return list(self._endpoints)
+
+    def _watch(self):
+        last = None
+        while True:
+            try:
+                with open(self._file) as f:
+                    eps = [
+                        ln.split("#", 1)[0].strip() for ln in f
+                        if ln.split("#", 1)[0].strip()
+                    ]
+                if eps != last:
+                    with self._lock:
+                        self._endpoints = eps
+                    last = eps
+                    logger.info("endpoints updated: %s", eps)
+            except OSError:
+                pass
+            time.sleep(self._interval)
+
+
+class ExtProcPicker:
+    """The ext-proc Process() implementation around the native picker."""
+
+    def __init__(self, pb2, state: EndpointState, algorithm: str = "prefix"):
+        from production_stack_tpu.native import NativePicker
+
+        self.pb2 = pb2
+        self.state = state
+        self.algorithm = algorithm
+        self.picker = NativePicker()
+        self.picks_total = 0
+
+    def _pick(self, prompt: str) -> str | None:
+        self.picker.set_endpoints(self.state.endpoints())
+        if self.algorithm == "roundrobin" or not prompt:
+            chosen = self.picker.pick_roundrobin()
+        elif self.algorithm == "kv":
+            chosen, _ = self.picker.pick_kv(prompt)
+            chosen = chosen or self.picker.pick_roundrobin()
+        else:  # prefix-aware (insert-after-pick keeps session affinity)
+            chosen = self.picker.pick_prefix(prompt)
+        return chosen
+
+    def process(self, request_iterator, context):
+        pb2 = self.pb2
+        body_buf = b""
+        for req in request_iterator:
+            kind = req.WhichOneof("request")
+            if kind == "request_headers":
+                if req.request_headers.end_of_stream:
+                    # Header-only request (no body to pick on): route by
+                    # round robin so the gateway still gets a destination.
+                    yield self._respond_headers(self._pick(""))
+                else:
+                    resp = pb2.ProcessingResponse()
+                    resp.request_headers.response.status = (
+                        pb2.CommonResponse.CONTINUE)
+                    yield resp
+            elif kind == "request_body":
+                body_buf += req.request_body.body
+                if not req.request_body.end_of_stream:
+                    continue
+                import json
+
+                try:
+                    parsed = json.loads(body_buf.decode() or "{}")
+                except (ValueError, UnicodeDecodeError):
+                    parsed = {}
+                chosen = self._pick(render_prompt(parsed))
+                self.picks_total += 1
+                yield self._respond_body(chosen)
+                body_buf = b""
+            # response_headers / response_body: nothing to do
+
+    def _mutation(self, common, chosen):
+        common.status = self.pb2.CommonResponse.CONTINUE
+        if chosen:
+            opt = common.header_mutation.set_headers.add()
+            opt.header.key = DEST_HEADER
+            opt.header.raw_value = chosen.encode()
+            common.clear_route_cache = True
+
+    def _respond_headers(self, chosen):
+        resp = self.pb2.ProcessingResponse()
+        self._mutation(resp.request_headers.response, chosen)
+        return resp
+
+    def _respond_body(self, chosen):
+        resp = self.pb2.ProcessingResponse()
+        self._mutation(resp.request_body.response, chosen)
+        return resp
+
+
+def build_server(port: int, state: EndpointState, algorithm: str = "prefix"):
+    """gRPC server with a generic handler for the envoy method path (no
+    generated service stubs needed — grpcio codegen is absent in-image)."""
+    import grpc
+
+    pb2 = ensure_pb2()
+    picker = ExtProcPicker(pb2, state, algorithm)
+
+    handler = grpc.method_handlers_generic_handler(SERVICE, {
+        "Process": grpc.stream_stream_rpc_method_handler(
+            picker.process,
+            request_deserializer=pb2.ProcessingRequest.FromString,
+            response_serializer=pb2.ProcessingResponse.SerializeToString,
+        ),
+    })
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
+    server.add_generic_rpc_handlers((handler,))
+    bound = server.add_insecure_port(f"[::]:{port}")
+    return server, bound, picker
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--port", type=int, default=9002)
+    parser.add_argument("--endpoints", default="",
+                        help="comma-separated ip:port endpoints")
+    parser.add_argument("--endpoints-file", default=None,
+                        help="watched file, one endpoint per line "
+                             "(ConfigMap mount)")
+    parser.add_argument("--algorithm", default="prefix",
+                        choices=["prefix", "kv", "roundrobin"])
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    state = EndpointState(
+        [e for e in args.endpoints.split(",") if e],
+        watch_file=args.endpoints_file)
+    server, bound, _ = build_server(args.port, state, args.algorithm)
+    server.start()
+    logger.info("EPP (ext-proc) on :%d, algorithm=%s", bound, args.algorithm)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    main()

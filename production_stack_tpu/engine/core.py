@@ -143,7 +143,13 @@ class EngineCore:
 
         def _dispatch_evict(prefix_hash: int, bid: int) -> None:
             if self.offload is not None:
+                # Spilled to the host/remote tier: the prefix is STILL
+                # servable here (external_lookup restores it), so don't
+                # retract the controller claim — that would defeat the
+                # offload tier exactly when it wins. Claims for chains the
+                # second tier later drops age out via the admit TTL.
                 self._offload_block(prefix_hash, bid)
+                return
             listener = self.prefix_evict_listener
             if listener is not None:
                 try:
