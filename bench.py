@@ -78,6 +78,13 @@ _CONFIGS = {
                    history_tokens=2000, max_model_len=8192,
                    max_num_seqs=16, routing="disaggregated_prefill",
                    engines=2, num_blocks=800),
+    # BASELINE config 5's LoRA leg at dev-chip scale: flagship engine
+    # with adapter slots compiled in; half the users request a hot-swapped
+    # adapter (engine-local delta weights, per-adapter KV namespaces).
+    "lora": dict(model="tpu-llama-1b", users=15, rounds=8,
+                 answer_tokens=100, sys_prompt_tokens=1000,
+                 history_tokens=2000, max_model_len=8192,
+                 max_num_seqs=16, max_loras=4, lora_users=7),
 }
 
 CONFIG_KEY = os.environ.get("BENCH_CONFIG", "flagship")
@@ -95,6 +102,10 @@ MAX_NUM_SEQS = _env_int("BENCH_MAX_NUM_SEQS", _cfg["max_num_seqs"])
 MAX_MODEL_LEN = _env_int("BENCH_MAX_MODEL_LEN", _cfg["max_model_len"])
 # New-user arrival rate (users/s), the reference's --qps pacing knob.
 QPS = _env_float("BENCH_QPS", 1.0)
+# LoRA leg (config "lora"): this many users request the hot-swapped
+# adapter instead of the base model.
+LORA_USERS = _env_int("BENCH_LORA_USERS", _cfg.get("lora_users", 0))
+ADAPTER_NAME = "bench-adapter"
 # Soft wall-clock budget for the traffic phase: users stop STARTING new
 # rounds after this many seconds (in-flight rounds finish), mirroring the
 # reference's --time per-point cap. 0 = no cap.
@@ -196,11 +207,12 @@ async def _drive(router_url: str):
             t0 = time.perf_counter()
             first = None
             answer = []
+            model = ADAPTER_NAME if uid < LORA_USERS else MODEL
             try:
                 async with session.post(
                     router_url + "/v1/chat/completions",
                     json={
-                        "model": MODEL, "messages": history,
+                        "model": model, "messages": history,
                         "max_tokens": ANSWER_TOKENS, "stream": True,
                         "temperature": 0.0, "ignore_eos": True,
                     },
@@ -275,7 +287,7 @@ async def _main() -> dict:
         model=MODEL,
         max_model_len=MAX_MODEL_LEN,
         max_num_seqs=MAX_NUM_SEQS,
-        max_loras=0,
+        max_loras=int(_cfg.get("max_loras", 0)),
         decode_steps=_env_int("BENCH_DECODE_STEPS", 16),
         kv_offload_bytes=int(
             float(_cfg.get("kv_offload_gb", 0)) * 1e9),
@@ -291,9 +303,21 @@ async def _main() -> dict:
         runners.append(runner)
         engine_urls.append(f"http://127.0.0.1:{port}")
 
+    if LORA_USERS > 0:
+        # The adapter is a served model on the same backend (the engine
+        # resolves the name to its LoRA slot; no alias rewrite, which
+        # would strip the adapter name from the forwarded body). A failed
+        # load would silently 404 the adapter users and publish a number
+        # measuring only the base traffic — fail fast instead.
+        assert servers[0].core.load_lora_adapter(ADAPTER_NAME, rank=8), \
+            "adapter load failed (max_loras=0 or no free slot?)"
+
     args = build_parser().parse_args([])
     args.static_backends = ",".join(engine_urls)
     args.static_models = ",".join([MODEL] * n_engines)
+    if LORA_USERS > 0:
+        args.static_backends += "," + engine_urls[0]
+        args.static_models += "," + ADAPTER_NAME
     args.routing_logic = routing
     args.session_key = "x-user-id"
     args.engine_stats_interval = 5

@@ -45,6 +45,11 @@ from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.core import EngineCore
 from production_stack_tpu.engine.sampling import SamplingParams
 from production_stack_tpu.engine.tokenizer import IncrementalDetokenizer
+from production_stack_tpu.engine.tools import (
+    parse_tool_calls,
+    render_tools_preamble,
+    tool_names,
+)
 from production_stack_tpu.utils.log import init_logger
 
 logger = init_logger(__name__)
@@ -376,6 +381,16 @@ class EngineServer:
                 {"error": {"message": "engine is sleeping",
                            "type": "ServiceUnavailable"}}, status=503)
         messages = body.get("messages", [])
+        tools = body.get("tools") or []
+        if tools and body.get("tool_choice") != "none":
+            # Fold the function schemas + <tool_call> output contract into
+            # the system context (hermes convention; vLLM does this via
+            # per-model parser plugins, ref tutorial 13). tool_choice
+            # "none" skips both the preamble and output parsing.
+            preamble = render_tools_preamble(
+                tools, body.get("tool_choice", "auto"))
+            messages = (
+                [{"role": "system", "content": preamble}] + list(messages))
         prompt = self.core.tokenizer.apply_chat_template(messages)
         prompt_ids = self.core.tokenizer.encode(prompt)
         adapter = self._resolve_adapter(model)
@@ -442,6 +457,13 @@ class EngineServer:
             return {"id": rid, "object": obj, "created": created,
                     "model": model, "choices": [choice]}
 
+        # Tool-call requests buffer the stream: calls can only be parsed
+        # from the complete text (vLLM's streaming tool parsers do
+        # per-model incremental parsing; the whole-text parse is the
+        # model-agnostic subset).
+        buffer_tools = (bool(body.get("tools")) and kind == "chat"
+                        and body.get("tool_choice") != "none")
+        declared_tools = tool_names(body.get("tools") or [])
         if stream_mode:
             resp = web.StreamResponse()
             resp.content_type = "text/event-stream"
@@ -466,9 +488,10 @@ class EngineServer:
                     emit, stopped = self._apply_stop(
                         text_so_far, delta, sampling.stop)
                     if emit or first:
-                        payload = chunk_payload(emit, None, first)
-                        await resp.write(
-                            f"data: {json.dumps(payload)}\n\n".encode())
+                        if not buffer_tools:
+                            payload = chunk_payload(emit, None, first)
+                            await resp.write(
+                                f"data: {json.dumps(payload)}\n\n".encode())
                         first = False
                         text_so_far += emit
                     if stopped:
@@ -477,6 +500,29 @@ class EngineServer:
                         break
                     if finish is not None:
                         break
+                if buffer_tools:
+                    content, tool_calls = parse_tool_calls(
+                        text_so_far, declared_tools)
+                    delta = {"role": "assistant"}
+                    if tool_calls:
+                        delta["tool_calls"] = [
+                            {**tc, "index": i}
+                            for i, tc in enumerate(tool_calls)
+                        ]
+                        finish_reason = "tool_calls"
+                        if content:
+                            delta["content"] = content
+                    else:
+                        delta["content"] = text_so_far
+                    payload = {
+                        "id": rid, "object": "chat.completion.chunk",
+                        "created": created, "model": model,
+                        "choices": [{"index": 0, "delta": delta,
+                                     "finish_reason": None}],
+                    }
+                    await resp.write(
+                        f"data: {json.dumps(payload)}\n\n".encode())
+                    first = False
                 final = chunk_payload("", finish_reason, first)
                 await resp.write(f"data: {json.dumps(final)}\n\n".encode())
                 await resp.write(b"data: [DONE]\n\n")
@@ -529,11 +575,19 @@ class EngineServer:
             "total_tokens": len(prompt_ids) + n_generated,
         }
         if kind == "chat":
+            message = {"role": "assistant", "content": text}
+            if buffer_tools:
+                content, tool_calls = parse_tool_calls(text, declared_tools)
+                if tool_calls:
+                    message = {"role": "assistant",
+                               "content": content or None,
+                               "tool_calls": tool_calls}
+                    finish_reason = "tool_calls"
             payload = {
                 "id": rid, "object": obj, "created": created, "model": model,
                 "choices": [{
                     "index": 0,
-                    "message": {"role": "assistant", "content": text},
+                    "message": message,
                     "finish_reason": finish_reason,
                 }],
                 "usage": usage,

@@ -1,0 +1,162 @@
+"""Tool calling: parser unit coverage + the chat surface contract
+(tools folded into the prompt; tool_calls + finish_reason in responses).
+Reference serves this via vLLM parser plugins (tutorial 13); here the
+hermes <tool_call> contract is parsed natively."""
+
+import asyncio
+import json
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.server import EngineServer, run_engine_server
+from production_stack_tpu.engine.tools import (
+    parse_tool_calls,
+    render_tools_preamble,
+)
+
+WEATHER_TOOL = {
+    "type": "function",
+    "function": {
+        "name": "get_weather",
+        "description": "Current weather for a city",
+        "parameters": {
+            "type": "object",
+            "properties": {"city": {"type": "string"}},
+            "required": ["city"],
+        },
+    },
+}
+
+
+def test_render_preamble_lists_functions():
+    text = render_tools_preamble([WEATHER_TOOL])
+    assert "<tools>" in text and "</tools>" in text
+    assert "get_weather" in text
+    assert "<tool_call>" in text  # output contract stated
+
+
+def test_render_preamble_forced_choice():
+    text = render_tools_preamble(
+        [WEATHER_TOOL],
+        tool_choice={"type": "function",
+                     "function": {"name": "get_weather"}})
+    assert "must call the function 'get_weather'" in text
+
+
+def test_parse_hermes_block():
+    out = ('Sure, let me check.\n<tool_call>{"name": "get_weather", '
+           '"arguments": {"city": "Paris"}}</tool_call>')
+    content, calls = parse_tool_calls(out)
+    assert content == "Sure, let me check."
+    assert len(calls) == 1
+    assert calls[0]["type"] == "function"
+    assert calls[0]["function"]["name"] == "get_weather"
+    assert json.loads(calls[0]["function"]["arguments"]) == {"city": "Paris"}
+    assert calls[0]["id"].startswith("call_")
+
+
+def test_parse_multiple_blocks_and_invalid_json():
+    out = ('<tool_call>{"name": "a", "arguments": {}}</tool_call>'
+           "<tool_call>not json</tool_call>"
+           '<tool_call>{"name": "b", "arguments": {"x": 1}}</tool_call>')
+    _, calls = parse_tool_calls(out)
+    assert [c["function"]["name"] for c in calls] == ["a", "b"]
+
+
+def test_parse_bare_json_object():
+    out = '{"name": "get_weather", "arguments": {"city": "Oslo"}} trailing'
+    content, calls = parse_tool_calls(out)
+    assert calls and calls[0]["function"]["name"] == "get_weather"
+    assert content == "trailing"
+    # Nested braces inside strings survive the brace scan.
+    out2 = ('{"name": "f", "arguments": {"s": "a { b } \\" c"}}')
+    _, calls2 = parse_tool_calls(out2)
+    assert calls2 and json.loads(
+        calls2[0]["function"]["arguments"])["s"] == 'a { b } " c'
+
+
+def test_parse_plain_text_no_calls():
+    content, calls = parse_tool_calls("just a normal answer")
+    assert calls == []
+    assert content == "just a normal answer"
+    # JSON without a name key is not a call.
+    content, calls = parse_tool_calls('{"foo": 1}')
+    assert calls == []
+
+
+def test_chat_surface_with_tools():
+    """Tools reach the prompt; the response carries tool_calls when (and
+    only when) the model emits the contract. Random weights cannot emit
+    valid calls, so the negative path runs e2e and the positive path is
+    asserted at the parse step the handler uses."""
+    server = EngineServer(EngineConfig(
+        model="tiny-llama", max_model_len=512, max_num_seqs=2,
+        block_size=8, num_blocks=64, max_loras=0))
+
+    async def run():
+        runner = await run_engine_server(server, "127.0.0.1", 0)
+        port = list(runner.sites)[0]._server.sockets[0].getsockname()[1]
+        import aiohttp
+
+        try:
+            async with aiohttp.ClientSession() as s:
+                body = {
+                    "model": "tiny-llama",
+                    "messages": [
+                        {"role": "user", "content": "weather in Paris?"}],
+                    "tools": [WEATHER_TOOL],
+                    "max_tokens": 8, "temperature": 0.0,
+                }
+                async with s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json=body) as resp:
+                    assert resp.status == 200, await resp.text()
+                    out = await resp.json()
+                choice = out["choices"][0]
+                # Random weights -> no valid contract -> plain message.
+                assert choice["finish_reason"] in ("stop", "length")
+                assert "content" in choice["message"]
+                # The preamble increased the prompt (tools were rendered).
+                assert out["usage"]["prompt_tokens"] > 200
+                # Streaming with tools: buffered single delta + [DONE].
+                body["stream"] = True
+                async with s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json=body) as resp:
+                    assert resp.status == 200
+                    raw = await resp.text()
+                assert "data: [DONE]" in raw
+                deltas = [json.loads(ln[len("data: "):])
+                          for ln in raw.splitlines()
+                          if ln.startswith("data: ")
+                          and ln != "data: [DONE]"]
+                content_deltas = [
+                    d for d in deltas
+                    if d["choices"][0]["delta"].get("content")]
+                assert len(content_deltas) == 1  # buffered, not token-wise
+        finally:
+            await runner.cleanup()
+
+    try:
+        asyncio.run(run())
+    finally:
+        server.core.stop()
+
+
+def test_tool_choice_none_and_undeclared_bare_json():
+    """tool_choice 'none' must suppress parsing, and a bare JSON answer
+    naming an UNDECLARED function is content, not a hijacked call."""
+    # Undeclared name -> not a call.
+    content, calls = parse_tool_calls(
+        '{"name": "Alice", "age": 30}', allowed_names=["get_weather"])
+    assert calls == []
+    assert content == '{"name": "Alice", "age": 30}'
+    # Declared name -> call.
+    _, calls = parse_tool_calls(
+        '{"name": "get_weather", "arguments": {"city": "Oslo"}}',
+        allowed_names=["get_weather"])
+    assert calls and calls[0]["function"]["name"] == "get_weather"
+    # Malformed <tool_call> fragments stay in the content.
+    content, calls = parse_tool_calls(
+        "before <tool_call>{bad json,}</tool_call> after")
+    assert calls == []
+    assert "{bad json,}" in content
