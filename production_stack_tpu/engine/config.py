@@ -47,6 +47,9 @@ class EngineConfig:
     max_top_k: int = 64
     seed: int = 0
     enforce_eager: bool = False
+    # Custom jinja chat template file (HF-tokenizer checkpoints only;
+    # helm modelSpec.chatTemplate mounts it from a ConfigMap).
+    chat_template: Optional[str] = None
 
     @property
     def max_blocks_per_seq(self) -> int:

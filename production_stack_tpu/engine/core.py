@@ -56,7 +56,8 @@ class EngineCore:
         if config.dtype:
             self.model_config = self.model_config.replace(dtype=config.dtype)
         self.tokenizer = build_tokenizer(
-            config.model, self.model_config.vocab_size
+            config.model, self.model_config.vocab_size,
+            chat_template_path=config.chat_template,
         )
 
         all_devices = list(devices if devices is not None else jax.devices())

@@ -51,6 +51,7 @@ class SamplingParams:
             ignore_eos=bool(body.get("ignore_eos", False)),
             presence_penalty=float(body.get("presence_penalty") or 0.0),
             frequency_penalty=float(body.get("frequency_penalty") or 0.0),
+            n=max(int(body.get("n") or 1), 1),
         )
 
 

@@ -464,6 +464,12 @@ class EngineServer:
         buffer_tools = (bool(body.get("tools")) and kind == "chat"
                         and body.get("tool_choice") != "none")
         declared_tools = tool_names(body.get("tools") or [])
+        if sampling.n > 1:
+            return await self._respond_n(
+                request, body, prompt_ids, sampling, rid, model, adapter,
+                kind=kind, stream=stream, stream_mode=stream_mode,
+                created=created, obj=obj, buffer_tools=buffer_tools,
+                declared_tools=declared_tools)
         if stream_mode:
             resp = web.StreamResponse()
             resp.content_type = "text/event-stream"
@@ -599,6 +605,190 @@ class EngineServer:
                              "finish_reason": finish_reason}],
                 "usage": usage,
             }
+        return web.json_response(payload, headers={"X-Request-Id": rid})
+
+    async def _respond_n(self, request, body, prompt_ids, sampling, rid,
+                         model, adapter, *, kind, stream, stream_mode,
+                         created, obj, buffer_tools, declared_tools):
+        """n>1 sampling: n independent engine requests (seeds derived per
+        choice) merged into one response — interleaved ``index``-tagged SSE
+        chunks when streaming, a choices array otherwise (vLLM's n
+        semantics on the OpenAI surface).
+
+        NOTE: this intentionally mirrors _respond's per-choice contract
+        (stop strings, buffered tools, finish reasons, oversize-prompt
+        400). A behavior change in _respond's n=1 path must land here too
+        — the shapes differ enough (merged queue vs single stream) that a
+        shared implementation would obscure both."""
+        import dataclasses
+
+        n = sampling.n
+        if len(prompt_ids) >= self.config.max_model_len:
+            # Mirror the n=1 path's scheduler-rejection contract up front
+            # (each sub-request would be rejected with zero tokens).
+            return web.json_response(
+                {"error": {
+                    "message": (f"prompt ({len(prompt_ids)} tokens) "
+                                f"exceeds max_model_len "
+                                f"{self.config.max_model_len}"),
+                    "type": "BadRequestError",
+                }}, status=400)
+        base_seed = (sampling.seed if sampling.seed is not None
+                     else hash(rid) % (2**31))
+        streams = [stream]
+        for i in range(1, n):
+            s_i = dataclasses.replace(sampling, seed=base_seed + i, n=1)
+            streams.append(await self._generate(
+                prompt_ids, s_i, f"{rid}-c{i}", adapter))
+        detoks = [IncrementalDetokenizer(self.core.tokenizer)
+                  for _ in range(n)]
+        texts = [""] * n
+        finishes = ["stop"] * n
+        counts = [0] * n
+
+        async def consume(i):
+            async for token_id, finish in streams[i]:
+                if token_id is None:
+                    if finish in ("stop", "length", "abort"):
+                        finishes[i] = finish
+                    break
+                counts[i] += 1
+                delta = detoks[i].push(token_id)
+                if finish is not None:
+                    delta += detoks[i].flush()
+                    finishes[i] = finish
+                emit, stopped = self._apply_stop(
+                    texts[i], delta, sampling.stop)
+                texts[i] += emit
+                if emit:
+                    yield emit  # before the stop-break: never drop the tail
+                if stopped:
+                    finishes[i] = "stop"
+                    self.core.abort_request(
+                        rid if i == 0 else f"{rid}-c{i}")
+                    break
+                if finish is not None:
+                    break
+
+        if stream_mode:
+            resp = web.StreamResponse()
+            resp.content_type = "text/event-stream"
+            resp.headers["Cache-Control"] = "no-cache"
+            resp.headers["X-Request-Id"] = rid
+            await resp.prepare(request)
+            queue: asyncio.Queue = asyncio.Queue()
+
+            async def pump(i):
+                try:
+                    async for emit in consume(i):
+                        await queue.put((i, emit))
+                finally:
+                    # Sentinel even on error: the merge loop must not
+                    # wait forever on a dead choice.
+                    await queue.put((i, None))
+
+            tasks = [asyncio.get_running_loop().create_task(pump(i))
+                     for i in range(n)]
+            first = [True] * n
+            live = n
+
+            def chunk(choice):
+                return {"id": rid, "object": (
+                    "chat.completion.chunk" if kind == "chat" else obj),
+                    "created": created, "model": model,
+                    "choices": [choice]}
+
+            try:
+                while live:
+                    i, emit = await queue.get()
+                    if emit is None:
+                        live -= 1
+                        continue
+                    if buffer_tools:
+                        continue  # parsed + emitted per choice below
+                    delta = ({"role": "assistant", "content": emit}
+                             if first[i] and kind == "chat"
+                             else {"content": emit})
+                    first[i] = False
+                    choice = ({"index": i, "delta": delta,
+                               "finish_reason": None} if kind == "chat"
+                              else {"index": i, "text": emit,
+                                    "finish_reason": None})
+                    await resp.write(
+                        f"data: {json.dumps(chunk(choice))}\n\n".encode())
+                for i in range(n):
+                    finish_reason = finishes[i]
+                    if buffer_tools:
+                        # Same buffered-tools contract as the n=1 stream:
+                        # one parsed delta per choice.
+                        content, tool_calls = parse_tool_calls(
+                            texts[i], declared_tools)
+                        delta = {"role": "assistant"}
+                        if tool_calls:
+                            delta["tool_calls"] = [
+                                {**tc, "index": k}
+                                for k, tc in enumerate(tool_calls)]
+                            finish_reason = "tool_calls"
+                            if content:
+                                delta["content"] = content
+                        else:
+                            delta["content"] = texts[i]
+                        payload = chunk({"index": i, "delta": delta,
+                                         "finish_reason": None})
+                        await resp.write(
+                            f"data: {json.dumps(payload)}\n\n".encode())
+                    choice = ({"index": i, "delta": {},
+                               "finish_reason": finish_reason}
+                              if kind == "chat"
+                              else {"index": i, "text": "",
+                                    "finish_reason": finish_reason})
+                    await resp.write(
+                        f"data: {json.dumps(chunk(choice))}\n\n".encode())
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+            except (ConnectionResetError, asyncio.CancelledError):
+                for i in range(n):
+                    self.core.abort_request(
+                        rid if i == 0 else f"{rid}-c{i}")
+                raise
+            finally:
+                for t in tasks:
+                    t.cancel()
+            return resp
+
+        async def drain(i):
+            async for _ in consume(i):
+                pass
+
+        await asyncio.gather(*[drain(i) for i in range(n)])
+        choices = []
+        for i in range(n):
+            if kind == "chat":
+                message = {"role": "assistant", "content": texts[i]}
+                finish_reason = finishes[i]
+                if buffer_tools:
+                    content, tool_calls = parse_tool_calls(
+                        texts[i], declared_tools)
+                    if tool_calls:
+                        message = {"role": "assistant",
+                                   "content": content or None,
+                                   "tool_calls": tool_calls}
+                        finish_reason = "tool_calls"
+                choices.append({"index": i, "message": message,
+                                "finish_reason": finish_reason})
+            else:
+                choices.append({"index": i, "text": texts[i],
+                                "finish_reason": finishes[i]})
+        total_new = sum(counts)
+        payload = {
+            "id": rid, "object": obj, "created": created, "model": model,
+            "choices": choices,
+            "usage": {
+                "prompt_tokens": len(prompt_ids),
+                "completion_tokens": total_new,
+                "total_tokens": len(prompt_ids) + total_new,
+            },
+        }
         return web.json_response(payload, headers={"X-Request-Id": rid})
 
     async def handle_embeddings(self, request: web.Request) -> web.Response:
@@ -1294,7 +1484,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--max-model-len", type=int, default=2048)
     p.add_argument("--max-num-seqs", type=int, default=8)
-    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--block-size", type=int, default=64)
     p.add_argument("--num-blocks", type=int, default=None)
     p.add_argument("--hbm-utilization", type=float, default=0.7)
     p.add_argument("--tensor-parallel-size", type=int, default=1)
@@ -1319,6 +1509,8 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="router URL to report KV admissions to "
                         "(enables kv-aware routing against this engine)")
     p.add_argument("--instance-id", default=None)
+    p.add_argument("--chat-template", default=None,
+                   help="custom jinja chat-template file (HF checkpoints)")
     p.add_argument("--advertise-url", default=None,
                    help="URL the router should route to for this instance")
     return p
@@ -1354,6 +1546,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         seed=args.seed,
         kv_offload_bytes=int(args.kv_offload_gb * (1 << 30)),
         kv_remote_url=args.kv_remote_url,
+        chat_template=args.chat_template,
     )
     server = EngineServer(config, args.served_model_name,
                           warmup=args.warmup,

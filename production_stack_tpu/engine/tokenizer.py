@@ -55,10 +55,15 @@ class ByteTokenizer:
 
 
 class HFTokenizer:
-    def __init__(self, path: str):
+    def __init__(self, path: str, chat_template: Optional[str] = None):
         from transformers import AutoTokenizer
 
         self.tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        if chat_template:
+            # Custom jinja template (helm modelSpec.chatTemplate — the
+            # reference mounts these as configmaps and passes vLLM
+            # --chat-template).
+            self.tok.chat_template = chat_template
         self.vocab_size = self.tok.vocab_size
         self.bos_token_id = self.tok.bos_token_id
         self.eos_token_id = self.tok.eos_token_id
@@ -79,13 +84,22 @@ class HFTokenizer:
             return ByteTokenizer.apply_chat_template(self, messages)  # type: ignore[arg-type]
 
 
-def build_tokenizer(model: str, vocab_size: int, tokenizer_path: Optional[str] = None):
+def build_tokenizer(model: str, vocab_size: int,
+                    tokenizer_path: Optional[str] = None,
+                    chat_template_path: Optional[str] = None):
     import os
 
+    template = None
+    if chat_template_path:
+        # An explicitly configured template that cannot be read must fail
+        # LOUDLY (crashlooping pod), not silently serve the checkpoint's
+        # default formatting.
+        with open(chat_template_path) as f:
+            template = f.read()
     path = tokenizer_path or model
     if os.path.isdir(path):
         try:
-            return HFTokenizer(path)
+            return HFTokenizer(path, chat_template=template)
         except Exception:  # noqa: BLE001
             pass
     return ByteTokenizer(vocab_size)

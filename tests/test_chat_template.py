@@ -1,0 +1,51 @@
+"""Custom chat templates: --chat-template file applied to HF-tokenizer
+checkpoints (helm modelSpec.chatTemplate -> ConfigMap mount; reference
+passes vLLM --chat-template the same way)."""
+
+from production_stack_tpu.engine.tokenizer import (
+    ByteTokenizer,
+    build_tokenizer,
+)
+
+
+def _tok_dir(tmp_path):
+    from transformers import BertTokenizerFast
+
+    words = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+             "hello", "world", "hi"]
+    d = tmp_path / "tok"
+    d.mkdir()
+    (d / "vocab.txt").write_text("\n".join(words))
+    BertTokenizerFast(vocab_file=str(d / "vocab.txt")).save_pretrained(d)
+    return str(d)
+
+
+def test_custom_template_applied(tmp_path):
+    path = _tok_dir(tmp_path)
+    template = tmp_path / "tmpl.jinja"
+    template.write_text(
+        "{% for m in messages %}[{{ m.role }}] {{ m.content }}\n"
+        "{% endfor %}ASSISTANT:")
+    tok = build_tokenizer(path, 512, chat_template_path=str(template))
+    out = tok.apply_chat_template(
+        [{"role": "user", "content": "hello world"}])
+    assert out == "[user] hello world\nASSISTANT:"
+
+
+def test_missing_template_file_fails_loudly(tmp_path):
+    import pytest
+
+    path = _tok_dir(tmp_path)
+    # An explicitly configured template that can't be read is a config
+    # error: crash at startup, never silently serve default formatting.
+    with pytest.raises(OSError):
+        build_tokenizer(path, 512,
+                        chat_template_path=str(tmp_path / "absent"))
+
+
+def test_preset_models_ignore_template(tmp_path):
+    template = tmp_path / "tmpl.jinja"
+    template.write_text("irrelevant")
+    tok = build_tokenizer("tiny-llama", 512,
+                          chat_template_path=str(template))
+    assert isinstance(tok, ByteTokenizer)

@@ -1,0 +1,78 @@
+"""n>1 sampling on the OpenAI surface: a choices array (non-stream) and
+index-tagged interleaved SSE chunks (stream), each choice an independent
+engine generation."""
+
+import asyncio
+import json
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.server import EngineServer, run_engine_server
+
+
+def test_n_sampling_choices():
+    server = EngineServer(EngineConfig(
+        model="tiny-llama", max_model_len=256, max_num_seqs=4,
+        block_size=8, num_blocks=64, max_loras=0))
+
+    async def run():
+        runner = await run_engine_server(server, "127.0.0.1", 0)
+        port = list(runner.sites)[0]._server.sockets[0].getsockname()[1]
+        import aiohttp
+
+        try:
+            async with aiohttp.ClientSession() as s:
+                body = {"model": "tiny-llama",
+                        "messages": [{"role": "user", "content": "hi"}],
+                        "n": 3, "max_tokens": 6, "temperature": 0.8,
+                        "seed": 7, "ignore_eos": True}
+                async with s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json=body) as resp:
+                    assert resp.status == 200, await resp.text()
+                    out = await resp.json()
+                assert len(out["choices"]) == 3
+                assert [c["index"] for c in out["choices"]] == [0, 1, 2]
+                for c in out["choices"]:
+                    assert c["message"]["role"] == "assistant"
+                    assert c["finish_reason"] == "length"
+                # Independent seeds: not all three identical.
+                texts = {c["message"]["content"] for c in out["choices"]}
+                assert len(texts) > 1
+                assert out["usage"]["completion_tokens"] == 18
+
+                # Streaming: chunks tagged per choice index, one final
+                # finish chunk per choice, then [DONE].
+                body["stream"] = True
+                async with s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json=body) as resp:
+                    assert resp.status == 200
+                    raw = await resp.text()
+                assert raw.strip().endswith("data: [DONE]")
+                chunks = [json.loads(ln[len("data: "):])
+                          for ln in raw.splitlines()
+                          if ln.startswith("data: ")
+                          and ln != "data: [DONE]"]
+                seen = {c["choices"][0]["index"] for c in chunks}
+                assert seen == {0, 1, 2}
+                finishes = [c["choices"][0] for c in chunks
+                            if c["choices"][0]["finish_reason"]]
+                assert len(finishes) == 3
+
+                # Completions surface too.
+                async with s.post(
+                        f"http://127.0.0.1:{port}/v1/completions",
+                        json={"model": "tiny-llama", "prompt": "abc",
+                              "n": 2, "max_tokens": 4,
+                              "temperature": 0.9,
+                              "ignore_eos": True}) as resp:
+                    assert resp.status == 200, await resp.text()
+                    out = await resp.json()
+                assert len(out["choices"]) == 2
+        finally:
+            await runner.cleanup()
+
+    try:
+        asyncio.run(run())
+    finally:
+        server.core.stop()
