@@ -192,6 +192,9 @@ def test_semantic_cache_sentence_transformer_path(tmp_path):
     """The ST embedder path (model_name = a local SentenceTransformer
     dir) loads, infers its dimension, and serves paraphrase-level hits
     the hashed-ngram fallback cannot (round-1/2 carried weak item)."""
+    import pytest
+
+    pytest.importorskip("sentence_transformers")
     import asyncio as _asyncio
 
     import numpy as np

@@ -10,6 +10,8 @@ import sys
 
 import pytest
 
+pytest.importorskip("grpc", reason="grpcio not installed")
+
 sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "deploy", "gateway"))
