@@ -25,6 +25,7 @@ from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.kvcache import KVCacheManager
 from production_stack_tpu.engine.sampling import (
     SamplingParams,
+    logprob_outputs,
     make_rng_keys,
     sample_tokens,
 )
@@ -360,7 +361,8 @@ class EngineCore:
             sampled = sample_tokens(
                 last, keys, temperature, top_k, top_p, max_top_k=max_top_k
             )
-            return sampled, kv
+            lp, top_lp, top_ids = logprob_outputs(last, sampled)
+            return (sampled, lp, top_lp, top_ids), kv
 
         return jax.jit(fwd, donate_argnums=(1,))
 
@@ -405,12 +407,15 @@ class EngineCore:
                     logits[:, 0], keys, temperature, top_k, top_p,
                     max_top_k=max_top_k,
                 )
-                return (sampled, kv, s + 1), sampled
+                lp, top_lp, top_ids = logprob_outputs(logits[:, 0], sampled)
+                return (sampled, kv, s + 1), (sampled, lp, top_lp, top_ids)
 
-            (_, kv, _), out = jax.lax.scan(
+            (_, kv, _), (out, lps, top_lps, top_idxs) = jax.lax.scan(
                 body, (tokens0, kv, jnp.int32(0)), slot_mat.T, length=K,
             )
-            return out.T, kv  # [B, K]
+            # [K, B, ...] -> [B, K, ...]
+            return (out.T, lps.T, top_lps.swapaxes(0, 1),
+                    top_idxs.swapaxes(0, 1)), kv
 
         return jax.jit(fwd, donate_argnums=(1,))
 
@@ -1108,14 +1113,22 @@ class EngineCore:
             start = end
         # Read back the in-flight burst while the chunks execute on device.
         self._flush_pending_burst()
-        token = int(np.asarray(jax.device_get(sampled))[0])
+        s_arr, lp_arr, top_lp_arr, top_id_arr = (
+            np.asarray(a) for a in jax.device_get(sampled))
+        token = int(s_arr[0])
+        lp = None
+        if req.sampling.logprobs is not None:
+            k = min(req.sampling.logprobs, top_lp_arr.shape[1])
+            lp = {"logprob": float(lp_arr[0]),
+                  "top": [(int(top_id_arr[0, j]), float(top_lp_arr[0, j]))
+                          for j in range(k)]}
         self.prompt_tokens_total += n
         self.cached_tokens_total += cached
 
         with self._lock:
             slot = self.scheduler._free_slot()
             seq = self.scheduler.start_running(req, slot)
-        self._emit_token(seq, int(token))
+        self._emit_token(seq, token, lp)
         # Decode position bookkeeping starts from the emitted tokens (a
         # re-prefill after preemption carries prior outputs forward).
         req.scheduled_steps = len(req.output_token_ids)
@@ -1290,10 +1303,11 @@ class EngineCore:
             r.scheduled_steps += allow
 
         tokens_prev = (
-            prev["out"] if prev is not None else np.zeros((B, K), np.int32)
+            prev["out"][0] if prev is not None
+            else np.zeros((B, K), np.int32)
         )
         fn = self._multi_decode_fn(K)
-        sampled, self.kv = fn(
+        outs, self.kv = fn(
             self.params, self.kv, tokens_prev, tok_idx, host_tokens,
             use_host, positions0, slot_mat, block_table, context0,
             adapter_ids, temperature, top_k, top_p, seed_base,
@@ -1301,7 +1315,7 @@ class EngineCore:
         # Read back the PREVIOUS burst (overlaps this burst's execution).
         self._flush_pending_burst()
         self._pending_burst = {
-            "out": sampled, "active": active, "allows": allows,
+            "out": outs, "active": active, "allows": allows,
         }
 
     def _flush_pending_burst(self) -> None:
@@ -1311,16 +1325,26 @@ class EngineCore:
             return
         self._pending_burst = None
         t0 = time.perf_counter()
-        sampled = np.asarray(jax.device_get(pending["out"]))  # [B, K]
+        sampled, lps, top_lps, top_idxs = (
+            np.asarray(a) for a in jax.device_get(pending["out"])
+        )  # [B, K], [B, K], [B, K, LOGPROB_K] x2
         self.flush_time_total += time.perf_counter() - t0
         emitted_seqs = []
         for seq in pending["active"]:
             allow = pending["allows"].get(seq.req.request_id, 1)
+            want_lp = seq.req.sampling.logprobs
             emitted = 0
             for s in range(allow):
                 if self.scheduler.slots[seq.slot] is not seq:
                     break  # finished / aborted / preempted mid-burst
-                self._emit_token(seq, int(sampled[seq.slot, s]))
+                lp = None
+                if want_lp is not None:
+                    k = min(want_lp, top_lps.shape[2])
+                    lp = {"logprob": float(lps[seq.slot, s]),
+                          "top": [(int(top_idxs[seq.slot, s, j]),
+                                   float(top_lps[seq.slot, s, j]))
+                                  for j in range(k)]}
+                self._emit_token(seq, int(sampled[seq.slot, s]), lp)
                 emitted += 1
             self.generation_tokens_total += emitted
             if emitted and self.scheduler.slots[seq.slot] is seq:
@@ -1344,7 +1368,12 @@ class EngineCore:
                 min(r.sampling.top_k, self.config.max_top_k),
                 r.sampling.top_p, seed)
 
-    def _emit_token(self, seq: RunningSeq, token: int) -> None:
+    def _emit_token(self, seq: RunningSeq, token: int,
+                    lp: Optional[dict] = None) -> None:
+        """Deliver one generated token. When the request asked for
+        logprobs, the callback payload is ``(token, lp)`` with
+        ``lp = {"logprob": float, "top": [(token_id, logprob), ...]}``;
+        otherwise the bare int (the common path stays allocation-free)."""
         req = seq.req
         req.output_token_ids.append(token)
         finish = None
@@ -1355,10 +1384,9 @@ class EngineCore:
             finish = "length"
         elif len(req.all_token_ids) >= self.config.max_model_len:
             finish = "length"
-        if finish is None:
-            req.on_token(token, None)
-        else:
-            req.on_token(token, None)
+        payload = token if lp is None else (token, lp)
+        req.on_token(payload, None)
+        if finish is not None:
             with self._lock:
                 self.scheduler.finish(seq, finish)
             self.requests_finished_total += 1

@@ -29,6 +29,9 @@ class SamplingParams:
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
     n: int = 1
+    # None = no logprobs; an int = return the sampled token's logprob plus
+    # that many top alternatives (raw log-softmax, OpenAI semantics).
+    logprobs: Optional[int] = None
 
     @staticmethod
     def from_request(body: dict, default_max_tokens: int = 16) -> "SamplingParams":
@@ -37,6 +40,16 @@ class SamplingParams:
             stop = [stop]
         t = body.get("temperature")
         p = body.get("top_p")
+        # completions: logprobs is an int (top-N); chat: logprobs is a
+        # bool gated by top_logprobs (OpenAI schema).
+        lp_raw = body.get("logprobs")
+        if isinstance(lp_raw, bool):
+            logprobs = (int(body.get("top_logprobs") or 0)
+                        if lp_raw else None)
+        elif lp_raw is None:
+            logprobs = None
+        else:
+            logprobs = int(lp_raw)
         return SamplingParams(
             temperature=1.0 if t is None else float(t),
             top_p=1.0 if p is None else float(p),
@@ -52,6 +65,7 @@ class SamplingParams:
             presence_penalty=float(body.get("presence_penalty") or 0.0),
             frequency_penalty=float(body.get("frequency_penalty") or 0.0),
             n=max(int(body.get("n") or 1), 1),
+            logprobs=logprobs,
         )
 
 
@@ -92,6 +106,24 @@ def sample_tokens(
     choice = jax.vmap(sample_one)(rng_keys, masked)  # [B] in [0, K)
     sampled_ids = jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0]
     return jnp.where(temperature <= 0.0, greedy_ids, sampled_ids)
+
+
+# Static top-K for logprob outputs baked into the serving programs
+# (requests clamp their top_logprobs to this; computing it always costs
+# ~nothing next to the forward, so no recompile per request).
+LOGPROB_K = 8
+
+
+def logprob_outputs(logits: jax.Array, sampled: jax.Array,
+                    k: int = LOGPROB_K):
+    """Raw log-softmax stats for the OpenAI logprobs surface:
+    (chosen_lp [B], top_lp [B, k], top_ids [B, k])."""
+    lse = jax.scipy.special.logsumexp(
+        logits.astype(jnp.float32), axis=-1, keepdims=True)
+    lp = logits.astype(jnp.float32) - lse
+    chosen = jnp.take_along_axis(lp, sampled[:, None], axis=-1)[:, 0]
+    top_lp, top_ids = jax.lax.top_k(lp, k)
+    return chosen, top_lp, top_ids
 
 
 def make_rng_keys(seed: int, step: int, seq_seeds: jax.Array) -> jax.Array:

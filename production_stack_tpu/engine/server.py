@@ -341,6 +341,27 @@ class EngineServer:
         return stream
 
     @staticmethod
+    def _split_token(payload):
+        """Engine emission -> (token_id, lp|None): logprob-requesting
+        streams carry (token, {"logprob", "top"}) tuples."""
+        if isinstance(payload, tuple):
+            return payload
+        return payload, None
+
+    def _lp_entry(self, token_id: int, lp: dict) -> dict:
+        """One OpenAI chat-logprobs content entry."""
+        text = self.core.tokenizer.decode([token_id])
+        entry = {"token": text, "logprob": lp["logprob"],
+                 "bytes": list(text.encode())}
+        tops = []
+        for tid, tlp in lp["top"]:
+            ttext = self.core.tokenizer.decode([tid])
+            tops.append({"token": ttext, "logprob": tlp,
+                         "bytes": list(ttext.encode())})
+        entry["top_logprobs"] = tops
+        return entry
+
+    @staticmethod
     def _apply_stop(text_so_far: str, delta: str, stop: Optional[List[str]]):
         """Returns (emit_delta, stopped). Stop strings end the stream and are
         not emitted."""
@@ -479,14 +500,21 @@ class EngineServer:
             text_so_far = ""
             first = True
             finish_reason = "stop"
+            # Logprob entries for tokens whose text is held back by the
+            # incremental detokenizer (partial UTF-8) ride the next
+            # written chunk instead of being dropped.
+            pending_lp: List[dict] = []
             try:
-                async for token_id, finish in stream:
-                    if token_id is None:
+                async for raw_tok, finish in stream:
+                    if raw_tok is None:
                         if finish in ("stop", "length", "abort"):
                             finish_reason = finish
                         if finish == "error":
                             finish_reason = "stop"
                         break
+                    token_id, lp = self._split_token(raw_tok)
+                    if lp is not None:
+                        pending_lp.append(self._lp_entry(token_id, lp))
                     delta = detok.push(token_id)
                     if finish is not None:
                         delta += detok.flush()
@@ -496,6 +524,12 @@ class EngineServer:
                     if emit or first:
                         if not buffer_tools:
                             payload = chunk_payload(emit, None, first)
+                            if pending_lp:
+                                payload["choices"][0]["logprobs"] = (
+                                    {"content": pending_lp}
+                                    if kind == "chat" else
+                                    self._completions_logprobs(pending_lp))
+                                pending_lp = []
                             await resp.write(
                                 f"data: {json.dumps(payload)}\n\n".encode())
                         first = False
@@ -520,16 +554,27 @@ class EngineServer:
                             delta["content"] = content
                     else:
                         delta["content"] = text_so_far
+                    choice = {"index": 0, "delta": delta,
+                              "finish_reason": None}
+                    if pending_lp:  # buffered mode: all entries ride here
+                        choice["logprobs"] = {"content": pending_lp}
+                        pending_lp = []
                     payload = {
                         "id": rid, "object": "chat.completion.chunk",
                         "created": created, "model": model,
-                        "choices": [{"index": 0, "delta": delta,
-                                     "finish_reason": None}],
+                        "choices": [choice],
                     }
                     await resp.write(
                         f"data: {json.dumps(payload)}\n\n".encode())
                     first = False
                 final = chunk_payload("", finish_reason, first)
+                if pending_lp:
+                    # Entries whose token text never surfaced (EOS, a
+                    # stop-trimmed tail) ride the final chunk so stream
+                    # and non-stream report the same token set.
+                    final["choices"][0]["logprobs"] = (
+                        {"content": pending_lp} if kind == "chat"
+                        else self._completions_logprobs(pending_lp))
                 await resp.write(f"data: {json.dumps(final)}\n\n".encode())
                 await resp.write(b"data: [DONE]\n\n")
                 await resp.write_eof()
@@ -540,11 +585,12 @@ class EngineServer:
 
         # Non-streaming: collect all tokens.
         pieces: List[str] = []
+        lp_entries: List[dict] = []
         n_generated = 0
         finish_reason = "stop"
         text_so_far = ""
-        async for token_id, finish in stream:
-            if token_id is None:
+        async for raw_tok, finish in stream:
+            if raw_tok is None:
                 if finish == "length" and n_generated == 0:
                     # Scheduler rejection: the prompt itself exceeds
                     # max_model_len. Surface as a client error, not an
@@ -560,7 +606,10 @@ class EngineServer:
                 if finish in ("stop", "length", "abort"):
                     finish_reason = finish
                 break
+            token_id, lp = self._split_token(raw_tok)
             n_generated += 1
+            if lp is not None:
+                lp_entries.append(self._lp_entry(token_id, lp))
             delta = detok.push(token_id)
             if finish is not None:
                 delta += detok.flush()
@@ -589,23 +638,47 @@ class EngineServer:
                                "content": content or None,
                                "tool_calls": tool_calls}
                     finish_reason = "tool_calls"
+            choice = {
+                "index": 0,
+                "message": message,
+                "finish_reason": finish_reason,
+            }
+            if lp_entries:
+                choice["logprobs"] = {"content": lp_entries}
             payload = {
                 "id": rid, "object": obj, "created": created, "model": model,
-                "choices": [{
-                    "index": 0,
-                    "message": message,
-                    "finish_reason": finish_reason,
-                }],
+                "choices": [choice],
                 "usage": usage,
             }
         else:
+            choice = {"index": 0, "text": text,
+                      "finish_reason": finish_reason}
+            if lp_entries:
+                choice["logprobs"] = self._completions_logprobs(lp_entries)
             payload = {
                 "id": rid, "object": obj, "created": created, "model": model,
-                "choices": [{"index": 0, "text": text,
-                             "finish_reason": finish_reason}],
+                "choices": [choice],
                 "usage": usage,
             }
         return web.json_response(payload, headers={"X-Request-Id": rid})
+
+    @staticmethod
+    def _completions_logprobs(entries: List[dict]) -> dict:
+        """Chat-style entries -> the legacy completions logprobs object."""
+        offsets = []
+        pos = 0
+        for e in entries:
+            offsets.append(pos)
+            pos += len(e["token"])
+        return {
+            "tokens": [e["token"] for e in entries],
+            "token_logprobs": [e["logprob"] for e in entries],
+            "top_logprobs": [
+                {t["token"]: t["logprob"] for t in e["top_logprobs"]}
+                for e in entries
+            ],
+            "text_offset": offsets,
+        }
 
     async def _respond_n(self, request, body, prompt_ids, sampling, rid,
                          model, adapter, *, kind, stream, stream_mode,
@@ -645,13 +718,24 @@ class EngineServer:
         texts = [""] * n
         finishes = ["stop"] * n
         counts = [0] * n
+        lp_all: "list[list[dict]]" = [[] for _ in range(n)]
+
+        # Per-choice logprob entries not yet shipped in a chunk (held-back
+        # text, EOS, stop-trimmed tails) — the finish chunk drains them.
+        pendings: "list[list[dict]]" = [[] for _ in range(n)]
 
         async def consume(i):
-            async for token_id, finish in streams[i]:
-                if token_id is None:
+            """Yields (emit_text, [lp_entries]) per written delta."""
+            async for raw_tok, finish in streams[i]:
+                if raw_tok is None:
                     if finish in ("stop", "length", "abort"):
                         finishes[i] = finish
                     break
+                token_id, lp = self._split_token(raw_tok)
+                if lp is not None:
+                    entry = self._lp_entry(token_id, lp)
+                    lp_all[i].append(entry)
+                    pendings[i].append(entry)
                 counts[i] += 1
                 delta = detoks[i].push(token_id)
                 if finish is not None:
@@ -661,7 +745,9 @@ class EngineServer:
                     texts[i], delta, sampling.stop)
                 texts[i] += emit
                 if emit:
-                    yield emit  # before the stop-break: never drop the tail
+                    # before the stop-break: never drop the tail
+                    yield emit, pendings[i]
+                    pendings[i] = []
                 if stopped:
                     finishes[i] = "stop"
                     self.core.abort_request(
@@ -680,12 +766,12 @@ class EngineServer:
 
             async def pump(i):
                 try:
-                    async for emit in consume(i):
-                        await queue.put((i, emit))
+                    async for emit, entries in consume(i):
+                        await queue.put((i, emit, entries))
                 finally:
                     # Sentinel even on error: the merge loop must not
                     # wait forever on a dead choice.
-                    await queue.put((i, None))
+                    await queue.put((i, None, None))
 
             tasks = [asyncio.get_running_loop().create_task(pump(i))
                      for i in range(n)]
@@ -700,7 +786,7 @@ class EngineServer:
 
             try:
                 while live:
-                    i, emit = await queue.get()
+                    i, emit, entries = await queue.get()
                     if emit is None:
                         live -= 1
                         continue
@@ -714,13 +800,19 @@ class EngineServer:
                                "finish_reason": None} if kind == "chat"
                               else {"index": i, "text": emit,
                                     "finish_reason": None})
+                    if entries:
+                        choice["logprobs"] = (
+                            {"content": entries} if kind == "chat"
+                            else self._completions_logprobs(entries))
                     await resp.write(
                         f"data: {json.dumps(chunk(choice))}\n\n".encode())
                 for i in range(n):
                     finish_reason = finishes[i]
                     if buffer_tools:
                         # Same buffered-tools contract as the n=1 stream:
-                        # one parsed delta per choice.
+                        # one parsed delta per choice (all the choice's
+                        # logprob entries ride it — nothing streamed
+                        # earlier).
                         content, tool_calls = parse_tool_calls(
                             texts[i], declared_tools)
                         delta = {"role": "assistant"}
@@ -733,8 +825,13 @@ class EngineServer:
                                 delta["content"] = content
                         else:
                             delta["content"] = texts[i]
-                        payload = chunk({"index": i, "delta": delta,
-                                         "finish_reason": None})
+                        tool_choice_payload = {"index": i, "delta": delta,
+                                               "finish_reason": None}
+                        if lp_all[i]:
+                            tool_choice_payload["logprobs"] = {
+                                "content": lp_all[i]}
+                            pendings[i] = []
+                        payload = chunk(tool_choice_payload)
                         await resp.write(
                             f"data: {json.dumps(payload)}\n\n".encode())
                     choice = ({"index": i, "delta": {},
@@ -742,6 +839,13 @@ class EngineServer:
                               if kind == "chat"
                               else {"index": i, "text": "",
                                     "finish_reason": finish_reason})
+                    if pendings[i]:
+                        # Entries whose text never surfaced (EOS, stop
+                        # tails) drain through the finish chunk.
+                        choice["logprobs"] = (
+                            {"content": pendings[i]} if kind == "chat"
+                            else self._completions_logprobs(pendings[i]))
+                        pendings[i] = []
                     await resp.write(
                         f"data: {json.dumps(chunk(choice))}\n\n".encode())
                 await resp.write(b"data: [DONE]\n\n")
@@ -774,11 +878,18 @@ class EngineServer:
                                    "content": content or None,
                                    "tool_calls": tool_calls}
                         finish_reason = "tool_calls"
-                choices.append({"index": i, "message": message,
-                                "finish_reason": finish_reason})
+                choice = {"index": i, "message": message,
+                          "finish_reason": finish_reason}
+                if lp_all[i]:
+                    choice["logprobs"] = {"content": lp_all[i]}
+                choices.append(choice)
             else:
-                choices.append({"index": i, "text": texts[i],
-                                "finish_reason": finishes[i]})
+                choice = {"index": i, "text": texts[i],
+                          "finish_reason": finishes[i]}
+                if lp_all[i]:
+                    choice["logprobs"] = self._completions_logprobs(
+                        lp_all[i])
+                choices.append(choice)
         total_new = sum(counts)
         payload = {
             "id": rid, "object": obj, "created": created, "model": model,

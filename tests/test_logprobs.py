@@ -1,0 +1,205 @@
+"""OpenAI logprobs surface: per-token chosen logprob + top-K alternatives
+computed on device inside the fused prefill/decode programs (raw
+log-softmax, vLLM/OpenAI semantics)."""
+
+import asyncio
+import json
+import math
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.server import EngineServer, run_engine_server
+
+
+def test_logprobs_chat_completions_and_stream():
+    server = EngineServer(EngineConfig(
+        model="tiny-llama", max_model_len=256, max_num_seqs=2,
+        block_size=8, num_blocks=64, max_loras=0))
+
+    async def run():
+        runner = await run_engine_server(server, "127.0.0.1", 0)
+        port = list(runner.sites)[0]._server.sockets[0].getsockname()[1]
+        import aiohttp
+
+        try:
+            async with aiohttp.ClientSession() as s:
+                # Chat, greedy: the chosen token must BE the top-1
+                # alternative with the same logprob.
+                body = {"model": "tiny-llama",
+                        "messages": [{"role": "user", "content": "hi"}],
+                        "max_tokens": 5, "temperature": 0.0,
+                        "ignore_eos": True,
+                        "logprobs": True, "top_logprobs": 4}
+                async with s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json=body) as resp:
+                    assert resp.status == 200, await resp.text()
+                    out = await resp.json()
+                content = out["choices"][0]["logprobs"]["content"]
+                assert len(content) == 5
+                for entry in content:
+                    assert entry["logprob"] <= 0.0
+                    tops = entry["top_logprobs"]
+                    assert len(tops) == 4
+                    # sorted descending, greedy pick == top-1
+                    lps = [t["logprob"] for t in tops]
+                    assert lps == sorted(lps, reverse=True)
+                    assert math.isclose(entry["logprob"], lps[0],
+                                        rel_tol=1e-5, abs_tol=1e-5)
+                    assert entry["bytes"] == list(
+                        entry["token"].encode())
+
+                # Completions: legacy logprobs object shape.
+                async with s.post(
+                        f"http://127.0.0.1:{port}/v1/completions",
+                        json={"model": "tiny-llama", "prompt": "abc",
+                              "max_tokens": 4, "temperature": 0.0,
+                              "ignore_eos": True, "logprobs": 3}) as resp:
+                    assert resp.status == 200, await resp.text()
+                    out = await resp.json()
+                lp = out["choices"][0]["logprobs"]
+                assert len(lp["tokens"]) == 4
+                assert len(lp["token_logprobs"]) == 4
+                # Text-keyed legacy dicts can collapse when distinct ids
+                # detokenize to the same text (byte-fallback tokenizer).
+                assert all(1 <= len(d) <= 3 for d in lp["top_logprobs"])
+                assert lp["text_offset"][0] == 0
+
+                # Streaming chat: every content chunk carries its entry.
+                body["stream"] = True
+                async with s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json=body) as resp:
+                    assert resp.status == 200
+                    raw = await resp.text()
+                chunks = [json.loads(ln[len("data: "):])
+                          for ln in raw.splitlines()
+                          if ln.startswith("data: ")
+                          and ln != "data: [DONE]"]
+                total_entries = sum(
+                    len(c["choices"][0]["logprobs"]["content"])
+                    for c in chunks if c["choices"][0].get("logprobs"))
+                # Every generated token's entry arrives exactly once
+                # (held-back partial-UTF-8 tokens ride a later chunk).
+                assert total_entries == 5
+                # Without logprobs: none attached.
+                async with s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json={"model": "tiny-llama",
+                              "messages": [{"role": "user",
+                                            "content": "hi"}],
+                              "max_tokens": 3,
+                              "temperature": 0.0}) as resp:
+                    out = await resp.json()
+                assert "logprobs" not in out["choices"][0]
+        finally:
+            await runner.cleanup()
+
+    try:
+        asyncio.run(run())
+    finally:
+        server.core.stop()
+
+
+def test_logprobs_with_n_choices():
+    server = EngineServer(EngineConfig(
+        model="tiny-llama", max_model_len=256, max_num_seqs=4,
+        block_size=8, num_blocks=64, max_loras=0))
+
+    async def run():
+        runner = await run_engine_server(server, "127.0.0.1", 0)
+        port = list(runner.sites)[0]._server.sockets[0].getsockname()[1]
+        import aiohttp
+
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json={"model": "tiny-llama",
+                              "messages": [{"role": "user",
+                                            "content": "hi"}],
+                              "n": 2, "max_tokens": 4,
+                              "temperature": 0.7, "seed": 3,
+                              "ignore_eos": True,
+                              "logprobs": True,
+                              "top_logprobs": 2}) as resp:
+                    assert resp.status == 200, await resp.text()
+                    out = await resp.json()
+                assert len(out["choices"]) == 2
+                for c in out["choices"]:
+                    entries = c["logprobs"]["content"]
+                    assert len(entries) == 4
+                    assert all(len(e["top_logprobs"]) == 2
+                               for e in entries)
+        finally:
+            await runner.cleanup()
+
+    try:
+        asyncio.run(run())
+    finally:
+        server.core.stop()
+
+
+def test_logprobs_streaming_completions_and_eos_entry():
+    """Legacy /v1/completions streaming carries logprobs objects, and an
+    EOS-terminated chat stream still reports the EOS token's entry (it
+    rides the final chunk), matching the non-stream token set."""
+    server = EngineServer(EngineConfig(
+        model="tiny-llama", max_model_len=256, max_num_seqs=2,
+        block_size=8, num_blocks=64, max_loras=0))
+
+    async def run():
+        runner = await run_engine_server(server, "127.0.0.1", 0)
+        port = list(runner.sites)[0]._server.sockets[0].getsockname()[1]
+        import aiohttp
+
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                        f"http://127.0.0.1:{port}/v1/completions",
+                        json={"model": "tiny-llama", "prompt": "xy",
+                              "max_tokens": 4, "temperature": 0.0,
+                              "ignore_eos": True, "logprobs": 2,
+                              "stream": True}) as resp:
+                    assert resp.status == 200
+                    raw = await resp.text()
+                chunks = [json.loads(ln[len("data: "):])
+                          for ln in raw.splitlines()
+                          if ln.startswith("data: ")
+                          and ln != "data: [DONE]"]
+                total = sum(
+                    len(c["choices"][0]["logprobs"]["tokens"])
+                    for c in chunks if c["choices"][0].get("logprobs"))
+                assert total == 4
+
+                # EOS path: do NOT ignore_eos; compare stream vs
+                # non-stream entry counts for the same seeded request.
+                body = {"model": "tiny-llama",
+                        "messages": [{"role": "user", "content": "q"}],
+                        "max_tokens": 40, "temperature": 1.2, "seed": 11,
+                        "logprobs": True, "top_logprobs": 1}
+                async with s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json=body) as resp:
+                    out = await resp.json()
+                n_entries = len(out["choices"][0]["logprobs"]["content"])
+                assert n_entries == out["usage"]["completion_tokens"]
+                body["stream"] = True
+                async with s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json=body) as resp:
+                    raw = await resp.text()
+                chunks = [json.loads(ln[len("data: "):])
+                          for ln in raw.splitlines()
+                          if ln.startswith("data: ")
+                          and ln != "data: [DONE]"]
+                streamed = sum(
+                    len(c["choices"][0]["logprobs"]["content"])
+                    for c in chunks if c["choices"][0].get("logprobs"))
+                assert streamed == n_entries
+        finally:
+            await runner.cleanup()
+
+    try:
+        asyncio.run(run())
+    finally:
+        server.core.stop()
