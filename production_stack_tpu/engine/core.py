@@ -164,6 +164,7 @@ class EngineCore:
         # -- compiled programs --------------------------------------------
         self._prefill_fn = self._make_forward("prefill")
         self._prefill_cached_fn = self._make_forward("prefill_cached")
+        self._set_counts_row_fn = self._make_set_counts_row()
         # Decode always runs through the fused burst program (K ==
         # decode_steps; K=1 degenerates to single-step).
         self._multi_decode_fns: Dict[int, Callable] = {}
@@ -194,6 +195,16 @@ class EngineCore:
         # In-flight speculative decode burst: dispatched to the device but
         # not yet read back (see _do_decode pipelining).
         self._pending_burst: Optional[dict] = None
+
+        # Per-slot output-token counts [B, V] (device-resident), the state
+        # behind presence/frequency penalties: updated inside the fused
+        # burst, row-reset in-burst for freshly prefilled slots. Small
+        # (B x V x 4B; 2 MB at 16 x 32k) and never host-transferred.
+        self._token_counts = jnp.zeros(
+            (config.max_num_seqs, self.model_config.vocab_size), jnp.int32)
+        # Slots whose counts row must reset at the next burst (set when a
+        # prefill lands in the slot; consumed by _do_decode).
+        self._counts_reset: "set[int]" = set()
 
         # -- engine thread -------------------------------------------------
         self._lock = threading.Condition()
@@ -380,9 +391,10 @@ class EngineCore:
         max_top_k = self.config.max_top_k
         seed = self.config.seed
 
-        def fwd(params, kv, tokens_prev, tok_idx, host_tokens, use_host,
-                positions0, slot_mat, block_tables, context0, adapter_ids,
-                temperature, top_k, top_p, seed_base):
+        def fwd(params, kv, counts, reset_counts, tokens_prev, tok_idx,
+                host_tokens, use_host, positions0, slot_mat, block_tables,
+                context0, adapter_ids, temperature, top_k, top_p,
+                seed_base, presence_penalty, frequency_penalty):
             # tokens_prev: [B, K] the PREVIOUS burst's sampled tokens (device
             # array — the feedback token never round-trips to the host, which
             # is what lets the engine dispatch burst N+1 before reading
@@ -393,31 +405,54 @@ class EngineCore:
                 use_host, host_tokens,
                 jnp.take_along_axis(tokens_prev, tok_idx[:, None], 1)[:, 0],
             )
+            # Freshly prefilled slots start a new output: zero their
+            # penalty-count rows in-burst (no extra dispatch), then count
+            # the slot's first output token (sampled during prefill, it
+            # arrives here as tokens0) so penalties see it too.
+            counts = jnp.where(reset_counts[:, None], 0, counts)
+            B = tokens0.shape[0]
+            counts = counts.at[jnp.arange(B), tokens0].add(
+                reset_counts.astype(jnp.int32))
 
             def body(carry, step_slots):
-                tokens, kv, s = carry
+                tokens, kv, counts, s = carry
                 logits, kv = apply(
                     params, cfg, tokens[:, None], (positions0 + s)[:, None],
                     kv, step_slots[:, None], block_tables, context0 + s,
                     jnp.ones_like(context0), mode="decode",
                     adapter_ids=adapter_ids,
                 )
+                raw = logits[:, 0]
+                # OpenAI presence/frequency penalties over the slot's
+                # OUTPUT tokens (logprobs report the raw distribution).
+                penalized = (
+                    raw
+                    - frequency_penalty[:, None] * counts
+                    - presence_penalty[:, None] * (counts > 0)
+                )
                 keys = make_rng_keys(seed, 0, seed_base + s)
                 sampled = sample_tokens(
-                    logits[:, 0], keys, temperature, top_k, top_p,
+                    penalized, keys, temperature, top_k, top_p,
                     max_top_k=max_top_k,
                 )
-                lp, top_lp, top_ids = logprob_outputs(logits[:, 0], sampled)
-                return (sampled, kv, s + 1), (sampled, lp, top_lp, top_ids)
+                lp, top_lp, top_ids = logprob_outputs(raw, sampled)
+                # Only steps whose page slot is live count (masked
+                # speculative steps are discarded at emission).
+                live = (step_slots >= 0).astype(jnp.int32)
+                counts = counts.at[jnp.arange(B), sampled].add(live)
+                return ((sampled, kv, counts, s + 1),
+                        (sampled, lp, top_lp, top_ids))
 
-            (_, kv, _), (out, lps, top_lps, top_idxs) = jax.lax.scan(
-                body, (tokens0, kv, jnp.int32(0)), slot_mat.T, length=K,
+            ((_, kv, counts, _),
+             (out, lps, top_lps, top_idxs)) = jax.lax.scan(
+                body, (tokens0, kv, counts, jnp.int32(0)), slot_mat.T,
+                length=K,
             )
             # [K, B, ...] -> [B, K, ...]
             return (out.T, lps.T, top_lps.swapaxes(0, 1),
-                    top_idxs.swapaxes(0, 1)), kv
+                    top_idxs.swapaxes(0, 1)), kv, counts
 
-        return jax.jit(fwd, donate_argnums=(1,))
+        return jax.jit(fwd, donate_argnums=(1, 2))
 
     def _multi_decode_fn(self, K: int):
         fn = self._multi_decode_fns.get(K)
@@ -437,6 +472,15 @@ class EngineCore:
             return k_pages, v_pages
 
         return write_block
+
+    def _make_set_counts_row(self):
+        """Jitted penalty-counts row install (preemption-resume path)."""
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def set_row(counts, slot, row):
+            return counts.at[slot].set(row)
+
+        return set_row
 
     def _make_write_blocks(self):
         """Jitted BATCHED page write: all transferred blocks land in one
@@ -770,8 +814,9 @@ class EngineCore:
             n_decode = 0
             while True:
                 maxb_w = min(maxb_w, cfg.max_blocks_per_seq)
-                _, self.kv = fn(
-                    self.params, self.kv,
+                _, self.kv, self._token_counts = fn(
+                    self.params, self.kv, self._token_counts,
+                    np.ones((B,), bool),         # reset_counts (warmup)
                     np.zeros((B, K), np.int32),  # tokens_prev
                     np.zeros((B,), np.int32),    # tok_idx
                     np.zeros((B,), np.int32),    # host_tokens
@@ -782,6 +827,8 @@ class EngineCore:
                     np.ones((B,), np.int32), np.zeros((B,), np.int32),
                     np.zeros((B,), np.float32), np.zeros((B,), np.int32),
                     np.ones((B,), np.float32), np.zeros((B,), np.int64),
+                    np.zeros((B,), np.float32),  # presence
+                    np.zeros((B,), np.float32),  # frequency
                 )
                 n_decode += 1
                 if maxb_w >= cfg.max_blocks_per_seq:
@@ -1128,6 +1175,28 @@ class EngineCore:
         with self._lock:
             slot = self.scheduler._free_slot()
             seq = self.scheduler.start_running(req, slot)
+        prior = req.output_token_ids
+        if prior and (req.sampling.presence_penalty
+                      or req.sampling.frequency_penalty):
+            # Resume after preemption with penalties active: rebuild the
+            # slot's count row from the carried-forward outputs instead of
+            # resetting it (the row may hold another request's counts).
+            # Rare path — one extra dispatch only when it matters.
+            row = np.zeros((self.model_config.vocab_size,), np.int32)
+            # prior outputs + the continuation token just sampled above
+            # (the in-burst tokens0 count only runs for reset slots).
+            ids = np.clip(np.asarray(prior + [token], np.int64), 0,
+                          self.model_config.vocab_size - 1)
+            np.add.at(row, ids, 1)
+            self._token_counts = self._set_counts_row_fn(
+                self._token_counts, np.int32(slot), row)
+            with self._lock:
+                self._counts_reset.discard(slot)
+        else:
+            with self._lock:
+                # Fresh output in this slot: its penalty counts reset at
+                # the next burst (which also counts this first token).
+                self._counts_reset.add(slot)
         self._emit_token(seq, token, lp)
         # Decode position bookkeeping starts from the emitted tokens (a
         # re-prefill after preemption carries prior outputs forward).
@@ -1266,6 +1335,13 @@ class EngineCore:
         top_k = np.zeros((B,), np.int32)
         top_p = np.ones((B,), np.float32)
         seed_base = np.zeros((B,), np.int64)
+        presence = np.zeros((B,), np.float32)
+        frequency = np.zeros((B,), np.float32)
+        reset_counts = np.zeros((B,), bool)
+        with self._lock:
+            for slot in self._counts_reset:
+                reset_counts[slot] = True
+            self._counts_reset.clear()
 
         for seq in active:
             i = seq.slot
@@ -1300,6 +1376,8 @@ class EngineCore:
             top_k[i] = k_
             top_p[i] = p_
             seed_base[i] = seed + r.scheduled_steps
+            presence[i] = r.sampling.presence_penalty
+            frequency[i] = r.sampling.frequency_penalty
             r.scheduled_steps += allow
 
         tokens_prev = (
@@ -1307,10 +1385,11 @@ class EngineCore:
             else np.zeros((B, K), np.int32)
         )
         fn = self._multi_decode_fn(K)
-        outs, self.kv = fn(
-            self.params, self.kv, tokens_prev, tok_idx, host_tokens,
-            use_host, positions0, slot_mat, block_table, context0,
-            adapter_ids, temperature, top_k, top_p, seed_base,
+        outs, self.kv, self._token_counts = fn(
+            self.params, self.kv, self._token_counts, reset_counts,
+            tokens_prev, tok_idx, host_tokens, use_host, positions0,
+            slot_mat, block_table, context0, adapter_ids, temperature,
+            top_k, top_p, seed_base, presence, frequency,
         )
         # Read back the PREVIOUS burst (overlaps this burst's execution).
         self._flush_pending_burst()

@@ -1,0 +1,97 @@
+"""Presence/frequency penalties are APPLIED (not just parsed): the burst
+carries per-slot output-token counts on device and penalizes logits
+OpenAI-style."""
+
+import asyncio
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.server import EngineServer, run_engine_server
+
+
+def _distinct_ratio(ids):
+    return len(set(ids)) / max(len(ids), 1)
+
+
+def test_frequency_penalty_reduces_repetition():
+    server = EngineServer(EngineConfig(
+        model="tiny-llama", max_model_len=256, max_num_seqs=2,
+        block_size=8, num_blocks=64, max_loras=0))
+
+    async def run():
+        runner = await run_engine_server(server, "127.0.0.1", 0)
+        port = list(runner.sites)[0]._server.sockets[0].getsockname()[1]
+        import aiohttp
+
+        async def gen(penalty):
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json={"model": "tiny-llama",
+                              "messages": [{"role": "user",
+                                            "content": "aaa"}],
+                              "max_tokens": 24, "temperature": 0.0,
+                              "ignore_eos": True,
+                              "frequency_penalty": penalty,
+                              "logprobs": True,
+                              "top_logprobs": 1}) as resp:
+                    assert resp.status == 200, await resp.text()
+                    out = await resp.json()
+            entries = out["choices"][0]["logprobs"]["content"]
+            return [e["token"] for e in entries]
+
+        try:
+            base = await gen(0.0)
+            # Greedy with random weights degenerates into a repeating
+            # cycle; a large frequency penalty must break it.
+            penalized = await gen(50.0)
+            assert _distinct_ratio(penalized) > _distinct_ratio(base)
+            # Greedy + penalty 0 is unchanged vs a second run (stable).
+            assert base == await gen(0.0)
+        finally:
+            await runner.cleanup()
+
+    try:
+        asyncio.run(run())
+    finally:
+        server.core.stop()
+
+
+def test_presence_penalty_and_slot_reset():
+    """Presence penalty changes sampling, and a slot reused by a new
+    request starts with fresh counts (the first request's outputs do not
+    penalize the second)."""
+    server = EngineServer(EngineConfig(
+        model="tiny-llama", max_model_len=256, max_num_seqs=1,
+        block_size=8, num_blocks=64, max_loras=0))
+
+    async def run():
+        runner = await run_engine_server(server, "127.0.0.1", 0)
+        port = list(runner.sites)[0]._server.sockets[0].getsockname()[1]
+        import aiohttp
+
+        async def gen(**kw):
+            body = {"model": "tiny-llama",
+                    "messages": [{"role": "user", "content": "zz"}],
+                    "max_tokens": 16, "temperature": 0.0,
+                    "ignore_eos": True, **kw}
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json=body) as resp:
+                    assert resp.status == 200, await resp.text()
+                    return (await resp.json())[
+                        "choices"][0]["message"]["content"]
+
+        try:
+            plain1 = await gen()
+            bent = await gen(presence_penalty=1.5)
+            plain2 = await gen()  # same slot, counts reset
+            assert plain1 == plain2  # reset works: deterministic repeat
+            assert bent != plain1   # penalty actually engaged
+        finally:
+            await runner.cleanup()
+
+    try:
+        asyncio.run(run())
+    finally:
+        server.core.stop()
