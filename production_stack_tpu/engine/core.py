@@ -24,6 +24,8 @@ import numpy as np
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.kvcache import KVCacheManager
 from production_stack_tpu.engine.sampling import (
+    MAX_LOGIT_BIAS,
+    MAX_STOP_IDS,
     SamplingParams,
     logprob_outputs,
     make_rng_keys,
@@ -355,9 +357,13 @@ class EngineCore:
         max_top_k = self.config.max_top_k
         seed_static = self.config.seed
 
+        _eos = getattr(self.tokenizer, "eos_token_id", None)
+        eos_id = int(_eos) if _eos is not None else -1  # 0 is a valid id
+
         def fwd(params, kv, token_ids, positions, slot_mapping,
                 block_tables, context_lens, seq_lens, adapter_ids,
-                temperature, top_k, top_p, seq_seeds, steps):
+                temperature, top_k, top_p, seq_seeds, steps,
+                suppress_eos, bias_ids, bias_vals, stop_ids, stop_valid):
             logits, kv = apply(
                 params, cfg, token_ids, positions, kv, slot_mapping,
                 block_tables, context_lens, seq_lens,
@@ -368,9 +374,21 @@ class EngineCore:
             else:  # prefill / prefill_cached: logits of the last real token
                 idx = jnp.maximum(seq_lens - 1, 0)[:, None, None]
                 last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
+            B = last.shape[0]
+            shaped = last.at[jnp.arange(B)[:, None], bias_ids].add(bias_vals)
+            if eos_id >= 0:  # min_tokens: mask EOS for the first token
+                shaped = jnp.where(
+                    suppress_eos[:, None]
+                    & (jnp.arange(shaped.shape[1])[None, :] == eos_id),
+                    -jnp.inf, shaped)
+            # stop_token_ids share the min_tokens mask (finite sentinel:
+            # -inf * 0 padding would make NaNs).
+            shaped = shaped.at[jnp.arange(B)[:, None], stop_ids].add(
+                -1e30 * stop_valid
+                * suppress_eos.astype(jnp.float32)[:, None])
             keys = make_rng_keys(seed_static, steps.max(), seq_seeds + steps)
             sampled = sample_tokens(
-                last, keys, temperature, top_k, top_p, max_top_k=max_top_k
+                shaped, keys, temperature, top_k, top_p, max_top_k=max_top_k
             )
             lp, top_lp, top_ids = logprob_outputs(last, sampled)
             return (sampled, lp, top_lp, top_ids), kv
@@ -391,10 +409,15 @@ class EngineCore:
         max_top_k = self.config.max_top_k
         seed = self.config.seed
 
+        _eos = getattr(self.tokenizer, "eos_token_id", None)
+        eos_id = int(_eos) if _eos is not None else -1  # 0 is a valid id
+
         def fwd(params, kv, counts, reset_counts, tokens_prev, tok_idx,
                 host_tokens, use_host, positions0, slot_mat, block_tables,
                 context0, adapter_ids, temperature, top_k, top_p,
-                seed_base, presence_penalty, frequency_penalty):
+                seed_base, presence_penalty, frequency_penalty,
+                min_tokens, out_len0, bias_ids, bias_vals,
+                stop_ids, stop_valid):
             # tokens_prev: [B, K] the PREVIOUS burst's sampled tokens (device
             # array — the feedback token never round-trips to the host, which
             # is what lets the engine dispatch burst N+1 before reading
@@ -424,12 +447,28 @@ class EngineCore:
                 )
                 raw = logits[:, 0]
                 # OpenAI presence/frequency penalties over the slot's
-                # OUTPUT tokens (logprobs report the raw distribution).
+                # OUTPUT tokens (logprobs report the raw distribution),
+                # plus sparse logit_bias and min_tokens EOS masking.
                 penalized = (
                     raw
                     - frequency_penalty[:, None] * counts
                     - presence_penalty[:, None] * (counts > 0)
                 )
+                penalized = penalized.at[
+                    jnp.arange(B)[:, None], bias_ids].add(bias_vals)
+                suppress = (out_len0 + s) < min_tokens  # [B]
+                if eos_id >= 0:
+                    penalized = jnp.where(
+                        suppress[:, None]
+                        & (jnp.arange(penalized.shape[1])[None, :]
+                           == eos_id),
+                        -jnp.inf, penalized)
+                # stop_token_ids share the min_tokens mask (finite
+                # sentinel: -inf * 0 padding would make NaNs).
+                penalized = penalized.at[
+                    jnp.arange(B)[:, None], stop_ids].add(
+                    -1e30 * stop_valid
+                    * suppress.astype(jnp.float32)[:, None])
                 keys = make_rng_keys(seed, 0, seed_base + s)
                 sampled = sample_tokens(
                     penalized, keys, temperature, top_k, top_p,
@@ -783,7 +822,11 @@ class EngineCore:
                 adapter_ids = np.zeros((1,), np.int32)
                 samp = (np.zeros((1,), np.float32), np.zeros((1,), np.int32),
                         np.ones((1,), np.float32), np.zeros((1,), np.int64),
-                        np.ones((1,), np.int64))
+                        np.ones((1,), np.int64), np.zeros((1,), bool),
+                        np.zeros((1, MAX_LOGIT_BIAS), np.int32),
+                        np.zeros((1, MAX_LOGIT_BIAS), np.float32),
+                        np.zeros((1, MAX_STOP_IDS), np.int32),
+                        np.zeros((1, MAX_STOP_IDS), np.float32))
                 # Plain prefill only ever sees context == span -> one tight
                 # table width per bucket.
                 _, self.kv = self._prefill_fn(
@@ -829,6 +872,12 @@ class EngineCore:
                     np.ones((B,), np.float32), np.zeros((B,), np.int64),
                     np.zeros((B,), np.float32),  # presence
                     np.zeros((B,), np.float32),  # frequency
+                    np.zeros((B,), np.int32),    # min_tokens
+                    np.zeros((B,), np.int32),    # out_len0
+                    np.zeros((B, MAX_LOGIT_BIAS), np.int32),
+                    np.zeros((B, MAX_LOGIT_BIAS), np.float32),
+                    np.zeros((B, MAX_STOP_IDS), np.int32),
+                    np.zeros((B, MAX_STOP_IDS), np.float32),
                 )
                 n_decode += 1
                 if maxb_w >= cfg.max_blocks_per_seq:
@@ -1239,6 +1288,16 @@ class EngineCore:
         seq_lens = np.asarray([take], np.int32)
         adapter_ids = np.asarray([req.adapter_id], np.int32)
         t, k_, p_, seed = self._sampling_for(req)
+        suppress_eos = np.asarray(
+            [len(req.output_token_ids) < req.sampling.min_tokens], bool)
+        bias_ids = np.zeros((1, MAX_LOGIT_BIAS), np.int32)
+        bias_vals = np.zeros((1, MAX_LOGIT_BIAS), np.float32)
+        self._fill_bias_row(bias_ids[0], bias_vals[0],
+                            self._resume_bias(req))
+        stop_ids = np.zeros((1, MAX_STOP_IDS), np.int32)
+        stop_valid = np.zeros((1, MAX_STOP_IDS), np.float32)
+        self._fill_stop_row(stop_ids[0], stop_valid[0],
+                            req.sampling.stop_token_ids)
 
         fn = self._prefill_cached_fn if start > 0 else self._prefill_fn
         sampled, self.kv = fn(
@@ -1247,6 +1306,7 @@ class EngineCore:
             np.asarray([t], np.float32), np.asarray([k_], np.int32),
             np.asarray([p_], np.float32), np.asarray([seed], np.int64),
             np.asarray([len(tokens)], np.int64),
+            suppress_eos, bias_ids, bias_vals, stop_ids, stop_valid,
         )
         return sampled
 
@@ -1337,6 +1397,12 @@ class EngineCore:
         seed_base = np.zeros((B,), np.int64)
         presence = np.zeros((B,), np.float32)
         frequency = np.zeros((B,), np.float32)
+        min_tok = np.zeros((B,), np.int32)
+        out_len0 = np.zeros((B,), np.int32)
+        bias_ids = np.zeros((B, MAX_LOGIT_BIAS), np.int32)
+        bias_vals = np.zeros((B, MAX_LOGIT_BIAS), np.float32)
+        stop_ids = np.zeros((B, MAX_STOP_IDS), np.int32)
+        stop_valid = np.zeros((B, MAX_STOP_IDS), np.float32)
         reset_counts = np.zeros((B,), bool)
         with self._lock:
             for slot in self._counts_reset:
@@ -1378,6 +1444,12 @@ class EngineCore:
             seed_base[i] = seed + r.scheduled_steps
             presence[i] = r.sampling.presence_penalty
             frequency[i] = r.sampling.frequency_penalty
+            min_tok[i] = r.sampling.min_tokens
+            out_len0[i] = r.scheduled_steps
+            self._fill_bias_row(bias_ids[i], bias_vals[i],
+                                r.sampling.logit_bias)
+            self._fill_stop_row(stop_ids[i], stop_valid[i],
+                                r.sampling.stop_token_ids)
             r.scheduled_steps += allow
 
         tokens_prev = (
@@ -1390,6 +1462,7 @@ class EngineCore:
             tokens_prev, tok_idx, host_tokens, use_host, positions0,
             slot_mat, block_table, context0, adapter_ids, temperature,
             top_k, top_p, seed_base, presence, frequency,
+            min_tok, out_len0, bias_ids, bias_vals, stop_ids, stop_valid,
         )
         # Read back the PREVIOUS burst (overlaps this burst's execution).
         self._flush_pending_burst()
@@ -1438,6 +1511,52 @@ class EngineCore:
                         seq.req.request_id, seq.req.all_token_ids
                     )
 
+    def _fill_stop_row(self, row_ids, row_valid,
+                       stop_token_ids: "list | None") -> None:
+        """Fill one slot's stop_token_ids mask arrays (masked alongside
+        EOS while min_tokens is unmet)."""
+        if not stop_token_ids:
+            return
+        vocab = self.model_config.vocab_size
+        ids = [t for t in stop_token_ids if 0 <= t < vocab][:MAX_STOP_IDS]
+        for j, tid in enumerate(ids):
+            row_ids[j] = tid
+            row_valid[j] = 1.0
+
+    def _resume_bias(self, req: EngineRequest) -> "dict | None":
+        """Effective logit_bias for the prefill program: the request's own
+        bias, plus — on preemption-resume with penalties active — the
+        penalty terms for the most-frequent prior output tokens (top
+        MAX_LOGIT_BIAS approximation; the burst program applies exact
+        counts from the next step on)."""
+        bias = dict(req.sampling.logit_bias or {})
+        pres = req.sampling.presence_penalty
+        freq = req.sampling.frequency_penalty
+        if req.output_token_ids and (pres or freq):
+            from collections import Counter
+
+            top = Counter(req.output_token_ids).most_common(MAX_LOGIT_BIAS)
+            for tid, cnt in top:
+                bias[tid] = bias.get(tid, 0.0) - freq * cnt - pres
+        return bias or None
+
+    def _fill_bias_row(self, row_ids, row_vals,
+                       logit_bias: "dict | None") -> None:
+        """Fill one slot's sparse logit_bias arrays (deterministic order,
+        excess entries dropped; padding rows add 0.0 to token 0)."""
+        if not logit_bias:
+            return
+        vocab = self.model_config.vocab_size
+        # Filter BEFORE capping so out-of-vocab keys can't crowd out
+        # valid biases.
+        items = sorted(
+            (tid, val) for tid, val in logit_bias.items()
+            if 0 <= tid < vocab
+        )[:MAX_LOGIT_BIAS]
+        for j, (tid, val) in enumerate(items):
+            row_ids[j] = tid
+            row_vals[j] = val
+
     def _sampling_for(self, r: EngineRequest):
         """Per-request sampling knobs (shared by prefill and burst decode):
         (temperature, clamped top_k, top_p, seed)."""
@@ -1457,9 +1576,15 @@ class EngineCore:
         req.output_token_ids.append(token)
         finish = None
         eos = getattr(self.tokenizer, "eos_token_id", None)
-        if (not req.sampling.ignore_eos) and eos is not None and token == eos:
+        n_out = len(req.output_token_ids)
+        min_ok = n_out >= req.sampling.min_tokens
+        if (not req.sampling.ignore_eos) and eos is not None \
+                and token == eos and min_ok:
             finish = "stop"
-        elif len(req.output_token_ids) >= req.sampling.max_tokens:
+        elif req.sampling.stop_token_ids and min_ok \
+                and token in req.sampling.stop_token_ids:
+            finish = "stop"
+        elif n_out >= req.sampling.max_tokens:
             finish = "length"
         elif len(req.all_token_ids) >= self.config.max_model_len:
             finish = "length"

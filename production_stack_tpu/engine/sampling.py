@@ -32,6 +32,16 @@ class SamplingParams:
     # None = no logprobs; an int = return the sampled token's logprob plus
     # that many top alternatives (raw log-softmax, OpenAI semantics).
     logprobs: Optional[int] = None
+    # EOS is suppressed (logit-masked in the fused programs) until this
+    # many output tokens exist — vLLM's min_tokens.
+    min_tokens: int = 0
+    # Extra token ids that finish the request like EOS (vLLM ext).
+    stop_token_ids: Optional[list] = None
+    # token id -> additive logit bias (OpenAI logit_bias; applied in the
+    # fused programs, capped at MAX_LOGIT_BIAS entries).
+    logit_bias: Optional[dict] = None
+    # Completions-only: prepend the prompt text to the output.
+    echo: bool = False
 
     @staticmethod
     def from_request(body: dict, default_max_tokens: int = 16) -> "SamplingParams":
@@ -66,6 +76,12 @@ class SamplingParams:
             frequency_penalty=float(body.get("frequency_penalty") or 0.0),
             n=max(int(body.get("n") or 1), 1),
             logprobs=logprobs,
+            min_tokens=int(body.get("min_tokens") or 0),
+            stop_token_ids=[int(t) for t in
+                            (body.get("stop_token_ids") or [])] or None,
+            logit_bias={int(k): float(v) for k, v in
+                        (body.get("logit_bias") or {}).items()} or None,
+            echo=bool(body.get("echo", False)),
         )
 
 
@@ -106,6 +122,16 @@ def sample_tokens(
     choice = jax.vmap(sample_one)(rng_keys, masked)  # [B] in [0, K)
     sampled_ids = jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0]
     return jnp.where(temperature <= 0.0, greedy_ids, sampled_ids)
+
+
+# Sparse logit_bias capacity baked into the serving programs (OpenAI caps
+# requests at 300 entries; 32 covers real use — excess entries are
+# dropped highest-id-last deterministically).
+MAX_LOGIT_BIAS = 32
+
+# stop_token_ids capacity in the serving programs (masked alongside EOS
+# while min_tokens is unmet, vLLM semantics).
+MAX_STOP_IDS = 8
 
 
 # Static top-K for logprob outputs baked into the serving programs

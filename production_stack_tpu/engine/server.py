@@ -505,6 +505,12 @@ class EngineServer:
             # written chunk instead of being dropped.
             pending_lp: List[dict] = []
             try:
+                if sampling.echo and kind == "completion":
+                    # OpenAI echo: the prompt text leads the stream.
+                    payload = chunk_payload(
+                        self.core.tokenizer.decode(prompt_ids), None, True)
+                    await resp.write(
+                        f"data: {json.dumps(payload)}\n\n".encode())
                 async for raw_tok, finish in stream:
                     if raw_tok is None:
                         if finish in ("stop", "length", "abort"):
@@ -651,7 +657,10 @@ class EngineServer:
                 "usage": usage,
             }
         else:
-            choice = {"index": 0, "text": text,
+            out_text = text
+            if sampling.echo:
+                out_text = self.core.tokenizer.decode(prompt_ids) + text
+            choice = {"index": 0, "text": out_text,
                       "finish_reason": finish_reason}
             if lp_entries:
                 choice["logprobs"] = self._completions_logprobs(lp_entries)
@@ -785,6 +794,14 @@ class EngineServer:
                     "choices": [choice]}
 
             try:
+                if sampling.echo and kind == "completion":
+                    # OpenAI echo: the prompt text leads each choice.
+                    prompt_text = self.core.tokenizer.decode(prompt_ids)
+                    for i in range(n):
+                        payload = chunk({"index": i, "text": prompt_text,
+                                         "finish_reason": None})
+                        await resp.write(
+                            f"data: {json.dumps(payload)}\n\n".encode())
                 while live:
                     i, emit, entries = await queue.get()
                     if emit is None:
@@ -884,7 +901,11 @@ class EngineServer:
                     choice["logprobs"] = {"content": lp_all[i]}
                 choices.append(choice)
             else:
-                choice = {"index": i, "text": texts[i],
+                out_text = texts[i]
+                if sampling.echo:
+                    out_text = (self.core.tokenizer.decode(prompt_ids)
+                                + out_text)
+                choice = {"index": i, "text": out_text,
                           "finish_reason": finishes[i]}
                 if lp_all[i]:
                     choice["logprobs"] = self._completions_logprobs(
