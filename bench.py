@@ -369,7 +369,11 @@ async def _main() -> dict:
         "config": CONFIG_KEY,
         "p50_ttft_s": round(statistics.median(ttfts), 4) if ttfts else None,
         "p99_ttft_s": (
-            round(sorted(ttfts)[max(0, int(len(ttfts) * 0.99) - 1)], 4)
+            # ceil-based index: with few samples this picks the LARGEST
+            # (int()-1 picked the smallest at n=2, reporting p99 < p50).
+            round(sorted(ttfts)[
+                min(len(ttfts) - 1,
+                    max(0, -(-99 * len(ttfts) // 100) - 1))], 4)
             if ttfts else None
         ),
         "p50_latency_s": (
