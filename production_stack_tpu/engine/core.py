@@ -268,11 +268,15 @@ class EngineCore:
 
     # Known per-chip HBM capacities, used when the runtime does not expose
     # memory_stats (e.g. tunneled/experimental platforms return None).
+    # v2/v3 are enumerated per-CORE by JAX (two cores per chip), so their
+    # entries are per-core HBM (8/16 GB), not per-chip (16/32 GB) —
+    # sizing a per-device KV pool from the chip figure would oversubscribe
+    # 2x. v4+ present one device per chip.
     _HBM_BY_KIND = (
         ("v5 lite", 16 << 30), ("v5e", 16 << 30),
         ("v5p", 95 << 30), ("v5", 95 << 30),
         ("v6", 32 << 30), ("v4", 32 << 30),
-        ("v3", 32 << 30), ("v2", 16 << 30),
+        ("v3", 16 << 30), ("v2", 8 << 30),
     )
 
     def _free_hbm_bytes(self) -> Optional[int]:
@@ -390,7 +394,10 @@ class EngineCore:
             sampled = sample_tokens(
                 shaped, keys, temperature, top_k, top_p, max_top_k=max_top_k
             )
-            lp, top_lp, top_ids = logprob_outputs(last, sampled)
+            # Logprobs reflect the distribution actually sampled from
+            # (logit_bias + min_tokens masking applied), matching
+            # OpenAI/vLLM post-processor logprob semantics.
+            lp, top_lp, top_ids = logprob_outputs(shaped, sampled)
             return (sampled, lp, top_lp, top_ids), kv
 
         return jax.jit(fwd, donate_argnums=(1,))
@@ -447,8 +454,9 @@ class EngineCore:
                 )
                 raw = logits[:, 0]
                 # OpenAI presence/frequency penalties over the slot's
-                # OUTPUT tokens (logprobs report the raw distribution),
-                # plus sparse logit_bias and min_tokens EOS masking.
+                # OUTPUT tokens, plus sparse logit_bias and min_tokens
+                # EOS masking. Logprobs are computed from these shaped
+                # logits (OpenAI/vLLM post-processor semantics).
                 penalized = (
                     raw
                     - frequency_penalty[:, None] * counts
@@ -474,7 +482,7 @@ class EngineCore:
                     penalized, keys, temperature, top_k, top_p,
                     max_top_k=max_top_k,
                 )
-                lp, top_lp, top_ids = logprob_outputs(raw, sampled)
+                lp, top_lp, top_ids = logprob_outputs(penalized, sampled)
                 # Only steps whose page slot is live count (masked
                 # speculative steps are discarded at emission).
                 live = (step_slots >= 0).astype(jnp.int32)

@@ -125,8 +125,8 @@ def sample_tokens(
 
 
 # Sparse logit_bias capacity baked into the serving programs (OpenAI caps
-# requests at 300 entries; 32 covers real use — excess entries are
-# dropped highest-id-last deterministically).
+# requests at 300 entries; 32 covers real use — requests exceeding it are
+# rejected with a 400 at the API layer rather than silently truncated).
 MAX_LOGIT_BIAS = 32
 
 # stop_token_ids capacity in the serving programs (masked alongside EOS

@@ -43,7 +43,7 @@ from aiohttp import web
 
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.core import EngineCore
-from production_stack_tpu.engine.sampling import SamplingParams
+from production_stack_tpu.engine.sampling import MAX_LOGIT_BIAS, SamplingParams
 from production_stack_tpu.engine.tokenizer import IncrementalDetokenizer
 from production_stack_tpu.engine.tools import (
     parse_tool_calls,
@@ -417,6 +417,9 @@ class EngineServer:
         adapter = self._resolve_adapter(model)
         self._report_kv_admission(prompt, prompt_ids, adapter or "")
         sampling = SamplingParams.from_request(body, default_max_tokens=128)
+        bad = self._reject_sampling(sampling)
+        if bad is not None:
+            return bad
         rid = request.headers.get("X-Request-Id") or f"chatcmpl-{uuid.uuid4().hex[:16]}"
         return await self._respond(
             request, body, prompt_ids, sampling, rid, model, adapter,
@@ -450,11 +453,32 @@ class EngineServer:
             self._report_kv_admission(
                 str(prompt), prompt_ids, adapter or "")
         sampling = SamplingParams.from_request(body, default_max_tokens=16)
+        bad = self._reject_sampling(sampling)
+        if bad is not None:
+            return bad
         rid = request.headers.get("X-Request-Id") or f"cmpl-{uuid.uuid4().hex[:16]}"
         return await self._respond(
             request, body, prompt_ids, sampling, rid, model, adapter,
             kind="completion",
         )
+
+    @staticmethod
+    def _reject_sampling(sampling) -> Optional[web.Response]:
+        """400 for sampling params beyond the compiled programs' capacity
+        instead of silently truncating (the fused programs bake in sparse
+        logit_bias slots — MAX_LOGIT_BIAS — so excess entries cannot be
+        applied; OpenAI accepts up to 300 but partial application would be
+        silent wrong output)."""
+        if sampling.logit_bias and len(sampling.logit_bias) > MAX_LOGIT_BIAS:
+            return web.json_response(
+                {"error": {
+                    "message": (
+                        f"logit_bias supports at most {MAX_LOGIT_BIAS} "
+                        f"entries on this engine "
+                        f"(got {len(sampling.logit_bias)})"),
+                    "type": "BadRequestError",
+                }}, status=400)
+        return None
 
     async def _respond(self, request, body, prompt_ids, sampling, rid, model,
                        adapter, *, kind: str) -> web.StreamResponse:
@@ -707,7 +731,11 @@ class EngineServer:
         n = sampling.n
         if len(prompt_ids) >= self.config.max_model_len:
             # Mirror the n=1 path's scheduler-rejection contract up front
-            # (each sub-request would be rejected with zero tokens).
+            # (each sub-request would be rejected with zero tokens). The
+            # choice-0 request is already enqueued (_respond creates it
+            # before branching here) — abort it rather than leaving it to
+            # the async scheduler rejection.
+            self.core.abort_request(rid)
             return web.json_response(
                 {"error": {
                     "message": (f"prompt ({len(prompt_ids)} tokens) "
@@ -717,11 +745,19 @@ class EngineServer:
                 }}, status=400)
         base_seed = (sampling.seed if sampling.seed is not None
                      else hash(rid) % (2**31))
+
+        def choice_rid(i: int) -> str:
+            return rid if i == 0 else f"{rid}-c{i}"
+
+        def abort_all() -> None:
+            for i in range(n):
+                self.core.abort_request(choice_rid(i))
+
         streams = [stream]
         for i in range(1, n):
             s_i = dataclasses.replace(sampling, seed=base_seed + i, n=1)
             streams.append(await self._generate(
-                prompt_ids, s_i, f"{rid}-c{i}", adapter))
+                prompt_ids, s_i, choice_rid(i), adapter))
         detoks = [IncrementalDetokenizer(self.core.tokenizer)
                   for _ in range(n)]
         texts = [""] * n
@@ -759,8 +795,7 @@ class EngineServer:
                     pendings[i] = []
                 if stopped:
                     finishes[i] = "stop"
-                    self.core.abort_request(
-                        rid if i == 0 else f"{rid}-c{i}")
+                    self.core.abort_request(choice_rid(i))
                     break
                 if finish is not None:
                     break
@@ -868,9 +903,7 @@ class EngineServer:
                 await resp.write(b"data: [DONE]\n\n")
                 await resp.write_eof()
             except (ConnectionResetError, asyncio.CancelledError):
-                for i in range(n):
-                    self.core.abort_request(
-                        rid if i == 0 else f"{rid}-c{i}")
+                abort_all()
                 raise
             finally:
                 for t in tasks:
@@ -881,7 +914,13 @@ class EngineServer:
             async for _ in consume(i):
                 pass
 
-        await asyncio.gather(*[drain(i) for i in range(n)])
+        try:
+            await asyncio.gather(*[drain(i) for i in range(n)])
+        except (ConnectionResetError, asyncio.CancelledError):
+            # Client vanished mid-gather (aiohttp cancels the handler):
+            # abort all n generations like the n=1 and streaming paths.
+            abort_all()
+            raise
         choices = []
         for i in range(n):
             if kind == "chat":
@@ -1303,6 +1342,13 @@ class EngineServer:
             return web.json_response(
                 {"error": "no cached prefix for these tokens"}, status=404)
         uuid_ = pipe.offer([payload["k"], payload["v"]])
+        if uuid_ is None:
+            # Offer table full (outstanding await_pull registrations pin
+            # HBM and cannot be cancelled) — puller falls back to
+            # /kv/extract.
+            return web.json_response(
+                {"error": "device pipe offer capacity exhausted"},
+                status=503)
         k = payload["k"]
         nbytes = int(k.size * k.dtype.itemsize * 2)
         self.kv_transfer_tx_bytes += nbytes
@@ -1366,28 +1412,51 @@ class EngineServer:
         specs = [jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
                  for _ in range(2)]
         pipe = self._device_pipe
-
-        def pull_and_inject():
-            k_dev, v_dev = pipe.pull(address, offer["uuid"], specs)
-            return self.core.inject_kv_blocks(
-                [int(h) for h in offer["hashes"]], k_dev, v_dev)
+        loop = asyncio.get_running_loop()
 
         try:
-            injected = await asyncio.get_running_loop().run_in_executor(
-                None, pull_and_inject)
+            k_dev, v_dev = await loop.run_in_executor(
+                None, lambda: pipe.pull(address, offer["uuid"], specs))
         except Exception as e:  # noqa: BLE001 - peer/transport error
+            # Deliberately NO /kv/release here: the sender's await_pull
+            # registration cannot be cancelled, so its buffers stay pinned
+            # whether or not the slot is freed. Keeping the slot counted
+            # means repeated pull failures exhaust MAX_PENDING_OFFERS and
+            # the pair degrades to the HTTP relay instead of pinning
+            # unbounded HBM on the sender.
             logger.warning("device pull failed, falling back: %s", e)
             return None
-        # Tell the sender its parked device buffers can be freed now
-        # (otherwise they stay pinned in HBM until the offer TTL).
+        # The pull consumed the sender's buffers, so release its offer
+        # slot NOW — before inject, whose failure must not burn the slot.
+        # Retried, status-checked: a swallowed failure would permanently
+        # hold one of the sender's slots (TTL expiry deliberately does
+        # not free them).
+        for attempt in range(3):
+            try:
+                async with aiohttp.ClientSession() as session:
+                    async with session.post(
+                            source.rstrip("/") + "/kv/release",
+                            json={"uuid": offer["uuid"]},
+                            timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                        if resp.status < 300:
+                            break
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                pass
+            if attempt == 2:
+                logger.warning(
+                    "kv/release to %s failed; sender offer slot %s "
+                    "stays held until its process restarts",
+                    source, offer["uuid"])
+            else:
+                await asyncio.sleep(0.2 * (attempt + 1))
         try:
-            async with aiohttp.ClientSession() as session:
-                await session.post(
-                    source.rstrip("/") + "/kv/release",
-                    json={"uuid": offer["uuid"]},
-                    timeout=aiohttp.ClientTimeout(total=5))
-        except aiohttp.ClientError:
-            pass  # TTL pruning covers it
+            injected = await loop.run_in_executor(
+                None, lambda: self.core.inject_kv_blocks(
+                    [int(h) for h in offer["hashes"]], k_dev, v_dev))
+        except Exception as e:  # noqa: BLE001 - local pool pressure etc.
+            logger.warning("device pull injected 0 blocks, falling back: %s",
+                           e)
+            return None
         total = time.monotonic() - t0
         nbytes = int(offer.get("bytes", 0))
         self.kv_transfer_device_pulls += 1

@@ -81,9 +81,19 @@ class KVDevicePipe:
     """One per engine process: offers extracted KV pages for pull and
     pulls offered pages from peers, all as device arrays."""
 
-    # Offers not pulled within this window are dropped (the decode side
-    # re-requests through the HTTP fallback on miss).
+    # Offers not pulled within this window are dropped from OUR table (the
+    # decode side re-requests through the HTTP fallback on miss). NOTE:
+    # expiry does NOT reclaim HBM — the experimental transfer API has no
+    # await_pull cancel, so the server-side registration keeps the device
+    # buffers alive until the peer pulls or the process exits. The
+    # MAX_PENDING_OFFERS cap below bounds that pinned memory: offer()
+    # refuses when full and the caller falls back to the HTTP relay.
     OFFER_TTL_SEC = 120.0
+
+    # Upper bound on concurrently registered (offered, not yet released)
+    # page bundles. At the default disagg shapes one bundle is tens of MB,
+    # so 8 bounds pinned HBM to a few hundred MB worst case.
+    MAX_PENDING_OFFERS = 8
 
     def __init__(self, listen: str = "0.0.0.0:0"):
         import jax
@@ -96,28 +106,54 @@ class KVDevicePipe:
         # uuid -> (arrays, deadline): keeps device buffers alive until
         # pulled or expired.
         self._pending: Dict[int, Tuple[Any, float]] = {}
+        # uuids with a live await_pull registration. Unlike _pending this
+        # never decays with the TTL (expiry cannot unregister buffers);
+        # entries leave only via release() of that exact uuid, so
+        # duplicate/bogus release calls cannot undercount pinned HBM.
+        self._registered: set = set()
         self._conns: Dict[str, Any] = {}
         self._lock = threading.Lock()
 
     def address(self) -> str:
         return self._server.address()
 
-    def offer(self, arrays: List[Any]) -> int:
-        """Park device arrays for a peer to pull; returns the pull uuid."""
-        uuid = next(self._uuid)
+    def offer(self, arrays: List[Any]) -> Optional[int]:
+        """Park device arrays for a peer to pull; returns the pull uuid,
+        or None when MAX_PENDING_OFFERS registrations are already
+        outstanding (un-released) — the caller must fall back to the HTTP
+        relay rather than pin more HBM behind an uncancellable
+        await_pull."""
         now = time.monotonic()
         with self._lock:
             self._pending = {
                 u: (a, dl) for u, (a, dl) in self._pending.items()
                 if dl > now
             }
+            if len(self._registered) >= self.MAX_PENDING_OFFERS:
+                logger.warning(
+                    "KV device pipe: %d offers outstanding, refusing new "
+                    "offer (HTTP relay fallback)", len(self._registered))
+                return None
+            uuid = next(self._uuid)
+            self._registered.add(uuid)
             self._pending[uuid] = (arrays, now + self.OFFER_TTL_SEC)
-        self._server.await_pull(uuid, arrays)
+        try:
+            self._server.await_pull(uuid, arrays)
+        except Exception:  # noqa: BLE001 - registration failed: no pin
+            with self._lock:
+                self._registered.discard(uuid)
+                self._pending.pop(uuid, None)
+            raise
         return uuid
 
     def release(self, uuid: int) -> None:
+        """Mark an offer consumed (peer pulled it, or the handoff was
+        abandoned and the puller told us). Frees a MAX_PENDING_OFFERS
+        slot; the device buffers themselves are reclaimed by the transfer
+        server once pulled."""
         with self._lock:
             self._pending.pop(uuid, None)
+            self._registered.discard(uuid)
 
     def pull(self, address: str, uuid: int, specs: List[Any]) -> List[Any]:
         """Pull device arrays matching ``specs`` (ShapeDtypeStructs with

@@ -1,6 +1,7 @@
 """OpenAI logprobs surface: per-token chosen logprob + top-K alternatives
-computed on device inside the fused prefill/decode programs (raw
-log-softmax, vLLM/OpenAI semantics)."""
+computed on device inside the fused prefill/decode programs, over the
+shaped (logit_bias / penalties / min_tokens-masked) distribution the token
+was actually sampled from (vLLM/OpenAI post-processor semantics)."""
 
 import asyncio
 import json
