@@ -14,6 +14,132 @@
 {{- end }}
 {{- end -}}
 
+{{/* Engine container command for a modelSpec entry (dict: model, port).
+     Shared by the single-host Deployment and the multi-host StatefulSet
+     so the flag surface cannot drift between them. */}}
+{{- define "chart.engineCommand" -}}
+- python
+- -m
+{{- if eq (default "generation" .model.modelType) "transcription" }}
+# Whisper-class ASR pod (reference: dedicated Whisper vLLM
+# pods behind the router's multipart transcription proxy).
+- production_stack_tpu.engine.asr_server
+- {{ .model.modelURL | quote }}
+- --host
+- "0.0.0.0"
+- --port
+- {{ .port | quote }}
+{{- range $arg := .model.extraArgs }}
+- {{ $arg | quote }}
+{{- end }}
+{{- else }}
+- production_stack_tpu.engine.server
+- {{ .model.modelURL | quote }}
+- --host
+- "0.0.0.0"
+- --port
+- {{ .port | quote }}
+{{- if .model.tensorParallelSize }}
+- --tensor-parallel-size
+- {{ .model.tensorParallelSize | quote }}
+{{- end }}
+{{- if .model.pipelineParallelSize }}
+- --pipeline-parallel-size
+- {{ .model.pipelineParallelSize | quote }}
+{{- end }}
+{{- if .model.maxModelLen }}
+- --max-model-len
+- {{ .model.maxModelLen | quote }}
+{{- end }}
+{{- if .model.maxNumSeqs }}
+- --max-num-seqs
+- {{ .model.maxNumSeqs | quote }}
+{{- end }}
+{{- if .model.kvOffloadGb }}
+- --kv-offload-gb
+- {{ .model.kvOffloadGb | quote }}
+{{- end }}
+{{- if .model.kvRemoteUrl }}
+- --kv-remote-url
+- {{ .model.kvRemoteUrl | quote }}
+{{- end }}
+{{- if .model.chatTemplate }}
+- --chat-template
+- /templates/chat-template.jinja
+{{- end }}
+{{- range $arg := .model.extraArgs }}
+- {{ $arg | quote }}
+{{- end }}
+{{- end }}
+{{- end -}}
+
+{{/* HF-token + extra env entries for a modelSpec (dict: root, model).
+     Shared by the Deployment and the multi-host StatefulSet. */}}
+{{- define "chart.engineEnvExtra" -}}
+{{- if .model.hfToken }}
+# HF gated-model auth: a plain string renders an inline secret;
+# {secretName, secretKey} references an existing one (matches
+# the reference chart's hf_token semantics).
+- name: HF_TOKEN
+  valueFrom:
+    secretKeyRef:
+      {{- if kindIs "string" .model.hfToken }}
+      name: "{{ include "chart.fullname" .root }}-{{ .model.name }}-hf-token"
+      key: token
+      {{- else }}
+      name: {{ .model.hfToken.secretName | quote }}
+      key: {{ .model.hfToken.secretKey | quote }}
+      {{- end }}
+{{- end }}
+{{- with .model.env }}
+{{- toYaml . }}
+{{- end }}
+{{- end -}}
+
+{{/* Startup + liveness probes (dict: root, port). */}}
+{{- define "chart.engineProbes" -}}
+startupProbe:
+  httpGet:
+    path: {{ .root.Values.servingEngineSpec.startupProbe.httpGet.path }}
+    port: {{ .port }}
+  initialDelaySeconds: {{ .root.Values.servingEngineSpec.startupProbe.initialDelaySeconds }}
+  periodSeconds: {{ .root.Values.servingEngineSpec.startupProbe.periodSeconds }}
+  failureThreshold: {{ .root.Values.servingEngineSpec.startupProbe.failureThreshold }}
+livenessProbe:
+  httpGet:
+    path: {{ .root.Values.servingEngineSpec.livenessProbe.httpGet.path }}
+    port: {{ .port }}
+  initialDelaySeconds: {{ .root.Values.servingEngineSpec.livenessProbe.initialDelaySeconds }}
+  periodSeconds: {{ .root.Values.servingEngineSpec.livenessProbe.periodSeconds }}
+  failureThreshold: {{ .root.Values.servingEngineSpec.livenessProbe.failureThreshold }}
+{{- end -}}
+
+{{/* volumeMounts entries for a modelSpec (empty when none needed). */}}
+{{- define "chart.engineVolumeMounts" -}}
+{{- if .model.pvcStorage }}
+- name: model-storage
+  mountPath: /models
+{{- end }}
+{{- if .model.chatTemplate }}
+- name: chat-template
+  mountPath: /templates
+{{- end }}
+{{- end -}}
+
+{{/* volumes entries for a modelSpec (dict: root, model). */}}
+{{- define "chart.engineVolumes" -}}
+{{- if .model.pvcStorage }}
+- name: model-storage
+  persistentVolumeClaim:
+    claimName: "{{ include "chart.fullname" .root }}-{{ .model.name }}-pvc"
+{{- end }}
+{{- if .model.chatTemplate }}
+- name: chat-template
+  configMap:
+    name: "{{ include "chart.fullname" .root }}-{{ .model.name }}-chat-template"
+{{- end }}
+{{- end -}}
+
 {{/* TPU resources block for a modelSpec entry. The reference's
      requestGPU/nvidia.com/gpu swap point (_helpers.tpl:108-150). */}}
 {{- define "chart.engineResources" -}}

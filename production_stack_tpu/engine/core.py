@@ -38,6 +38,7 @@ from production_stack_tpu.engine.scheduler import (
 )
 from production_stack_tpu.engine.tokenizer import build_tokenizer
 from production_stack_tpu.models import build_model, get_model_config
+from production_stack_tpu.parallel import multihost
 from production_stack_tpu.parallel.mesh import build_mesh
 from production_stack_tpu.parallel.sharding import (
     kv_pages_sharding,
@@ -63,17 +64,48 @@ class EngineCore:
             chat_template_path=config.chat_template,
         )
 
+        # Multi-host: every process joins one jax.distributed job, the
+        # mesh spans the GLOBAL device set, and followers replay the
+        # leader's dispatches (see parallel/multihost.py; the reference
+        # spans hosts with KubeRay — ref helm/templates/ray-cluster.yaml).
+        self._mh = multihost.maybe_context()
+        if self._mh is not None and (
+            config.kv_offload_bytes > 0 or config.kv_remote_url
+        ):
+            raise ValueError(
+                "KV offload tiers are not supported in multi-host mode "
+                "(pages are sharded across hosts; no single process can "
+                "serialize them)")
+
         all_devices = list(devices if devices is not None else jax.devices())
         pp = max(config.pipeline_parallel_size, 1)
-        n_needed = (
-            config.tensor_parallel_size * max(config.data_parallel_size, 1) * pp
-        )
+        tp = max(config.tensor_parallel_size, 1)
+        if self._mh is not None and config.data_parallel_size <= 1:
+            # Multi-host: the mesh MUST cover every process (a program
+            # whose mesh excludes a process cannot be executed by it), so
+            # dp auto-fills the whole global device set.
+            dp = len(all_devices) // (tp * pp)
+        else:
+            dp = max(config.data_parallel_size, 1)
+        n_needed = tp * dp * pp
+        if self._mh is not None and n_needed != len(all_devices):
+            raise ValueError(
+                f"multi-host mesh tp={tp} x pp={pp} x dp={dp} covers "
+                f"{n_needed} devices but the job has {len(all_devices)}; "
+                f"size the parallelism to the whole slice")
         self.mesh = build_mesh(
-            tensor_parallel_size=config.tensor_parallel_size,
-            data_parallel_size=max(config.data_parallel_size, 1),
+            tensor_parallel_size=tp,
+            data_parallel_size=dp,
             pipeline_parallel_size=pp,
             devices=all_devices[:n_needed],
         )
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        # Replicated-on-the-mesh sharding for host-read outputs and small
+        # device state: in multi-host SPMD every output the leader reads
+        # back (sampled tokens, logprobs) must be fully replicated, or
+        # device_get would need shards this process cannot address.
+        self._repl = NamedSharding(self.mesh, PartitionSpec())
 
         self._init_fn, self._apply = build_model(self.model_config)
         if pp > 1:
@@ -117,7 +149,19 @@ class EngineCore:
         self._maybe_load_checkpoint()
 
         # -- KV pages ------------------------------------------------------
-        self.num_blocks = config.num_blocks or self._auto_num_blocks()
+        if self._mh is not None and not self._mh.is_leader:
+            # The pool size is a host-side decision that must agree across
+            # processes (it fixes the global KV array shape): followers
+            # take the leader's figure instead of auto-sizing from their
+            # own memory stats.
+            op = self._mh.channel.recv()
+            assert op[0] == "cfg", op
+            self.num_blocks = int(op[1]["num_blocks"])
+        else:
+            self.num_blocks = config.num_blocks or self._auto_num_blocks()
+            if self._mh is not None:
+                self._mh.channel.send(
+                    ("cfg", {"num_blocks": self.num_blocks}, []))
         self._kv_sharding = kv_pages_sharding(self.model_config, self.mesh)
         self.kv = self._alloc_kv()
         self.kv_mgr = KVCacheManager(
@@ -197,13 +241,22 @@ class EngineCore:
         # In-flight speculative decode burst: dispatched to the device but
         # not yet read back (see _do_decode pipelining).
         self._pending_burst: Optional[dict] = None
+        # Device-resident [B, K] tokens of the most recent burst — the
+        # next burst's feedback source (kept per-process so multi-host
+        # followers never need the leader to ship device state).
+        self._last_burst_tokens = None
 
         # Per-slot output-token counts [B, V] (device-resident), the state
         # behind presence/frequency penalties: updated inside the fused
         # burst, row-reset in-burst for freshly prefilled slots. Small
         # (B x V x 4B; 2 MB at 16 x 32k) and never host-transferred.
-        self._token_counts = jnp.zeros(
-            (config.max_num_seqs, self.model_config.vocab_size), jnp.int32)
+        # Created THROUGH jit with an explicit mesh sharding: a plain
+        # jnp.zeros would be committed to this process's default device
+        # only, which cannot feed a computation over a multi-host mesh.
+        _counts_shape = (config.max_num_seqs, self.model_config.vocab_size)
+        self._token_counts = jax.jit(
+            lambda: jnp.zeros(_counts_shape, jnp.int32),
+            out_shardings=self._repl)()
         # Slots whose counts row must reset at the next burst (set when a
         # prefill lands in the slot; consumed by _do_decode).
         self._counts_reset: "set[int]" = set()
@@ -245,7 +298,11 @@ class EngineCore:
                 if isinstance(val, dict):
                     merge(dst.setdefault(key, {}), val, shard.get(key, {}))
                 else:
-                    dst[key] = jax.device_put(
+                    # put_global: each process contributes its local
+                    # shards (device_put cannot target non-addressable
+                    # devices of a multi-host mesh; every process loads
+                    # the same checkpoint from its own disk).
+                    dst[key] = multihost.put_global(
                         val, shard.get(key, replicated))
 
         params = dict(self.params)
@@ -285,7 +342,12 @@ class EngineCore:
         resident parameters actually occupy on this device, minus a fixed
         workspace reserve for XLA temporaries (prefill activations, f32
         score buffers, compile-time scratch)."""
-        dev = self.mesh.devices.flat[0]
+        # First ADDRESSABLE mesh device: in a multi-host job, device [0]
+        # may belong to another process and expose no stats here.
+        dev = next(
+            (d for d in self.mesh.devices.flat
+             if d.process_index == jax.process_index()),
+            self.mesh.devices.flat[0])
         try:
             stats = dev.memory_stats()
             if stats:
@@ -400,7 +462,12 @@ class EngineCore:
             lp, top_lp, top_ids = logprob_outputs(shaped, sampled)
             return (sampled, lp, top_lp, top_ids), kv
 
-        return jax.jit(fwd, donate_argnums=(1,))
+        # Sampled tokens / logprobs are read back on the host: pin them
+        # fully replicated so device_get works from any process of a
+        # multi-host mesh (and is a no-copy local read).
+        return jax.jit(
+            fwd, donate_argnums=(1,),
+            out_shardings=((self._repl,) * 4, self._kv_sharding))
 
     def _make_multi_decode(self, K: int):
         """Fused K-step decode: forward + on-device sampling (keys derived
@@ -499,7 +566,10 @@ class EngineCore:
             return (out.T, lps.T, top_lps.swapaxes(0, 1),
                     top_idxs.swapaxes(0, 1)), kv, counts
 
-        return jax.jit(fwd, donate_argnums=(1, 2))
+        return jax.jit(
+            fwd, donate_argnums=(1, 2),
+            out_shardings=((self._repl,) * 4, self._kv_sharding,
+                           self._repl))
 
     def _multi_decode_fn(self, K: int):
         fn = self._multi_decode_fns.get(K)
@@ -511,7 +581,9 @@ class EngineCore:
     def _make_write_block(self):
         """Jitted single-block page write (offload restore / KV inject)."""
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
+        @functools.partial(
+            jax.jit, donate_argnums=(0,),
+            out_shardings=(self._kv_sharding, self._kv_sharding))
         def write_block(kv, bid, k, v):
             k_pages, v_pages = kv
             k_pages = k_pages.at[:, bid].set(k.astype(k_pages.dtype))
@@ -523,7 +595,8 @@ class EngineCore:
     def _make_set_counts_row(self):
         """Jitted penalty-counts row install (preemption-resume path)."""
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
+        @functools.partial(jax.jit, donate_argnums=(0,),
+                           out_shardings=self._repl)
         def set_row(counts, slot, row):
             return counts.at[slot].set(row)
 
@@ -535,7 +608,9 @@ class EngineCore:
         receive path's scatter; per-block writes would cost one dispatch
         per page."""
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
+        @functools.partial(
+            jax.jit, donate_argnums=(0,),
+            out_shardings=(self._kv_sharding, self._kv_sharding))
         def write_blocks(kv, bids, k, v):
             k_pages, v_pages = kv
             k_pages = k_pages.at[:, bids].set(k.astype(k_pages.dtype))
@@ -543,6 +618,90 @@ class EngineCore:
             return k_pages, v_pages
 
         return write_blocks
+
+    # -- multi-host lockstep dispatch -------------------------------------
+    # Every serving-time device dispatch funnels through _dispatch: on a
+    # single host it just executes; in a multi-host job the leader first
+    # streams the op (name, static params, numpy args) to the followers,
+    # and every process then enqueues the SAME compiled program via
+    # _exec_op — the SPMD replacement for the reference's Ray actor RPCs
+    # (ref helm/templates/ray-cluster.yaml). Device-side state (params,
+    # KV pages, penalty counts, the previous burst's feedback tokens)
+    # stays process-local as addressable shards of the global arrays.
+
+    def _dispatch(self, name: str, static: dict, arrays: list):
+        mh = self._mh
+        if mh is None:
+            return self._exec_op(name, static, arrays)
+        with mh.lock:  # (send, enqueue) must be atomic for op ordering
+            mh.channel.send((name, static, arrays))
+            return self._exec_op(name, static, arrays)
+
+    def _exec_op(self, name: str, static: dict, arrays: list):
+        """The single source of truth for what each op does on-device;
+        leader and followers both run exactly this."""
+        if name == "prefill":
+            fn = (self._prefill_cached_fn if static["cached"]
+                  else self._prefill_fn)
+            out, self.kv = fn(self.params, self.kv, *arrays)
+            return out
+        if name == "decode":
+            K = static["K"]
+            fn = self._multi_decode_fn(K)
+            B = self.config.max_num_seqs
+            tokens_prev = (
+                self._last_burst_tokens if static["use_prev"]
+                else np.zeros((B, K), np.int32))
+            outs, self.kv, self._token_counts = fn(
+                self.params, self.kv, self._token_counts, arrays[0],
+                tokens_prev, *arrays[1:])
+            # The feedback tokens for the NEXT burst live on device on
+            # every process (the host never sees them mid-pipeline).
+            self._last_burst_tokens = outs[0]
+            return outs
+        if name == "set_counts_row":
+            self._token_counts = self._set_counts_row_fn(
+                self._token_counts, *arrays)
+            return None
+        if name == "write_block":
+            self.kv = self._write_block_fn(self.kv, *arrays)
+            return None
+        if name == "write_blocks":
+            self.kv = self._write_blocks_fn(self.kv, *arrays)
+            return None
+        if name == "embed":
+            fn = self._embed_fn(static["bucket"])
+            return fn(self.params, *arrays)
+        if name == "lora_load":
+            return self._lora_load_local(**static)
+        if name == "lora_unload":
+            return self._lora_unload_local(**static)
+        raise ValueError(f"unknown multihost op {name!r}")
+
+    def run_follower(self) -> None:
+        """Mirror loop for follower processes (process_id > 0): replay the
+        leader's op stream until it stops. The follower runs no scheduler,
+        no HTTP surface — just the same sequence of XLA programs, each of
+        which blocks at its collectives until all processes arrive."""
+        assert self._mh is not None and not self._mh.is_leader
+        logger.info("Follower %d/%d: entering mirror loop",
+                    self._mh.process_id, self._mh.num_processes)
+        while True:
+            op = self._mh.channel.recv()
+            if op[0] == "stop":
+                logger.info("Follower: leader stopped, exiting")
+                return
+            try:
+                self._exec_op(op[0], op[1], op[2])
+            except Exception:  # noqa: BLE001
+                # Mirror the leader's _loop contract: a failed step is
+                # logged and the loop continues. The same program + args
+                # fail symmetrically on the leader (its _loop catches
+                # too), so both sides skip the same state mutation and
+                # stay lockstep; dying here instead would wedge the
+                # leader at its next collective with no error surfaced.
+                logger.exception("Follower: op %r failed (continuing to "
+                                 "mirror)", op[0])
 
     # -- KV offload / transfer helpers ------------------------------------
     def _offload_block(self, prefix_hash: int, bid: int) -> None:
@@ -575,13 +734,17 @@ class EngineCore:
             if entry is None:
                 return False
             k, v = entry
-            self.kv = self._write_block_fn(self.kv, bid, k, v)
+            self._dispatch("write_block", {}, [np.int32(bid), k, v])
         return True
 
     def extract_kv(self, token_ids: List[int], adapter: str = ""):
         """Serialize the KV pages of the longest cached prefix of
         ``token_ids`` (disaggregated-prefill sender side; the NIXL-pipe
-        replacement, SURVEY §2.3). Returns dict or None."""
+        replacement, SURVEY §2.3). Returns dict or None. Unsupported in
+        multi-host mode (pages are sharded across hosts — no process can
+        serialize them alone); disagg units are per-mesh engines."""
+        if self._mh is not None:
+            return None
         from production_stack_tpu.engine.kvcache import BlockAllocator
 
         bs = self.config.block_size
@@ -623,7 +786,10 @@ class EngineCore:
         """Device-side variant of :meth:`extract_kv` for the transfer-pipe
         handoff: the gathered prefix pages STAY on device ([L, N, bs, KVH,
         D] arrays the KV device pipe offers for a peer pull) — no
-        device_get, no host copy. Returns dict or None."""
+        device_get, no host copy. Returns dict or None. Unsupported in
+        multi-host mode (see extract_kv)."""
+        if self._mh is not None:
+            return None
         from production_stack_tpu.engine.kvcache import BlockAllocator
 
         bs = self.config.block_size
@@ -666,7 +832,10 @@ class EngineCore:
         """Install transferred KV pages ([L, N, bs, KVH, D] — device
         arrays from the pipe or numpy from the HTTP relay) as cached
         (cold) prefix pages in ONE batched scatter dispatch. Returns
-        #blocks installed (cache-hit blocks count as installed)."""
+        #blocks installed (cache-hit blocks count as installed).
+        Unsupported in multi-host mode (see extract_kv)."""
+        if self._mh is not None:
+            return 0
         alloc = self.kv_mgr.allocator
         with self._step_lock:
             if self.kv is None or not alloc.enable_prefix_caching:
@@ -717,7 +886,10 @@ class EngineCore:
         is the fast path when prefill and decode engines share a chip or
         process (co-located multi-model pods; the dev-bench disagg
         topology); cross-host moves go through the transfer pipe or the
-        TKV2 relay. Returns #blocks installed."""
+        TKV2 relay. Returns #blocks installed. Unsupported in multi-host
+        mode (see extract_kv)."""
+        if self._mh is not None or src._mh is not None:
+            return 0
         from production_stack_tpu.engine.kvcache import BlockAllocator
 
         bs = self.config.block_size
@@ -925,10 +1097,20 @@ class EngineCore:
             self._lock.notify()
         if self._thread.ident is not None:  # started
             self._thread.join(timeout=10)
+        if self._mh is not None and self._mh.is_leader:
+            try:
+                self._mh.channel.send(("stop", {}, []))
+            except Exception:  # noqa: BLE001 - followers may be gone
+                pass
+            self._mh.channel.close()
 
     # -- sleep mode (reference relies on vLLM --enable-sleep-mode) ---------
     def sleep(self, level: int = 1) -> None:
-        """Free HBM: discard KV, move weights to host RAM."""
+        """Free HBM: discard KV, move weights to host RAM. Unsupported in
+        multi-host mode (params are sharded across hosts; device_get from
+        one process cannot stage them)."""
+        if self._mh is not None:
+            raise RuntimeError("sleep mode is unsupported in multi-host mode")
         with self._step_lock:  # wait out any in-flight forward step
             self._flush_pending_burst()
             with self._lock:
@@ -968,7 +1150,21 @@ class EngineCore:
         self, name: str, rank: Optional[int] = None,
         weights: Optional[dict] = None, alpha: float = 16.0,
     ) -> bool:
-        """Install an adapter into a free slot without recompiling."""
+        """Install an adapter into a free slot without recompiling. The
+        slot scatter is a device dispatch, so in multi-host mode it rides
+        the op channel like any other (weights travel as numpy; the
+        update itself is deterministic from the args)."""
+        if weights is not None:
+            weights = {k: np.asarray(v) for k, v in weights.items()}
+        return self._dispatch(
+            "lora_load",
+            {"name": name, "rank": rank, "weights": weights, "alpha": alpha},
+            [])
+
+    def _lora_load_local(
+        self, name: str, rank: Optional[int] = None,
+        weights: Optional[dict] = None, alpha: float = 16.0,
+    ) -> bool:
         rank = min(rank or self.config.max_lora_rank, self.config.max_lora_rank)
         with self._lock:
             # All state checks under the lock: sleep() can null self.params
@@ -988,18 +1184,27 @@ class EngineCore:
             if weights is not None:
                 for key in ("wq_a", "wq_b", "wv_a", "wv_b"):
                     if key in weights:
-                        w = jnp.asarray(weights[key], lora[key].dtype)
+                        # put_global: the update operand must live on the
+                        # same (possibly multi-host) mesh as the slot array.
+                        w = multihost.put_global(
+                            np.asarray(weights[key], lora[key].dtype),
+                            self._repl)
                         lora[key] = lora[key].at[:, slot].set(w)
             else:
                 # No weight source (zero egress): deterministic small init so
-                # the adapter is a real, observable delta.
-                key = jax.random.key(hash(name) % (2**31))
+                # the adapter is a real, observable delta. crc32, not
+                # hash(): str hashing is salted per process and multi-host
+                # followers must derive the identical key.
+                import zlib
+
+                key = jax.random.key(zlib.crc32(name.encode()) % (2**31))
                 for kname in ("wq_a", "wv_a"):
                     shape = lora[kname].shape  # [L, S, Hd, R]
-                    upd = 0.01 * jax.random.normal(
+                    upd = np.asarray(0.01 * jax.random.normal(
                         key, (shape[0], shape[2], shape[3]), jnp.float32
-                    ).astype(lora[kname].dtype)
-                    lora[kname] = lora[kname].at[:, slot].set(upd)
+                    )).astype(lora[kname].dtype)
+                    lora[kname] = lora[kname].at[:, slot].set(
+                        multihost.put_global(upd, self._repl))
             lora["scaling"] = lora["scaling"].at[slot].set(alpha / rank)
             self.params = {**self.params, "lora": lora}
             self.lora_slots[name] = slot
@@ -1007,6 +1212,9 @@ class EngineCore:
         return True
 
     def unload_lora_adapter(self, name: str) -> bool:
+        return self._dispatch("lora_unload", {"name": name}, [])
+
+    def _lora_unload_local(self, name: str) -> bool:
         with self._lock:
             if name not in self.lora_slots:
                 return False
@@ -1026,9 +1234,18 @@ class EngineCore:
             return fn
         apply = self._apply
         cfg = self.model_config
+        bs = self.config.block_size
 
-        def embed_fwd(params, kv, token_ids, positions, slot_mapping,
+        def embed_fwd(params, token_ids, positions, slot_mapping,
                       block_tables, seq_lens):
+            # Throwaway single-page KV pool created INSIDE the program
+            # (a host-side jnp.zeros would be committed to one process's
+            # local device and could not feed a multi-host computation);
+            # slot_mapping is all -1, so writes drop.
+            kv_shape = (cfg.num_layers, 1, bs, cfg.num_kv_heads,
+                        cfg.head_dim)
+            kv = (jnp.zeros(kv_shape, cfg.jnp_dtype),
+                  jnp.zeros(kv_shape, cfg.jnp_dtype))
             hidden, _ = apply(
                 params, cfg, token_ids, positions, kv, slot_mapping,
                 block_tables, seq_lens, seq_lens,
@@ -1042,7 +1259,7 @@ class EngineCore:
             norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
             return pooled / jnp.maximum(norm, 1e-12)
 
-        fn = jax.jit(embed_fwd)
+        fn = jax.jit(embed_fwd, out_shardings=self._repl)
         self._embed_fns[bucket] = fn
         return fn
 
@@ -1070,13 +1287,8 @@ class EngineCore:
         slot_mapping = np.full((1, bucket), -1, np.int64)  # writes dropped
         block_tables = np.zeros((1, 4), np.int32)
         seq_lens = np.asarray([n], np.int32)
-        kv_shape = (mc.num_layers, 1, cfg.block_size,
-                    mc.num_kv_heads, mc.head_dim)
-        dummy_kv = (jnp.zeros(kv_shape, mc.jnp_dtype),
-                    jnp.zeros(kv_shape, mc.jnp_dtype))
-        fn = self._embed_fn(bucket)
-        pooled = fn(params, dummy_kv, token_ids, positions, slot_mapping,
-                    block_tables, seq_lens)
+        pooled = self._dispatch("embed", {"bucket": bucket}, [
+            token_ids, positions, slot_mapping, block_tables, seq_lens])
         return np.asarray(jax.device_get(pooled), np.float32)[0].tolist()
 
     # -- stats -------------------------------------------------------------
@@ -1245,8 +1457,7 @@ class EngineCore:
             ids = np.clip(np.asarray(prior + [token], np.int64), 0,
                           self.model_config.vocab_size - 1)
             np.add.at(row, ids, 1)
-            self._token_counts = self._set_counts_row_fn(
-                self._token_counts, np.int32(slot), row)
+            self._dispatch("set_counts_row", {}, [np.int32(slot), row])
             with self._lock:
                 self._counts_reset.discard(slot)
         else:
@@ -1307,16 +1518,14 @@ class EngineCore:
         self._fill_stop_row(stop_ids[0], stop_valid[0],
                             req.sampling.stop_token_ids)
 
-        fn = self._prefill_cached_fn if start > 0 else self._prefill_fn
-        sampled, self.kv = fn(
-            self.params, self.kv, token_arr, positions, slot_mapping,
+        return self._dispatch("prefill", {"cached": start > 0}, [
+            token_arr, positions, slot_mapping,
             block_table, context_lens, seq_lens, adapter_ids,
             np.asarray([t], np.float32), np.asarray([k_], np.int32),
             np.asarray([p_], np.float32), np.asarray([seed], np.int64),
             np.asarray([len(tokens)], np.int64),
             suppress_eos, bias_ids, bias_vals, stop_ids, stop_valid,
-        )
-        return sampled
+        ])
 
     # -- decode ------------------------------------------------------------
     def _do_decode(self) -> None:
@@ -1460,18 +1669,13 @@ class EngineCore:
                                 r.sampling.stop_token_ids)
             r.scheduled_steps += allow
 
-        tokens_prev = (
-            prev["out"][0] if prev is not None
-            else np.zeros((B, K), np.int32)
-        )
-        fn = self._multi_decode_fn(K)
-        outs, self.kv, self._token_counts = fn(
-            self.params, self.kv, self._token_counts, reset_counts,
-            tokens_prev, tok_idx, host_tokens, use_host, positions0,
-            slot_mat, block_table, context0, adapter_ids, temperature,
-            top_k, top_p, seed_base, presence, frequency,
-            min_tok, out_len0, bias_ids, bias_vals, stop_ids, stop_valid,
-        )
+        outs = self._dispatch(
+            "decode", {"K": K, "use_prev": prev is not None}, [
+                reset_counts, tok_idx, host_tokens, use_host, positions0,
+                slot_mat, block_table, context0, adapter_ids, temperature,
+                top_k, top_p, seed_base, presence, frequency,
+                min_tok, out_len0, bias_ids, bias_vals, stop_ids, stop_valid,
+            ])
         # Read back the PREVIOUS burst (overlaps this burst's execution).
         self._flush_pending_burst()
         self._pending_burst = {

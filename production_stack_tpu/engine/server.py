@@ -1157,7 +1157,15 @@ class EngineServer:
     # lifecycle / metrics
     # ------------------------------------------------------------------ #
     async def handle_health(self, request: web.Request) -> web.Response:
-        return web.json_response({"status": "ok"})
+        body = {"status": "ok"}
+        mh = self.core._mh
+        if mh is not None:
+            # All processes joined by construction (jax.distributed and
+            # the op channel both barrier at startup) — report the span.
+            body.update({"role": "leader",
+                         "num_processes": mh.num_processes,
+                         "mesh": dict(self.core.mesh.shape)})
+        return web.json_response(body)
 
     async def handle_version(self, request: web.Request) -> web.Response:
         from production_stack_tpu import __version__
@@ -1166,8 +1174,13 @@ class EngineServer:
 
     async def handle_sleep(self, request: web.Request) -> web.Response:
         level = int(request.query.get("level", "1"))
-        await asyncio.get_running_loop().run_in_executor(
-            None, self.core.sleep, level)
+        try:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.core.sleep, level)
+        except RuntimeError as e:  # multi-host: params sharded across hosts
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "BadRequestError"}}, status=400)
         return web.json_response({"status": "sleeping", "level": level})
 
     async def handle_wake(self, request: web.Request) -> web.Response:
@@ -1728,6 +1741,13 @@ def main(argv: Optional[List[str]] = None) -> None:
         import jax
 
         jax.config.update("jax_platforms", jax_config_platforms)
+    # Multi-host: join the jax.distributed job BEFORE any device use. The
+    # engine's mesh then spans the global device set; follower processes
+    # (process_id > 0) run the mirror loop instead of serving HTTP (the
+    # reference's equivalent is a KubeRay worker pod, ray-cluster.yaml).
+    from production_stack_tpu.parallel import multihost
+
+    mh_env = multihost.initialize_from_env()
     args = build_arg_parser().parse_args(argv)
     model = args.model_flag or args.model or "tiny-llama"
     config = EngineConfig(
@@ -1749,6 +1769,10 @@ def main(argv: Optional[List[str]] = None) -> None:
         kv_remote_url=args.kv_remote_url,
         chat_template=args.chat_template,
     )
+    if mh_env is not None and mh_env["process_id"] != 0:
+        _run_follower(config, args)
+        return
+
     server = EngineServer(config, args.served_model_name,
                           warmup=args.warmup,
                           kv_controller_url=args.kv_controller_url,
@@ -1761,6 +1785,42 @@ def main(argv: Optional[List[str]] = None) -> None:
             await asyncio.sleep(3600)
 
     asyncio.run(_run())
+
+
+def _run_follower(config: EngineConfig, args) -> None:
+    """Follower process of a multi-host engine: build the identical core
+    (its __init__ and warmup enqueue the same collective programs as the
+    leader's), serve a bare /health for pod probes, then replay the
+    leader's op stream until it stops."""
+    core = EngineCore(config)
+    if args.warmup:
+        core.warmup()
+
+    async def _health(request):
+        return web.json_response({
+            "status": "ok", "role": "follower",
+            "process_id": core._mh.process_id,
+            "num_processes": core._mh.num_processes,
+        })
+
+    async def _serve_health():
+        app = web.Application()
+        app.router.add_get("/health", _health)
+        app.router.add_get("/healthz", _health)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        await web.TCPSite(runner, args.host, args.port).start()
+        return runner
+
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(_serve_health())
+    t = threading.Thread(target=loop.run_forever, daemon=True,
+                         name="follower-health")
+    t.start()
+    try:
+        core.run_follower()
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
 
 
 if __name__ == "__main__":

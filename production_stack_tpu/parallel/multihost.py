@@ -1,0 +1,292 @@
+"""Multi-host serving bring-up: ``jax.distributed`` + the lockstep op channel.
+
+The reference serves models too big for one node by spanning a KubeRay
+cluster (ref ``helm/templates/ray-cluster.yaml:1-622``: head + workers,
+``EXPECTED_NODES`` readiness gate at ``:46-47``;
+``docs/source/use_cases/pipeline-parallelism-kuberay.rst``) and letting
+vLLM drive pipeline stages through Ray actors over NCCL. The TPU-native
+equivalent is SPMD, not actors: every host joins one ``jax.distributed``
+job, the engine builds its mesh over the GLOBAL device set, and each
+compiled program is executed by ALL processes — XLA's collectives ride
+ICI within a slice and DCN between slices. No Ray, no RPC per tensor.
+
+What replaces the actor mailbox is a tiny control plane: process 0 (the
+leader) owns the scheduler, the KV block accounting, and the HTTP
+surface; follower processes mirror every device dispatch. The leader
+serializes each op's host-side arguments (a few KB of numpy per step)
+over a TCP side channel, and followers replay them through the same
+``EngineCore._exec_op`` chokepoint, so both sides enqueue the identical
+sequence of XLA programs. Device-side state (params, KV pages, penalty
+counts, the in-flight burst's feedback tokens) never crosses the wire —
+each process holds its own addressable shards of the same global arrays.
+
+Why TCP and not ``multihost_utils.broadcast_one_to_all``: the broadcast
+is itself a collective device computation, so using it for control
+messages would put two extra device dispatches on every engine step and
+entangle control ordering with compute ordering. A socket write is
+~microseconds and keeps the op stream strictly host-side.
+
+Readiness ("EXPECTED_NODES" equivalent): ``jax.distributed.initialize``
+blocks until all processes join, and the leader's channel bind blocks
+until every follower connects — by the time the leader can serve, the
+cluster is complete.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, List, Optional
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+# Port offset of the op channel relative to the jax.distributed
+# coordinator port (overridable: TPU_STACK_OP_PORT).
+_OP_PORT_OFFSET = 1
+
+
+def distributed_env() -> Optional[dict]:
+    """Multi-host settings from the environment, or None when single-host.
+
+    - ``TPU_STACK_COORDINATOR``: ``host:port`` of process 0 (in K8s, the
+      pod-0 DNS name of the headless service — see
+      ``helm/templates/statefulset-engine-multihost.yaml``).
+    - ``TPU_STACK_NUM_PROCESSES``: total process count.
+    - ``TPU_STACK_PROCESS_ID``: this process's id; when unset, derived
+      from the trailing ordinal of the hostname (StatefulSet pods are
+      named ``<name>-<ordinal>``).
+    """
+    n = int(os.environ.get("TPU_STACK_NUM_PROCESSES", "1") or 1)
+    if n <= 1:
+        return None
+    coord = os.environ.get("TPU_STACK_COORDINATOR")
+    if not coord:
+        raise ValueError(
+            "TPU_STACK_NUM_PROCESSES > 1 requires TPU_STACK_COORDINATOR "
+            "(host:port of process 0)")
+    pid_s = os.environ.get("TPU_STACK_PROCESS_ID")
+    if pid_s is None or pid_s == "":
+        host = socket.gethostname()
+        tail = host.rsplit("-", 1)[-1]
+        if not tail.isdigit():
+            raise ValueError(
+                f"TPU_STACK_PROCESS_ID unset and hostname {host!r} has no "
+                f"trailing ordinal")
+        pid = int(tail)
+    else:
+        pid = int(pid_s)
+    op_port = int(os.environ.get("TPU_STACK_OP_PORT", "0") or 0)
+    if not op_port:
+        op_port = int(coord.rsplit(":", 1)[-1]) + _OP_PORT_OFFSET
+    return {
+        "coordinator": coord,
+        "num_processes": n,
+        "process_id": pid,
+        "op_port": op_port,
+    }
+
+
+_initialized = False
+
+
+def initialize_from_env() -> Optional[dict]:
+    """Join the ``jax.distributed`` job when configured. Must run before
+    the first device use. Returns the distributed env dict (or None)."""
+    global _initialized
+    env = distributed_env()
+    if env is None:
+        return None
+    if not _initialized:
+        import jax
+
+        logger.info(
+            "Joining distributed job: coordinator=%s process %d/%d",
+            env["coordinator"], env["process_id"], env["num_processes"])
+        jax.distributed.initialize(
+            coordinator_address=env["coordinator"],
+            num_processes=env["num_processes"],
+            process_id=env["process_id"],
+        )
+        _initialized = True
+    return env
+
+
+def put_global(value, sharding):
+    """Place a host array on the (possibly multi-host) mesh.
+
+    ``jax.device_put`` only handles shardings whose devices are all
+    addressable; across processes each host must contribute its local
+    shards, which ``make_array_from_callback`` assembles into one global
+    array (every process calls this with the same host value)."""
+    import jax
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return jax.device_put(value, sharding)
+    arr = np.asarray(value)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
+class OpChannel:
+    """Ordered, one-way op stream from the leader to every follower.
+
+    Leader: ``send(obj)`` fans a pickled frame out to all follower
+    connections. Follower: ``recv()`` blocks for the next frame. Frames
+    are length-prefixed; per-connection TCP FIFO plus the engine's
+    single dispatch lock give a total order identical on every process.
+    """
+
+    def __init__(self, env: dict):
+        self.num_processes = env["num_processes"]
+        self.process_id = env["process_id"]
+        self.is_leader = env["process_id"] == 0
+        host = env["coordinator"].rsplit(":", 1)[0]
+        port = env["op_port"]
+        if self.is_leader:
+            self._conns = self._accept_followers(port)
+            self._sock = None
+        else:
+            self._sock = self._connect(host, port)
+            self._conns = []
+        self._send_lock = threading.Lock()
+
+    # How long the leader waits for all followers to join before giving
+    # up (jax.distributed.initialize has its own, longer timeout; this
+    # one exists so a missing pod produces a diagnosable error rather
+    # than a silent hang).
+    ACCEPT_TIMEOUT_SEC = 600.0
+
+    def _accept_followers(self, port: int) -> List[socket.socket]:
+        """Accept exactly one connection per follower pid. Hardened
+        against strays: the port is published on the headless Service, so
+        probes/scanners may connect — a connection only claims a slot
+        after a valid, non-duplicate pid handshake; anything else is
+        closed and does not consume a slot or crash bring-up."""
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("0.0.0.0", port))
+        srv.listen(self.num_processes)
+        srv.settimeout(5.0)
+        by_pid: dict = {}
+        deadline = time.monotonic() + self.ACCEPT_TIMEOUT_SEC
+        last_log = 0.0
+        while len(by_pid) < self.num_processes - 1:
+            now = time.monotonic()
+            if now > deadline:
+                srv.close()
+                missing = sorted(set(range(1, self.num_processes))
+                                 - set(by_pid))
+                raise TimeoutError(
+                    f"op channel: followers {missing} did not connect "
+                    f"within {self.ACCEPT_TIMEOUT_SEC:.0f}s")
+            if now - last_log > 30.0:
+                missing = sorted(set(range(1, self.num_processes))
+                                 - set(by_pid))
+                logger.info("Op channel: waiting for followers %s", missing)
+                last_log = now
+            try:
+                conn, _addr = srv.accept()
+            except socket.timeout:
+                continue
+            try:
+                conn.settimeout(5.0)
+                (pid,) = struct.unpack("!q", self._read_exact(conn, 8))
+            except (ConnectionError, socket.timeout, struct.error):
+                conn.close()  # stray probe/scanner: no slot consumed
+                continue
+            if not (1 <= pid < self.num_processes) or pid in by_pid:
+                logger.warning(
+                    "Op channel: rejecting connection with %s pid %d",
+                    "duplicate" if pid in by_pid else "out-of-range", pid)
+                conn.close()
+                continue
+            conn.settimeout(None)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            by_pid[pid] = conn
+            logger.info("Op channel: follower %d connected", pid)
+        srv.close()
+        return [by_pid[pid] for pid in sorted(by_pid)]
+
+    def _connect(self, host: str, port: int,
+                 timeout: float = 120.0) -> socket.socket:
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                sock = socket.create_connection((host, port), timeout=5.0)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock.settimeout(None)
+                sock.sendall(struct.pack("!q", self.process_id))
+                return sock
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.25)
+
+    @staticmethod
+    def _read_exact(sock: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("op channel closed")
+            buf += chunk
+        return buf
+
+    def send(self, obj: Any) -> None:
+        assert self.is_leader
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        frame = struct.pack("!q", len(payload)) + payload
+        with self._send_lock:
+            for conn in self._conns:
+                conn.sendall(frame)
+
+    def recv(self) -> Any:
+        assert not self.is_leader
+        (n,) = struct.unpack("!q", self._read_exact(self._sock, 8))
+        return pickle.loads(self._read_exact(self._sock, n))
+
+    def close(self) -> None:
+        for conn in self._conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+
+class MultihostContext:
+    """Per-process handle the engine uses: the op channel plus a dispatch
+    lock serializing (send, enqueue) pairs so the leader's op order is
+    exactly the followers' replay order."""
+
+    def __init__(self, env: dict):
+        self.env = env
+        self.channel = OpChannel(env)
+        self.is_leader = self.channel.is_leader
+        self.num_processes = env["num_processes"]
+        self.process_id = env["process_id"]
+        self.lock = threading.RLock()
+
+
+def maybe_context() -> Optional[MultihostContext]:
+    """A MultihostContext when this process is part of a multi-host job
+    (``initialize_from_env`` already ran), else None."""
+    env = distributed_env()
+    if env is None:
+        return None
+    if not _initialized:
+        raise RuntimeError(
+            "multi-host env configured but jax.distributed not initialized; "
+            "call multihost.initialize_from_env() before building the engine")
+    return MultihostContext(env)
