@@ -16,7 +16,8 @@ in ``bench_baselines.json`` (prior-round measurements on this hardware);
 
 Configs (BENCH_CONFIG):
   flagship  tpu-llama-1b, reference shape w/ history scaled to the chip
-  llama3b   tpu-llama-3b (largest Llama-class fitting one v5e chip)
+  llama3b   tpu-llama-3b (largest Llama-class fitting one v5e chip in bf16)
+  llama8b   meta-llama/Llama-3-8B at int8 (the BASELINE model class)
   opt       facebook/opt-125m smoke config (BASELINE config 1)
 Every knob is still individually overridable via BENCH_* env vars.
 """
@@ -59,6 +60,14 @@ _CONFIGS = {
                     answer_tokens=100, sys_prompt_tokens=1000,
                     history_tokens=2000, max_model_len=8192,
                     max_num_seqs=16),
+    # THE BASELINE model class: Llama-3-8B. bf16 weights (~16 GB) cannot
+    # fit a 16 GB chip; int8 weight-only quantization (~8 GB +
+    # per-channel scales, models/quantize.py) makes the headline model
+    # servable on one v5e.
+    "llama8b": dict(model="meta-llama/Llama-3-8B", users=15, rounds=6,
+                    answer_tokens=100, sys_prompt_tokens=1000,
+                    history_tokens=2000, max_model_len=8192,
+                    max_num_seqs=16, quantization="int8"),
     "opt": dict(model="facebook/opt-125m", users=15, rounds=6,
                 answer_tokens=100, sys_prompt_tokens=400,
                 history_tokens=400, max_model_len=2048,
@@ -294,6 +303,7 @@ async def _main() -> dict:
         # Multi-engine configs size pools explicitly: the capacity
         # fallback can't see the sibling engine's HBM footprint.
         num_blocks=_cfg.get("num_blocks"),
+        quantization=_cfg.get("quantization"),
     )
     servers = [EngineServer(config, warmup=True) for _ in range(n_engines)]
     runners, engine_urls = [], []

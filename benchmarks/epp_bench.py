@@ -1,0 +1,136 @@
+#!/usr/bin/env python3
+"""Gateway EPP load test: concurrent Envoy ext-proc streams against
+deploy/gateway/epp_server.py, measuring picks/sec and per-pick added
+latency (the time the gateway would stall waiting for the destination
+header).
+
+The reference's point for this component is a non-Python data plane (Go
+EPP, ref README "gateway API inference extension"); picks here are C++
+(native/pickers via ctypes) with a Python gRPC transport. This bench
+decides whether that transport is the bottleneck: one ext-proc stream
+per HTTP request (Envoy's model), two frames per stream
+(request_headers, then request_body end_of_stream), destination read
+from the header mutation.
+
+Output: one JSON line per concurrency level + a summary
+(BENCH_EPP_r*.json artifact shape).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.abspath(os.path.join(_HERE, "..")))
+sys.path.insert(0, os.path.join(_HERE, "..", "deploy", "gateway"))
+sys.path.insert(0, os.path.join(_HERE, "..", "deploy", "gateway", "protos"))
+
+
+def run_level(channel_addr, pb2, grpc, concurrency: int, requests: int,
+              prompt_tokens: int = 600):
+    """`requests` picks spread over `concurrency` worker threads, a fresh
+    stream per pick (Envoy opens one ext-proc stream per HTTP request)."""
+    latencies = []
+    lat_lock = threading.Lock()
+    body = json.dumps({
+        "model": "m",
+        "messages": [
+            {"role": "system", "content": "s" * prompt_tokens},
+            {"role": "user", "content": "question here"},
+        ],
+    }).encode()
+
+    def frames():
+        h = pb2.ProcessingRequest()
+        h.request_headers.end_of_stream = False
+        yield h
+        b = pb2.ProcessingRequest()
+        b.request_body.body = body
+        b.request_body.end_of_stream = True
+        yield b
+
+    def worker(n: int):
+        channel = grpc.insecure_channel(channel_addr)
+        stub = channel.unary_unary  # noqa: F841 - warm the channel
+        call = channel.stream_stream(
+            "/envoy.service.ext_proc.v3.ExternalProcessor/Process",
+            request_serializer=pb2.ProcessingRequest.SerializeToString,
+            response_deserializer=pb2.ProcessingResponse.FromString,
+        )
+        local = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            picked = None
+            for resp in call(frames()):
+                kind = resp.WhichOneof("response")
+                if kind == "request_body":
+                    for h in resp.request_body.response.header_mutation.set_headers:
+                        if h.header.key == "x-gateway-destination-endpoint":
+                            picked = h.header.raw_value.decode()
+            assert picked, "no destination header returned"
+            local.append(time.perf_counter() - t0)
+        with lat_lock:
+            latencies.extend(local)
+        channel.close()
+
+    per = requests // concurrency
+    threads = [threading.Thread(target=worker, args=(per,))
+               for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    lat_sorted = sorted(latencies)
+    return {
+        "concurrency": concurrency,
+        "picks": len(latencies),
+        "picks_per_sec": round(len(latencies) / elapsed, 1),
+        "p50_ms": round(statistics.median(lat_sorted) * 1e3, 3),
+        "p99_ms": round(
+            lat_sorted[max(0, -(-99 * len(lat_sorted) // 100) - 1)] * 1e3,
+            3),
+        "elapsed_s": round(elapsed, 2),
+    }
+
+
+def main() -> None:
+    import grpc
+
+    from epp_server import EndpointState, build_server, ensure_pb2
+
+    pb2 = ensure_pb2()
+    state = EndpointState([f"10.0.0.{i}:8000" for i in range(4)])
+    server, port, picker = build_server(0, state, "prefix")
+    server.start()
+    addr = f"127.0.0.1:{port}"
+
+    requests = int(os.environ.get("EPP_BENCH_REQUESTS", "2000"))
+    levels = [int(x) for x in
+              os.environ.get("EPP_BENCH_CONCURRENCY", "1,8,32").split(",")]
+    # Warmup (trie allocation, channel setup, code paths hot).
+    run_level(addr, pb2, grpc, 4, 200)
+
+    results = [run_level(addr, pb2, grpc, c, requests) for c in levels]
+    server.stop(0)
+    peak = max(r["picks_per_sec"] for r in results)
+    out = {
+        "metric": "gateway_epp_picks_per_sec",
+        "value": peak,
+        "unit": "picks/s",
+        "algorithm": "prefix",
+        "transport": "python-grpc (C++ picks in-process)",
+        "levels": results,
+        "picks_total": picker.picks_total,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -63,6 +63,10 @@
 - --kv-remote-url
 - {{ .model.kvRemoteUrl | quote }}
 {{- end }}
+{{- if .model.quantization }}
+- --quantization
+- {{ .model.quantization | quote }}
+{{- end }}
 {{- if .model.chatTemplate }}
 - --chat-template
 - /templates/chat-template.jinja

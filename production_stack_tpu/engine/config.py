@@ -50,6 +50,16 @@ class EngineConfig:
     # Custom jinja chat template file (HF-tokenizer checkpoints only;
     # helm modelSpec.chatTemplate mounts it from a ConfigMap).
     chat_template: Optional[str] = None
+    # Weight-only quantization: "int8" stores weights as int8 + per-
+    # output-channel scales (models/quantize.py) — an 8 B model fits one
+    # 16 GB chip and decode's HBM weight read halves. None = bf16.
+    quantization: Optional[str] = None
+
+    def __post_init__(self):
+        if self.quantization not in (None, "int8"):
+            raise ValueError(
+                f"unsupported quantization {self.quantization!r} "
+                f"(supported: int8)")
 
     @property
     def max_blocks_per_seq(self) -> int:

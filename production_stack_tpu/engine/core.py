@@ -137,9 +137,23 @@ class EngineCore:
                 "lora_rank": config.max_lora_rank,
             }
         rng = jax.random.key(config.seed)
+        if config.quantization and self.model_config.arch != "llama":
+            raise ValueError(
+                "int8 quantization is supported for the llama family "
+                f"(model arch {self.model_config.arch!r})")
 
         def _init():
-            return self._init_fn(self.model_config, rng, **lora_kwargs)
+            p = self._init_fn(self.model_config, rng, **lora_kwargs)
+            if config.quantization == "int8":
+                # Quantize INSIDE the init program: each bf16 leaf is
+                # freed as soon as its int8 twin exists, so an 8 B model
+                # never materializes fully in bf16 on device.
+                from production_stack_tpu.models.quantize import (
+                    quantize_tree,
+                )
+
+                p = quantize_tree(p, self.model_config.arch)
+            return p
 
         shapes = jax.eval_shape(_init)
         self._param_shardings = param_shardings(
@@ -234,6 +248,8 @@ class EngineCore:
         self.flush_time_total = 0.0
         self.prefill_count = 0
         self.decode_burst_count = 0
+        self.dispatch_count_total = 0
+        self.dispatch_enqueue_s = 0.0
         self._sleeping = False
         self._sleep_level = 1
         self._host_params = None
@@ -288,6 +304,12 @@ class EngineCore:
         if not has_checkpoint(self.config.model):
             return
         loaded = load_checkpoint(self.model_config, self.config.model)
+        if self.config.quantization == "int8":
+            # Quantize on the host so the device transfer ships int8 (and
+            # the merged leaves match the quantized init structure).
+            from production_stack_tpu.models.quantize import quantize_loaded
+
+            loaded = quantize_loaded(loaded, self.model_config.arch)
 
         from jax.sharding import NamedSharding, PartitionSpec
 
@@ -312,6 +334,7 @@ class EngineCore:
             # Tied-embedding checkpoint: drop the random head so apply()
             # falls back to embed.T.
             params.pop("lm_head", None)
+            params.pop("lm_head_scale", None)
         self.params = params
         logger.info("Loaded checkpoint weights from %s", self.config.model)
 
@@ -325,15 +348,18 @@ class EngineCore:
 
     # Known per-chip HBM capacities, used when the runtime does not expose
     # memory_stats (e.g. tunneled/experimental platforms return None).
-    # v2/v3 are enumerated per-CORE by JAX (two cores per chip), so their
-    # entries are per-core HBM (8/16 GB), not per-chip (16/32 GB) —
+    # DECIMAL bytes, not GiB: the vendor "16 GB" on a v5e is 16e9 bytes
+    # (measured on hardware: a 16<<30 figure oversizes the pool ~7% and
+    # OOMs exactly when params+KV are sized to the margin, e.g.
+    # llama-8b-int8). v2/v3 are enumerated per-CORE by JAX (two cores per
+    # chip), so their entries are per-core HBM (8/16 GB), not per-chip —
     # sizing a per-device KV pool from the chip figure would oversubscribe
     # 2x. v4+ present one device per chip.
     _HBM_BY_KIND = (
-        ("v5 lite", 16 << 30), ("v5e", 16 << 30),
-        ("v5p", 95 << 30), ("v5", 95 << 30),
-        ("v6", 32 << 30), ("v4", 32 << 30),
-        ("v3", 16 << 30), ("v2", 8 << 30),
+        ("v5 lite", int(16e9)), ("v5e", int(16e9)),
+        ("v5p", int(95e9)), ("v5", int(95e9)),
+        ("v6", int(32e9)), ("v4", int(32e9)),
+        ("v3", int(16e9)), ("v2", int(8e9)),
     )
 
     def _free_hbm_bytes(self) -> Optional[int]:
@@ -631,11 +657,21 @@ class EngineCore:
 
     def _dispatch(self, name: str, static: dict, arrays: list):
         mh = self._mh
-        if mh is None:
-            return self._exec_op(name, static, arrays)
-        with mh.lock:  # (send, enqueue) must be atomic for op ordering
-            mh.channel.send((name, static, arrays))
-            return self._exec_op(name, static, arrays)
+        t0 = time.perf_counter()
+        try:
+            if mh is None:
+                return self._exec_op(name, static, arrays)
+            with mh.lock:  # (send, enqueue) must be atomic for op ordering
+                mh.channel.send((name, static, arrays))
+                return self._exec_op(name, static, arrays)
+        finally:
+            # Dispatch accounting: how much engine-thread wall time goes
+            # into ENQUEUEING programs (on a tunneled dev chip this is
+            # dominated by the per-dispatch RTT; on direct-attached HW it
+            # is microseconds). Readback waits are counted separately
+            # (flush_time_total / the prefill device_get).
+            self.dispatch_count_total += 1
+            self.dispatch_enqueue_s += time.perf_counter() - t0
 
     def _exec_op(self, name: str, static: dict, arrays: list):
         """The single source of truth for what each op does on-device;
@@ -694,14 +730,17 @@ class EngineCore:
             try:
                 self._exec_op(op[0], op[1], op[2])
             except Exception:  # noqa: BLE001
-                # Mirror the leader's _loop contract: a failed step is
-                # logged and the loop continues. The same program + args
-                # fail symmetrically on the leader (its _loop catches
-                # too), so both sides skip the same state mutation and
-                # stay lockstep; dying here instead would wedge the
-                # leader at its next collective with no error surfaced.
-                logger.exception("Follower: op %r failed (continuing to "
-                                 "mirror)", op[0])
+                # A failed replay is NOT safely resumable: ops donate
+                # kv/_token_counts, so a host-local failure (per-host
+                # OOM) can leave this process's buffers deleted while
+                # the leader's mutation succeeded — continuing would
+                # silently diverge lockstep. Die loudly instead: the
+                # health endpoint goes down (probes restart the pod) and
+                # the leader's next channel send surfaces the break.
+                logger.exception(
+                    "Follower: op %r failed — exiting (lockstep cannot "
+                    "be resumed past a one-sided failure)", op[0])
+                raise
 
     # -- KV offload / transfer helpers ------------------------------------
     def _offload_block(self, prefix_hash: int, bid: int) -> None:
@@ -1313,6 +1352,8 @@ class EngineCore:
             "flush_time_total": round(self.flush_time_total, 3),
             "prefill_count": self.prefill_count,
             "decode_burst_count": self.decode_burst_count,
+            "dispatch_count_total": self.dispatch_count_total,
+            "dispatch_enqueue_s": round(self.dispatch_enqueue_s, 3),
         }
 
     # ------------------------------------------------------------------ #

@@ -151,13 +151,15 @@ class EngineServer:
         return self._kv_registered
 
     def _track_admission(self, text: str, ids: List[int],
-                         adapter: str = "") -> None:
+                         adapter: str = "",
+                         offsets: Optional[List[int]] = None) -> None:
         """Record the mapping between this prompt's page chain-hashes and
         its controller text-chunk hashes, so evictions can be reported.
-        The char->token alignment is proportional (exact for the byte
-        tokenizer, approximate for BPE — the controller itself is
-        approximate, erring toward over-eviction which only costs a
-        recomputable route)."""
+        The char->token alignment uses the tokenizer's EXACT per-token
+        char offsets (byte positions for the byte tokenizer, the fast
+        tokenizer's offset mapping for BPE), so the controller evicts
+        precisely the chunks the dropped chain covered — proportional
+        mapping over-evicted kvaware-routable prefixes under BPE."""
         from production_stack_tpu.engine.kvcache import BlockAllocator
         from production_stack_tpu.kv.controller import (
             CHUNK_SIZE,
@@ -170,12 +172,13 @@ class EngineServer:
             return
         bs = self.core.config.block_size
         parent = self.core.kv_mgr.chain_root(adapter)
-        ratio = len(text) / n
+        if offsets is None or len(offsets) != n:
+            offsets = self.core.tokenizer.token_char_offsets(text, ids)
         blocks = []
         i = 0
         while i + bs <= n:
             parent = BlockAllocator.chain_hash(parent, tuple(ids[i : i + bs]))
-            chunk_start = min(int(i * ratio) // CHUNK_SIZE, len(chunks) - 1)
+            chunk_start = min(offsets[i] // CHUNK_SIZE, len(chunks) - 1)
             blocks.append((parent, chunk_start))
             i += bs
         if not blocks:
@@ -250,9 +253,20 @@ class EngineServer:
         except RuntimeError:
             pass  # loop closed (shutdown)
 
+    def _encode_prompt(self, text: str):
+        """(ids, per-token char offsets | None): one tokenizer pass that
+        also yields the offsets the admission tracker needs (only
+        requested when a KV controller is wired)."""
+        tok = self.core.tokenizer
+        if self.kv_controller_url is not None and hasattr(
+                tok, "encode_with_offsets"):
+            return tok.encode_with_offsets(text)
+        return tok.encode(text), None
+
     def _report_kv_admission(self, prompt_text: str,
                              prompt_ids: Optional[List[int]] = None,
-                             adapter: str = "") -> None:
+                             adapter: str = "",
+                             offsets: Optional[List[int]] = None) -> None:
         """Fire-and-forget admission report (prompt text chunk hashes)."""
         if self.kv_controller_url is None or not prompt_text:
             return
@@ -262,7 +276,7 @@ class EngineServer:
             # ahead of its admission is benign — TTL backstops).
             asyncio.get_running_loop().run_in_executor(
                 None, self._track_admission, prompt_text, list(prompt_ids),
-                adapter)
+                adapter, offsets)
 
         async def _send():
             import aiohttp
@@ -413,9 +427,10 @@ class EngineServer:
             messages = (
                 [{"role": "system", "content": preamble}] + list(messages))
         prompt = self.core.tokenizer.apply_chat_template(messages)
-        prompt_ids = self.core.tokenizer.encode(prompt)
+        prompt_ids, offs = self._encode_prompt(prompt)
         adapter = self._resolve_adapter(model)
-        self._report_kv_admission(prompt, prompt_ids, adapter or "")
+        self._report_kv_admission(prompt, prompt_ids, adapter or "",
+                                  offsets=offs)
         sampling = SamplingParams.from_request(body, default_max_tokens=128)
         bad = self._reject_sampling(sampling)
         if bad is not None:
@@ -449,9 +464,9 @@ class EngineServer:
         else:
             if isinstance(prompt, list):
                 prompt = prompt[0] if prompt else ""
-            prompt_ids = self.core.tokenizer.encode(str(prompt))
+            prompt_ids, offs = self._encode_prompt(str(prompt))
             self._report_kv_admission(
-                str(prompt), prompt_ids, adapter or "")
+                str(prompt), prompt_ids, adapter or "", offsets=offs)
         sampling = SamplingParams.from_request(body, default_max_tokens=16)
         bad = self._reject_sampling(sampling)
         if bad is not None:
@@ -1696,6 +1711,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--served-model-name", action="append", default=None)
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--quantization", default=None, choices=["int8"],
+                   help="weight-only quantization: int8 weights + "
+                        "per-channel scales (llama family)")
     p.add_argument("--max-model-len", type=int, default=2048)
     p.add_argument("--max-num-seqs", type=int, default=8)
     p.add_argument("--block-size", type=int, default=64)
@@ -1753,6 +1771,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     config = EngineConfig(
         model=model,
         dtype=args.dtype,
+        quantization=args.quantization,
         max_model_len=args.max_model_len,
         max_num_seqs=args.max_num_seqs,
         block_size=args.block_size,

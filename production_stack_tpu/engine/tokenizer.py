@@ -53,6 +53,33 @@ class ByteTokenizer:
         parts.append("<|assistant|>\n")
         return "\n".join(parts)
 
+    def encode_with_offsets(self, text: str,
+                            add_bos: bool = True):
+        """(ids, per-token char offsets) in one pass — the admission
+        path uses this so the KV controller mapping never re-tokenizes
+        the prompt."""
+        ids = self.encode(text, add_bos=add_bos)
+        return ids, self.token_char_offsets(text, ids)
+
+    def token_char_offsets(self, text: str, ids: List[int]) -> List[int]:
+        """Char offset in ``text`` where each token of ``ids`` begins
+        (specials take the current position). Exact: one token per UTF-8
+        byte, so map byte index -> char index."""
+        char_at_byte: List[int] = []
+        for j, ch in enumerate(text):
+            char_at_byte.extend([j] * len(ch.encode("utf-8")))
+        starts: List[int] = []
+        byte_i = 0
+        for tid in ids:
+            if 0 <= tid < 256:
+                starts.append(char_at_byte[byte_i]
+                              if byte_i < len(char_at_byte) else len(text))
+                byte_i += 1
+            else:  # BOS/EOS/specials occupy no text
+                starts.append(char_at_byte[byte_i]
+                              if byte_i < len(char_at_byte) else len(text))
+        return starts
+
 
 class HFTokenizer:
     def __init__(self, path: str, chat_template: Optional[str] = None):
@@ -82,6 +109,41 @@ class HFTokenizer:
             )
         except Exception:  # noqa: BLE001 - no template in tokenizer config
             return ByteTokenizer.apply_chat_template(self, messages)  # type: ignore[arg-type]
+
+    def encode_with_offsets(self, text: str, add_bos: bool = True):
+        """(ids, per-token char offsets) in ONE tokenizer pass (fast
+        tokenizers); (ids, None) when offsets are unavailable. The
+        request path uses this when admission reporting is on, so
+        _track_admission never re-tokenizes multi-thousand-token
+        prompts."""
+        try:
+            enc = self.tok(text, return_offsets_mapping=True,
+                           add_special_tokens=add_bos)
+            return (list(enc["input_ids"]),
+                    [int(s) for s, _ in enc["offset_mapping"]])
+        except Exception:  # noqa: BLE001 - slow tokenizer: no offsets
+            return self.encode(text, add_bos=add_bos), None
+
+    def token_char_offsets(self, text: str, ids: List[int]) -> List[int]:
+        """Char offset in ``text`` where each token of ``ids`` begins.
+        Exact via the fast tokenizer's offset mapping when the re-encode
+        reproduces ``ids``; proportional fallback otherwise (slow
+        tokenizers, or ids produced from different text). Prefer
+        :meth:`encode_with_offsets` on the request path (single pass)."""
+        try:
+            enc = self.tok(text, return_offsets_mapping=True,
+                           add_special_tokens=True)
+            if list(enc["input_ids"]) == list(ids):
+                return [int(s) for s, _ in enc["offset_mapping"]]
+            enc = self.tok(text, return_offsets_mapping=True,
+                           add_special_tokens=False)
+            if list(enc["input_ids"]) == list(ids):
+                return [int(s) for s, _ in enc["offset_mapping"]]
+        except Exception:  # noqa: BLE001 - slow tokenizer: no offsets
+            pass
+        n = max(len(ids), 1)
+        ratio = len(text) / n
+        return [int(i * ratio) for i in range(len(ids))]
 
 
 def build_tokenizer(model: str, vocab_size: int,

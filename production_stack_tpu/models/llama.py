@@ -113,6 +113,18 @@ def init_params(
     return params
 
 
+def _proj(h: jax.Array, p: Dict, name: str) -> jax.Array:
+    """``h @ W`` for a weight leaf that may be int8-quantized
+    (models/quantize.py): int8 storage halves the HBM weight read and the
+    ``astype`` dequant fuses into the matmul operand; the per-output-
+    channel scale applies to the [B, T, out] result."""
+    w = p[name]
+    if w.dtype == jnp.int8:
+        out = h @ w.astype(h.dtype)
+        return out * p[name + "_scale"][0].astype(h.dtype)
+    return h @ w
+
+
 def _lora_delta(h, a, b, scaling, adapter_ids):
     """Per-sequence LoRA delta: h [B,T,Hd] @ A[sel] @ B[sel] * scale."""
     a_sel = a[adapter_ids]  # [B, Hd, R]
@@ -146,8 +158,8 @@ def _layer(
     k_pages, v_pages = kv
 
     h = rms_norm(x, p["attn_norm"], cfg.rms_norm_eps)
-    q_flat = h @ p["wq"]
-    v_flat = h @ p["wv"]
+    q_flat = _proj(h, p, "wq")
+    v_flat = _proj(h, p, "wv")
     if lora is not None:
         q_flat = q_flat + _lora_delta(
             h, lora["wq_a"], lora["wq_b"], lora_scaling, adapter_ids
@@ -156,7 +168,7 @@ def _layer(
             h, lora["wv_a"], lora["wv_b"], lora_scaling, adapter_ids
         )
     q = q_flat.reshape(B, T, H, D)
-    k = (h @ p["wk"]).reshape(B, T, KVH, D)
+    k = _proj(h, p, "wk").reshape(B, T, KVH, D)
     v = v_flat.reshape(B, T, KVH, D)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
@@ -178,11 +190,12 @@ def _layer(
             q[:, 0], k_pages, v_pages, block_tables, context_lens, layer,
             scale=scale,
         )[:, None]
-    x = x + attn.reshape(B, T, H * D) @ p["wo"]
+    x = x + _proj(attn.reshape(B, T, H * D), p, "wo")
 
     h = rms_norm(x, p["mlp_norm"], cfg.rms_norm_eps)
-    gate = jax.nn.silu((h @ p["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-    x = x + (gate * (h @ p["w_up"])) @ p["w_down"]
+    gate = jax.nn.silu(
+        _proj(h, p, "w_gate").astype(jnp.float32)).astype(h.dtype)
+    x = x + _proj(gate * _proj(h, p, "w_up"), p, "w_down")
     return x, (k_pages, v_pages)
 
 
@@ -194,7 +207,13 @@ def embed_tokens(params: Dict, cfg: ModelConfig, token_ids: jax.Array,
     wrapper (``parallel/pp_serving.py``) so the two paths cannot diverge.
     Returns (x, lora_layers, lora_scaling, adapter_ids).
     """
-    x = params["embed"][token_ids].astype(cfg.jnp_dtype)
+    emb = params["embed"]
+    if emb.dtype == jnp.int8:
+        # Row-quantized table: dequant only the gathered rows.
+        x = (emb[token_ids].astype(cfg.jnp_dtype)
+             * params["embed_scale"][token_ids].astype(cfg.jnp_dtype))
+    else:
+        x = emb[token_ids].astype(cfg.jnp_dtype)
     lora = params.get("lora")
     lora_scaling = lora["scaling"] if lora is not None else None
     if lora is not None and adapter_ids is None:
@@ -213,9 +232,19 @@ def project_out(params: Dict, cfg: ModelConfig, x: jax.Array,
     if output_hidden:
         return x.astype(jnp.float32)
     head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-    return (x @ head).astype(jnp.float32)
+    if head is not None:
+        if head.dtype == jnp.int8:
+            # [Hd, V] int8 with scale [1, V]: scale per vocab channel.
+            logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+            return logits * params["lm_head_scale"][0]
+        return (x @ head).astype(jnp.float32)
+    emb = params["embed"]
+    if emb.dtype == jnp.int8:
+        # Tied head: embed [V, Hd] row scales [V, 1] become per-vocab
+        # output scales of embed.T.
+        logits = (x @ emb.T.astype(x.dtype)).astype(jnp.float32)
+        return logits * params["embed_scale"][:, 0]
+    return (x @ emb.T).astype(jnp.float32)
 
 
 def apply(

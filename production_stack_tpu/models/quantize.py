@@ -1,0 +1,111 @@
+"""Weight-only int8 quantization (per-output-channel, symmetric).
+
+Serves the BASELINE model class on one 16 GB chip: an 8 B-parameter model
+is ~16 GB in bf16 (does not fit next to KV + workspace) but ~8 GB in
+int8. The compute path stays bf16 on the MXU — each weight is stored as
+``int8`` plus a per-output-channel ``float32`` scale, and the dequant
+(`w.astype(bf16) * scale`) fuses into the matmul's operand read under
+XLA, so the HBM weight traffic (the decode bottleneck) halves too.
+
+The reference reaches this class through vLLM's quantization support in
+its CUDA images (``--quantization`` engine args in
+``helm/templates/deployment-vllm-multi.yaml`` extraArgs); this is the
+TPU-native equivalent at the engine layer.
+
+Two entry points with matching semantics (identical up to one-ULP
+rounding-tie flips between XLA's and numpy's division):
+- :func:`quantize_tree` — traceable (jax.numpy); used inside the jitted
+  init so a random-init 8 B model NEVER materializes fully in bf16 on
+  device (each leaf quantizes as it is created, peak = one bf16 leaf).
+- :func:`quantize_loaded` — numpy; used on host-loaded checkpoints so
+  the device transfer ships int8, not bf16.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+# Weight leaves quantized for the llama family; everything else (norms,
+# LoRA slots) stays bf16 — they are a rounding error of the total bytes.
+_LLAMA_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+# Symmetric int8 range. 127 (not 128) keeps the scale exact for the max.
+_QMAX = 127.0
+
+
+def _quantize_jnp(w, reduce_axis: int):
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=reduce_axis,
+                   keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / _QMAX
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -_QMAX, _QMAX)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def _quantize_np(w: np.ndarray, reduce_axis: int):
+    w32 = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w32), axis=reduce_axis, keepdims=True)
+    scale = np.maximum(amax, 1e-8) / _QMAX
+    q = np.clip(np.round(w32 / scale), -_QMAX, _QMAX).astype(np.int8)
+    return q, scale.astype(np.float32)
+
+
+def _apply_tree(params: Dict, arch: str, quant) -> Dict:
+    if arch != "llama":
+        raise ValueError(
+            f"int8 quantization is supported for the llama family "
+            f"(got arch {arch!r})")
+    out = dict(params)
+    layers = dict(params["layers"])
+    for name in _LLAMA_LAYER_KEYS:
+        if name in layers:
+            # [L, in, out] -> int8 [L, in, out] + scale [L, 1, out]
+            q, s = quant(layers[name], -2)
+            layers[name] = q
+            layers[name + "_scale"] = s
+    out["layers"] = layers
+    # embed [V, Hd]: per-ROW scales [V, 1] — correct for both the lookup
+    # (dequant the gathered rows) and the tied head (x @ embed.T scales
+    # per output/vocab channel).
+    q, s = quant(params["embed"], -1)
+    out["embed"] = q
+    out["embed_scale"] = s
+    if "lm_head" in params:
+        q, s = quant(params["lm_head"], -2)  # [Hd, V] -> scale [1, V]
+        out["lm_head"] = q
+        out["lm_head_scale"] = s
+    return out
+
+
+def quantize_tree(params: Dict, arch: str) -> Dict:
+    """Traceable int8 quantization of a params pytree (use inside jit)."""
+    return _apply_tree(params, arch, _quantize_jnp)
+
+
+def quantize_loaded(loaded: Dict, arch: str) -> Dict:
+    """Numpy twin of :func:`quantize_tree` for host-loaded checkpoints.
+    Only quantizes the leaves the checkpoint actually carries."""
+    if arch != "llama":
+        raise ValueError(
+            f"int8 quantization is supported for the llama family "
+            f"(got arch {arch!r})")
+    out = dict(loaded)
+    if "layers" in loaded:
+        layers = dict(loaded["layers"])
+        for name in _LLAMA_LAYER_KEYS:
+            if name in layers:
+                q, s = _quantize_np(layers[name], -2)
+                layers[name] = q
+                layers[name + "_scale"] = s
+        out["layers"] = layers
+    if "embed" in loaded:
+        q, s = _quantize_np(loaded["embed"], -1)
+        out["embed"] = q
+        out["embed_scale"] = s
+    if "lm_head" in loaded:
+        q, s = _quantize_np(loaded["lm_head"], -2)
+        out["lm_head"] = q
+        out["lm_head_scale"] = s
+    return out

@@ -198,15 +198,36 @@ class OpChannel:
             try:
                 conn.settimeout(5.0)
                 (pid,) = struct.unpack("!q", self._read_exact(conn, 8))
+                token = os.environ.get("TPU_STACK_OP_TOKEN")
+                if token:
+                    # Optional shared-secret handshake (set the same env
+                    # on every pod): without it, any in-cluster connector
+                    # guessing a pid could claim a follower slot and
+                    # receive the op stream.
+                    got = self._read_exact(conn, 32)
+                    want = token.encode().ljust(32, b"\0")[:32]
+                    if got != want:
+                        raise ConnectionError("bad op-channel token")
             except (ConnectionError, socket.timeout, struct.error):
                 conn.close()  # stray probe/scanner: no slot consumed
                 continue
-            if not (1 <= pid < self.num_processes) or pid in by_pid:
+            if not (1 <= pid < self.num_processes):
                 logger.warning(
-                    "Op channel: rejecting connection with %s pid %d",
-                    "duplicate" if pid in by_pid else "out-of-range", pid)
+                    "Op channel: rejecting connection with out-of-range "
+                    "pid %d", pid)
                 conn.close()
                 continue
+            if pid in by_pid:
+                # A reconnect (pod restarted inside the accept window)
+                # supersedes the stale socket — rejecting it would wedge
+                # bring-up permanently.
+                logger.warning(
+                    "Op channel: follower %d reconnected, replacing the "
+                    "previous connection", pid)
+                try:
+                    by_pid[pid].close()
+                except OSError:
+                    pass
             conn.settimeout(None)
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             by_pid[pid] = conn
@@ -223,6 +244,9 @@ class OpChannel:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 sock.settimeout(None)
                 sock.sendall(struct.pack("!q", self.process_id))
+                token = os.environ.get("TPU_STACK_OP_TOKEN")
+                if token:
+                    sock.sendall(token.encode().ljust(32, b"\0")[:32])
                 return sock
             except OSError:
                 if time.monotonic() > deadline:

@@ -140,6 +140,12 @@ def param_shardings(
         key = tuple(
             p.key if hasattr(p, "key") else p.idx for p in path
         )
+        if key and isinstance(key[-1], str) and key[-1].endswith("_scale"):
+            # int8 quantization scales (models/quantize.py) keep their
+            # base weight's ndim with singleton reduced dims, so the base
+            # spec applies; _divisible falls back to replicated when the
+            # sharded dim collapsed to 1 (scales are tiny either way).
+            key = key[:-1] + (key[-1][: -len("_scale")],)
         spec = specs.get(key)
         if spec is not None:
             spec = _with_pp(key, spec, leaf.shape, cfg, mesh)

@@ -176,8 +176,9 @@ def test_device_pipe_offer_cap():
 def test_hbm_table_uses_per_core_capacities():
     """JAX enumerates v2/v3 per-core (8/16 GB per device); the
     memory_stats-less fallback must not size the KV pool from per-chip
-    figures."""
+    figures. Entries are DECIMAL vendor bytes (16e9, not 16<<30) — the
+    GiB figure oversizes ~7% and OOMs margin-sized configs."""
     table = dict(EngineCore._HBM_BY_KIND)
-    assert table["v2"] == 8 << 30
-    assert table["v3"] == 16 << 30
-    assert table["v5e"] == 16 << 30
+    assert table["v2"] == int(8e9)
+    assert table["v3"] == int(16e9)
+    assert table["v5e"] == int(16e9)

@@ -1,0 +1,115 @@
+"""BPE-exact eviction alignment: the controller's evict path must cover
+exactly the chunks a dropped page chain served. The old proportional
+char->token mapping was exact only for the byte tokenizer; with a BPE
+tokenizer (multi-char tokens of varying width) it pointed eviction at
+the wrong chunks, silently retracting kvaware-routable prefixes."""
+
+import json
+import os
+
+import pytest
+
+from production_stack_tpu.engine.tokenizer import ByteTokenizer, HFTokenizer
+from production_stack_tpu.kv.controller import CHUNK_SIZE
+
+
+def _build_word_tokenizer(tmp_path) -> str:
+    """A real HF *fast* tokenizer whose tokens are whole words — token
+    widths vary wildly, so proportional mapping is maximally wrong."""
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    words = (["verylongcompoundword%d" % i for i in range(8)]
+             + list("abcdefgh") + ["[UNK]", "[BOS]", "[EOS]"])
+    vocab = {w: i for i, w in enumerate(words)}
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="[UNK]"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    d = tmp_path / "word-tok"
+    d.mkdir()
+    tok.save(str(d / "tokenizer.json"))
+    (d / "tokenizer_config.json").write_text(json.dumps({
+        "tokenizer_class": "PreTrainedTokenizerFast",
+        "bos_token": "[BOS]", "eos_token": "[EOS]", "unk_token": "[UNK]",
+    }))
+    return str(d)
+
+
+def test_byte_tokenizer_offsets_exact_including_multibyte():
+    tok = ByteTokenizer()
+    text = "héllo wörld"  # é/ö are 2 UTF-8 bytes each
+    ids = tok.encode(text)  # BOS + bytes
+    offs = tok.token_char_offsets(text, ids)
+    assert len(offs) == len(ids)
+    assert offs[0] == 0  # BOS
+    # Token 1 is the first byte of 'h' (char 0); the two bytes of 'é'
+    # (chars at index 1) both map to char 1.
+    assert offs[1] == 0
+    assert offs[2] == 1 and offs[3] == 1
+    # Last token maps inside the text, one past is the length.
+    assert offs[-1] == len(text) - 1
+
+
+def test_hf_bpe_offsets_exact_and_proportional_is_wrong(tmp_path):
+    path = _build_word_tokenizer(tmp_path)
+    tok = HFTokenizer(path)
+
+    # 8 long words (~21 chars each) then 8 single-letter words: the first
+    # 8 tokens cover ~170 chars, the next 8 cover 16.
+    text = " ".join(["verylongcompoundword%d" % i for i in range(8)]
+                    + list("abcdefgh"))
+    ids = tok.encode(text, add_bos=False)
+    assert len(ids) == 16
+    offs = tok.token_char_offsets(text, ids)
+    # Exact: token 8 starts right after the 8 long words.
+    expected_start = len(" ".join(
+        "verylongcompoundword%d" % i for i in range(8))) + 1
+    assert offs[8] == expected_start
+    # Proportional would claim token 8 starts mid-text at len(text)/2.
+    proportional = int(8 * len(text) / 16)
+    assert abs(proportional - expected_start) > 20  # the old error
+
+
+def test_track_admission_records_exact_chunks(tmp_path):
+    """EngineServer._track_admission must bind each page chain-hash to
+    the chunk its block's first token actually begins in."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.server import EngineServer
+
+    path = _build_word_tokenizer(tmp_path)
+    hftok = HFTokenizer(path)
+
+    server = EngineServer(EngineConfig(
+        model="tiny-llama", max_model_len=256, max_num_seqs=2,
+        block_size=4, num_blocks=32, max_loras=0))
+    try:
+        server.core.tokenizer = hftok
+        server.kv_controller_url = "http://controller"  # enables tracking
+
+        # 12 long words then 28 short ones: block 1 (tokens 4..7) is still
+        # deep in the long-word region; block 3+ is in the short region.
+        long_words = ["verylongcompoundword%d" % (i % 8) for i in range(12)]
+        short_words = list("abcdefgh") * 4
+        text = " ".join(long_words + short_words[:28])
+        ids = hftok.encode(text, add_bos=False)
+        assert len(ids) == 40
+        offs = hftok.token_char_offsets(text, ids)
+
+        server._track_admission(text, ids)
+        assert server._admissions, "admission not recorded"
+        (chunks, blocks) = next(iter(server._admissions.values()))
+        # 40 tokens / block_size 4 = 10 chain blocks.
+        assert len(blocks) == 10
+        for n, (_bh, chunk_start) in enumerate(blocks):
+            tok_i = n * 4
+            expected = min(offs[tok_i] // CHUNK_SIZE, len(chunks) - 1)
+            assert chunk_start == expected, (n, chunk_start, expected)
+        # And the exactness matters: for at least one block the
+        # proportional mapping would have picked a different chunk.
+        ratio = len(text) / len(ids)
+        diffs = [
+            n for n, (_bh, cs) in enumerate(blocks)
+            if cs != min(int(n * 4 * ratio) // CHUNK_SIZE, len(chunks) - 1)
+        ]
+        assert diffs, "workload failed to distinguish exact vs proportional"
+    finally:
+        server.core.stop()
