@@ -359,7 +359,8 @@ async def _main() -> dict:
                             "prefix_cache_queries", "num_preempted_total",
                             "prefill_time_total", "decode_time_total",
                             "flush_time_total", "prefill_count",
-                            "decode_burst_count"):
+                            "decode_burst_count", "dispatch_count_total",
+                            "dispatch_enqueue_s"):
                     core_stats[key] += s[key]
     finally:
         await router_runner.cleanup()
@@ -414,6 +415,8 @@ async def _main() -> dict:
         "engine_flush_s": core_stats["flush_time_total"],
         "engine_prefills": core_stats["prefill_count"],
         "engine_bursts": core_stats["decode_burst_count"],
+        "engine_dispatches": core_stats["dispatch_count_total"],
+        "engine_dispatch_enqueue_s": core_stats["dispatch_enqueue_s"],
         "backend": None,  # filled below
     }
     return result
