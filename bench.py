@@ -56,10 +56,13 @@ _CONFIGS = {
                      answer_tokens=100, sys_prompt_tokens=1000,
                      history_tokens=2000, max_model_len=8192,
                      max_num_seqs=16),
+    # Big models prefill in 2048-token chunks (half the chunk barriers /
+    # readback syncs of the default 1024 on 3k-token first-round prompts;
+    # attention memory still O(chunk x ctx)).
     "llama3b": dict(model="tpu-llama-3b", users=15, rounds=8,
                     answer_tokens=100, sys_prompt_tokens=1000,
                     history_tokens=2000, max_model_len=8192,
-                    max_num_seqs=16),
+                    max_num_seqs=16, prefill_chunk=2048),
     # THE BASELINE model class: Llama-3-8B. bf16 weights (~16 GB) cannot
     # fit a 16 GB chip; int8 weight-only quantization (~8 GB +
     # per-channel scales, models/quantize.py) makes the headline model
@@ -67,7 +70,8 @@ _CONFIGS = {
     "llama8b": dict(model="meta-llama/Llama-3-8B", users=15, rounds=6,
                     answer_tokens=100, sys_prompt_tokens=1000,
                     history_tokens=2000, max_model_len=8192,
-                    max_num_seqs=16, quantization="int8"),
+                    max_num_seqs=16, quantization="int8",
+                    prefill_chunk=2048),
     "opt": dict(model="facebook/opt-125m", users=15, rounds=6,
                 answer_tokens=100, sys_prompt_tokens=400,
                 history_tokens=400, max_model_len=2048,
@@ -304,6 +308,8 @@ async def _main() -> dict:
         # fallback can't see the sibling engine's HBM footprint.
         num_blocks=_cfg.get("num_blocks"),
         quantization=_cfg.get("quantization"),
+        prefill_chunk_size=_env_int(
+            "BENCH_PREFILL_CHUNK", _cfg.get("prefill_chunk", 1024)),
     )
     servers = [EngineServer(config, warmup=True) for _ in range(n_engines)]
     runners, engine_urls = [], []

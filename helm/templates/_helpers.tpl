@@ -33,6 +33,18 @@
 - {{ $arg | quote }}
 {{- end }}
 {{- else }}
+{{- if eq (default "generation" .model.modelType) "fake" }}
+# Chart-testing mode: the hermetic fake OpenAI engine (no accelerator),
+# used by the CI kind-install job — the counterpart of the reference's
+# fake-openai-server perftest backend.
+- production_stack_tpu.testing.fake_engine
+- --model
+- {{ .model.modelURL | quote }}
+- --host
+- "0.0.0.0"
+- --port
+- {{ .port | quote }}
+{{- else }}
 - production_stack_tpu.engine.server
 - {{ .model.modelURL | quote }}
 - --host
@@ -73,6 +85,7 @@
 {{- end }}
 {{- range $arg := .model.extraArgs }}
 - {{ $arg | quote }}
+{{- end }}
 {{- end }}
 {{- end }}
 {{- end -}}
@@ -118,11 +131,26 @@ livenessProbe:
   failureThreshold: {{ .root.Values.servingEngineSpec.livenessProbe.failureThreshold }}
 {{- end -}}
 
-{{/* volumeMounts entries for a modelSpec (empty when none needed). */}}
+{{/* Whether a modelSpec mounts the cluster-wide shared model storage
+     (sharedStorage.enabled and no per-model PVC overriding /models). */}}
+{{- define "chart.usesSharedStorage" -}}
+{{- if .root.Values.sharedStorage -}}
+{{- if and .root.Values.sharedStorage.enabled (not .model.pvcStorage) -}}
+true
+{{- end -}}
+{{- end -}}
+{{- end -}}
+
+{{/* volumeMounts entries for a modelSpec (dict: root, model). */}}
 {{- define "chart.engineVolumeMounts" -}}
 {{- if .model.pvcStorage }}
 - name: model-storage
   mountPath: /models
+{{- end }}
+{{- if include "chart.usesSharedStorage" . }}
+- name: shared-models
+  mountPath: /models
+  readOnly: true
 {{- end }}
 {{- if .model.chatTemplate }}
 - name: chat-template
@@ -136,6 +164,11 @@ livenessProbe:
 - name: model-storage
   persistentVolumeClaim:
     claimName: "{{ include "chart.fullname" .root }}-{{ .model.name }}-pvc"
+{{- end }}
+{{- if include "chart.usesSharedStorage" . }}
+- name: shared-models
+  persistentVolumeClaim:
+    claimName: "{{ include "chart.fullname" .root }}-shared-models"
 {{- end }}
 {{- if .model.chatTemplate }}
 - name: chat-template

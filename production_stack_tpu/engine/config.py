@@ -43,6 +43,16 @@ class EngineConfig:
     # lax.scan per dispatch; sequences that cannot use the full burst are
     # masked per step. 1 disables fusion.
     decode_steps: int = 8
+    # Burst width while admissible prompts are WAITING: a new request's
+    # prefill can only start between bursts, so at big-model per-step
+    # costs a full decode_steps burst adds ~K x step_time to TTFT.
+    # When > 0 and the waiting queue is non-empty the next burst uses
+    # this width instead. Measured on the dev chip (llama3b, reference
+    # shape): ~7% throughput cost WITHOUT a reliable p99-TTFT gain — the
+    # tail there is the serial uncached-prefill queue, not burst width —
+    # so the default is OFF; the knob remains for decode-dominated
+    # workloads with sparse arrivals.
+    decode_steps_pressure: int = 0
     # Sampling safety cap
     max_top_k: int = 64
     seed: int = 0

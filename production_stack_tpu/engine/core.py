@@ -257,6 +257,9 @@ class EngineCore:
         # In-flight speculative decode burst: dispatched to the device but
         # not yet read back (see _do_decode pipelining).
         self._pending_burst: Optional[dict] = None
+        # Prefills dispatched but whose first token is not yet read back
+        # (deferred sync: see _do_prefill / _flush_pending_prefills).
+        self._pending_prefills: "list[dict]" = []
         # Device-resident [B, K] tokens of the most recent burst — the
         # next burst's feedback source (kept per-process so multi-host
         # followers never need the leader to ship device state).
@@ -508,6 +511,7 @@ class EngineCore:
         cfg = self.model_config
         max_top_k = self.config.max_top_k
         seed = self.config.seed
+        K_max = max(self.config.decode_steps, 1)
 
         _eos = getattr(self.tokenizer, "eos_token_id", None)
         eos_id = int(_eos) if _eos is not None else -1  # 0 is a valid id
@@ -588,8 +592,17 @@ class EngineCore:
                 body, (tokens0, kv, counts, jnp.int32(0)), slot_mat.T,
                 length=K,
             )
+            # Feedback tokens are padded to the FULL decode_steps width so
+            # tokens_prev keeps one static shape across adaptive burst
+            # widths (decode_steps_pressure) — otherwise each (K_cur,
+            # K_prev) pair would compile its own program.
+            out_fb = out
+            if K < K_max:
+                out_fb = jnp.concatenate(
+                    [out, jnp.zeros((K_max - K,) + out.shape[1:],
+                                    out.dtype)], axis=0)
             # [K, B, ...] -> [B, K, ...]
-            return (out.T, lps.T, top_lps.swapaxes(0, 1),
+            return (out_fb.T, lps.T, top_lps.swapaxes(0, 1),
                     top_idxs.swapaxes(0, 1)), kv, counts
 
         return jax.jit(
@@ -685,9 +698,12 @@ class EngineCore:
             K = static["K"]
             fn = self._multi_decode_fn(K)
             B = self.config.max_num_seqs
+            # Feedback tokens always carry the FULL decode_steps width
+            # (bursts pad their output) so adaptive widths share shapes.
+            K_max = max(self.config.decode_steps, 1)
             tokens_prev = (
                 self._last_burst_tokens if static["use_prev"]
-                else np.zeros((B, K), np.int32))
+                else np.zeros((B, K_max), np.int32))
             outs, self.kv, self._token_counts = fn(
                 self.params, self.kv, self._token_counts, arrays[0],
                 tokens_prev, *arrays[1:])
@@ -1067,41 +1083,47 @@ class EngineCore:
                     if maxb >= cfg.max_blocks_per_seq:
                         break
                     maxb *= 2
-            # Decode: one burst width (decode_steps), one variant per
-            # block-table bucket (4 doubling to max_blocks_per_seq).
+            # Decode: the full burst width plus the pressure width
+            # (decode_steps_pressure, used while prompts wait), one
+            # variant per block-table bucket (4 doubling to
+            # max_blocks_per_seq). tokens_prev is always full-width.
             B = cfg.max_num_seqs
-            K = max(cfg.decode_steps, 1)
-            fn = self._multi_decode_fn(K)
-            maxb_w = 4
+            K_full = max(cfg.decode_steps, 1)
+            widths = {K_full}
+            if cfg.decode_steps_pressure > 0:
+                widths.add(min(K_full, max(cfg.decode_steps_pressure, 1)))
             n_decode = 0
-            while True:
-                maxb_w = min(maxb_w, cfg.max_blocks_per_seq)
-                _, self.kv, self._token_counts = fn(
-                    self.params, self.kv, self._token_counts,
-                    np.ones((B,), bool),         # reset_counts (warmup)
-                    np.zeros((B, K), np.int32),  # tokens_prev
-                    np.zeros((B,), np.int32),    # tok_idx
-                    np.zeros((B,), np.int32),    # host_tokens
-                    np.ones((B,), bool),         # use_host
-                    np.zeros((B,), np.int32),    # positions0
-                    np.full((B, K), -1, np.int64),
-                    np.zeros((B, maxb_w), np.int32),
-                    np.ones((B,), np.int32), np.zeros((B,), np.int32),
-                    np.zeros((B,), np.float32), np.zeros((B,), np.int32),
-                    np.ones((B,), np.float32), np.zeros((B,), np.int64),
-                    np.zeros((B,), np.float32),  # presence
-                    np.zeros((B,), np.float32),  # frequency
-                    np.zeros((B,), np.int32),    # min_tokens
-                    np.zeros((B,), np.int32),    # out_len0
-                    np.zeros((B, MAX_LOGIT_BIAS), np.int32),
-                    np.zeros((B, MAX_LOGIT_BIAS), np.float32),
-                    np.zeros((B, MAX_STOP_IDS), np.int32),
-                    np.zeros((B, MAX_STOP_IDS), np.float32),
-                )
-                n_decode += 1
-                if maxb_w >= cfg.max_blocks_per_seq:
-                    break
-                maxb_w *= 2
+            for K in sorted(widths):
+                fn = self._multi_decode_fn(K)
+                maxb_w = 4
+                while True:
+                    maxb_w = min(maxb_w, cfg.max_blocks_per_seq)
+                    _, self.kv, self._token_counts = fn(
+                        self.params, self.kv, self._token_counts,
+                        np.ones((B,), bool),         # reset_counts (warmup)
+                        np.zeros((B, K_full), np.int32),  # tokens_prev
+                        np.zeros((B,), np.int32),    # tok_idx
+                        np.zeros((B,), np.int32),    # host_tokens
+                        np.ones((B,), bool),         # use_host
+                        np.zeros((B,), np.int32),    # positions0
+                        np.full((B, K), -1, np.int64),
+                        np.zeros((B, maxb_w), np.int32),
+                        np.ones((B,), np.int32), np.zeros((B,), np.int32),
+                        np.zeros((B,), np.float32), np.zeros((B,), np.int32),
+                        np.ones((B,), np.float32), np.zeros((B,), np.int64),
+                        np.zeros((B,), np.float32),  # presence
+                        np.zeros((B,), np.float32),  # frequency
+                        np.zeros((B,), np.int32),    # min_tokens
+                        np.zeros((B,), np.int32),    # out_len0
+                        np.zeros((B, MAX_LOGIT_BIAS), np.int32),
+                        np.zeros((B, MAX_LOGIT_BIAS), np.float32),
+                        np.zeros((B, MAX_STOP_IDS), np.int32),
+                        np.zeros((B, MAX_STOP_IDS), np.float32),
+                    )
+                    n_decode += 1
+                    if maxb_w >= cfg.max_blocks_per_seq:
+                        break
+                    maxb_w *= 2
         logger.info("Warmup compiled %d prefill + %d decode variants "
                     "in %.1f s", n_prefill, n_decode, time.time() - t0)
 
@@ -1151,6 +1173,7 @@ class EngineCore:
         if self._mh is not None:
             raise RuntimeError("sleep mode is unsupported in multi-host mode")
         with self._step_lock:  # wait out any in-flight forward step
+            self._flush_pending_prefills()
             self._flush_pending_burst()
             with self._lock:
                 if self._sleeping:
@@ -1390,6 +1413,7 @@ class EngineCore:
                         self.decode_time_total += time.perf_counter() - t0
                         self.decode_burst_count += 1
                     else:
+                        self._flush_pending_prefills()
                         self._flush_pending_burst()
                         time.sleep(0.001)
             except Exception as e:  # noqa: BLE001
@@ -1470,46 +1494,87 @@ class EngineCore:
             start = end
         # Read back the in-flight burst while the chunks execute on device.
         self._flush_pending_burst()
-        s_arr, lp_arr, top_lp_arr, top_id_arr = (
-            np.asarray(a) for a in jax.device_get(sampled))
-        token = int(s_arr[0])
-        lp = None
-        if req.sampling.logprobs is not None:
-            k = min(req.sampling.logprobs, top_lp_arr.shape[1])
-            lp = {"logprob": float(lp_arr[0]),
-                  "top": [(int(top_id_arr[0, j]), float(top_lp_arr[0, j]))
-                          for j in range(k)]}
+        # Settle the PREVIOUS prefill now — after this one's dispatch —
+        # so its ~100 ms readback overlaps this one's device execution
+        # (depth-1 pipelining: a queue of arrivals drains at on-chip
+        # rate, while each first token still lands one dispatch later at
+        # most — deeper deferral measured better throughput but visibly
+        # worse p50 TTFT).
+        self._flush_pending_prefills()
         self.prompt_tokens_total += n
         self.cached_tokens_total += cached
-
+        # Reserve the slot now (next_action guaranteed a free one);
+        # the sampled-token readback is deferred as above. Deferred seqs
+        # are settled before any decode burst is built (they carry no
+        # output token until then).
         with self._lock:
             slot = self.scheduler._free_slot()
             seq = self.scheduler.start_running(req, slot)
-        prior = req.output_token_ids
-        if prior and (req.sampling.presence_penalty
-                      or req.sampling.frequency_penalty):
-            # Resume after preemption with penalties active: rebuild the
-            # slot's count row from the carried-forward outputs instead of
-            # resetting it (the row may hold another request's counts).
-            # Rare path — one extra dispatch only when it matters.
-            row = np.zeros((self.model_config.vocab_size,), np.int32)
-            # prior outputs + the continuation token just sampled above
-            # (the in-burst tokens0 count only runs for reset slots).
-            ids = np.clip(np.asarray(prior + [token], np.int64), 0,
-                          self.model_config.vocab_size - 1)
-            np.add.at(row, ids, 1)
-            self._dispatch("set_counts_row", {}, [np.int32(slot), row])
+        self._pending_prefills.append(
+            {"req": req, "seq": seq, "slot": slot, "sampled": sampled})
+
+    def _flush_pending_prefills(self) -> None:
+        """Read back and emit deferred prefill first tokens, in dispatch
+        order. Must run before a decode burst is built (the burst's
+        feedback/position bookkeeping needs each seq's first token)."""
+        if not self._pending_prefills:
+            return
+        pending, self._pending_prefills = self._pending_prefills, []
+        t0 = time.perf_counter()
+        for entry in pending:
+            req, seq, slot = entry["req"], entry["seq"], entry["slot"]
+            try:
+                s_arr, lp_arr, top_lp_arr, top_id_arr = (
+                    np.asarray(a) for a in jax.device_get(entry["sampled"]))
+            except Exception:  # noqa: BLE001 - async device failure
+                # The deferred readback failed AFTER the dispatch
+                # succeeded: the request would otherwise hang with its
+                # slot leaked (the loop's error handler only covers the
+                # current action's req). Finish it with an error.
+                logger.exception(
+                    "Deferred prefill readback failed for %s",
+                    req.request_id)
+                with self._lock:
+                    if self.scheduler.slots[slot] is seq:
+                        self.scheduler.finish(seq, "error")
+                continue
             with self._lock:
-                self._counts_reset.discard(slot)
-        else:
-            with self._lock:
-                # Fresh output in this slot: its penalty counts reset at
-                # the next burst (which also counts this first token).
-                self._counts_reset.add(slot)
-        self._emit_token(seq, token, lp)
-        # Decode position bookkeeping starts from the emitted tokens (a
-        # re-prefill after preemption carries prior outputs forward).
-        req.scheduled_steps = len(req.output_token_ids)
+                if self.scheduler.slots[slot] is not seq:
+                    continue  # aborted/finished before its first token
+            token = int(s_arr[0])
+            lp = None
+            if req.sampling.logprobs is not None:
+                k = min(req.sampling.logprobs, top_lp_arr.shape[1])
+                lp = {"logprob": float(lp_arr[0]),
+                      "top": [(int(top_id_arr[0, j]),
+                               float(top_lp_arr[0, j])) for j in range(k)]}
+            prior = req.output_token_ids
+            if prior and (req.sampling.presence_penalty
+                          or req.sampling.frequency_penalty):
+                # Resume after preemption with penalties active: rebuild
+                # the slot's count row from the carried-forward outputs
+                # instead of resetting it (the row may hold another
+                # request's counts). Rare path — one extra dispatch only
+                # when it matters.
+                row = np.zeros((self.model_config.vocab_size,), np.int32)
+                # prior outputs + the continuation token just sampled
+                # (the in-burst tokens0 count only runs for reset slots).
+                ids = np.clip(np.asarray(prior + [token], np.int64), 0,
+                              self.model_config.vocab_size - 1)
+                np.add.at(row, ids, 1)
+                self._dispatch("set_counts_row", {}, [np.int32(slot), row])
+                with self._lock:
+                    self._counts_reset.discard(slot)
+            else:
+                with self._lock:
+                    # Fresh output in this slot: its penalty counts reset
+                    # at the next burst (which also counts this token).
+                    self._counts_reset.add(slot)
+            self._emit_token(seq, token, lp)
+            # Decode position bookkeeping starts from the emitted tokens
+            # (a re-prefill after preemption carries prior outputs).
+            req.scheduled_steps = len(req.output_token_ids)
+        self.flush_time_total += time.perf_counter() - t0
 
     def _prefill_span(self, req: EngineRequest, tokens, block_ids,
                       start: int, end: int):
@@ -1580,8 +1645,24 @@ class EngineCore:
         the finish are re-written by any later owner before its attention
         can read them — device dispatch order guarantees it)."""
         cfg = self.config
+        # Deferred prefill first-tokens must land before the burst is
+        # built (feedback tokens / positions depend on them).
+        self._flush_pending_prefills()
         B = cfg.max_num_seqs
         K = max(cfg.decode_steps, 1)
+        # Prompts waiting AND admissible (free slot — a slot-blocked
+        # waiter gains nothing from shorter bursts): shrink the burst so
+        # the prefill starts within ~pressure_K step-times instead of a
+        # full burst (the big-model TTFT tail — a 3B/8B burst is
+        # ~0.5-1 s of wall time).
+        with self._lock:
+            admissible_waiter = (
+                self.scheduler.num_waiting > 0
+                and self.scheduler._free_slot() is not None
+                and self.kv_mgr.can_allocate(
+                    len(self.scheduler.waiting[0].all_token_ids) + 1))
+        if cfg.decode_steps_pressure > 0 and admissible_waiter:
+            K = min(K, max(cfg.decode_steps_pressure, 1))
 
         # Per-seq usable burst width (bounded by max_tokens/max_model_len);
         # a fixed K with per-seq masking keeps ONE compiled program per

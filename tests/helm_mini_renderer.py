@@ -1,0 +1,444 @@
+"""A minimal Go-template/Sprig renderer covering exactly the constructs
+this repo's helm chart uses, so chart correctness is asserted by TESTS in
+this hermetic image (no helm binary; CI additionally runs real `helm
+template` + kubeconform — see .github/workflows/ci.yml).
+
+Supported: {{- ... -}} trimming, if/else/end, range (with/without
+variable), with, define/include, variables ($x := / =), pipelines, and
+the functions: default quote nindent indent toYaml int add gt le eq and
+or not kindIs printf join list dict include. Paths: .a.b, $var.a, $.a.b.
+
+NOT a general helm implementation — unknown constructs raise, so a new
+template feature must extend this file (that is the point: silent
+mis-rendering is the failure mode this exists to prevent).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+
+class TemplateError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------- lexer
+_ACTION_RE = re.compile(r"\{\{(-?)\s*(.*?)\s*(-?)\}\}", re.S)
+
+
+def _lex(src: str):
+    """("text", s) / ("action", expr) tokens with Go whitespace trimming:
+    ``{{-`` strips ALL whitespace before the action, ``-}}`` strips ALL
+    whitespace after it (text/template semantics, which helm relies on
+    for YAML-shaped output)."""
+    out = []
+    pos = 0
+    trim_next = False
+    for m in _ACTION_RE.finditer(src):
+        text = src[pos:m.start()]
+        if trim_next:
+            text = text.lstrip(" \t\r\n")
+        if m.group(1) == "-":
+            text = text.rstrip(" \t\r\n")
+        out.append(("text", text))
+        if not m.group(2).startswith("/*"):  # {{/* comment */}}
+            out.append(("action", m.group(2)))
+        trim_next = m.group(3) == "-"
+        pos = m.end()
+    tail = src[pos:]
+    if trim_next:
+        tail = tail.lstrip(" \t\r\n")
+    out.append(("text", tail))
+    return out
+
+
+# --------------------------------------------------------------- parser
+class Node:
+    pass
+
+
+class Text(Node):
+    def __init__(self, s):
+        self.s = s
+
+
+class Action(Node):
+    def __init__(self, expr):
+        self.expr = expr
+
+
+class Block(Node):
+    """if/range/with block with optional else."""
+
+    def __init__(self, kind, expr, body, orelse):
+        self.kind, self.expr, self.body, self.orelse = (
+            kind, expr, body, orelse)
+
+
+def _parse(tokens, i=0, stop=("end", "else")):
+    nodes: List[Node] = []
+    while i < len(tokens):
+        kind, val = tokens[i]
+        if kind == "text":
+            nodes.append(Text(val))
+            i += 1
+            continue
+        word = val.split(None, 1)[0] if val else ""
+        if word in stop:
+            return nodes, i
+        if word in ("if", "range", "with"):
+            expr = val.split(None, 1)[1]
+            body, j = _parse(tokens, i + 1)
+            orelse = []
+            if tokens[j][1].split(None, 1)[0] == "else":
+                if len(tokens[j][1].split(None, 1)) > 1:
+                    raise TemplateError("else-if unsupported; nest the if")
+                orelse, j = _parse(tokens, j + 1)
+            if tokens[j][1].split(None, 1)[0] != "end":
+                raise TemplateError(f"unclosed {word}")
+            nodes.append(Block(word, expr, body, orelse))
+            i = j + 1
+            continue
+        if word == "define":
+            name = val.split(None, 1)[1].strip().strip('"')
+            body, j = _parse(tokens, i + 1, stop=("end",))
+            nodes.append(Block("define", name, body, []))
+            i = j + 1
+            continue
+        nodes.append(Action(val))
+        i += 1
+    return nodes, i
+
+
+# ---------------------------------------------------------- expressions
+_TOKEN_RE = re.compile(
+    r'"(?:[^"\\]|\\.)*"|\(|\)|\||[^\s()|]+')
+
+
+def _tokenize_expr(expr: str) -> List[str]:
+    return _TOKEN_RE.findall(expr)
+
+
+def _truthy(v) -> bool:
+    if v is None or v is False:
+        return False
+    if isinstance(v, (int, float)):
+        return v != 0
+    if isinstance(v, (str, list, dict, tuple)):
+        return len(v) > 0
+    return True
+
+
+class Renderer:
+    def __init__(self, defines: Dict[str, list], root: Any):
+        self.defines = defines
+        self.root = root
+
+    # -- expression evaluation -------------------------------------
+    def eval_expr(self, expr: str, dot, vars_) -> Any:
+        toks = _tokenize_expr(expr)
+        val, rest = self._eval_pipeline(toks, dot, vars_)
+        if rest:
+            raise TemplateError(f"trailing tokens {rest!r} in {expr!r}")
+        return val
+
+    def _eval_pipeline(self, toks, dot, vars_):
+        val, toks = self._eval_call(toks, dot, vars_)
+        while toks and toks[0] == "|":
+            fn = toks[1]
+            args, toks = self._collect_args(toks[2:], dot, vars_)
+            val = self._call(fn, args + [val], dot, vars_)
+        return val, toks
+
+    def _collect_args(self, toks, dot, vars_):
+        args = []
+        while toks and toks[0] not in ("|", ")"):
+            arg, toks = self._eval_operand(toks, dot, vars_)
+            args.append(arg)
+        return args, toks
+
+    def _eval_call(self, toks, dot, vars_):
+        """A command: either `fn arg arg ...` or a single operand."""
+        if not toks:
+            raise TemplateError("empty expression")
+        head = toks[0]
+        if head in _FUNCS or head in ("include",):
+            args, rest = self._collect_args(toks[1:], dot, vars_)
+            return self._call(head, args, dot, vars_), rest
+        return self._eval_operand(toks, dot, vars_)
+
+    def _eval_operand(self, toks, dot, vars_):
+        t = toks[0]
+        if t == "(":
+            # find matching paren at depth 0
+            depth, j = 1, 1
+            while j < len(toks):
+                if toks[j] == "(":
+                    depth += 1
+                elif toks[j] == ")":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                j += 1
+            inner, _ = self._eval_pipeline(toks[1:j], dot, vars_)
+            return inner, toks[j + 1:]
+        if t.startswith('"'):
+            return t[1:-1].encode().decode("unicode_escape"), toks[1:]
+        if re.fullmatch(r"-?\d+", t):
+            return int(t), toks[1:]
+        if re.fullmatch(r"-?\d+\.\d+", t):
+            return float(t), toks[1:]
+        if t == "true":
+            return True, toks[1:]
+        if t == "false":
+            return False, toks[1:]
+        if t == "nil":
+            return None, toks[1:]
+        if t == ".":
+            return dot, toks[1:]
+        if t == "$":
+            return vars_["$"], toks[1:]
+        if t.startswith("$"):
+            name, _, path = t.partition(".")
+            if name not in vars_:
+                raise TemplateError(f"undefined variable {name}")
+            base = vars_[name]
+            return (self._walk(base, path) if path else base), toks[1:]
+        if t.startswith("."):
+            return self._walk(dot, t[1:]), toks[1:]
+        raise TemplateError(f"cannot evaluate operand {t!r}")
+
+    @staticmethod
+    def _walk(base, path: str):
+        cur = base
+        for part in filter(None, path.split(".")):
+            if isinstance(cur, dict):
+                cur = cur.get(part)
+            else:
+                cur = getattr(cur, part, None)
+            if cur is None:
+                return None
+        return cur
+
+    def _call(self, fn, args, dot, vars_):
+        if fn == "include":
+            name, arg = args[0], (args[1] if len(args) > 1 else None)
+            return self.render_define(name, arg)
+        return _FUNCS[fn](*args)
+
+    # -- node rendering --------------------------------------------
+    def render_define(self, name: str, dot) -> str:
+        if name not in self.defines:
+            raise TemplateError(f"include of unknown template {name!r}")
+        return self.render_nodes(
+            self.defines[name], dot, {"$": self.root})
+
+    def render_nodes(self, nodes, dot, vars_) -> str:
+        out = []
+        for node in nodes:
+            if isinstance(node, Text):
+                out.append(node.s)
+            elif isinstance(node, Action):
+                expr = node.expr
+                m = re.match(r"(\$[A-Za-z0-9_]+)\s*(:=|=)\s*(.*)", expr)
+                if m:
+                    name, op, rhs = m.groups()
+                    if op == "=" and name not in vars_:
+                        raise TemplateError(
+                            f"assignment to undeclared {name}")
+                    vars_[name] = self.eval_expr(rhs, dot, vars_)
+                    continue
+                val = self.eval_expr(expr, dot, vars_)
+                if val is None:
+                    val = ""
+                if val is True or val is False:
+                    val = "true" if val else "false"
+                out.append(str(val))
+            elif isinstance(node, Block):
+                # Blocks share the enclosing variable scope: Go scopes
+                # NEW declarations to the block but `=` mutates outward;
+                # our templates only need the latter (e.g. the $hosts
+                # compute-inside-if idiom), so a shared dict is correct
+                # for this chart and keeps mutation visible.
+                if node.kind == "if":
+                    cond = self.eval_expr(node.expr, dot, vars_)
+                    body = node.body if _truthy(cond) else node.orelse
+                    out.append(self.render_nodes(body, dot, vars_))
+                elif node.kind == "with":
+                    val = self.eval_expr(node.expr, dot, vars_)
+                    if _truthy(val):
+                        out.append(
+                            self.render_nodes(node.body, val, vars_))
+                    else:
+                        out.append(self.render_nodes(
+                            node.orelse, dot, vars_))
+                elif node.kind == "range":
+                    expr = node.expr
+                    m = re.match(
+                        r"(\$[A-Za-z0-9_]+)\s*:=\s*(.*)", expr)
+                    var = None
+                    if m:
+                        var, expr = m.group(1), m.group(2)
+                    seq = self.eval_expr(expr, dot, vars_) or []
+                    if isinstance(seq, dict):
+                        seq = list(seq.values())
+                    if not seq and node.orelse:
+                        out.append(self.render_nodes(
+                            node.orelse, dot, vars_))
+                    for item in seq:
+                        v2 = dict(vars_)  # loop var stays loop-local
+                        d2 = dot
+                        if var:
+                            v2[var] = item
+                        else:
+                            d2 = item
+                        out.append(self.render_nodes(node.body, d2, v2))
+                elif node.kind == "define":
+                    pass  # collected separately
+                else:
+                    raise TemplateError(node.kind)
+        return "".join(out)
+
+
+# ------------------------------------------------------------ functions
+def _to_yaml(v) -> str:
+    return yaml.safe_dump(v, default_flow_style=False,
+                          sort_keys=False).rstrip("\n")
+
+
+def _nindent(n, s) -> str:
+    pad = " " * int(n)
+    return "\n" + "\n".join(
+        (pad + ln if ln.strip() else ln) for ln in str(s).splitlines())
+
+
+def _indent(n, s) -> str:
+    pad = " " * int(n)
+    return "\n".join(
+        (pad + ln if ln.strip() else ln) for ln in str(s).splitlines())
+
+
+def _default(*args):
+    # Go order: default DEFAULT VALUE (value is last after piping)
+    d, v = args[0], args[-1]
+    return v if _truthy(v) else d
+
+
+def _dict(*kv):
+    return {kv[i]: kv[i + 1] for i in range(0, len(kv), 2)}
+
+
+def _kind_is(kind, v):
+    kinds = {"string": str, "map": dict, "slice": list, "bool": bool,
+             "int": int, "float64": float}
+    if kind == "int" and isinstance(v, bool):
+        return False
+    return isinstance(v, kinds[kind])
+
+
+_FUNCS = {
+    "default": _default,
+    "quote": lambda v: '"%s"' % str(v if v is not None else ""),
+    "nindent": _nindent,
+    "indent": _indent,
+    "toYaml": _to_yaml,
+    "int": lambda v: int(v or 0),
+    "add": lambda *a: sum(int(x) for x in a),
+    "gt": lambda a, b: a > b,
+    "ge": lambda a, b: a >= b,
+    "lt": lambda a, b: a < b,
+    "le": lambda a, b: a <= b,
+    "eq": lambda a, b: a == b,
+    "ne": lambda a, b: a != b,
+    "and": lambda *a: next((x for x in a if not _truthy(x)), a[-1]),
+    "or": lambda *a: next((x for x in a if _truthy(x)), a[-1]),
+    "not": lambda v: not _truthy(v),
+    "kindIs": _kind_is,
+    "printf": lambda fmt, *a: fmt % tuple(a),
+    "join": lambda sep, seq: str(sep).join(str(x) for x in (seq or [])),
+    "list": lambda *a: list(a),
+    "dict": _dict,
+    "len": lambda v: len(v or []),
+}
+
+
+# ---------------------------------------------------------------- chart
+class MiniHelm:
+    """Render a chart directory against a values dict, helm-style."""
+
+    def __init__(self, chart_dir: str, release: str = "test",
+                 namespace: str = "default"):
+        import os
+
+        self.chart_dir = chart_dir
+        self.release = release
+        self.namespace = namespace
+        self.defines: Dict[str, list] = {}
+        self.templates: Dict[str, list] = {}
+        tdir = os.path.join(chart_dir, "templates")
+        for fname in sorted(os.listdir(tdir)):
+            if not (fname.endswith(".yaml") or fname.endswith(".tpl")):
+                continue
+            with open(os.path.join(tdir, fname)) as f:
+                src = f.read()
+            nodes, _ = _parse(_lex(src), stop=())
+            self._collect_defines(nodes)
+            if fname.endswith(".yaml"):
+                self.templates[fname] = nodes
+
+    def _collect_defines(self, nodes):
+        for node in nodes:
+            if isinstance(node, Block) and node.kind == "define":
+                self.defines[node.expr] = node.body
+
+    def render(self, values: dict) -> Dict[str, List[dict]]:
+        """filename -> list of parsed YAML docs (comment-only docs are
+        dropped). Raises on template errors OR invalid YAML output."""
+        root = {
+            "Values": values,
+            "Release": {"Name": self.release, "Namespace": self.namespace},
+            "Chart": {"Name": "production-stack-tpu"},
+        }
+        out: Dict[str, List[dict]] = {}
+        for fname, nodes in self.templates.items():
+            r = Renderer(self.defines, root)
+            text = r.render_nodes(nodes, root, {"$": root})
+            docs = []
+            for raw in re.split(r"^---\s*$", text, flags=re.M):
+                if not raw.strip():
+                    continue
+                try:
+                    doc = yaml.safe_load(raw)
+                except yaml.YAMLError as e:
+                    raise TemplateError(
+                        f"{fname}: rendered invalid YAML: {e}\n--- doc:\n"
+                        f"{raw}") from e
+                if doc:
+                    docs.append(doc)
+            out[fname] = docs
+        return out
+
+
+def load_values(chart_dir: str, example: Optional[str] = None) -> dict:
+    """Chart default values, deep-merged with an example values file."""
+    import os
+
+    def deep_merge(base, over):
+        merged = dict(base)
+        for k, v in over.items():
+            if (k in merged and isinstance(merged[k], dict)
+                    and isinstance(v, dict)):
+                merged[k] = deep_merge(merged[k], v)
+            else:
+                merged[k] = v
+        return merged
+
+    with open(os.path.join(chart_dir, "values.yaml")) as f:
+        values = yaml.safe_load(f) or {}
+    if example:
+        with open(example) as f:
+            values = deep_merge(values, yaml.safe_load(f) or {})
+    return values
