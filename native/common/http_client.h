@@ -18,8 +18,10 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cstring>
 #include <fstream>
+#include <functional>
 #include <mutex>
 #include <sstream>
 #include <string>
@@ -252,6 +254,130 @@ class HttpClient {
   HttpResponse get(const std::string& path) const {
     return request("GET", path);
   }
+
+  // Streaming GET for Kubernetes watch endpoints: the apiserver holds the
+  // connection open and emits one JSON watch event per newline. Each
+  // complete line is handed to `on_line`; returning false stops the
+  // stream. Incremental chunked-transfer decoding (the apiserver uses
+  // chunked for watches). Returns the HTTP status (0 = transport error);
+  // the stream ends when the server closes (watch timeoutSeconds), the
+  // callback stops it, or the socket read times out.
+  int watch_lines(const std::string& path,
+                  const std::function<bool(const std::string&)>& on_line,
+                  int read_timeout_sec = 0) const {
+    int fd = connect_();
+    if (fd < 0) return 0;
+    if (read_timeout_sec > 0) {
+      struct timeval tv{read_timeout_sec, 0};
+      ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    }
+
+    std::ostringstream req;
+    req << "GET " << url_.base_path << path << " HTTP/1.1\r\n"
+        << "Host: " << url_.host << ':' << url_.port << "\r\n"
+        << "Connection: close\r\n"
+        << "Accept: application/json\r\n";
+    std::string token = read_token_();
+    if (!token.empty()) req << "Authorization: Bearer " << token << "\r\n";
+    req << "\r\n";
+    std::string data = req.str();
+
+    // TLS watches share the exact session setup (incl. IP-SAN peer
+    // verification — in-cluster apiservers are IPs) with tls_roundtrip_.
+    const TlsLib* ssl = url_.tls ? &TlsLib::get() : nullptr;
+    TlsLib::SSL* sess = nullptr;
+    if (url_.tls) {
+      sess = tls_open_session_(fd);
+      if (!sess) { ::close(fd); return 0; }
+    }
+    auto send_all = [&](const std::string& d) -> bool {
+      size_t sent = 0;
+      while (sent < d.size()) {
+        ssize_t n = url_.tls
+            ? ssl->SSL_write(sess, d.data() + sent,
+                             static_cast<int>(d.size() - sent))
+            : ::send(fd, d.data() + sent, d.size() - sent, MSG_NOSIGNAL);
+        if (n <= 0) return false;
+        sent += static_cast<size_t>(n);
+      }
+      return true;
+    };
+    auto recv_some = [&](char* buf, size_t cap) -> ssize_t {
+      return url_.tls ? ssl->SSL_read(sess, buf, static_cast<int>(cap))
+                      : ::recv(fd, buf, cap, 0);
+    };
+    auto cleanup = [&] {
+      if (sess) { if (ssl->SSL_shutdown) ssl->SSL_shutdown(sess);
+                  ssl->SSL_free(sess); }
+      ::close(fd);
+    };
+    if (!send_all(data)) { cleanup(); return 0; }
+
+    std::string buf;
+    int status = 0;
+    bool headers_done = false, chunked = false;
+    bool need_trailer = false;   // a finished chunk's CRLF not yet seen
+    size_t chunk_remaining = 0;  // bytes left in the current chunk body
+    std::string line_buf;
+    char rbuf[8192];
+    ssize_t n;
+    bool stop = false;
+    auto feed_payload = [&](const char* p, size_t len) {
+      line_buf.append(p, len);
+      size_t nl;
+      while ((nl = line_buf.find('\n')) != std::string::npos) {
+        std::string line = line_buf.substr(0, nl);
+        if (!line.empty() && line.back() == '\r') line.pop_back();
+        line_buf.erase(0, nl + 1);
+        if (!line.empty() && !on_line(line)) { stop = true; return; }
+      }
+    };
+    while (!stop && (n = recv_some(rbuf, sizeof(rbuf))) > 0) {
+      buf.append(rbuf, static_cast<size_t>(n));
+      if (!headers_done) {
+        auto he = buf.find("\r\n\r\n");
+        if (he == std::string::npos) continue;
+        std::string headers = buf.substr(0, he);
+        auto sp1 = headers.find(' ');
+        if (sp1 != std::string::npos)
+          status = std::atoi(headers.c_str() + sp1 + 1);
+        // Lowercase-insensitive-enough: apiservers send either casing.
+        chunked = headers.find("chunked") != std::string::npos ||
+                  headers.find("Chunked") != std::string::npos;
+        buf.erase(0, he + 4);
+        headers_done = true;
+        if (status < 200 || status >= 300) { cleanup(); return status; }
+      }
+      // Drain `buf` into payload lines.
+      while (!stop && !buf.empty()) {
+        if (!chunked) {
+          feed_payload(buf.data(), buf.size());
+          buf.clear();
+          break;
+        }
+        if (need_trailer) {
+          if (buf.size() < 2) break;  // CRLF split across reads
+          buf.erase(0, 2);
+          need_trailer = false;
+        }
+        if (chunk_remaining == 0) {
+          auto le = buf.find("\r\n");
+          if (le == std::string::npos) break;  // need more header bytes
+          long len = std::strtol(buf.substr(0, le).c_str(), nullptr, 16);
+          buf.erase(0, le + 2);
+          if (len <= 0) { stop = true; break; }  // final chunk
+          chunk_remaining = static_cast<size_t>(len);
+        }
+        size_t take = std::min(chunk_remaining, buf.size());
+        feed_payload(buf.data(), take);
+        buf.erase(0, take);
+        chunk_remaining -= take;
+        if (chunk_remaining == 0) need_trailer = true;
+      }
+    }
+    cleanup();
+    return status;
+  }
   HttpResponse post(const std::string& path, const std::string& body) const {
     return request("POST", path, body);
   }
@@ -332,16 +458,18 @@ class HttpClient {
     return ctx_;
   }
 
-  // One TLS request/response over an already-connected socket. Verifies
-  // the server certificate (unless insecure_skip_verify) and the hostname.
-  bool tls_roundtrip_(int fd, const std::string& data,
-                      std::string* raw) const {
+  // Open a verified TLS session on an already-connected socket: cert +
+  // hostname/IP-SAN verification (unless insecure_skip_verify) and SNI.
+  // Shared by the one-shot roundtrip and the streaming watch so the
+  // verification logic cannot drift between them. Returns nullptr on
+  // setup/handshake failure (caller closes the fd).
+  TlsLib::SSL* tls_open_session_(int fd) const {
     const TlsLib& ssl = TlsLib::get();
-    if (!ssl.loaded) return false;
+    if (!ssl.loaded) return nullptr;
     TlsLib::SSL_CTX* ctx = tls_ctx_();
-    if (!ctx) return false;
+    if (!ctx) return nullptr;
     TlsLib::SSL* s = ssl.SSL_new(ctx);
-    if (!s) return false;
+    if (!s) return nullptr;
     ssl.SSL_set_fd(s, fd);
     if (!auth_.insecure_skip_verify) {
       struct in_addr a4{};
@@ -350,6 +478,8 @@ class HttpClient {
                    ::inet_pton(AF_INET6, url_.host.c_str(), &a6) == 1;
       if (is_ip && ssl.SSL_get0_param &&
           ssl.X509_VERIFY_PARAM_set1_ip_asc) {
+        // In-cluster apiservers are usually IPs; X509_check_host does
+        // not match SAN IP entries.
         ssl.X509_VERIFY_PARAM_set1_ip_asc(ssl.SSL_get0_param(s),
                                           url_.host.c_str());
       } else if (ssl.SSL_set1_host) {
@@ -359,11 +489,23 @@ class HttpClient {
     if (ssl.SSL_ctrl) {
       // SSL_set_tlsext_host_name (SNI): SSL_CTRL_SET_TLSEXT_HOSTNAME=55,
       // TLSEXT_NAMETYPE_host_name=0.
-      ssl.SSL_ctrl(s, 55, 0,
-                   const_cast<char*>(url_.host.c_str()));
+      ssl.SSL_ctrl(s, 55, 0, const_cast<char*>(url_.host.c_str()));
     }
+    if (ssl.SSL_connect(s) != 1) {
+      ssl.SSL_free(s);
+      return nullptr;
+    }
+    return s;
+  }
+
+  // One TLS request/response over an already-connected socket.
+  bool tls_roundtrip_(int fd, const std::string& data,
+                      std::string* raw) const {
+    const TlsLib& ssl = TlsLib::get();
+    TlsLib::SSL* s = tls_open_session_(fd);
+    if (!s) return false;
     bool ok = false;
-    if (ssl.SSL_connect(s) == 1) {
+    {
       size_t sent = 0;
       ok = true;
       while (sent < data.size()) {

@@ -21,18 +21,25 @@
 // verification (in-cluster, autodetected from KUBERNETES_SERVICE_HOST and
 // /var/run/secrets/kubernetes.io/serviceaccount), or plain HTTP
 // (kubectl-proxy sidecar, fake API server in tests). Reconciliation is
-// level-based with adaptive backoff: a pass whose CR specs are unchanged
-// doubles the interval up to --max-interval; any spec change or transport
-// error resets it — the poll-based stand-in for a watch that keeps idle
-// clusters cheap (ref uses controller-runtime watches,
-// operator/cmd/main.go:58-266). A /healthz endpoint reports liveness and
-// last-reconcile age for kubelet probes.
+// level-based and EVENT-DRIVEN: one apiserver watch stream per CR type
+// (chunked JSON events, resourceVersion resume, 410 recovery) wakes the
+// loop within milliseconds of a change — the controller-runtime-informer
+// equivalent (ref operator/cmd/main.go:58-266) — while the adaptive
+// poll interval (doubling to --max-interval when specs are unchanged)
+// remains as the level-set fallback. --leader-elect coordinates replicas
+// through a coordination.k8s.io/v1 Lease so only the holder mutates
+// cluster state. A /healthz endpoint reports liveness and last-reconcile
+// age for kubelet probes.
 
 #include <csignal>
 #include <unistd.h>
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <ctime>
+#include <mutex>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -66,6 +73,17 @@ struct Config {
   std::string ca_file;         // CA bundle for https:// verification
   bool insecure_tls = false;
   bool once = false;
+  // Event-driven reconciliation: one apiserver watch stream per CR type
+  // wakes the loop within milliseconds of a change (the controller-
+  // runtime-informer equivalent; the poll interval stays as fallback).
+  bool watch = true;
+  // Leader election via a coordination.k8s.io/v1 Lease: with N replicas
+  // only the holder mutates cluster state (ref operator/cmd/main.go
+  // EnableLeaderElection).
+  bool leader_elect = false;
+  std::string lease_name = "tpu-stack-operator";
+  std::string identity;        // default: hostname-pid
+  int lease_duration_sec = 15;
 };
 
 const char* kGroup = "production-stack.tpu";
@@ -923,12 +941,253 @@ std::pair<uint64_t, bool> reconcile_once(const HttpClient& api,
 }
 
 // ---------------------------------------------------------------------- //
+// Watch streams: one thread per CR type runs the apiserver's HTTP watch
+// (chunked JSON event lines) and pokes the reconcile loop on any event —
+// event-to-reconcile latency becomes milliseconds instead of the poll
+// interval (ref: controller-runtime informers, operator/cmd/main.go +
+// loraadapter_controller.go:235-275 pod-watch wiring). resourceVersion
+// resume: each event's metadata.resourceVersion is carried into the next
+// watch request; a 410 Gone clears it (restart from "now"; the reconcile
+// pass re-lists anyway, so no event is ultimately missed).
+// ---------------------------------------------------------------------- //
+
+struct WatchState {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool dirty = false;
+  std::atomic<bool> stop{false};
+  std::atomic<int64_t> events_total{0};
+
+  void poke() {
+    {
+      std::lock_guard<std::mutex> lock(mu);
+      dirty = true;
+    }
+    cv.notify_all();
+  }
+};
+
+std::string json_escape_free_rv(const std::string& line) {
+  // Extract "resourceVersion":"N" from a watch event line (first match —
+  // the event object's own metadata comes first in apiserver output; a
+  // fake server that omits it just yields empty = watch from now).
+  auto pos = line.find("\"resourceVersion\"");
+  if (pos == std::string::npos) return "";
+  pos = line.find(':', pos);
+  if (pos == std::string::npos) return "";
+  auto q1 = line.find('"', pos);
+  if (q1 == std::string::npos) return "";
+  auto q2 = line.find('"', q1 + 1);
+  if (q2 == std::string::npos) return "";
+  return line.substr(q1 + 1, q2 - q1 - 1);
+}
+
+void watch_loop(const Config& cfg, const HttpAuth& auth,
+                const std::string& plural, WatchState* state) {
+  HttpClient api(cfg.api_base, 10, auth);
+  std::string rv;
+  while (!state->stop.load()) {
+    // allowWatchBookmarks keeps rv fresh on quiet resources, so resume
+    // rarely hits the event-cache horizon at all.
+    std::string path = cr_path(cfg, plural) +
+                       "?watch=true&timeoutSeconds=30"
+                       "&allowWatchBookmarks=true";
+    if (!rv.empty()) path += "&resourceVersion=" + rv;
+    bool expired = false;
+    int status = api.watch_lines(
+        path,
+        [&](const std::string& line) {
+          if (state->stop.load()) return false;
+          // Expiry arrives IN-STREAM on HTTP 200: a Status event
+          // {"type":"ERROR","object":{...,"code":410}} — not as an HTTP
+          // status. Clear the resume point and restart from "now" (the
+          // reconcile pass re-lists, so nothing is ultimately missed).
+          if (line.find("\"type\":\"ERROR\"") != std::string::npos) {
+            if (line.find("410") != std::string::npos) expired = true;
+            return false;
+          }
+          // BOOKMARK events update the resume point without a reconcile.
+          std::string new_rv = json_escape_free_rv(line);
+          if (!new_rv.empty()) rv = new_rv;
+          if (line.find("\"type\":\"BOOKMARK\"") == std::string::npos) {
+            state->events_total.fetch_add(1);
+            state->poke();
+          }
+          return true;
+        },
+        // Server-side timeout + margin; also bounds a dead connection.
+        40);
+    if (state->stop.load()) return;
+    if (expired || status == 410) {
+      rv.clear();  // history compacted: resume from now
+      state->poke();
+      ::sleep(1);  // don't hammer the apiserver on repeated expiry
+      continue;
+    }
+    if (status < 200 || status >= 300) {
+      // Transport error / endpoint without watch support: back off and
+      // retry; the poll fallback keeps reconciliation alive meanwhile.
+      ::sleep(2);
+    }
+  }
+}
+
+// ---------------------------------------------------------------------- //
+// Leader election: coordination.k8s.io/v1 Lease (ref operator/cmd/main.go
+// EnableLeaderElection). The holder renews every duration/3; a candidate
+// acquires when the Lease is absent or its renewTime is older than the
+// lease duration. Optimistic concurrency rides metadata.resourceVersion
+// (the apiserver rejects stale writes with 409).
+// ---------------------------------------------------------------------- //
+
+std::string lease_path(const Config& cfg) {
+  return "/apis/coordination.k8s.io/v1/namespaces/" + cfg.ns + "/leases/" +
+         cfg.lease_name;
+}
+
+std::string rfc3339_micro_now() {
+  struct timespec ts{};
+  clock_gettime(CLOCK_REALTIME, &ts);
+  struct tm tm_utc{};
+  gmtime_r(&ts.tv_sec, &tm_utc);
+  char buf[80];  // worst-case snprintf bound, keeps -Wformat-truncation quiet
+  std::snprintf(buf, sizeof(buf), "%04d-%02d-%02dT%02d:%02d:%02d.%06ldZ",
+                tm_utc.tm_year + 1900, tm_utc.tm_mon + 1, tm_utc.tm_mday,
+                tm_utc.tm_hour, tm_utc.tm_min, tm_utc.tm_sec,
+                ts.tv_nsec / 1000);
+  return buf;
+}
+
+class LeaderElector {
+ public:
+  LeaderElector(const Config& cfg) : cfg_(cfg) {}
+
+  bool is_leader() const { return leader_.load(); }
+
+  // One election tick: acquire / renew / observe. Called from the
+  // DEDICATED election thread (never the reconcile thread — a slow
+  // reconcile pass must not delay renewal past the lease duration;
+  // client-go renews on its own goroutine for the same reason).
+  bool tick(const HttpClient& api) {
+    int64_t now = ::time(nullptr);
+    if (leader_.load() && now - last_renew_sec_ <
+                              cfg_.lease_duration_sec / 3) {
+      return true;  // renewed recently enough
+    }
+    HttpResponse resp = api.get(lease_path(cfg_));
+    if (resp.status == 404) {
+      return try_write_lease(api, Json(), now);
+    }
+    Json lease;
+    if (!resp.ok() || !Json::try_parse(resp.body, &lease)) {
+      // Apiserver unreachable: a standing leader keeps acting until its
+      // lease would have expired (client-go semantics), then demotes.
+      if (leader_.load() &&
+          now - last_renew_sec_ > cfg_.lease_duration_sec) {
+        demote("apiserver unreachable");
+      }
+      return leader_.load();
+    }
+    const Json& spec = lease.get("spec");
+    std::string holder = spec.get("holderIdentity").as_string();
+    std::string renew_str = spec.get("renewTime").as_string();
+    int64_t duration = spec.get("leaseDurationSeconds").as_int(
+        cfg_.lease_duration_sec);
+    // Expiry is measured from the LOCAL time we first observed this
+    // (holder, renewTime) pair — not by comparing the remote wall-clock
+    // timestamp to our clock (client-go semantics; clock skew between
+    // nodes must not cause double leadership or delayed failover).
+    if (holder != observed_holder_ || renew_str != observed_renew_) {
+      observed_holder_ = holder;
+      observed_renew_ = renew_str;
+      observed_at_sec_ = now;
+    }
+    bool expired = now - observed_at_sec_ > duration;
+    if (holder == cfg_.identity || holder.empty() || expired) {
+      return try_write_lease(api, lease, now);
+    }
+    if (leader_.load()) demote("lost lease to " + holder);
+    return false;
+  }
+
+ private:
+  bool try_write_lease(const HttpClient& api, const Json& existing,
+                       int64_t now) {
+    JsonObject meta;
+    meta["name"] = cfg_.lease_name;
+    meta["namespace"] = cfg_.ns;
+    bool create = !existing.get("metadata").get("name").is_string();
+    if (!create) {
+      // Optimistic concurrency: echo the observed resourceVersion so a
+      // concurrent candidate's write makes ours fail with 409.
+      const Json& rv = existing.get("metadata").get("resourceVersion");
+      if (rv.is_string()) meta["resourceVersion"] = rv.as_string();
+    }
+    JsonObject spec;
+    spec["holderIdentity"] = cfg_.identity;
+    spec["leaseDurationSeconds"] =
+        static_cast<int64_t>(cfg_.lease_duration_sec);
+    spec["renewTime"] = rfc3339_micro_now();
+    std::string acquire_time = rfc3339_micro_now();
+    int64_t transitions = 0;
+    if (!create) {
+      const Json& old_spec = existing.get("spec");
+      if (old_spec.get("holderIdentity").as_string() == cfg_.identity &&
+          old_spec.get("acquireTime").is_string()) {
+        acquire_time = old_spec.get("acquireTime").as_string();
+        transitions = old_spec.get("leaseTransitions").as_int(0);
+      } else {
+        transitions = old_spec.get("leaseTransitions").as_int(0) + 1;
+      }
+    }
+    spec["acquireTime"] = acquire_time;
+    spec["leaseTransitions"] = transitions;
+    JsonObject lease;
+    lease["apiVersion"] = std::string("coordination.k8s.io/v1");
+    lease["kind"] = std::string("Lease");
+    lease["metadata"] = Json(meta);
+    lease["spec"] = Json(spec);
+    HttpResponse resp =
+        create ? api.post("/apis/coordination.k8s.io/v1/namespaces/" +
+                              cfg_.ns + "/leases",
+                          Json(lease).dump())
+               : api.put(lease_path(cfg_), Json(lease).dump());
+    if (resp.ok()) {
+      if (!leader_.load())
+        log_line("leader election: acquired lease as " + cfg_.identity);
+      leader_.store(true);
+      last_renew_sec_ = now;
+      return true;
+    }
+    if (leader_.load() && ::time(nullptr) - last_renew_sec_ >
+                              cfg_.lease_duration_sec) {
+      demote("renew failed with status " + std::to_string(resp.status));
+    }
+    return leader_.load();
+  }
+
+  void demote(const std::string& why) {
+    log_line("leader election: standing down (" + why + ")");
+    leader_.store(false);
+  }
+
+  const Config& cfg_;
+  std::atomic<bool> leader_{false};
+  int64_t last_renew_sec_ = 0;
+  // (holder, renewTime) observation for local-clock expiry tracking.
+  std::string observed_holder_;
+  std::string observed_renew_;
+  int64_t observed_at_sec_ = 0;
+};
+
+// ---------------------------------------------------------------------- //
 // /healthz listener (kubelet liveness/readiness; ref exposes :8081 via
 // controller-runtime's healthz.Ping)
 // ---------------------------------------------------------------------- //
 
 std::atomic<int64_t> g_last_reconcile_ms{0};
 std::atomic<int64_t> g_passes{0};
+std::atomic<bool> g_shutdown{false};
 
 int64_t now_ms() {
   struct timespec ts{};
@@ -1008,6 +1267,12 @@ int main(int argc, char** argv) {
     else if (a == "--ca-file") cfg.ca_file = next("--ca-file");
     else if (a == "--insecure-skip-tls-verify") cfg.insecure_tls = true;
     else if (a == "--once") cfg.once = true;
+    else if (a == "--no-watch") cfg.watch = false;
+    else if (a == "--leader-elect") cfg.leader_elect = true;
+    else if (a == "--lease-name") cfg.lease_name = next("--lease-name");
+    else if (a == "--identity") cfg.identity = next("--identity");
+    else if (a == "--lease-duration")
+      cfg.lease_duration_sec = std::stoi(next("--lease-duration"));
     else if (a == "--help" || a == "-h") {
       std::printf(
           "tpu-stack-operator: reconciles production-stack.tpu/v1alpha1 "
@@ -1022,7 +1287,14 @@ int main(int argc, char** argv) {
           "  --interval SEC   base reconcile interval (default 5)\n"
           "  --max-interval S backoff ceiling when idle (default 30)\n"
           "  --health-port P  /healthz listener (default 8081, 0=off)\n"
-          "  --once           single reconcile pass, then exit\n");
+          "  --once           single reconcile pass, then exit\n"
+          "  --no-watch       disable apiserver watch streams (poll only)\n"
+          "  --leader-elect   coordinate replicas via a Lease; only the\n"
+          "                   holder reconciles\n"
+          "  --lease-name N   Lease object name (default\n"
+          "                   tpu-stack-operator)\n"
+          "  --identity ID    holder identity (default hostname-pid)\n"
+          "  --lease-duration S  lease TTL seconds (default 15)\n");
       return 0;
     }
   }
@@ -1065,14 +1337,64 @@ int main(int argc, char** argv) {
     health.detach();
   }
 
+  if (cfg.identity.empty()) {
+    char host[256] = "operator";
+    ::gethostname(host, sizeof(host) - 1);
+    cfg.identity = std::string(host) + "-" + std::to_string(::getpid());
+  }
+  LeaderElector elector(cfg);
+
+  // Graceful shutdown (SIGTERM/SIGINT): stop the loop, then stop and
+  // JOIN the worker threads — destroying a joinable std::thread would
+  // std::terminate. Watch reads time out within ~40 s, bounding the join.
+  std::signal(SIGTERM, [](int) { g_shutdown.store(true); });
+  std::signal(SIGINT, [](int) { g_shutdown.store(true); });
+
+  // Watch streams (skipped in --once mode: a single pass needs no events).
+  WatchState watch_state;
+  std::vector<std::thread> watchers;
+  if (!cfg.once && cfg.watch) {
+    for (const char* plural :
+         {"tpuruntimes", "tpurouters", "cacheservers", "loraadapters"}) {
+      watchers.emplace_back(watch_loop, std::cref(cfg), std::cref(auth),
+                            std::string(plural), &watch_state);
+    }
+  }
+
+  // Leader election runs on its OWN thread with its own client: a slow
+  // reconcile pass (sequential HTTP calls, 10 s timeouts each) must
+  // never delay lease renewal past the lease duration, or a standby
+  // would take over while this replica is still mid-mutation (client-go
+  // renews on a dedicated goroutine for the same reason).
+  std::thread election;
+  if (!cfg.once && cfg.leader_elect) {
+    election = std::thread([&cfg, &auth, &elector, &watch_state] {
+      HttpClient lease_api(cfg.api_base, 5, auth);
+      bool was_leader = false;
+      while (!g_shutdown.load()) {
+        bool leads = elector.tick(lease_api);
+        if (leads != was_leader) {
+          was_leader = leads;
+          watch_state.poke();  // role change: reconcile promptly
+        }
+        int nap = std::max(cfg.lease_duration_sec / 3, 1);
+        for (int i = 0; i < nap * 10 && !g_shutdown.load(); ++i)
+          ::usleep(100 * 1000);
+      }
+    });
+  } else if (cfg.once && cfg.leader_elect) {
+    elector.tick(api);
+  }
+
   uint64_t prev_fp = 0;
   bool have_fp = false;
   int interval = cfg.interval_sec;
   do {
-    auto [fp, ok] = reconcile_once(api, cfg);
-    g_last_reconcile_ms.store(now_ms());
-    g_passes.fetch_add(1);
-    if (!cfg.once) {
+    bool act = !cfg.leader_elect || elector.is_leader();
+    if (act) {
+      auto [fp, ok] = reconcile_once(api, cfg);
+      g_last_reconcile_ms.store(now_ms());
+      g_passes.fetch_add(1);
       if (ok && have_fp && fp == prev_fp) {
         interval = std::min(interval * 2, cfg.max_interval_sec);
       } else {
@@ -1080,8 +1402,23 @@ int main(int argc, char** argv) {
       }
       prev_fp = fp;
       have_fp = ok;
-      ::sleep(interval);
+    } else {
+      // Standby replica: stay cheap but current (the lease holder may
+      // die any moment), and keep the health probe fed.
+      g_last_reconcile_ms.store(now_ms());
+      have_fp = false;  // act immediately on promotion
+      interval = std::max(cfg.lease_duration_sec / 3, 1);
     }
-  } while (!cfg.once);
+    if (cfg.once || g_shutdown.load()) break;
+    // Event-driven wake-up: a watch event (or an election role change)
+    // cuts the wait short.
+    std::unique_lock<std::mutex> lock(watch_state.mu);
+    watch_state.cv.wait_for(lock, std::chrono::seconds(interval),
+                            [&] { return watch_state.dirty; });
+    watch_state.dirty = false;
+  } while (!g_shutdown.load());
+  watch_state.stop.store(true);
+  for (auto& w : watchers) w.join();
+  if (election.joinable()) election.join();
   return 0;
 }

@@ -110,26 +110,101 @@ def test_native_kv_aware():
 
 class FakeK8s:
     """Tiny in-memory Kubernetes API server covering what the operator
-    uses: CR lists, deployments, services, serviceaccounts, pods, status
-    subresources."""
+    uses: CR lists + WATCH streams, deployments, services,
+    serviceaccounts, pods, status subresources, and coordination.k8s.io
+    Leases (with resourceVersion optimistic concurrency)."""
 
     def __init__(self):
         self.objects = {}  # path -> body dict
         self.crs = {}      # plural -> [cr dicts]
         self.pods = []
         self.status_updates = []
+        self.leases = {}   # name -> lease dict
+        self._lease_rv = 0
+        self._watchers = []  # asyncio.Queue of event lines
+
+    def emit_watch_event(self, event_type: str, obj: dict) -> None:
+        line = json.dumps({"type": event_type, "object": obj})
+        for q in list(self._watchers):
+            q.put_nowait(line)
 
     def make_app(self):
         app = web.Application()
         app.router.add_route("*", "/{tail:.*}", self.handle)
         return app
 
+    async def _serve_watch(self, request: web.Request):
+        """Chunked watch stream: emits queued event lines until the
+        client's timeoutSeconds elapses or it disconnects."""
+        timeout = float(request.query.get("timeoutSeconds", "30"))
+        resp = web.StreamResponse()
+        resp.enable_chunked_encoding()
+        resp.content_type = "application/json"
+        await resp.prepare(request)
+        q: asyncio.Queue = asyncio.Queue()
+        self._watchers.append(q)
+        deadline = asyncio.get_running_loop().time() + timeout
+        try:
+            while True:
+                remain = deadline - asyncio.get_running_loop().time()
+                if remain <= 0:
+                    break
+                try:
+                    line = await asyncio.wait_for(q.get(), timeout=remain)
+                except asyncio.TimeoutError:
+                    break
+                await resp.write(line.encode() + b"\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            self._watchers.remove(q)
+        try:
+            await resp.write_eof()
+        except ConnectionResetError:
+            pass
+        return resp
+
+    def _handle_lease(self, request, path, method, body):
+        name = path.rstrip("/").split("/")[-1]
+        if method == "GET":
+            if name in self.leases:
+                return web.json_response(self.leases[name])
+            return web.json_response({"reason": "NotFound"}, status=404)
+        if method == "POST":
+            lease_name = body["metadata"]["name"]
+            if lease_name in self.leases:
+                return web.json_response(
+                    {"reason": "AlreadyExists"}, status=409)
+            self._lease_rv += 1
+            body["metadata"]["resourceVersion"] = str(self._lease_rv)
+            self.leases[lease_name] = body
+            return web.json_response(body, status=201)
+        if method == "PUT":
+            existing = self.leases.get(name)
+            if existing is None:
+                return web.json_response({"reason": "NotFound"}, status=404)
+            sent_rv = body.get("metadata", {}).get("resourceVersion")
+            if sent_rv != existing["metadata"]["resourceVersion"]:
+                # Optimistic concurrency: stale writers lose.
+                return web.json_response({"reason": "Conflict"}, status=409)
+            self._lease_rv += 1
+            body["metadata"]["resourceVersion"] = str(self._lease_rv)
+            self.leases[name] = body
+            return web.json_response(body)
+        return web.json_response({}, status=405)
+
     async def handle(self, request: web.Request) -> web.Response:
         path = "/" + request.match_info["tail"]
         method = request.method
+        if "/leases" in path:
+            body = (json.loads(await request.text())
+                    if method in ("POST", "PUT") else None)
+            return self._handle_lease(request, path, method, body)
         if "/pods" in path and method == "GET":
             return web.json_response({"items": self.pods})
         if "production-stack.tpu" in path:
+            if method == "GET" and request.query.get("watch") == "true":
+                return await self._serve_watch(request)
             parts = path.rstrip("/").split("/")
             if path.endswith("/status") and method == "PUT":
                 body = json.loads(await request.text())
@@ -883,3 +958,140 @@ def test_operator_https_rejects_untrusted_ca(tmp_path):
     proc = asyncio.run(run())
     assert proc.returncode == 0
     assert not fake.objects  # handshake refused -> nothing written
+
+
+def _start_operator(api_url: str, *extra):
+    binary = os.path.join(BUILD_DIR, "tpu-stack-operator")
+    return subprocess.Popen(
+        [binary, "--api-base", api_url, "--namespace", "default",
+         "--health-port", "0", *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+
+def test_operator_watch_event_reconciles_within_a_second():
+    """The apiserver watch stream wakes the reconcile loop immediately:
+    with an effectively-infinite poll interval, a CR added + watch event
+    emitted must materialize its Deployment in well under the interval
+    (ref: controller-runtime informers vs the old adaptive polling)."""
+    fake = FakeK8s()
+    fake.crs["tpuruntimes"] = [{
+        "metadata": {"name": "first", "uid": "uid-1"},
+        "spec": {"model": "tiny-llama", "replicas": 1, "port": 8000},
+    }]
+
+    async def run():
+        runner = web.AppRunner(fake.make_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        url = f"http://127.0.0.1:{port}"
+        proc = _start_operator(url, "--interval", "600",
+                               "--max-interval", "600")
+        try:
+            # Initial pass (runs immediately at startup).
+            for _ in range(100):
+                if any(k.endswith("first-engine") for k in fake.objects):
+                    break
+                await asyncio.sleep(0.05)
+            assert any(k.endswith("first-engine") for k in fake.objects)
+
+            # Let the operator settle into its 600 s wait + its watch
+            # streams connect.
+            await asyncio.sleep(1.0)
+
+            new_cr = {
+                "metadata": {"name": "second", "uid": "uid-2",
+                             "resourceVersion": "7"},
+                "spec": {"model": "tiny-llama", "replicas": 1,
+                         "port": 8000},
+            }
+            fake.crs["tpuruntimes"].append(new_cr)
+            t0 = asyncio.get_running_loop().time()
+            fake.emit_watch_event("ADDED", new_cr)
+            deadline = t0 + 2.0
+            while asyncio.get_running_loop().time() < deadline:
+                if any(k.endswith("second-engine") for k in fake.objects):
+                    break
+                await asyncio.sleep(0.02)
+            latency = asyncio.get_running_loop().time() - t0
+            assert any(k.endswith("second-engine") for k in fake.objects), \
+                "watch event did not trigger a reconcile"
+            assert latency < 1.0, f"event->reconcile took {latency:.2f}s"
+        finally:
+            proc.kill()
+            proc.wait(timeout=10)
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_operator_leader_election_standby_and_failover():
+    """With --leader-elect only the lease holder reconciles; a standby
+    replica takes over once the holder's lease expires (ref
+    operator/cmd/main.go EnableLeaderElection)."""
+    fake = FakeK8s()
+    fake.crs["tpuruntimes"] = [{
+        "metadata": {"name": "m", "uid": "uid-1"},
+        "spec": {"model": "tiny-llama", "replicas": 1, "port": 8000},
+    }]
+
+    async def run():
+        runner = web.AppRunner(fake.make_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        url = f"http://127.0.0.1:{port}"
+        flags = ("--leader-elect", "--lease-duration", "2",
+                 "--interval", "1", "--max-interval", "1")
+        a = _start_operator(url, "--identity", "op-a", *flags)
+        b = None
+        try:
+            # A acquires the lease and reconciles.
+            for _ in range(200):
+                if (fake.leases.get("tpu-stack-operator", {}).get(
+                        "spec", {}).get("holderIdentity") == "op-a"
+                        and any(k.endswith("m-engine")
+                                for k in fake.objects)):
+                    break
+                await asyncio.sleep(0.05)
+            assert fake.leases["tpu-stack-operator"]["spec"][
+                "holderIdentity"] == "op-a"
+
+            # B starts as standby: with the deployment deleted it must
+            # NOT recreate it while A holds the lease.
+            b = _start_operator(url, "--identity", "op-b", *flags)
+            await asyncio.sleep(1.0)  # B is up and observing
+            fake.objects = {k: v for k, v in fake.objects.items()
+                            if not k.endswith("m-engine")}
+            await asyncio.sleep(1.0)
+            # A (the leader) recreates it; kill A and delete again to
+            # isolate B's standby behavior.
+            a.kill()
+            a.wait(timeout=10)
+            fake.objects = {k: v for k, v in fake.objects.items()
+                            if not k.endswith("m-engine")}
+            await asyncio.sleep(0.8)  # < lease duration: B still standby
+            assert not any(k.endswith("m-engine") for k in fake.objects), \
+                "standby replica acted while the lease was live"
+
+            # Lease expires -> B acquires and reconciles.
+            for _ in range(200):
+                if any(k.endswith("m-engine") for k in fake.objects):
+                    break
+                await asyncio.sleep(0.05)
+            assert any(k.endswith("m-engine") for k in fake.objects), \
+                "standby never took over after lease expiry"
+            assert fake.leases["tpu-stack-operator"]["spec"][
+                "holderIdentity"] == "op-b"
+        finally:
+            if b is not None:
+                b.kill()
+                b.wait(timeout=10)
+            if a.poll() is None:
+                a.kill()
+                a.wait(timeout=10)
+            await runner.cleanup()
+
+    asyncio.run(run())
