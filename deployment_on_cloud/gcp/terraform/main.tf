@@ -1,0 +1,115 @@
+# GKE + TPU node pool + helm release for production-stack-tpu.
+#
+# Terraform counterpart of ../entry_point.sh and of the reference's
+# tutorials/terraform/gke (which provisions GPU nodes + the GPU stack;
+# here the engine pool is a TPU slice and nothing requests a GPU).
+#
+#   terraform init && terraform apply -var project_id=my-project
+#
+# Multi-host slices: set tpu_machine_type=ct5lp-hightpu-4t,
+# tpu_topology=4x4, tpu_node_count=4 and use a values file with
+# modelSpec.tpu.hosts=4 (helm/examples/values-07-multihost-llama70b.yaml).
+
+terraform {
+  required_version = ">= 1.5"
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = ">= 5.0"
+    }
+    helm = {
+      source  = "hashicorp/helm"
+      version = ">= 2.12"
+    }
+  }
+}
+
+provider "google" {
+  project = var.project_id
+  region  = var.region
+}
+
+resource "google_container_cluster" "stack" {
+  name     = var.cluster_name
+  location = var.zone
+
+  # Node pools are managed explicitly below.
+  remove_default_node_pool = true
+  initial_node_count       = 1
+
+  release_channel {
+    channel = "REGULAR"
+  }
+}
+
+# CPU pool: router, operator (2 replicas, leader-elected), cache server,
+# observability.
+resource "google_container_node_pool" "cpu" {
+  name     = "cpu-pool"
+  cluster  = google_container_cluster.stack.name
+  location = var.zone
+
+  node_count = 2
+  node_config {
+    machine_type = var.cpu_machine_type
+    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+  }
+}
+
+# TPU slice pool: GKE labels these nodes with
+# cloud.google.com/gke-tpu-accelerator + -topology; the chart's
+# modelSpec.tpu block node-selects onto them and requests google.com/tpu.
+resource "google_container_node_pool" "tpu" {
+  name     = "tpu-pool"
+  cluster  = google_container_cluster.stack.name
+  location = var.zone
+
+  node_count = var.tpu_node_count
+  node_config {
+    machine_type = var.tpu_machine_type
+    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+  }
+  placement_policy {
+    type         = "COMPACT"
+    tpu_topology = var.tpu_topology
+  }
+}
+
+data "google_client_config" "current" {}
+
+provider "helm" {
+  kubernetes {
+    host                   = "https://${google_container_cluster.stack.endpoint}"
+    token                  = data.google_client_config.current.access_token
+    cluster_ca_certificate = base64decode(
+      google_container_cluster.stack.master_auth[0].cluster_ca_certificate
+    )
+  }
+}
+
+resource "helm_release" "stack" {
+  name      = "tpu-stack"
+  chart     = "${path.module}/../../../helm"
+  timeout   = 1200
+  values    = [file(var.values_file)]
+  depends_on = [
+    google_container_node_pool.cpu,
+    google_container_node_pool.tpu,
+  ]
+
+  set {
+    name  = "routerSpec.repository"
+    value = var.image_repository
+  }
+  set {
+    name  = "routerSpec.tag"
+    value = var.image_tag
+  }
+  dynamic "set_sensitive" {
+    for_each = var.api_key == "" ? [] : [1]
+    content {
+      name  = "routerSpec.apiKey"
+      value = var.api_key
+    }
+  }
+}

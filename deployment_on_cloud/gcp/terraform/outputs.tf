@@ -1,0 +1,12 @@
+output "cluster_name" {
+  value = google_container_cluster.stack.name
+}
+
+output "cluster_endpoint" {
+  value     = google_container_cluster.stack.endpoint
+  sensitive = true
+}
+
+output "kubeconfig_hint" {
+  value = "gcloud container clusters get-credentials ${google_container_cluster.stack.name} --zone ${var.zone} --project ${var.project_id}"
+}

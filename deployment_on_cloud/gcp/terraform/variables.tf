@@ -1,0 +1,73 @@
+# Inputs for the GKE TPU production-stack deployment (the terraform
+# counterpart of entry_point.sh; reference tutorials/terraform/gke is the
+# GPU-shaped original this mirrors for TPU slices).
+
+variable "project_id" {
+  description = "GCP project with TPU quota in the chosen location"
+  type        = string
+}
+
+variable "region" {
+  description = "GKE control-plane region"
+  type        = string
+  default     = "us-central1"
+}
+
+variable "zone" {
+  description = "Zone for the TPU node pool (must offer the accelerator)"
+  type        = string
+  default     = "us-central1-a"
+}
+
+variable "cluster_name" {
+  type    = string
+  default = "tpu-stack"
+}
+
+variable "tpu_machine_type" {
+  description = "TPU slice host machine type (ct5lp-hightpu-1t = 1 v5e chip/host, -4t = 4, -8t = 8)"
+  type        = string
+  default     = "ct5lp-hightpu-1t"
+}
+
+variable "tpu_topology" {
+  description = "TPU slice topology (1x1 single chip; 2x4 = 8 chips; 4x4 multi-host)"
+  type        = string
+  default     = "1x1"
+}
+
+variable "tpu_node_count" {
+  description = "Hosts in the TPU pool (multi-host slices need topology hosts)"
+  type        = number
+  default     = 1
+}
+
+variable "cpu_machine_type" {
+  description = "Machine type for the router/operator/cache CPU pool"
+  type        = string
+  default     = "e2-standard-8"
+}
+
+variable "image_repository" {
+  description = "Pushed production-stack-tpu image (docker/Dockerfile)"
+  type        = string
+  default     = "production-stack-tpu"
+}
+
+variable "image_tag" {
+  type    = string
+  default = "latest"
+}
+
+variable "values_file" {
+  description = "Helm values for the stack (defaults to the single-chip example)"
+  type        = string
+  default     = "../values-gke-tpu.yaml"
+}
+
+variable "api_key" {
+  description = "Optional serving API key (tutorial 18); empty disables auth"
+  type        = string
+  default     = ""
+  sensitive   = true
+}

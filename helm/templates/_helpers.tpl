@@ -93,6 +93,20 @@
 {{/* HF-token + extra env entries for a modelSpec (dict: root, model).
      Shared by the Deployment and the multi-host StatefulSet. */}}
 {{- define "chart.engineEnvExtra" -}}
+{{- if .model.apiKey }}
+# Serving-surface auth: the engine reads VLLM_API_KEY and requires
+# `Authorization: Bearer <key>` (reference tutorial 11).
+- name: VLLM_API_KEY
+  valueFrom:
+    secretKeyRef:
+      {{- if kindIs "string" .model.apiKey }}
+      name: "{{ include "chart.fullname" .root }}-{{ .model.name }}-api-key"
+      key: key
+      {{- else }}
+      name: {{ .model.apiKey.secretName | quote }}
+      key: {{ .model.apiKey.secretKey | quote }}
+      {{- end }}
+{{- end }}
 {{- if .model.hfToken }}
 # HF gated-model auth: a plain string renders an inline secret;
 # {secretName, secretKey} references an existing one (matches

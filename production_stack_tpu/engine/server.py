@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import os
 import itertools
 import json
 import threading
@@ -79,7 +80,16 @@ class EngineServer:
                  warmup: bool = False,
                  kv_controller_url: Optional[str] = None,
                  instance_id: Optional[str] = None,
-                 advertise_url: Optional[str] = None):
+                 advertise_url: Optional[str] = None,
+                 api_key: Optional[str] = None):
+        # Serving-surface auth (reference tutorial 11 "secure vLLM
+        # serve": VLLM_API_KEY): /v1/* requests must carry
+        # `Authorization: Bearer <key>`; the intra-stack control plane
+        # (probes, /metrics, /kv/*, sleep admin) stays open — see
+        # utils/auth.py. None disables.
+        from production_stack_tpu.utils.auth import resolve_api_key
+
+        self.api_key = resolve_api_key(api_key)
         self.config = config
         self.core = EngineCore(config)
         if warmup:
@@ -137,7 +147,7 @@ class EngineServer:
         import aiohttp
 
         try:
-            async with aiohttp.ClientSession() as s:
+            async with aiohttp.ClientSession(headers=self._auth_headers()) as s:
                 async with s.post(
                     f"{self.kv_controller_url}/kv/register",
                     json={"instance_id": self.instance_id,
@@ -237,7 +247,7 @@ class EngineServer:
             import aiohttp
 
             try:
-                async with aiohttp.ClientSession() as s:
+                async with aiohttp.ClientSession(headers=self._auth_headers()) as s:
                     await s.post(
                         f"{self.kv_controller_url}/kv/evict",
                         json={"instance_id": self.instance_id,
@@ -284,7 +294,7 @@ class EngineServer:
             if not self._kv_registered and not await self._kv_register():
                 return
             try:
-                async with aiohttp.ClientSession() as s:
+                async with aiohttp.ClientSession(headers=self._auth_headers()) as s:
                     await s.post(
                         f"{self.kv_controller_url}/kv/admit",
                         json={"instance_id": self.instance_id,
@@ -299,8 +309,26 @@ class EngineServer:
     # ------------------------------------------------------------------ #
     # app assembly
     # ------------------------------------------------------------------ #
+    def _auth_headers(self) -> dict:
+        """Default headers for this engine's OUTBOUND calls (router KV
+        controller, peer engines in disagg): under a shared deployment
+        API key every tier authenticates with the same credential."""
+        if self.api_key:
+            return {"Authorization": f"Bearer {self.api_key}"}
+        return {}
+
+    @web.middleware
+    async def _auth_middleware(self, request: web.Request, handler):
+        from production_stack_tpu.utils import auth
+
+        if self.api_key and auth.is_gated(request.path) and \
+                not auth.check_bearer(
+                    request.headers.get("Authorization"), self.api_key):
+            return auth.unauthorized_response()
+        return await handler(request)
+
     def make_app(self) -> web.Application:
-        app = web.Application()
+        app = web.Application(middlewares=[self._auth_middleware])
         r = app.router
         r.add_get("/v1/models", self.handle_models)
         r.add_post("/v1/chat/completions", self.handle_chat)
@@ -1415,7 +1443,7 @@ class EngineServer:
             return None
         t0 = time.monotonic()
         try:
-            async with aiohttp.ClientSession() as session:
+            async with aiohttp.ClientSession(headers=self._auth_headers()) as session:
                 async with session.post(
                     source.rstrip("/") + "/kv/prepare_pull",
                     json={"token_ids": token_ids,
@@ -1461,7 +1489,7 @@ class EngineServer:
         # not free them).
         for attempt in range(3):
             try:
-                async with aiohttp.ClientSession() as session:
+                async with aiohttp.ClientSession(headers=self._auth_headers()) as session:
                     async with session.post(
                             source.rstrip("/") + "/kv/release",
                             json={"uuid": offer["uuid"]},
@@ -1567,7 +1595,7 @@ class EngineServer:
                     {"error": "device path unavailable"}, status=501)
         t0 = time.monotonic()
         try:
-            async with aiohttp.ClientSession() as session:
+            async with aiohttp.ClientSession(headers=self._auth_headers()) as session:
                 async with session.post(
                     source.rstrip("/") + "/kv/extract",
                     json={"token_ids": token_ids,
@@ -1714,6 +1742,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--quantization", default=None, choices=["int8"],
                    help="weight-only quantization: int8 weights + "
                         "per-channel scales (llama family)")
+    p.add_argument("--api-key", default=None,
+                   help="require 'Authorization: Bearer <key>' on the "
+                        "serving surface (default: VLLM_API_KEY / "
+                        "TPU_STACK_API_KEY env; /health and /metrics "
+                        "stay open)")
     p.add_argument("--max-model-len", type=int, default=2048)
     p.add_argument("--max-num-seqs", type=int, default=8)
     p.add_argument("--block-size", type=int, default=64)
@@ -1796,7 +1829,8 @@ def main(argv: Optional[List[str]] = None) -> None:
                           warmup=args.warmup,
                           kv_controller_url=args.kv_controller_url,
                           instance_id=args.instance_id,
-                          advertise_url=args.advertise_url)
+                          advertise_url=args.advertise_url,
+                          api_key=args.api_key)
 
     async def _run():
         await run_engine_server(server, args.host, args.port)

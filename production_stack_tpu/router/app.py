@@ -308,7 +308,28 @@ async def kv_lookup(request: web.Request) -> web.Response:
 
 
 def build_app(args) -> web.Application:
-    app = web.Application(client_max_size=1024**3)
+    # Edge auth (reference tutorial 11 "secure vLLM serve"): with an API
+    # key configured, the inference surface (/v1/* + aliases; see
+    # utils/auth.py) requires `Authorization: Bearer <key>`. The header
+    # is forwarded to backends, so engines sharing the deployment key
+    # verify it too; calls the ROUTER itself originates toward engines
+    # (model probes, batch replays) attach the key via
+    # deployment_auth_headers().
+    from production_stack_tpu.utils import auth
+
+    api_key = auth.resolve_api_key(getattr(args, "api_key", None))
+    auth.set_deployment_key(api_key)
+
+    @web.middleware
+    async def auth_middleware(request: web.Request, handler):
+        if api_key and auth.is_gated(request.path) and \
+                not auth.check_bearer(
+                    request.headers.get("Authorization"), api_key):
+            return auth.unauthorized_response()
+        return await handler(request)
+
+    app = web.Application(client_max_size=1024**3,
+                          middlewares=[auth_middleware])
     state = initialize_all(args)
     app["state"] = state
 

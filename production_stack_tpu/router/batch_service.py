@@ -177,8 +177,16 @@ class LocalBatchProcessor:
                 )
                 if asyncio.iscoroutine(url):
                     url = await url
+                # Batch replays run long after the submitting client is
+                # gone: authenticate with the deployment key (the
+                # engines gate /v1/* when a key is configured).
+                from production_stack_tpu.utils.auth import (
+                    deployment_auth_headers,
+                )
+
                 async with session.post(
-                    f"{url}{item.get('url', batch.endpoint)}", json=body
+                    f"{url}{item.get('url', batch.endpoint)}", json=body,
+                    headers=deployment_auth_headers(),
                 ) as resp:
                     resp_body = await resp.json()
                     results.append({

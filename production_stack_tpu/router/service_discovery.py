@@ -92,9 +92,14 @@ class ServiceDiscovery(abc.ABC):
 
 
 def _probe_models(url: str, timeout: float = 5.0) -> List[str]:
-    """Ask an engine which models it serves (reference :498-531)."""
+    """Ask an engine which models it serves (reference :498-531).
+    /v1/models is part of the engines' key-gated surface, so the probe
+    authenticates with the deployment key when one is configured."""
+    from production_stack_tpu.utils.auth import deployment_auth_headers
+
     try:
-        resp = requests.get(f"{url}/v1/models", timeout=timeout)
+        resp = requests.get(f"{url}/v1/models", timeout=timeout,
+                            headers=deployment_auth_headers())
         resp.raise_for_status()
         return [m["id"] for m in resp.json().get("data", [])]
     except Exception as e:  # noqa: BLE001

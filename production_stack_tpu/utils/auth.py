@@ -1,0 +1,66 @@
+"""Deployment API-key auth shared by the router and engine tiers
+(reference tutorial 11 "secure vLLM serve", VLLM_API_KEY).
+
+Semantics follow vLLM: the key gates the INFERENCE surface (`/v1/*`
+plus the non-versioned aliases of the same endpoints), not the
+intra-stack control plane — probes (`/health`), scrapes (`/metrics`),
+the KV controller channel (`/kv/*`), and sleep administration carry no
+client credentials and stay open. Router-originated calls to engines
+(model probes, batch replays) attach the deployment key registered at
+app build time.
+
+Comparisons are constant-time (`hmac.compare_digest`)."""
+
+from __future__ import annotations
+
+import hmac
+import os
+from typing import Optional
+
+# Non-/v1 aliases of gated inference endpoints.
+_GATED_EXACT = frozenset({"/score", "/rerank", "/tokenize", "/detokenize"})
+
+
+def is_gated(path: str) -> bool:
+    """True when the path belongs to the API-key-protected surface."""
+    return path.startswith("/v1/") or path in _GATED_EXACT
+
+
+def resolve_api_key(explicit: Optional[str] = None) -> Optional[str]:
+    """Explicit flag value, else the vLLM-compatible env vars."""
+    return (explicit or os.environ.get("VLLM_API_KEY")
+            or os.environ.get("TPU_STACK_API_KEY") or None)
+
+
+def check_bearer(authorization: Optional[str], key: str) -> bool:
+    """Constant-time check of an `Authorization: Bearer <key>` header."""
+    if not authorization or not authorization.startswith("Bearer "):
+        return False
+    return hmac.compare_digest(authorization[len("Bearer "):], key)
+
+
+def auth_headers(key: Optional[str]) -> dict:
+    return {"Authorization": f"Bearer {key}"} if key else {}
+
+
+def unauthorized_response():
+    from aiohttp import web
+
+    return web.json_response(
+        {"error": {"message": "invalid or missing API key",
+                   "type": "AuthenticationError"}}, status=401)
+
+
+# The key this process uses for calls IT originates toward other tiers
+# (the router's model probes and batch replays). Registered once at app
+# build; one shared key per deployment is the supported topology.
+_deployment_key: Optional[str] = None
+
+
+def set_deployment_key(key: Optional[str]) -> None:
+    global _deployment_key
+    _deployment_key = key
+
+
+def deployment_auth_headers() -> dict:
+    return auth_headers(_deployment_key)
