@@ -67,15 +67,22 @@ _CONFIGS = {
     # fit a 16 GB chip; int8 weight-only quantization (~8 GB +
     # per-channel scales, models/quantize.py) makes the headline model
     # servable on one v5e.
+    # Pool pinned explicitly: int8 weights (~8.5 GB) + pool sit within
+    # ~1 GB of the chip's usable HBM, and the auto-sizer's 0.7 margin
+    # lands on the edge depending on residual allocator state.
     "llama8b": dict(model="meta-llama/Llama-3-8B", users=15, rounds=6,
                     answer_tokens=100, sys_prompt_tokens=1000,
                     history_tokens=2000, max_model_len=8192,
                     max_num_seqs=16, quantization="int8",
-                    prefill_chunk=2048),
+                    prefill_chunk=1024, num_blocks=440),
+    # OPT's (12 kv-heads, 64 head_dim) pages tile-pad 2.7x AND the page
+    # scatter materializes a padded pool copy as an HLO temp (no lane
+    # merge at head_dim 64), so the pool is sized explicitly: 768 blocks
+    # = 49k tokens, 16 seqs x 2k ctx + headroom.
     "opt": dict(model="facebook/opt-125m", users=15, rounds=6,
                 answer_tokens=100, sys_prompt_tokens=400,
                 history_tokens=400, max_model_len=2048,
-                max_num_seqs=16),
+                max_num_seqs=16, num_blocks=768),
     # BASELINE config 3: prefix/KV-aware routing + host-RAM KV offload
     # (the LMCache CPU-offload topology, values-07/09 equivalent).
     "kvaware": dict(model="tpu-llama-1b", users=15, rounds=10,
@@ -440,7 +447,26 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
     import jax
 
-    result = asyncio.run(_main())
+    try:
+        result = asyncio.run(_main())
+    except Exception as e:  # noqa: BLE001
+        # The tunneled dev runtime leaks residual HBM across processes:
+        # configs near the ceiling (llama8b: weights+pool ~13 GB of a
+        # ~13 GB usable chip) nondeterministically OOM at engine INIT —
+        # measured back-to-back identical runs flip between success and
+        # ResourceExhausted. A retry must come from a FRESH process (this
+        # one holds partial allocations), so re-exec up to 2 times.
+        retries = int(os.environ.get("BENCH_OOM_RETRY", "0"))
+        if "RESOURCE_EXHAUSTED" in str(e) and retries < 2:
+            import sys
+            import time as _time
+
+            print(f"init OOM (residual runtime state); re-exec retry "
+                  f"{retries + 1}/2", file=sys.stderr)
+            _time.sleep(30)
+            os.environ["BENCH_OOM_RETRY"] = str(retries + 1)
+            os.execv(sys.executable, [sys.executable] + sys.argv)
+        raise
     result["backend"] = jax.devices()[0].platform
     print(json.dumps(result))
 

@@ -18,8 +18,10 @@ terraform {
       version = ">= 5.0"
     }
     helm = {
-      source  = "hashicorp/helm"
-      version = ">= 2.12"
+      source = "hashicorp/helm"
+      # Pinned to the 2.x block syntax (kubernetes{}/set{}); provider
+      # 3.x switched to attributes and rejects these blocks.
+      version = "~> 2.12"
     }
   }
 }

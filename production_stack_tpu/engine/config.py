@@ -38,6 +38,15 @@ class EngineConfig:
     # Long prompts prefill in chunks of at most this many tokens (attention
     # memory stays O(chunk * context) instead of O(len^2)); 0 disables.
     prefill_chunk_size: int = 1024
+    # Up to this many long-prompt prefills share one [prefill_batch,
+    # chunk] dispatch (the arrival-storm TTFT tail is a QUEUE of
+    # first-round prefills). Measured on the dev chip at the reference
+    # workload (llama3b): throughput-neutral and p50-TTFT-worse — the
+    # pipelined single path already drains the queue, and padded rows
+    # waste chunk-width compute — so the default is OFF; the knob (and
+    # its parity tests) remain for prefill-heavy workloads with low
+    # cache hit rates. 1 disables; requires chunking.
+    prefill_batch: int = 1
     # Fused multi-step decode: exactly this many decode iterations
     # (forward + sampling + token feedback) run inside one compiled
     # lax.scan per dispatch; sequences that cannot use the full burst are

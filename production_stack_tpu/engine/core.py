@@ -342,11 +342,22 @@ class EngineCore:
         logger.info("Loaded checkpoint weights from %s", self.config.model)
 
     def _kv_bytes_per_block(self) -> int:
+        """Per-block HBM bytes INCLUDING XLA's tile padding. When
+        head_dim is lane-aligned (multiple of 128) the trailing
+        (KVH, D) dims flatten onto the lanes and occupy exactly their
+        unpadded size (llama-family: 8x128). Otherwise the minor dim
+        pads to 128 and the kv-head dim to the sublane granularity —
+        e.g. OPT's (12, 64) stores as (16, 128), a 2.7x expansion that
+        OOMed compile when the pool was sized from unpadded bytes."""
         mc = self.model_config
         itemsize = jnp.dtype(mc.dtype).itemsize
+        kvh, d = mc.num_kv_heads, mc.head_dim
+        if d % 128 != 0:
+            d = -(-d // 128) * 128
+            sublane = 16 if itemsize == 2 else 8
+            kvh = -(-kvh // sublane) * sublane
         return (
-            mc.num_layers * 2 * self.config.block_size
-            * mc.num_kv_heads * mc.head_dim * itemsize
+            mc.num_layers * 2 * self.config.block_size * kvh * d * itemsize
         )
 
     # Known per-chip HBM capacities, used when the runtime does not expose
@@ -459,16 +470,19 @@ class EngineCore:
                 block_tables, context_lens, seq_lens, adapter_ids,
                 temperature, top_k, top_p, seq_seeds, steps,
                 suppress_eos, bias_ids, bias_vals, stop_ids, stop_valid):
+            # Prefill: only the last REAL token's logits are ever read,
+            # so the model slices hidden states to that position before
+            # the vocab projection (for 128k-vocab models the full
+            # [B, T, V] f32 logits temp is multi-GB and its head GEMM is
+            # pure waste).
+            last_idx = (None if mode == "decode"
+                        else jnp.maximum(seq_lens - 1, 0))
             logits, kv = apply(
                 params, cfg, token_ids, positions, kv, slot_mapping,
                 block_tables, context_lens, seq_lens,
-                mode=mode, adapter_ids=adapter_ids,
+                mode=mode, adapter_ids=adapter_ids, last_token=last_idx,
             )
-            if mode == "decode":
-                last = logits[:, 0]
-            else:  # prefill / prefill_cached: logits of the last real token
-                idx = jnp.maximum(seq_lens - 1, 0)[:, None, None]
-                last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
+            last = logits[:, 0]
             B = last.shape[0]
             shaped = last.at[jnp.arange(B)[:, None], bias_ids].add(bias_vals)
             if eos_id >= 0:  # min_tokens: mask EOS for the first token
@@ -1083,6 +1097,41 @@ class EngineCore:
                     if maxb >= cfg.max_blocks_per_seq:
                         break
                     maxb *= 2
+            # Batched prefill ([prefill_batch, chunk] cached rows): one
+            # variant per reachable block-table width.
+            if cfg.prefill_batch > 1 and cfg.prefill_chunk_size > 0:
+                R = cfg.prefill_batch
+                pb_bucket = cfg.bucket_for(
+                    min(cfg.prefill_chunk_size, cfg.max_model_len))
+                samp_r = (np.zeros((R,), np.float32),
+                          np.zeros((R,), np.int32),
+                          np.ones((R,), np.float32),
+                          np.zeros((R,), np.int64),
+                          np.ones((R,), np.int64), np.zeros((R,), bool),
+                          np.zeros((R, MAX_LOGIT_BIAS), np.int32),
+                          np.zeros((R, MAX_LOGIT_BIAS), np.float32),
+                          np.zeros((R, MAX_STOP_IDS), np.int32),
+                          np.zeros((R, MAX_STOP_IDS), np.float32))
+                maxb_b = 4
+                maxb_cap = self._prefill_batch_maxb()
+                while True:
+                    maxb_b = min(maxb_b, maxb_cap)
+                    _, self.kv = self._prefill_cached_fn(
+                        self.params, self.kv,
+                        np.zeros((R, pb_bucket), np.int32),
+                        np.tile(np.arange(pb_bucket, dtype=np.int32),
+                                (R, 1)),
+                        np.full((R, pb_bucket), -1, np.int64),
+                        np.zeros((R, maxb_b), np.int32),
+                        np.full((R,), 2, np.int32),
+                        np.full((R,), 2, np.int32),
+                        np.zeros((R,), np.int32), *samp_r,
+                    )
+                    n_prefill += 1
+                    if maxb_b >= maxb_cap:
+                        break
+                    maxb_b *= 2
+
             # Decode: the full burst width plus the pressure width
             # (decode_steps_pressure, used while prompts wait), one
             # variant per block-table bucket (4 doubling to
@@ -1423,33 +1472,26 @@ class EngineCore:
             self.step_count += 1
 
     # -- prefill -----------------------------------------------------------
-    def _do_prefill(self, req: EngineRequest) -> None:
-        """Block accounting is host-only, so the prompt's chunk forwards are
-        dispatched BEFORE the in-flight decode burst is read back: XLA
-        orders them after the burst via the kv dependency, and the burst's
-        host readback then overlaps the chunks' device execution. (A page
-        freed by a finished sequence may still receive the burst's
-        speculative write, but the burst was dispatched first, so the
-        prefill's own writes land after it — device order.)"""
-        cfg = self.config
-        tokens = req.all_token_ids
-        n = len(tokens)
+    def _allocate_for_prefill(self, req: EngineRequest):
+        """KV allocation + offload-restore for one prompt. Returns
+        (block_ids, cached) or None after requeuing the request (pool
+        exhausted / restore failure retry also failed)."""
         alloc = self.kv_mgr.allocate_prompt(
-            req.request_id, tokens, adapter=req.adapter_name
+            req.request_id, req.all_token_ids, adapter=req.adapter_name
         )
         if alloc is None:
             # Pool tight: settle the in-flight burst (its emission may
             # finish sequences and free pages), then retry once.
             self._flush_pending_burst()
             alloc = self.kv_mgr.allocate_prompt(
-                req.request_id, tokens, adapter=req.adapter_name
+                req.request_id, req.all_token_ids, adapter=req.adapter_name
             )
         self._drain_offload()
         if alloc is None:
             # Raced out of blocks; requeue.
             with self._lock:
                 self.scheduler.waiting.appendleft(req)
-            return
+            return None
         block_ids, cached, restores = alloc
         if restores and not self._restore_blocks(restores):
             # Offload tier lied (e.g. remote evicted between HEAD and GET):
@@ -1468,7 +1510,8 @@ class EngineCore:
             self.kv_mgr.external_lookup = None
             try:
                 alloc = self.kv_mgr.allocate_prompt(
-                    req.request_id, tokens, adapter=req.adapter_name
+                    req.request_id, req.all_token_ids,
+                    adapter=req.adapter_name
                 )
             finally:
                 self.kv_mgr.external_lookup = ext
@@ -1476,8 +1519,42 @@ class EngineCore:
             if alloc is None:
                 with self._lock:
                     self.scheduler.waiting.appendleft(req)
-                return
+                return None
             block_ids, cached, _ = alloc
+        return block_ids, cached
+
+    def _do_prefill(self, req: EngineRequest) -> None:
+        """Block accounting is host-only, so the prompt's chunk forwards are
+        dispatched BEFORE the in-flight decode burst is read back: XLA
+        orders them after the burst via the kv dependency, and the burst's
+        host readback then overlaps the chunks' device execution. (A page
+        freed by a finished sequence may still receive the burst's
+        speculative write, but the burst was dispatched first, so the
+        prefill's own writes land after it — device order.)"""
+        cfg = self.config
+        tokens = req.all_token_ids
+        n = len(tokens)
+        got = self._allocate_for_prefill(req)
+        if got is None:
+            return
+        block_ids, cached = got
+
+        # Big uncached spans batch with other waiting long prompts: the
+        # arrival-storm TTFT tail is a QUEUE of first-round prefills, and
+        # one [PB, chunk] dispatch drains PB of them per chunk-time
+        # instead of one (see _do_prefill_group). Contexts wider than
+        # _prefill_batch_maxb() blocks stay on the single path — the
+        # batched cached-attention temp is PB x chunk x context x heads
+        # in f32 and must stay bounded.
+        chunk = cfg.prefill_chunk_size
+        if (cfg.prefill_batch > 1 and chunk > 0
+                and n - cached >= max(chunk // 2, 1)
+                and ((n + cfg.block_size - 1) // cfg.block_size
+                     <= self._prefill_batch_maxb())):
+            group = self._gather_prefill_group(req, block_ids, cached)
+            if len(group) > 1:
+                self._do_prefill_group(group)
+                return
 
         # Only the un-cached suffix runs through the model; its queries
         # attend to the prefix via the HBM pages (prefill_cached). Long
@@ -1523,6 +1600,7 @@ class EngineCore:
         t0 = time.perf_counter()
         for entry in pending:
             req, seq, slot = entry["req"], entry["seq"], entry["slot"]
+            row_i = entry.get("row", 0)  # batched prefills: row per req
             try:
                 s_arr, lp_arr, top_lp_arr, top_id_arr = (
                     np.asarray(a) for a in jax.device_get(entry["sampled"]))
@@ -1541,13 +1619,14 @@ class EngineCore:
             with self._lock:
                 if self.scheduler.slots[slot] is not seq:
                     continue  # aborted/finished before its first token
-            token = int(s_arr[0])
+            token = int(s_arr[row_i])
             lp = None
             if req.sampling.logprobs is not None:
                 k = min(req.sampling.logprobs, top_lp_arr.shape[1])
-                lp = {"logprob": float(lp_arr[0]),
-                      "top": [(int(top_id_arr[0, j]),
-                               float(top_lp_arr[0, j])) for j in range(k)]}
+                lp = {"logprob": float(lp_arr[row_i]),
+                      "top": [(int(top_id_arr[row_i, j]),
+                               float(top_lp_arr[row_i, j]))
+                              for j in range(k)]}
             prior = req.output_token_ids
             if prior and (req.sampling.presence_penalty
                           or req.sampling.frequency_penalty):
@@ -1575,6 +1654,173 @@ class EngineCore:
             # (a re-prefill after preemption carries prior outputs).
             req.scheduled_steps = len(req.output_token_ids)
         self.flush_time_total += time.perf_counter() - t0
+
+    def _prefill_batch_maxb(self) -> int:
+        """Widest block table the batched-prefill programs compile (64
+        blocks = 4k-token contexts at the default page size): bounds the
+        PB-row cached-attention f32 temp at warmup and serving time."""
+        return min(64, self.config.max_blocks_per_seq)
+
+    def _gather_prefill_group(self, req: EngineRequest, block_ids,
+                              cached: int) -> "list[dict]":
+        """Collect up to prefill_batch long-prompt requests (the head
+        request plus qualifying waiters) that can be admitted NOW —
+        free slot counted per member, KV allocated eagerly. Members that
+        fail allocation are requeued by _allocate_for_prefill."""
+        cfg = self.config
+        chunk = cfg.prefill_chunk_size
+        group = [{"req": req, "block_ids": block_ids, "cached": cached}]
+        while len(group) < cfg.prefill_batch:
+            with self._lock:
+                free_slots = sum(
+                    1 for s in self.scheduler.slots if s is None)
+                if free_slots <= len(group):  # head + members need slots
+                    break
+                nxt = None
+                maxb_cap = self._prefill_batch_maxb()
+                for cand in list(self.scheduler.waiting):
+                    n_c = len(cand.all_token_ids)
+                    # Long uncached span only (short/cached follow-ups
+                    # would waste a chunk-wide row); the uncached length
+                    # is only known after allocation, so gate on total
+                    # length here and fall back below if it cache-hits.
+                    blocks_c = (n_c + self.config.block_size - 1) \
+                        // self.config.block_size
+                    if n_c >= max(chunk // 2, 1) and blocks_c <= maxb_cap:
+                        nxt = cand
+                        break
+                if nxt is None:
+                    break
+                self.scheduler.waiting.remove(nxt)
+            got = self._allocate_for_prefill(nxt)
+            if got is None:
+                break  # pool tight: nxt was requeued; stop growing
+            bids_c, cached_c = got
+            if len(nxt.all_token_ids) - cached_c < max(chunk // 2, 1):
+                # Cache-hit: its span is short — release the allocation
+                # and requeue; the single-row path re-allocates next loop
+                # iteration, re-hitting the prefix cache cheaply.
+                self.kv_mgr.free(nxt.request_id)
+                with self._lock:
+                    self.scheduler.waiting.appendleft(nxt)
+                break
+            group.append(
+                {"req": nxt, "block_ids": bids_c, "cached": cached_c})
+        return group
+
+    def _do_prefill_group(self, group: "list[dict]") -> None:
+        """Batched prefill: every member's chunk si rides ONE [PB, chunk]
+        dispatch (rows beyond the live members are padding — seq_lens 0,
+        page writes dropped). Shared prefixes across members are correct
+        within a dispatch because every layer writes all rows' K/V pages
+        before attention reads them. Each member's first token comes from
+        its LAST chunk's dispatch (per-row sampled), deferred like the
+        single-row path."""
+        cfg = self.config
+        chunk = cfg.prefill_chunk_size
+        spans: "dict[int, list]" = {}
+        for m in group:
+            n_m = len(m["req"].all_token_ids)
+            s_list = []
+            start = m["cached"]
+            while start < n_m:
+                end = min(start + chunk, n_m)
+                s_list.append((start, end))
+                start = end
+            spans[id(m)] = s_list
+        max_spans = max(len(s) for s in spans.values())
+        finished = []  # (member, sampled ref, row)
+        for si in range(max_spans):
+            rows = [m for m in group if si < len(spans[id(m)])]
+            sampled = self._prefill_rows(
+                [(m["req"], m["req"].all_token_ids, m["block_ids"],
+                  *spans[id(m)][si]) for m in rows],
+                pad_to=cfg.prefill_batch)
+            for row_i, m in enumerate(rows):
+                if si == len(spans[id(m)]) - 1:
+                    finished.append((m, sampled, row_i))
+        # Same pipelining as the single path: settle the in-flight burst
+        # and the previous prefill while the group executes on device.
+        self._flush_pending_burst()
+        self._flush_pending_prefills()
+        for m, sampled, row in finished:
+            req_m = m["req"]
+            self.prompt_tokens_total += len(req_m.all_token_ids)
+            self.cached_tokens_total += m["cached"]
+            with self._lock:
+                slot = self.scheduler._free_slot()
+                seq = self.scheduler.start_running(req_m, slot)
+            self._pending_prefills.append(
+                {"req": req_m, "seq": seq, "slot": slot,
+                 "sampled": sampled, "row": row})
+
+    def _prefill_rows(self, rows, pad_to: int):
+        """One batched prefill dispatch: rows = [(req, tokens, block_ids,
+        start, end), ...], padded to ``pad_to`` rows (padding rows have
+        seq_lens 0 and dropped page writes). Always the cached-prefill
+        program at the CHUNK bucket — one compiled variant per block-
+        table width regardless of group composition. Returns the sampled
+        tuple ([pad_to]-wide rows)."""
+        cfg = self.config
+        R = pad_to
+        bucket = cfg.bucket_for(
+            min(cfg.prefill_chunk_size, cfg.max_model_len))
+        blocks_needed = max(
+            (m[4] + cfg.block_size - 1) // cfg.block_size for m in rows)
+        maxb = 4
+        while maxb < blocks_needed:
+            maxb *= 2
+        maxb = min(maxb, self._prefill_batch_maxb())
+
+        token_arr = np.zeros((R, bucket), np.int32)
+        positions = np.zeros((R, bucket), np.int32)
+        slot_mapping = np.full((R, bucket), -1, np.int64)
+        block_table = np.zeros((R, maxb), np.int32)
+        context_lens = np.ones((R,), np.int32)
+        seq_lens = np.zeros((R,), np.int32)
+        adapter_ids = np.zeros((R,), np.int32)
+        temp = np.zeros((R,), np.float32)
+        topk = np.zeros((R,), np.int32)
+        topp = np.ones((R,), np.float32)
+        seeds = np.zeros((R,), np.int64)
+        steps = np.ones((R,), np.int64)
+        suppress_eos = np.zeros((R,), bool)
+        bias_ids = np.zeros((R, MAX_LOGIT_BIAS), np.int32)
+        bias_vals = np.zeros((R, MAX_LOGIT_BIAS), np.float32)
+        stop_ids = np.zeros((R, MAX_STOP_IDS), np.int32)
+        stop_valid = np.zeros((R, MAX_STOP_IDS), np.float32)
+
+        for i, (req, tokens, block_ids, start, end) in enumerate(rows):
+            take = end - start
+            token_arr[i, :take] = tokens[start:end]
+            positions[i, :bucket] = start + np.arange(bucket)
+            pos_idx = start + np.arange(take)
+            blocks = np.asarray(block_ids, np.int64)
+            slot_mapping[i, :take] = (
+                blocks[pos_idx // cfg.block_size] * cfg.block_size
+                + pos_idx % cfg.block_size
+            )
+            use = min(len(block_ids), maxb)
+            block_table[i, :use] = block_ids[:use]
+            context_lens[i] = end
+            seq_lens[i] = take
+            adapter_ids[i] = req.adapter_id
+            t, k_, p_, seed = self._sampling_for(req)
+            temp[i], topk[i], topp[i], seeds[i] = t, k_, p_, seed
+            steps[i] = len(tokens)
+            suppress_eos[i] = (
+                len(req.output_token_ids) < req.sampling.min_tokens)
+            self._fill_bias_row(bias_ids[i], bias_vals[i],
+                                self._resume_bias(req))
+            self._fill_stop_row(stop_ids[i], stop_valid[i],
+                                req.sampling.stop_token_ids)
+
+        return self._dispatch("prefill", {"cached": True}, [
+            token_arr, positions, slot_mapping,
+            block_table, context_lens, seq_lens, adapter_ids,
+            temp, topk, topp, seeds, steps,
+            suppress_eos, bias_ids, bias_vals, stop_ids, stop_valid,
+        ])
 
     def _prefill_span(self, req: EngineRequest, tokens, block_ids,
                       start: int, end: int):

@@ -321,9 +321,17 @@ class EngineServer:
     async def _auth_middleware(self, request: web.Request, handler):
         from production_stack_tpu.utils import auth
 
-        if self.api_key and auth.is_gated(request.path) and \
-                not auth.check_bearer(
-                    request.headers.get("Authorization"), self.api_key):
+        # Engines gate the inference surface AND /kv/* — /kv/extract
+        # returns raw cache pages (exfiltration surface), and every
+        # legitimate in-stack caller (router controller reports, peer
+        # engines in disagg) attaches the shared deployment key via
+        # _auth_headers(). The router's own /kv controller endpoints stay
+        # open so an edge-only-key topology (router key, keyless
+        # engines) keeps its kvaware reporting channel.
+        gated = (auth.is_gated(request.path)
+                 or request.path.startswith("/kv/"))
+        if self.api_key and gated and not auth.check_bearer(
+                request.headers.get("Authorization"), self.api_key):
             return auth.unauthorized_response()
         return await handler(request)
 
@@ -1767,6 +1775,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="host-RAM KV offload budget (0 disables)")
     p.add_argument("--kv-remote-url", default=None,
                    help="remote cache server URL (second offload tier)")
+    p.add_argument("--prefill-chunk-size", type=int, default=1024,
+                   help="long prompts prefill in chunks of this many "
+                        "tokens (0 disables chunking)")
+    p.add_argument("--prefill-batch", type=int, default=1,
+                   help="batch up to N queued long-prompt prefills into "
+                        "one dispatch (1 disables; see EngineConfig."
+                        "prefill_batch for the measured trade-off)")
     p.add_argument("--no-warmup", dest="warmup", action="store_false",
                    default=True,
                    help="skip precompiling serving programs at startup")
@@ -1805,6 +1820,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         model=model,
         dtype=args.dtype,
         quantization=args.quantization,
+        prefill_chunk_size=args.prefill_chunk_size,
+        prefill_batch=args.prefill_batch,
         max_model_len=args.max_model_len,
         max_num_seqs=args.max_num_seqs,
         block_size=args.block_size,

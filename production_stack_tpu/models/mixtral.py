@@ -118,6 +118,7 @@ def apply(
     cfg: ModelConfig,
     token_ids, positions, kv_pages, slot_mapping, block_tables,
     context_lens, seq_lens, *, mode: str, adapter_ids=None, output_hidden: bool = False,
+    last_token=None,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     del adapter_ids  # LoRA slots are a Llama-family feature for now
     x = params["embed"][token_ids].astype(cfg.jnp_dtype)
@@ -141,6 +142,10 @@ def apply(
         scan_body, (x, k_all, v_all, jnp.int32(0)), params["layers"],
         length=L,
     )
+    if last_token is not None:
+        # Prefill sampling reads ONE position: slice before norm + head
+        # (positionwise ops commute with the slice; see llama.apply).
+        x = jnp.take_along_axis(x, last_token[:, None, None], axis=1)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     if output_hidden:
         return x.astype(jnp.float32), (k_all, v_all)

@@ -115,6 +115,7 @@ def apply(
     cfg: ModelConfig,
     token_ids, positions, kv_pages, slot_mapping, block_tables,
     context_lens, seq_lens, *, mode: str, adapter_ids=None, output_hidden: bool = False,
+    last_token=None,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     del adapter_ids  # LoRA slots are a Llama-family feature for now
     x = params["embed"][token_ids].astype(cfg.jnp_dtype)
@@ -139,6 +140,10 @@ def apply(
         scan_body, (x, k_all, v_all, jnp.int32(0)), params["layers"],
         length=L,
     )
+    if last_token is not None:
+        # Prefill sampling reads ONE position: slice before norm + head
+        # (positionwise ops commute with the slice; see llama.apply).
+        x = jnp.take_along_axis(x, last_token[:, None, None], axis=1)
     x = layer_norm(x, params["final_ln_w"], params["final_ln_b"])
     if output_hidden:
         return x.astype(jnp.float32), (k_all, v_all)

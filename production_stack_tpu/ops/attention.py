@@ -23,6 +23,12 @@ import jax.numpy as jnp
 
 NEG_INF = -1e30
 
+# context_prefill_attention switches to the chunked online-softmax path
+# when its f32 scores tensor would exceed this (tests lower it to force
+# the chunked path at toy shapes).
+_CHUNKED_SCORE_BYTES = 1 << 30
+_CHUNKED_SCORE_SPAN = 1024
+
 
 def _use_pallas() -> bool:
     if os.environ.get("TPU_STACK_FORCE_XLA_ATTENTION"):
@@ -100,10 +106,60 @@ def context_prefill_attention(
     k_ctx = _gather_ctx(k_pages, block_tables, layer)
     v_ctx = _gather_ctx(v_pages, block_tables, layer)
     qg = q.reshape(B, T, KVH, group, D)
+    S = MAXB * bs
+    # The one-shot einsum materializes f32 scores [B, KVH, g, T, S] —
+    # fine for single-row prefills, but multi-GB for batched-prefill
+    # shapes ([4, 2048] rows over 4k contexts). Past ~1 GB, stream the
+    # context in chunks with an online softmax instead (flash-attention
+    # structure in plain lax.scan; same math, bounded temps).
+    scores_bytes = 4 * B * KVH * group * T * S
+    chunk = _CHUNKED_SCORE_SPAN
+    if scores_bytes > _CHUNKED_SCORE_BYTES and S > chunk:
+        # Ragged tails pad with zero pages (their span indices exceed
+        # every total_len, so the mask drops them) — the bounded-memory
+        # path must engage for ANY S, not only multiples of the chunk.
+        nc = -(-S // chunk)
+        if nc * chunk != S:
+            pad = nc * chunk - S
+            k_ctx = jnp.pad(k_ctx, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            v_ctx = jnp.pad(v_ctx, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        k_chunks = k_ctx.reshape(B, nc, chunk, KVH, D).swapaxes(0, 1)
+        v_chunks = v_ctx.reshape(B, nc, chunk, KVH, D).swapaxes(0, 1)
+
+        def body(carry, inputs):
+            m, l, acc, ci = carry
+            k_c, v_c = inputs  # [B, chunk, KVH, D]
+            s = jnp.einsum(
+                "btkgd,bskd->bkgts", qg, k_c,
+                preferred_element_type=jnp.float32) * scale
+            span_c = ci * chunk + jnp.arange(chunk)
+            causal = span_c[None, None, :] <= positions[:, :, None]
+            valid = span_c[None, None, :] < total_lens[:, None, None]
+            s = jnp.where((causal & valid)[:, None, None, :, :],
+                          s, NEG_INF)
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m, m_cur)
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            upd = jnp.einsum("bkgts,bskd->bkgtd", p.astype(v_c.dtype),
+                             v_c).astype(jnp.float32)
+            acc_new = acc * alpha + upd
+            return (m_new, l_new, acc_new, ci + 1), None
+
+        m0 = jnp.full((B, KVH, group, T, 1), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((B, KVH, group, T, 1), jnp.float32)
+        a0 = jnp.zeros((B, KVH, group, T, D), jnp.float32)
+        (m, l, acc, _), _ = jax.lax.scan(
+            body, (m0, l0, a0, jnp.int32(0)), (k_chunks, v_chunks),
+            length=nc)
+        out = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+        return out.swapaxes(2, 3).swapaxes(1, 2).reshape(B, T, H, D)
+
     scores = jnp.einsum(
         "btkgd,bskd->bkgts", qg, k_ctx, preferred_element_type=jnp.float32
     ) * scale
-    span = jnp.arange(MAXB * bs)
+    span = jnp.arange(S)
     causal = span[None, None, :] <= positions[:, :, None]  # [B, T, S]
     valid = span[None, None, :] < total_lens[:, None, None]
     mask = causal & valid
@@ -184,9 +240,15 @@ def paged_decode_attention(
 ) -> jax.Array:
     """Dispatch to the pallas kernel on TPU, XLA reference elsewhere."""
     block_size = k_pages.shape[2]
-    # Full K/V pages are DMA'd per grid step, so any head_dim/KVH works;
-    # only the page's token rows must respect the sublane tile.
-    tile_ok = block_size % 8 == 0
+    kvh, head_dim = k_pages.shape[3], k_pages.shape[4]
+    # The kernel's manual page DMAs slice [bs, KVH, D] out of HBM:
+    # Mosaic requires the sliced dims tile-aligned (KVH to the 8-row
+    # sublane, D to the 128 lanes; bs to 8). Misaligned models (e.g.
+    # OPT: 12 kv-heads, head_dim 64) take the XLA reference — and this
+    # MUST be decided here, at trace time: a Mosaic failure surfaces at
+    # AOT compile where no fallback is possible.
+    tile_ok = (block_size % 8 == 0 and kvh % 8 == 0
+               and head_dim % 128 == 0)
     if tile_ok and _use_pallas():
         from production_stack_tpu.ops.pallas_paged_attention import (
             pallas_paged_attention,

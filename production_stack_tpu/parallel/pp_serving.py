@@ -75,6 +75,7 @@ def make_pp_apply(mesh: Mesh, microbatches: int = 1):
         mode: str,
         adapter_ids: jax.Array | None = None,
         output_hidden: bool = False,
+        last_token: jax.Array | None = None,
     ):
         B, T = token_ids.shape
         M = _microbatch_count(B, microbatches)
@@ -201,6 +202,9 @@ def make_pp_apply(mesh: Mesh, microbatches: int = 1):
           x_mb, pos_mb, slots_mb, tables_mb, ctx_mb, seq_mb, aid_mb)
 
         x = hidden_mb.reshape(B, T, -1)
+        if last_token is not None:
+            # Prefill sampling reads ONE position (see llama.apply).
+            x = jnp.take_along_axis(x, last_token[:, None, None], axis=1)
         return project_out(params, cfg, x, output_hidden), (k_all, v_all)
 
     return pp_apply

@@ -55,8 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--request-stats-window", type=float, default=60.0)
     parser.add_argument("--api-key", default=None,
                         help="require 'Authorization: Bearer <key>' on "
-                             "every endpoint except /health, /metrics, "
-                             "/version (default: VLLM_API_KEY env)")
+                             "the inference surface (/v1/* and the "
+                             "score/rerank/tokenize/detokenize aliases; "
+                             "default: VLLM_API_KEY / TPU_STACK_API_KEY "
+                             "env)")
     parser.add_argument("--log-stats", action="store_true")
     parser.add_argument("--log-stats-interval", type=float, default=10.0)
     # Batch & files API

@@ -78,3 +78,42 @@ def test_kernel_ragged_contexts_ignore_padded_pages():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(ref), rtol=2e-3, atol=2e-3)
     assert np.isfinite(np.asarray(got)).all()
+
+
+def test_chunked_context_prefill_matches_einsum(monkeypatch):
+    """The online-softmax (flash-structure) cached-prefill path must
+    match the one-shot einsum path bit-for-tolerance (it engages
+    automatically when the scores temp would exceed ~1 GB; forced here
+    at toy shapes)."""
+    import production_stack_tpu.ops.attention as att
+
+    B, T, H, KVH, D, L, bs, MAXB = 3, 16, 12, 4, 32, 2, 16, 8
+    NB = B * MAXB + 2
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    k_pages = jnp.asarray(rng.normal(size=(L, NB, bs, KVH, D)), jnp.float32)
+    v_pages = jnp.asarray(rng.normal(size=(L, NB, bs, KVH, D)), jnp.float32)
+    tables = jnp.asarray(
+        rng.permutation(NB)[: B * MAXB].reshape(B, MAXB).astype(np.int32))
+    # Suffix queries at absolute positions near the context end.
+    total = jnp.asarray([100, 77, 128], jnp.int32)
+    positions = jnp.stack([t - T + jnp.arange(T) for t in total])
+
+    ref = att.context_prefill_attention(
+        q, k_pages, v_pages, tables, positions, total, jnp.int32(1),
+        scale=0.11)
+    monkeypatch.setattr(att, "_CHUNKED_SCORE_BYTES", 0)
+    monkeypatch.setattr(att, "_CHUNKED_SCORE_SPAN", 32)
+    got = att.context_prefill_attention(
+        q, k_pages, v_pages, tables, positions, total, jnp.int32(1),
+        scale=0.11)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+    # Ragged tail: a span that does NOT divide S pads with masked zero
+    # pages and must still match.
+    monkeypatch.setattr(att, "_CHUNKED_SCORE_SPAN", 48)
+    got_ragged = att.context_prefill_attention(
+        q, k_pages, v_pages, tables, positions, total, jnp.int32(1),
+        scale=0.11)
+    np.testing.assert_allclose(
+        np.asarray(got_ragged), np.asarray(ref), rtol=2e-5, atol=2e-5)
