@@ -1,0 +1,168 @@
+#!/usr/bin/env python3
+"""DMA-only twin of the decode kernel: same grid, same double-buffered
+page copies, but compute replaced by a trivial accumulate. Separates
+"HBM can't stream scattered pages faster" from "the softmax compute is
+the per-byte bottleneck"."""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.experimental import pallas as pl  # noqa: E402
+from jax.experimental.pallas import tpu as pltpu  # noqa: E402
+
+B, MAXB, NB, CTX = 16, 64, 843, 3000
+L, bs, KVH, D = 16, 64, 8, 128
+N1, N2 = 2, 12
+
+
+def _dma_kernel(bt_ref, cl_ref, layer_ref, k_hbm, v_hbm, o_ref,
+                k_buf, v_buf, sems, *, pages_per_block):
+    b = pl.program_id(0)
+    c = pl.program_id(1)
+    layer = layer_ref[0]
+    ctx = cl_ref[b]
+    P = pages_per_block
+    span = P * bs
+    slot = jax.lax.rem(c, 2)
+
+    def start(chunk, sl):
+        for p in range(P):
+            page = bt_ref[b, chunk * P + p]
+            pltpu.make_async_copy(
+                k_hbm.at[layer, page], k_buf.at[sl, p], sems.at[sl, 0, p]
+            ).start()
+            pltpu.make_async_copy(
+                v_hbm.at[layer, page], v_buf.at[sl, p], sems.at[sl, 1, p]
+            ).start()
+
+    def wait(chunk, sl):
+        for p in range(P):
+            page = bt_ref[b, chunk * P + p]
+            pltpu.make_async_copy(
+                k_hbm.at[layer, page], k_buf.at[sl, p], sems.at[sl, 0, p]
+            ).wait()
+            pltpu.make_async_copy(
+                v_hbm.at[layer, page], v_buf.at[sl, p], sems.at[sl, 1, p]
+            ).wait()
+
+    @pl.when(c == 0)
+    def _():
+        o_ref[...] = jnp.zeros_like(o_ref)
+        start(0, 0)
+
+    nc = pl.num_programs(1)
+
+    @pl.when(jnp.logical_and(c + 1 < nc, (c + 1) * span < ctx))
+    def _():
+        start(c + 1, jax.lax.rem(c + 1, 2))
+
+    @pl.when(c * span < ctx)
+    def _():
+        wait(c, slot)
+        # Trivial consume so the copies can't be elided: one add of the
+        # first page's first rows.
+        o_ref[...] += (k_buf[slot, 0, :8, 0, :].astype(jnp.float32)
+                       + v_buf[slot, 0, :8, 0, :].astype(jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("pages_per_block",))
+def dma_only(k_pages, v_pages, bt, cl, layer, *, pages_per_block=8):
+    P = pages_per_block
+    nc = MAXB // P
+    kernel = functools.partial(_dma_kernel, pages_per_block=P)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(B, nc),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec((8, D), lambda b, c, bt, cl, lr: (0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, P, bs, KVH, D), k_pages.dtype),
+                pltpu.VMEM((2, P, bs, KVH, D), v_pages.dtype),
+                pltpu.SemaphoreType.DMA((2, 2, P)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((8, D), jnp.float32),
+    )(bt.astype(jnp.int32), cl.astype(jnp.int32),
+      jnp.asarray(layer, jnp.int32).reshape(1), k_pages, v_pages)
+
+
+def timed_per_call(fn, *args):
+    out = fn(*args)
+    np.asarray(out[0, 0])
+    walls = {}
+    for n in (N1, N2, N1, N2):
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(n):
+            last = fn(*args)
+        np.asarray(last[0, 0])
+        walls.setdefault(n, []).append(time.perf_counter() - t0)
+    return (min(walls[N2]) - min(walls[N1])) / (N2 - N1)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    shape = (L, NB, bs, KVH, D)
+
+    @jax.jit
+    def mk(key):
+        k1, k2 = jax.random.split(key)
+        return (jax.random.normal(k1, shape, jnp.bfloat16) * 0.1,
+                jax.random.normal(k2, shape, jnp.bfloat16) * 0.1)
+
+    k_pages, v_pages = mk(jax.random.key(0))
+    bt = jnp.asarray(rng.integers(0, NB, (B, MAXB)), jnp.int32)
+    cl = jnp.full((B,), CTX, jnp.int32)
+
+    # Also: XLA contiguous-stream baseline (sum the whole pool) to learn
+    # the achievable contiguous read BW on this chip.
+    @jax.jit
+    def stream_sum(k_pages):
+        return jnp.sum(k_pages.astype(jnp.float32))
+
+    t_stream = timed_per_call(
+        lambda kp: stream_sum(kp).reshape(1, 1), k_pages)
+    pool_gb = np.prod(shape) * 2 / 1e9
+    print(json.dumps({"contiguous_sum_s": round(t_stream, 5),
+                      "pool_gb": round(pool_gb, 3),
+                      "contig_gbs": round(pool_gb / t_stream, 1)}),
+          flush=True)
+
+    for P in (4, 8, 16):
+        @jax.jit
+        def all_layers(k_pages, v_pages, bt, cl, P=P):
+            def body(acc, l):
+                o = dma_only(k_pages, v_pages, bt, cl, l,
+                             pages_per_block=P)
+                return acc + o, None
+            out, _ = jax.lax.scan(
+                body, jnp.zeros((8, D), jnp.float32), jnp.arange(L))
+            return out
+
+        per_call = timed_per_call(all_layers, k_pages, v_pages, bt, cl)
+        live = -(-CTX // bs)
+        gb = B * live * bs * KVH * D * 2 * 2 * L / 1e9
+        print(json.dumps({
+            "P": P, "dma_only_all_L_s": round(per_call, 5),
+            "bytes_gb": round(gb, 2),
+            "effective_gbs": round(gb / per_call, 1),
+            "floor_819_s": round(gb / 819, 5),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

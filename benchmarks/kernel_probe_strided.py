@@ -1,0 +1,195 @@
+#!/usr/bin/env python3
+"""Probe: ring DMA + per-head strided reads + dots, NO softmax. If this
+lands near the full kernel's time, the strided [.., h, :] slices (and/or
+dot issue) are the exposed cost, not the softmax VPU work."""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.experimental import pallas as pl  # noqa: E402
+from jax.experimental.pallas import tpu as pltpu  # noqa: E402
+
+B, MAXB, NB, CTX = 16, 64, 843, 3000
+L, bs, KVH, D = 16, 64, 8, 128
+G = 8  # padded head group rows
+N1, N2 = 2, 12
+RING = 4
+
+
+def _kernel(bt_ref, cl_ref, layer_ref, q_ref, k_hbm, v_hbm, o_ref,
+            k_buf, v_buf, sems, *, pages_per_block, mode):
+    b = pl.program_id(0)
+    c = pl.program_id(1)
+    nc = pl.num_programs(1)
+    nb = pl.num_programs(0)
+    layer = layer_ref[0]
+    ctx = cl_ref[b]
+    P = pages_per_block
+    span = P * bs
+    g = b * nc + c
+    slot = jax.lax.rem(g, RING)
+
+    def start(gb, gc, sl):
+        for p in range(P):
+            page = bt_ref[gb, gc * P + p]
+            pltpu.make_async_copy(
+                k_hbm.at[layer, page], k_buf.at[sl, p], sems.at[sl, 0, p]
+            ).start()
+            pltpu.make_async_copy(
+                v_hbm.at[layer, page], v_buf.at[sl, p], sems.at[sl, 1, p]
+            ).start()
+
+    def wait(gb, gc, sl):
+        for p in range(P):
+            page = bt_ref[gb, gc * P + p]
+            pltpu.make_async_copy(
+                k_hbm.at[layer, page], k_buf.at[sl, p], sems.at[sl, 0, p]
+            ).wait()
+            pltpu.make_async_copy(
+                v_hbm.at[layer, page], v_buf.at[sl, p], sems.at[sl, 1, p]
+            ).wait()
+
+    @pl.when(g == 0)
+    def _fill():
+        for k in range(min(RING - 1, nb * nc)):
+            gb, gc = divmod(k, nc)
+
+            @pl.when(gc * span < cl_ref[gb])
+            def _(gb=gb, gc=gc, k=k):
+                start(gb, gc, k % RING)
+
+    @pl.when(c == 0)
+    def _init():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    g_pre = g + RING - 1
+    b_pre = g_pre // nc
+    c_pre = jax.lax.rem(g_pre, nc)
+
+    @pl.when(jnp.logical_and(
+        b_pre < nb,
+        c_pre * span < cl_ref[jnp.minimum(b_pre, nb - 1)]))
+    def _prefetch():
+        start(b_pre, c_pre, jax.lax.rem(g_pre, RING))
+
+    @pl.when(c * span < ctx)
+    def _compute():
+        wait(b, c, slot)
+        if mode == "dots":
+            # Strided per-head reads + both dots, no softmax.
+            for h in range(KVH):
+                rows = slice(h * G, (h + 1) * G)
+                q = q_ref[0, rows, :].astype(jnp.float32)
+                k = (k_buf[slot, :, :, h, :]
+                     .reshape(span, -1).astype(jnp.float32))
+                s = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                v = (v_buf[slot, :, :, h, :]
+                     .reshape(span, -1).astype(jnp.float32))
+                o_ref[0, rows, :] += jax.lax.dot(
+                    s, v, preferred_element_type=jnp.float32
+                ).astype(o_ref.dtype)
+        elif mode == "reads":
+            # Strided per-head reads only (forced by a cheap add).
+            for h in range(KVH):
+                rows = slice(h * G, (h + 1) * G)
+                k = (k_buf[slot, :, :, h, :]
+                     .reshape(span, -1).astype(jnp.float32))
+                v = (v_buf[slot, :, :, h, :]
+                     .reshape(span, -1).astype(jnp.float32))
+                o_ref[0, rows, :] += (k[:G, :] + v[:G, :]).astype(
+                    o_ref.dtype)
+
+
+def build(mode, P=8):
+    kernel = functools.partial(_kernel, pages_per_block=P, mode=mode)
+    nc = MAXB // P
+
+    @jax.jit
+    def run(q, k_pages, v_pages, bt, cl):
+        def body(acc, l):
+            o = pl.pallas_call(
+                kernel,
+                grid_spec=pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=3,
+                    grid=(B, nc),
+                    in_specs=[
+                        pl.BlockSpec((1, KVH * G, D),
+                                     lambda b, c, bt, cl, lr: (b, 0, 0)),
+                        pl.BlockSpec(memory_space=pl.ANY),
+                        pl.BlockSpec(memory_space=pl.ANY),
+                    ],
+                    out_specs=pl.BlockSpec(
+                        (1, KVH * G, D), lambda b, c, bt, cl, lr: (b, 0, 0)),
+                    scratch_shapes=[
+                        pltpu.VMEM((RING, P, bs, KVH, D), jnp.bfloat16),
+                        pltpu.VMEM((RING, P, bs, KVH, D), jnp.bfloat16),
+                        pltpu.SemaphoreType.DMA((RING, 2, P)),
+                    ],
+                ),
+                out_shape=jax.ShapeDtypeStruct((B, KVH * G, D),
+                                               jnp.float32),
+            )(bt.astype(jnp.int32), cl.astype(jnp.int32),
+              jnp.asarray(l, jnp.int32).reshape(1), q, k_pages, v_pages)
+            return acc + o[0, 0, :8], None
+        out, _ = jax.lax.scan(
+            body, jnp.zeros((8,), jnp.float32), jnp.arange(L))
+        return out.reshape(1, 8)
+    return run
+
+
+def timed_per_call(fn, *args):
+    out = fn(*args)
+    np.asarray(out[0, 0])
+    walls = {}
+    for n in (N1, N2, N1, N2):
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(n):
+            last = fn(*args)
+        np.asarray(last[0, 0])
+        walls.setdefault(n, []).append(time.perf_counter() - t0)
+    return (min(walls[N2]) - min(walls[N1])) / (N2 - N1)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    shape = (L, NB, bs, KVH, D)
+
+    @jax.jit
+    def mk(key):
+        k1, k2 = jax.random.split(key)
+        return (jax.random.normal(k1, shape, jnp.bfloat16) * 0.1,
+                jax.random.normal(k2, shape, jnp.bfloat16) * 0.1)
+
+    k_pages, v_pages = mk(jax.random.key(0))
+    bt = jnp.asarray(rng.integers(0, NB, (B, MAXB)), jnp.int32)
+    cl = jnp.full((B,), CTX, jnp.int32)
+    q = jnp.asarray(rng.standard_normal((B, KVH * G, D)), jnp.bfloat16)
+
+    for mode in ("reads", "dots"):
+        fn = build(mode)
+        try:
+            t = timed_per_call(fn, q, k_pages, v_pages, bt, cl)
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"mode": mode, "error": str(e)[:200]}),
+                  flush=True)
+            continue
+        print(json.dumps({"mode": mode, "all_L_s": round(t, 5)}),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -2,24 +2,34 @@
 
 One query token per sequence attends over that sequence's KV pages
 scattered in HBM — the TPU counterpart of vLLM's CUDA PagedAttention
-kernel, which the reference stack consumes via engine images.
+kernel, which the reference stack consumes via engine images
+(ref helm/templates/deployment-vllm-multi.yaml:108-199).
 
-v2 (round 4): the v1 kernel walked ONE page per (sequence, page) grid
-step through BlockSpec indexing — B x MAXB serial steps, each a ~128 KB
-DMA followed by 8-row dot products, leaving the measured attention cost
-~60x above the KV-read HBM floor. This version adopts the structure of
+v3 (round 5). Round-5 profiling (benchmarks/kernel_dma_only.py) showed
+the v2 kernel's double-buffered page DMAs already stream at ~705 GB/s —
+1.16x the HBM floor — while the full kernel ran at 2.3x: the per-chunk
+softmax compute was NOT overlapping the DMA stream (total ~= DMA +
+compute instead of max(DMA, compute)). v3 restructures for overlap and
+for fewer vector-op issues:
+
+- **Ring buffer, depth R=4** (was 2): page copies are issued ``R-1``
+  chunks ahead along a GLOBAL step index ``g = b * nc + c``, so the
+  prefetch window crosses sequence boundaries — while sequence ``b``'s
+  last chunks compute, sequence ``b+1``'s first pages are already in
+  flight (the v2 kernel paid a cold refill at every ``c == 0``).
+- **Head-batched softmax**: one scores scratch ``[KVH * g_pad, span]``
+  is filled by per-head QK dots, then masking, running max, exp, and
+  the l/acc updates run ONCE over all heads' rows (v2 issued every
+  VPU stage 8x per chunk, once per kv head).
+- q is pre-scaled by ``scale`` outside the kernel (one [B, H, D]
+  multiply) instead of scaling every [g_pad, span] score tile.
+
+Structure credit: the grid/BlockSpec shape follows
 ``jax.experimental.pallas.ops.tpu.paged_attention`` (which cannot be
 used directly: it wants per-layer page arrays, and slicing our
 layer-stacked pool [L, NB, bs, KVH, D] per layer would copy the whole
 layer every scan step — the layer index must reach the kernel as a
-prefetched scalar):
-
-- K/V pools stay in HBM (``memory_space=ANY``); the kernel issues its
-  own DMAs for the block table's scattered pages.
-- Each grid step covers ``pages_per_block`` pages (one [g_pad, P*bs]
-  dot per kv head instead of P tiny ones).
-- Double buffering: the next chunk's pages are copied while the current
-  chunk computes, hiding DMA latency behind the MXU.
+prefetched scalar).
 
 Correctness is pinned by tests/test_pallas_attention.py (interpret-mode
 parity vs the XLA reference on CPU; the bench drives it on real TPU).
@@ -36,10 +46,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# DMA ring depth: chunks prefetched ahead of compute. The round-5 sweep
+# measured depth 6 (with the default 8-page chunks: ~12 MB of the
+# ~16 MB VMEM) fastest — deep enough to cover DMA issue->complete
+# latency across sequence boundaries.
+RING = 6
+
 
 def _start_chunk_copy(k_hbm, v_hbm, k_buf, v_buf, sems, bt_ref, layer,
                       b, chunk, slot, pages_per_block):
-    """Kick off async copies of one chunk's pages into buffer `slot`."""
+    """Kick off async copies of one chunk's pages into ring slot `slot`."""
     for p in range(pages_per_block):
         page = bt_ref[b, chunk * pages_per_block + p]
         pltpu.make_async_copy(
@@ -68,91 +84,114 @@ def _decode_kernel(
     context_lens_ref,  # [B]
     layer_ref,  # [1]
     # inputs
-    q_ref,  # [1, KVH * g_pad, D] (VMEM block for sequence b)
+    q_ref,  # [1, KVH * g_pad, D] (VMEM block for sequence b; pre-scaled)
     k_hbm_ref,  # [L, NB, bs, KVH, D] in ANY/HBM
     v_hbm_ref,
     # output
     o_ref,  # [1, KVH * g_pad, D]
     # scratch
-    k_buf,  # VMEM [2, P, bs, KVH, D]
+    k_buf,  # VMEM [RING, P, bs, KVH, D]
     v_buf,
-    sems,  # DMA [2, 2, P]
+    sems,  # DMA [RING, 2, P]
+    s_ref,  # [KVH * g_pad, span] f32 scores (all heads batched)
     acc_ref,  # [KVH * g_pad, D] f32
     m_ref,  # [KVH * g_pad, 128] f32
     l_ref,  # [KVH * g_pad, 128] f32
     *,
-    scale: float,
     block_size: int,
     kvh: int,
     g_pad: int,
     pages_per_block: int,
+    ring: int,
 ):
     b = pl.program_id(0)
     c = pl.program_id(1)
     nc = pl.num_programs(1)
+    nb = pl.num_programs(0)
     layer = layer_ref[0]
     ctx = context_lens_ref[b]
     P = pages_per_block
     span_tokens = P * block_size
     chunk_start = c * span_tokens
-    # Buffer parity is (chunk index) mod 2 — a pure function of c, so
-    # start/wait pairs always agree (no SMEM toggle state needed).
-    slot = jax.lax.rem(c, 2)
+    g = b * nc + c  # global step: the prefetch window crosses sequences
+    slot = jax.lax.rem(g, ring)
+
+    @pl.when(g == 0)
+    def _fill():
+        # Cold start: fill the ring for the first live chunks of the
+        # leading sequences (liveness-guarded per chunk; the guard is
+        # the same predicate the consumer uses, so every started copy
+        # is waited exactly once).
+        for k in range(min(ring - 1, nb * nc)):
+            gb, gc = divmod(k, nc)
+
+            @pl.when(gc * span_tokens < context_lens_ref[gb])
+            def _(gb=gb, gc=gc, k=k):
+                _start_chunk_copy(
+                    k_hbm_ref, v_hbm_ref, k_buf, v_buf, sems,
+                    block_tables_ref, layer, gb, gc, k % ring, P)
 
     @pl.when(c == 0)
     def _init():
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
-        _start_chunk_copy(k_hbm_ref, v_hbm_ref, k_buf, v_buf, sems,
-                          block_tables_ref, layer, b, 0, 0, P)
 
-    # Prefetch the NEXT live chunk of this sequence while this one
-    # computes (same guard expression the consumer step uses).
-    @pl.when(jnp.logical_and(c + 1 < nc, (c + 1) * span_tokens < ctx))
+    # Issue the chunk RING-1 global steps ahead (lands in the slot just
+    # consumed, which the serial grid has already finished reading).
+    g_pre = g + ring - 1
+    b_pre = g_pre // nc
+    c_pre = jax.lax.rem(g_pre, nc)
+
+    @pl.when(jnp.logical_and(
+        b_pre < nb,
+        c_pre * span_tokens < context_lens_ref[jnp.minimum(b_pre, nb - 1)]))
     def _prefetch():
         _start_chunk_copy(k_hbm_ref, v_hbm_ref, k_buf, v_buf, sems,
-                          block_tables_ref, layer, b, c + 1,
-                          jax.lax.rem(c + 1, 2), P)
+                          block_tables_ref, layer, b_pre, c_pre,
+                          jax.lax.rem(g_pre, ring), P)
 
     @pl.when(chunk_start < ctx)
     def _compute():
         _wait_chunk_copy(k_hbm_ref, v_hbm_ref, k_buf, v_buf, sems,
                          block_tables_ref, layer, b, c, slot, P)
-        span = chunk_start + jax.lax.broadcasted_iota(
-            jnp.int32, (1, span_tokens), 1
-        )
-        valid = span < ctx  # [1, P*bs]
+        # Per-head QK dots into ONE scores scratch, then every VPU stage
+        # (mask, max, exp, l/acc updates) runs once over all heads' rows.
+        # Operands are cast to f32 first — measured FASTER than feeding
+        # bf16 straight to the MXU at these tiny tile shapes (ring sweep,
+        # round 5: bf16 operands cost +66%; Mosaic's repacking of skinny
+        # bf16 tiles outweighs the cast traffic).
         for h in range(kvh):  # static unroll over kv heads
             rows = slice(h * g_pad, (h + 1) * g_pad)
             q = q_ref[0, rows, :].astype(jnp.float32)  # [g_pad, D]
             k = (k_buf[slot, :, :, h, :]
-                 .reshape(span_tokens, -1).astype(jnp.float32))  # [P*bs, D]
+                 .reshape(span_tokens, -1).astype(jnp.float32))
+            s_ref[rows, :] = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        span = chunk_start + jax.lax.broadcasted_iota(
+            jnp.int32, (1, span_tokens), 1
+        )
+        valid = span < ctx  # [1, span]
+        s = jnp.where(valid, s_ref[...], NEG_INF)  # [KVH*g_pad, span]
+        m_prev = m_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)  # [KVH*g_pad, 1]
+        p_ = jnp.exp(s - m_new)
+        l_ref[...] = jnp.broadcast_to(
+            alpha * l_ref[:, :1] + jnp.sum(p_, axis=1, keepdims=True),
+            l_ref.shape,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        acc_ref[...] = acc_ref[...] * alpha  # one batched rescale
+        for h in range(kvh):
+            rows = slice(h * g_pad, (h + 1) * g_pad)
             v = (v_buf[slot, :, :, h, :]
                  .reshape(span_tokens, -1).astype(jnp.float32))
-            s = (
-                jax.lax.dot_general(
-                    q, k, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-                * scale
-            )  # [g_pad, P*bs]
-            s = jnp.where(valid, s, NEG_INF)
-            m_prev = m_ref[rows, :1]  # [g_pad, 1]
-            m_cur = jnp.max(s, axis=1, keepdims=True)
-            m_new = jnp.maximum(m_prev, m_cur)
-            alpha = jnp.exp(m_prev - m_new)
-            p_ = jnp.exp(s - m_new)  # [g_pad, P*bs]
-            l_ref[rows, :] = jnp.broadcast_to(
-                alpha * l_ref[rows, :1]
-                + jnp.sum(p_, axis=1, keepdims=True),
-                (g_pad, l_ref.shape[1]),
-            )
-            acc_ref[rows, :] = acc_ref[rows, :] * alpha + jax.lax.dot(
-                p_, v, preferred_element_type=jnp.float32
-            )
-            m_ref[rows, :] = jnp.broadcast_to(
-                m_new, (g_pad, m_ref.shape[1]))
+            acc_ref[rows, :] = acc_ref[rows, :] + jax.lax.dot(
+                p_[rows, :], v, preferred_element_type=jnp.float32)
 
     @pl.when(c == nc - 1)
     def _finalize():
@@ -161,7 +200,7 @@ def _decode_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "pages_per_block", "interpret"))
+    jax.jit, static_argnames=("scale", "pages_per_block", "ring", "interpret"))
 def pallas_paged_attention(
     q: jax.Array,  # [B, H, D]
     k_pages: jax.Array,  # [L, NB, bs, KVH, D] stacked pages
@@ -172,6 +211,7 @@ def pallas_paged_attention(
     *,
     scale: float,
     pages_per_block: int = 0,  # 0 -> min(8, MAXB)
+    ring: int = 0,  # DMA ring depth; 0 -> RING default
     interpret: bool = False,
 ) -> jax.Array:
     B, H, D = q.shape
@@ -193,14 +233,15 @@ def pallas_paged_attention(
     nc = MAXB // P
     # Pad each query-head group to the float32 sublane tile (8 rows).
     g_pad = max(group, 8)
-    qg = q.reshape(B, KVH, group, D)
+    qg = (q * scale).astype(q.dtype).reshape(B, KVH, group, D)
     if g_pad != group:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, g_pad - group), (0, 0)))
     qg = qg.reshape(B, KVH * g_pad, D)
 
+    R = ring or RING
     kernel = functools.partial(
-        _decode_kernel, scale=scale, block_size=bs, kvh=KVH, g_pad=g_pad,
-        pages_per_block=P,
+        _decode_kernel, block_size=bs, kvh=KVH, g_pad=g_pad,
+        pages_per_block=P, ring=R,
     )
     layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
     out = pl.pallas_call(
@@ -219,9 +260,10 @@ def pallas_paged_attention(
                 (1, KVH * g_pad, D), lambda b, c, bt, cl, lr: (b, 0, 0)
             ),
             scratch_shapes=[
-                pltpu.VMEM((2, P, bs, KVH, D), k_pages.dtype),
-                pltpu.VMEM((2, P, bs, KVH, D), v_pages.dtype),
-                pltpu.SemaphoreType.DMA((2, 2, P)),
+                pltpu.VMEM((R, P, bs, KVH, D), k_pages.dtype),
+                pltpu.VMEM((R, P, bs, KVH, D), v_pages.dtype),
+                pltpu.SemaphoreType.DMA((R, 2, P)),
+                pltpu.VMEM((KVH * g_pad, P * bs), jnp.float32),
                 pltpu.VMEM((KVH * g_pad, D), jnp.float32),
                 pltpu.VMEM((KVH * g_pad, 128), jnp.float32),
                 pltpu.VMEM((KVH * g_pad, 128), jnp.float32),
