@@ -1208,6 +1208,13 @@ class EngineServer:
     # lifecycle / metrics
     # ------------------------------------------------------------------ #
     async def handle_health(self, request: web.Request) -> web.Response:
+        if self.core.fatal_error is not None:
+            # Unrecoverable fault (e.g. multi-host op-channel break):
+            # report unhealthy so probes restart the pod instead of
+            # routing traffic into a wedged job.
+            return web.json_response(
+                {"status": "unhealthy", "error": self.core.fatal_error},
+                status=503)
         body = {"status": "ok"}
         mh = self.core._mh
         if mh is not None:
@@ -1675,6 +1682,10 @@ class EngineServer:
             f"vllm:num_preemptions_total{{{labels}}} {s['num_preempted_total']}",
             "# TYPE tpu:num_kv_blocks gauge",
             f"tpu:num_kv_blocks{{{labels}}} {s['num_blocks']}",
+            *(["# TYPE tpu:hbm_headroom_bytes gauge",
+               f"tpu:hbm_headroom_bytes{{{labels}}} "
+               f"{s['hbm_headroom_bytes']}"]
+              if s.get("hbm_headroom_bytes") is not None else []),
             "# TYPE tpu:engine_sleeping gauge",
             f"tpu:engine_sleeping{{{labels}}} {int(s['is_sleeping'])}",
             "# TYPE tpu:cached_prompt_tokens counter",

@@ -1,11 +1,14 @@
 """Weight-only int8 quantization (per-output-channel, symmetric).
 
 Serves the BASELINE model class on one 16 GB chip: an 8 B-parameter model
-is ~16 GB in bf16 (does not fit next to KV + workspace) but ~8 GB in
-int8. The compute path stays bf16 on the MXU — each weight is stored as
-``int8`` plus a per-output-channel ``float32`` scale, and the dequant
-(`w.astype(bf16) * scale`) fuses into the matmul's operand read under
-XLA, so the HBM weight traffic (the decode bottleneck) halves too.
+is ~16 GB in bf16 (does not fit next to KV + workspace) but ~9 GB with
+int8 layer weights (embed/lm_head stay bf16 by default — quantizing them
+disproportionately hurts output quality for ~1 GB more;
+``quantize_embeddings=True`` reclaims it when HBM is the binding
+constraint). The compute path stays bf16 on the MXU — each weight is
+stored as ``int8`` plus a per-output-channel ``float32`` scale, and the
+dequant (`w.astype(bf16) * scale`) fuses into the matmul's operand read
+under XLA, so the HBM weight traffic (the decode bottleneck) halves too.
 
 The reference reaches this class through vLLM's quantization support in
 its CUDA images (``--quantization`` engine args in
@@ -52,7 +55,8 @@ def _quantize_np(w: np.ndarray, reduce_axis: int):
     return q, scale.astype(np.float32)
 
 
-def _apply_tree(params: Dict, arch: str, quant) -> Dict:
+def _apply_tree(params: Dict, arch: str, quant,
+                quantize_embeddings: bool) -> Dict:
     if arch != "llama":
         raise ValueError(
             f"int8 quantization is supported for the llama family "
@@ -66,25 +70,32 @@ def _apply_tree(params: Dict, arch: str, quant) -> Dict:
             layers[name] = q
             layers[name + "_scale"] = s
     out["layers"] = layers
-    # embed [V, Hd]: per-ROW scales [V, 1] — correct for both the lookup
-    # (dequant the gathered rows) and the tied head (x @ embed.T scales
-    # per output/vocab channel).
-    q, s = quant(params["embed"], -1)
-    out["embed"] = q
-    out["embed_scale"] = s
-    if "lm_head" in params:
-        q, s = quant(params["lm_head"], -2)  # [Hd, V] -> scale [1, V]
-        out["lm_head"] = q
-        out["lm_head_scale"] = s
+    # embed / lm_head stay bf16 by default: quantizing them hurts output
+    # quality disproportionately (standard weight-only recipes exclude
+    # them) while saving only ~1 GB of an 8 B model's bytes — the HBM win
+    # is nearly unchanged without them.
+    if quantize_embeddings:
+        # embed [V, Hd]: per-ROW scales [V, 1] — correct for both the
+        # lookup (dequant the gathered rows) and the tied head
+        # (x @ embed.T scales per output/vocab channel).
+        q, s = quant(params["embed"], -1)
+        out["embed"] = q
+        out["embed_scale"] = s
+        if "lm_head" in params:
+            q, s = quant(params["lm_head"], -2)  # [Hd, V] -> scale [1, V]
+            out["lm_head"] = q
+            out["lm_head_scale"] = s
     return out
 
 
-def quantize_tree(params: Dict, arch: str) -> Dict:
+def quantize_tree(params: Dict, arch: str, *,
+                  quantize_embeddings: bool = False) -> Dict:
     """Traceable int8 quantization of a params pytree (use inside jit)."""
-    return _apply_tree(params, arch, _quantize_jnp)
+    return _apply_tree(params, arch, _quantize_jnp, quantize_embeddings)
 
 
-def quantize_loaded(loaded: Dict, arch: str) -> Dict:
+def quantize_loaded(loaded: Dict, arch: str, *,
+                    quantize_embeddings: bool = False) -> Dict:
     """Numpy twin of :func:`quantize_tree` for host-loaded checkpoints.
     Only quantizes the leaves the checkpoint actually carries."""
     if arch != "llama":
@@ -100,12 +111,13 @@ def quantize_loaded(loaded: Dict, arch: str) -> Dict:
                 layers[name] = q
                 layers[name + "_scale"] = s
         out["layers"] = layers
-    if "embed" in loaded:
-        q, s = _quantize_np(loaded["embed"], -1)
-        out["embed"] = q
-        out["embed_scale"] = s
-    if "lm_head" in loaded:
-        q, s = _quantize_np(loaded["lm_head"], -2)
-        out["lm_head"] = q
-        out["lm_head_scale"] = s
+    if quantize_embeddings:
+        if "embed" in loaded:
+            q, s = _quantize_np(loaded["embed"], -1)
+            out["embed"] = q
+            out["embed_scale"] = s
+        if "lm_head" in loaded:
+            q, s = _quantize_np(loaded["lm_head"], -2)
+            out["lm_head"] = q
+            out["lm_head_scale"] = s
     return out

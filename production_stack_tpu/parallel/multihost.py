@@ -34,6 +34,7 @@ cluster is complete.
 
 from __future__ import annotations
 
+import hmac
 import os
 import pickle
 import socket
@@ -149,6 +150,18 @@ class OpChannel:
         self.is_leader = env["process_id"] == 0
         host = env["coordinator"].rsplit(":", 1)[0]
         port = env["op_port"]
+        # The op stream carries user prompt token ids over a port that is
+        # published on the headless Service — authentication is REQUIRED
+        # in multi-host mode (the helm chart generates a per-release
+        # secret; see statefulset-engine-multihost.yaml). The explicit
+        # insecure flag exists for closed-network bring-up only.
+        self._token = os.environ.get("TPU_STACK_OP_TOKEN") or ""
+        if not self._token and not os.environ.get("TPU_STACK_OP_INSECURE"):
+            raise ValueError(
+                "multi-host mode requires TPU_STACK_OP_TOKEN (a shared "
+                "secret set on every pod; the helm chart wires one "
+                "automatically) — or set TPU_STACK_OP_INSECURE=1 to "
+                "accept unauthenticated followers on a closed network")
         if self.is_leader:
             self._conns = self._accept_followers(port)
             self._sock = None
@@ -156,6 +169,12 @@ class OpChannel:
             self._sock = self._connect(host, port)
             self._conns = []
         self._send_lock = threading.Lock()
+
+    def _token_bytes(self) -> bytes:
+        """Fixed 32-byte token field (zeros when auth is disabled) — ALWAYS
+        sent/read, so a token config mismatch can never desynchronize the
+        frame stream into garbage pickles."""
+        return self._token.encode().ljust(32, b"\0")[:32]
 
     # How long the leader waits for all followers to join before giving
     # up (jax.distributed.initialize has its own, longer timeout; this
@@ -197,17 +216,16 @@ class OpChannel:
                 continue
             try:
                 conn.settimeout(5.0)
+                # Handshake: pid (8 bytes) + token field (32 bytes, ALWAYS
+                # present — zeros when auth is off — so a one-sided token
+                # config can never desync the frame stream), answered by a
+                # 1-byte ack so a rejected follower fails immediately
+                # instead of believing it connected.
                 (pid,) = struct.unpack("!q", self._read_exact(conn, 8))
-                token = os.environ.get("TPU_STACK_OP_TOKEN")
-                if token:
-                    # Optional shared-secret handshake (set the same env
-                    # on every pod): without it, any in-cluster connector
-                    # guessing a pid could claim a follower slot and
-                    # receive the op stream.
-                    got = self._read_exact(conn, 32)
-                    want = token.encode().ljust(32, b"\0")[:32]
-                    if got != want:
-                        raise ConnectionError("bad op-channel token")
+                got = self._read_exact(conn, 32)
+                if self._token and not hmac.compare_digest(
+                        got, self._token_bytes()):
+                    raise ConnectionError("bad op-channel token")
             except (ConnectionError, socket.timeout, struct.error):
                 conn.close()  # stray probe/scanner: no slot consumed
                 continue
@@ -228,6 +246,11 @@ class OpChannel:
                     by_pid[pid].close()
                 except OSError:
                     pass
+            try:
+                conn.sendall(b"\x01")  # handshake ack
+            except OSError:
+                conn.close()
+                continue
             conn.settimeout(None)
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             by_pid[pid] = conn
@@ -242,16 +265,27 @@ class OpChannel:
             try:
                 sock = socket.create_connection((host, port), timeout=5.0)
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                sock.settimeout(None)
+                sock.settimeout(30.0)
                 sock.sendall(struct.pack("!q", self.process_id))
-                token = os.environ.get("TPU_STACK_OP_TOKEN")
-                if token:
-                    sock.sendall(token.encode().ljust(32, b"\0")[:32])
-                return sock
+                sock.sendall(self._token_bytes())
+                # Wait for the leader's 1-byte handshake ack: a rejection
+                # (token mismatch, bad pid) closes the socket, which must
+                # fail HERE, loudly — not later as a 600 s accept-timeout
+                # wedge with the follower believing it connected.
+                ack = sock.recv(1)
             except OSError:
                 if time.monotonic() > deadline:
                     raise
                 time.sleep(0.25)
+                continue
+            if ack != b"\x01":
+                sock.close()
+                raise ConnectionError(
+                    "op channel handshake rejected by leader (token "
+                    "mismatch or bad process id) — check that every pod "
+                    "has the same TPU_STACK_OP_TOKEN")
+            sock.settimeout(None)
+            return sock
 
     @staticmethod
     def _read_exact(sock: socket.socket, n: int) -> bytes:

@@ -15,6 +15,8 @@ mis-rendering is the failure mode this exists to prevent).
 
 from __future__ import annotations
 
+import base64
+import hashlib
 import re
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -362,7 +364,26 @@ _FUNCS = {
     "list": lambda *a: list(a),
     "dict": _dict,
     "len": lambda v: len(v or []),
+    # Deterministic stand-in for helm's randAlphaNum: render tests only
+    # assert structure, never the token value (real helm generates a
+    # fresh one per install).
+    "randAlphaNum": lambda n: "x" * int(n),
+    "sha256sum": lambda v: hashlib.sha256(
+        str(v).encode()).hexdigest(),
+    "b64dec": lambda v: base64.b64decode(str(v)).decode(),
+    # No cluster in render tests: lookup always misses (templates must
+    # handle the fresh-install path; real helm fills this on upgrade).
+    "lookup": lambda *a: None,
+    "index": lambda obj, *keys: _index(obj, *keys),
 }
+
+
+def _index(obj, *keys):
+    for k in keys:
+        if obj is None:
+            return None
+        obj = obj[k] if not isinstance(obj, dict) else obj.get(k)
+    return obj
 
 
 # ---------------------------------------------------------------- chart

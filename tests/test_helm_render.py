@@ -252,3 +252,51 @@ def test_shared_storage_mounts_into_engine_pods():
     names = [m["name"] for m in spec["containers"][0]["volumeMounts"]]
     assert names.count("shared-models") == 0
     assert "model-storage" in names
+
+
+def test_gateway_api_httproute_renders():
+    """Tutorial 20: gatewayApi.enableHTTPRoute attaches an HTTPRoute to
+    the router Service; off by default."""
+    import copy
+
+    values = copy.deepcopy(load_values(CHART))
+    values["gatewayApi"]["enableHTTPRoute"] = True
+    values["gatewayApi"]["gatewayName"] = "edge-gw"
+    values["gatewayApi"]["hostnames"] = ["llm.example.com"]
+    rendered = MiniHelm(CHART).render(values)
+
+    routes = list(_docs(rendered, "HTTPRoute"))
+    assert len(routes) == 1
+    spec = routes[0]["spec"]
+    assert spec["parentRefs"][0]["name"] == "edge-gw"
+    assert spec["hostnames"] == ["llm.example.com"]
+    backend = spec["rules"][0]["backendRefs"][0]
+    assert backend["name"].endswith("-router-service")
+    assert backend["port"] == 80
+
+    assert not list(_docs(MiniHelm(CHART).render(load_values(CHART)),
+                          "HTTPRoute"))
+
+
+def test_multihost_op_token_secret_renders():
+    """ADVICE r4: the multihost StatefulSet carries an op-channel token
+    Secret, injects it as TPU_STACK_OP_TOKEN, and rolls pods on
+    rotation via a checksum annotation."""
+    example = os.path.join(
+        CHART, "examples", "values-07-multihost-llama70b.yaml")
+    rendered = _render(example)
+
+    secrets = [d for d in _docs(rendered, "Secret")
+               if d["metadata"]["name"].endswith("-op-token")]
+    assert len(secrets) == 1
+    assert secrets[0]["stringData"]["token"]
+
+    stss = list(_docs(rendered, "StatefulSet"))
+    assert stss
+    tmpl = stss[0]["spec"]["template"]
+    ann = tmpl["metadata"]["annotations"]
+    assert "checksum/op-token" in ann and len(ann["checksum/op-token"]) == 64
+    env = {e["name"]: e for e in tmpl["spec"]["containers"][0]["env"]}
+    ref = env["TPU_STACK_OP_TOKEN"]["valueFrom"]["secretKeyRef"]
+    assert ref["name"] == secrets[0]["metadata"]["name"]
+    assert ref["key"] == "token"

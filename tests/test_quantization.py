@@ -37,9 +37,16 @@ def test_int8_logits_close_to_bf16():
     qparams = jax.jit(lambda p: quantize_tree(p, "llama"))(params)
 
     assert qparams["layers"]["wq"].dtype == jnp.int8
-    assert qparams["embed"].dtype == jnp.int8
+    # embed / lm_head stay bf16 by default (head/embedding quantization
+    # disproportionately hurts output quality for ~no HBM win).
+    assert qparams["embed"].dtype == cfg.jnp_dtype
+    assert "embed_scale" not in qparams
     assert qparams["layers"]["wq_scale"].shape == (
         cfg.num_layers, 1, cfg.num_heads * cfg.head_dim)
+    q_all = jax.jit(
+        lambda p: quantize_tree(p, "llama", quantize_embeddings=True)
+    )(params)
+    assert q_all["embed"].dtype == jnp.int8
 
     ids = jnp.asarray([[1, 7, 42, 99, 200, 3, 5, 17]], jnp.int32)
     ref = _forward(params, cfg, ids)
@@ -60,8 +67,10 @@ def test_quantize_loaded_matches_quantize_tree():
     host = jax.tree_util.tree_map(
         lambda x: np.asarray(x, np.float32), params)
 
-    q_dev = jax.jit(lambda p: quantize_tree(p, "llama"))(params)
-    q_host = quantize_loaded(host, "llama")
+    q_dev = jax.jit(
+        lambda p: quantize_tree(p, "llama", quantize_embeddings=True)
+    )(params)
+    q_host = quantize_loaded(host, "llama", quantize_embeddings=True)
 
     # XLA's fused division can differ from numpy by a ULP, flipping
     # round-to-nearest at exact ties on a tiny fraction of weights —
