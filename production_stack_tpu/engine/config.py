@@ -40,13 +40,19 @@ class EngineConfig:
     prefill_chunk_size: int = 1024
     # Up to this many long-prompt prefills share one [prefill_batch,
     # chunk] dispatch (the arrival-storm TTFT tail is a QUEUE of
-    # first-round prefills). Measured on the dev chip at the reference
-    # workload (llama3b): throughput-neutral and p50-TTFT-worse — the
-    # pipelined single path already drains the queue, and padded rows
-    # waste chunk-width compute — so the default is OFF; the knob (and
-    # its parity tests) remain for prefill-heavy workloads with low
-    # cache hit rates. 1 disables; requires chunking.
-    prefill_batch: int = 1
+    # first-round prefills). Round 4 measured always-on batching
+    # throughput-neutral with WORSE p50 at steady state (padded rows
+    # waste chunk-width compute when the queue is shallow), so batching
+    # is storm-scoped: it only engages when at least
+    # ``prefill_batch_min_waiting`` other qualifying long prompts are
+    # queued — exactly the arrival-storm condition that serializes
+    # first-round prefills into the p99 TTFT tail. 1 disables; requires
+    # chunking.
+    prefill_batch: int = 4
+    # The storm gate: batch only when this many OTHER qualifying
+    # (long, uncached-span) prompts are waiting. 0 = batch whenever a
+    # group can form (round-4 always-on behavior).
+    prefill_batch_min_waiting: int = 2
     # Fused multi-step decode: exactly this many decode iterations
     # (forward + sampling + token feedback) run inside one compiled
     # lax.scan per dispatch; sequences that cannot use the full burst are
@@ -73,6 +79,11 @@ class EngineConfig:
     # output-channel scales (models/quantize.py) — an 8 B model fits one
     # 16 GB chip and decode's HBM weight read halves. None = bf16.
     quantization: Optional[str] = None
+    # int8 only: also quantize the embedding table and lm_head. Off by
+    # default — head/embedding quantization disproportionately hurts
+    # output quality for ~1 GB of savings on an 8 B model; turn on when
+    # HBM is the binding constraint.
+    quantize_embeddings: bool = False
 
     def __post_init__(self):
         if self.quantization not in (None, "int8"):

@@ -256,8 +256,17 @@ class HostKVStore:
             _time.sleep(0.02)
 
     @staticmethod
-    def _size(k: np.ndarray, v: np.ndarray) -> int:
-        return k.nbytes + v.nbytes
+    def _size(k, v) -> int:
+        # Multi-host engines stage per-process SHARD DICTS
+        # ({shard_index: ndarray}) instead of whole-block arrays; sizes
+        # stay equal across processes (equal mesh splits), which keeps
+        # the per-process LRU states in lockstep.
+        def nbytes(x):
+            if isinstance(x, dict):
+                return sum(a.nbytes for a in x.values())
+            return x.nbytes
+
+        return nbytes(k) + nbytes(v)
 
     def put(self, prefix_hash: int, k: np.ndarray, v: np.ndarray) -> None:
         size = self._size(k, v)

@@ -170,6 +170,23 @@ def kv_pages_sharding(cfg: ModelConfig, mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def kv_block_sharding(cfg: ModelConfig, mesh: Mesh) -> NamedSharding:
+    """ONE block [L, bs, KVH, D] — the pool spec minus the NB axis.
+    Per-host offload staging slices blocks out of the pool and later
+    reassembles them from locally-staged shards
+    (``make_array_from_callback``); the spec must mirror
+    :func:`kv_pages_sharding` exactly or the reassembled block would
+    re-shard through a collective."""
+    tp = mesh.shape.get("tp", 1)
+    pp = mesh.shape.get("pp", 1)
+    layer_axis = "pp" if pp > 1 and cfg.num_layers % pp == 0 else None
+    if cfg.num_kv_heads % tp == 0 and tp > 1:
+        return NamedSharding(mesh, P(layer_axis, None, "tp", None))
+    if layer_axis:
+        return NamedSharding(mesh, P(layer_axis, None, None, None))
+    return NamedSharding(mesh, P())
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Replicated host-built batch metadata (tokens, tables, lens)."""
     return NamedSharding(mesh, P())

@@ -39,6 +39,7 @@ config = EngineConfig(
     block_size=8, num_blocks=64, max_loras=2,
     tensor_parallel_size=2, pipeline_parallel_size=2,
     decode_steps=4,
+    kv_offload_bytes=1 << 24,  # round 5: per-host shard offload tier
 )
 core = EngineCore(config)
 assert dict(core.mesh.shape) == {"dp": 2, "pp": 2, "tp": 2}
@@ -77,9 +78,53 @@ assert d2.wait(180), "request b timed out"
 assert core.load_lora_adapter("mh-adapter")
 emb = core.embed(prompt)
 cached = core.cached_tokens_total
+
+# ---- round 5: KV extract via the replicated gather op -------------------
+payload = core.extract_kv(prompt[:16])  # 2 full blocks of 8
+assert payload is not None and payload["num_tokens"] == 16
+assert payload["k"].shape[0] == 2  # [N, L, bs, KVH, D]
+
+# Inject the payload back under a different adapter namespace: the
+# scatter rides the op channel; a follow-up extract must round-trip
+# the exact bytes.
+import numpy as np
+from production_stack_tpu.engine.kvcache import BlockAllocator
+parent = core.kv_mgr.chain_root("other-adapter")
+inj_hashes = []
+for i in range(2):
+    parent = BlockAllocator.chain_hash(
+        parent, tuple(prompt[i * 8:(i + 1) * 8]))
+    inj_hashes.append(parent)
+# inject expects [L, N, bs, KVH, D] (extract emits per-block-major).
+n_inj = core.inject_kv_blocks(inj_hashes,
+                              payload["k"].swapaxes(0, 1),
+                              payload["v"].swapaxes(0, 1))
+assert n_inj == 2, n_inj
+back = core.extract_kv(prompt[:16], adapter="other-adapter")
+inject_roundtrip = bool(
+    back is not None
+    and np.allclose(back["k"], payload["k"], atol=1e-5)
+    and np.allclose(back["v"], payload["v"], atol=1e-5))
+
+# ---- round 5: multi-host sleep/wake (per-host param shard staging) ------
+core.sleep()
+assert core.params is None
+# Sleeping spilled the cached blocks to each host's offload tier.
+assert core.offload.stats()["blocks"] > 0
+core.wake_up()
+assert core.params is not None
+d3, t3, cb3 = collect()
+core.add_request("c", prompt,
+                 SamplingParams(max_tokens=8, temperature=0.0,
+                                ignore_eos=True), cb3)
+assert d3.wait(180), "post-wake request timed out"
+offload_hits = core.offload.hits
+
 core.stop()
 print("RESULT " + json.dumps(
-    {"a": t1, "b": t2, "emb": emb[:8], "cached": cached}), flush=True)
+    {"a": t1, "b": t2, "c": t3, "emb": emb[:8], "cached": cached,
+     "inject_roundtrip": inject_roundtrip,
+     "offload_hits": offload_hits}), flush=True)
 """
 
 
@@ -107,6 +152,8 @@ def _spawn(pid: int, port: int):
         "TPU_STACK_COORDINATOR": f"127.0.0.1:{port}",
         "TPU_STACK_NUM_PROCESSES": "2",
         "TPU_STACK_PROCESS_ID": str(pid),
+        # The op channel refuses unauthenticated multi-host bring-up.
+        "TPU_STACK_OP_TOKEN": "test-op-token",
     })
     return subprocess.Popen(
         [sys.executable, "-c", _WORKER], env=env,
@@ -178,6 +225,15 @@ def test_two_process_mesh_parity():
     # (cached-prefill op crossed the channel, not just plain prefill).
     assert got["cached"] > 0
 
+    # Round 5: the multi-host KV surface — extract (replicated gather op)
+    # round-trips bit-exact through inject (op-channel scatter)...
+    assert got["inject_roundtrip"] is True
+    # ...and sleep/wake staged every host's param shards correctly: the
+    # post-wake greedy rerun of prompt "a" is identical, with the prefix
+    # cache restored through the per-host offload tier, not recomputed.
+    assert got["c"] == got["a"], (got["c"], got["a"])
+    assert got["offload_hits"] > 0
+
     ref = _single_process_reference()
     assert got["a"] == ref["a"], (got["a"], ref["a"])
     assert got["b"] == ref["b"], (got["b"], ref["b"])
@@ -210,3 +266,166 @@ def test_distributed_env_parsing(monkeypatch):
     monkeypatch.delenv("TPU_STACK_COORDINATOR")
     with pytest.raises(ValueError):
         multihost.distributed_env()
+
+
+# ---- round 5: disaggregated prefill BETWEEN multi-host units ------------
+# Unit A (2 processes) prefills and extracts the prompt's KV; unit B
+# (2 processes, separate jax.distributed job) injects it and decodes
+# with a prefix-cache hit — BASELINE config 4's topology (70B disagg
+# across two slices) at CPU-mesh scale. The payload crosses units the
+# same way the HTTP relay rung ships it (host numpy), exchanged here
+# through a temp file.
+_UNIT_WORKER = r"""
+import os, sys, json, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.setdefault("TPU_STACK_LOG_LEVEL", "WARNING")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from production_stack_tpu.parallel import multihost
+
+env = multihost.initialize_from_env()
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.core import EngineCore
+from production_stack_tpu.engine.kvcache import BlockAllocator
+from production_stack_tpu.engine.sampling import SamplingParams
+
+role = os.environ["TPU_STACK_TEST_ROLE"]
+xdir = os.environ["TPU_STACK_TEST_DIR"]
+config = EngineConfig(
+    model="tiny-llama", max_model_len=128, max_num_seqs=2,
+    block_size=8, num_blocks=64, max_loras=0,
+    tensor_parallel_size=2, pipeline_parallel_size=2, decode_steps=4,
+)
+core = EngineCore(config)
+
+if env["process_id"] != 0:
+    core.run_follower()
+    sys.exit(0)
+
+import threading
+
+def serve(rid, ids, n=8):
+    done = threading.Event(); toks = []
+    def cb(t, f):
+        if t is not None:
+            toks.append(int(t[0]) if isinstance(t, tuple) else int(t))
+        if f is not None:
+            done.set()
+    core.add_request(rid, ids, SamplingParams(
+        max_tokens=n, temperature=0.0, ignore_eos=True), cb)
+    assert done.wait(180), rid
+    return toks
+
+core.start()
+prompt = list(range(1, 25))   # 3 full blocks of 8
+if role == "prefill":
+    serve("warm", prompt, n=1)  # prefill-side: one token, like disagg
+    payload = core.extract_kv(prompt[:24])
+    assert payload is not None and payload["num_tokens"] == 24
+    # f32 for the file exchange: np.savez cannot round-trip ml_dtypes
+    # bfloat16, and bf16 -> f32 -> bf16 is lossless.
+    np.savez(os.path.join(xdir, "kv.tmp.npz"),
+             k=np.asarray(payload["k"], np.float32),
+             v=np.asarray(payload["v"], np.float32),
+             hashes=np.asarray(payload["hashes"], np.uint64))
+    os.replace(os.path.join(xdir, "kv.tmp.npz"),
+               os.path.join(xdir, "kv.npz"))
+    core.stop()
+    print("RESULT " + json.dumps({"role": "prefill"}), flush=True)
+else:
+    path = os.path.join(xdir, "kv.npz")
+    deadline = time.time() + 300
+    while not os.path.exists(path):
+        if time.time() > deadline:
+            raise TimeoutError("prefill unit never produced KV")
+        time.sleep(0.25)
+    data = np.load(path)
+    n_inj = core.inject_kv_blocks(
+        [int(h) for h in data["hashes"]],
+        data["k"].swapaxes(0, 1), data["v"].swapaxes(0, 1))
+    assert n_inj == 3, n_inj
+    toks = serve("decode", prompt, n=8)
+    cached = core.cached_tokens_total
+    core.stop()
+    print("RESULT " + json.dumps(
+        {"role": "decode", "toks": toks, "cached": cached}), flush=True)
+"""
+
+
+def _spawn_unit(role, pid, port, xdir):
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env.update({
+        "TPU_STACK_COORDINATOR": f"127.0.0.1:{port}",
+        "TPU_STACK_NUM_PROCESSES": "2",
+        "TPU_STACK_PROCESS_ID": str(pid),
+        "TPU_STACK_OP_TOKEN": "test-op-token",
+        "TPU_STACK_TEST_ROLE": role,
+        "TPU_STACK_TEST_DIR": xdir,
+    })
+    return subprocess.Popen(
+        [sys.executable, "-c", _UNIT_WORKER], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+def test_disagg_between_multihost_units(tmp_path):
+    port_a = _free_port_pair()
+    procs = [_spawn_unit("prefill", 0, port_a, str(tmp_path)),
+             _spawn_unit("prefill", 1, port_a, str(tmp_path))]
+    port_b = _free_port_pair()
+    while port_b in (port_a, port_a + 1):
+        port_b = _free_port_pair()
+    procs += [_spawn_unit("decode", 0, port_b, str(tmp_path)),
+              _spawn_unit("decode", 1, port_b, str(tmp_path))]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out.decode())
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-4000:]
+    line = next(ln for ln in outs[2].splitlines()
+                if ln.startswith("RESULT "))
+    got = json.loads(line[len("RESULT "):])
+    # The decode unit served from INJECTED pages: its 24-token prompt
+    # cache-hit on the transferred blocks instead of recomputing (the
+    # tail block recomputes — the final position always needs a fresh
+    # hidden state, so cached caps below the full prompt).
+    assert got["cached"] >= 16, got
+    # Greedy parity vs a single-process engine with the same sharding.
+    ref = _single_process_reference_prompt24()
+    assert got["toks"] == ref, (got["toks"], ref)
+
+
+def _single_process_reference_prompt24():
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.core import EngineCore
+    from production_stack_tpu.engine.sampling import SamplingParams
+
+    config = EngineConfig(
+        model="tiny-llama", max_model_len=128, max_num_seqs=2,
+        block_size=8, num_blocks=64, max_loras=0,
+        tensor_parallel_size=2, pipeline_parallel_size=2, decode_steps=4)
+    core = EngineCore(config)
+    try:
+        core.start()
+        done = threading.Event()
+        toks = []
+
+        def cb(t, f):
+            if t is not None:
+                toks.append(int(t[0]) if isinstance(t, tuple) else int(t))
+            if f is not None:
+                done.set()
+
+        core.add_request("ref", list(range(1, 25)), SamplingParams(
+            max_tokens=8, temperature=0.0, ignore_eos=True), cb)
+        assert done.wait(180)
+        return toks
+    finally:
+        core.stop()
