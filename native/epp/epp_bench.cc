@@ -1,0 +1,291 @@
+// Load generator for tpu-stack-epp: Envoy's usage model — ONE ext-proc
+// stream per gateway HTTP request, two messages per stream
+// (request_headers, then request_body end_of_stream) — at C concurrent
+// in-flight streams, using the same h2grpc.h stack as the server (the
+// round-4 Python bench was bound by grpcio's client transport well
+// before the server's limit). Matches benchmarks/epp_bench.py semantics
+// message-for-message.
+//
+// Output: one JSON array of per-concurrency results on stdout
+// (BENCH_EPP_r*.json levels shape).
+
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cstring>
+#include <map>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "h2grpc.h"
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+std::string make_body(int user, int round) {
+  char buf[1024];
+  int n = snprintf(
+      buf, sizeof(buf),
+      "{\"model\":\"m\",\"messages\":[{\"role\":\"system\",\"content\":"
+      "\"You are a helpful benchmark assistant answering tersely. "
+      "Shared instructions pad this system prompt so prefix chunks "
+      "exist across users and rounds; the text keeps going to reach a "
+      "realistic OpenAI body size for the gateway data plane, including "
+      "policies, formatting guidance, and other boilerplate that "
+      "production system prompts accumulate over time.\"},"
+      "{\"role\":\"user\",\"content\":"
+      "\"user-%d question round %d: summarize the previous answer\"}]}",
+      user, round);
+  return std::string(buf, n);
+}
+
+std::string msg_request_headers() {
+  // ProcessingRequest{request_headers{headers{headers[{key,raw_value}]}}}
+  std::string hv;
+  h2::pb_bytes(&hv, 1, ":path");
+  h2::pb_bytes(&hv, 3, "/v1/chat/completions");
+  std::string hm;
+  h2::pb_bytes(&hm, 1, hv);
+  std::string hh;
+  h2::pb_bytes(&hh, 1, hm);
+  std::string req;
+  h2::pb_bytes(&req, 2, hh);
+  return req;
+}
+
+std::string msg_request_body(const std::string& body) {
+  std::string http_body;
+  h2::pb_bytes(&http_body, 1, body);
+  h2::pb_bool(&http_body, 2, true);  // end_of_stream
+  std::string req;
+  h2::pb_bytes(&req, 4, http_body);
+  return req;
+}
+
+struct Slot {
+  int user;
+  int remaining;
+  int round = 0;
+  int msgs_seen = 0;
+  Clock::time_point started;
+  h2::GrpcBuf grpc;
+};
+
+struct Result {
+  std::vector<double> lat_ms;
+  int picks = 0;
+};
+
+// One connection: `slots` concurrent stream-per-pick sequences.
+Result run_connection(const char* host, int port, int slots,
+                      int picks_per_slot, int conn_id) {
+  Result res;
+  int fd = h2::connect_to(host, port);
+  if (fd < 0) {
+    perror("connect");
+    return res;
+  }
+  h2::write_all(fd, h2::kPreface, h2::kPrefaceLen);
+  h2::write_frame(fd, h2::SETTINGS, 0, 0, "");
+
+  h2::SendWindows wins;
+  std::map<uint32_t, Slot> by_sid;  // live stream -> its slot state
+  uint32_t next_sid = 1;
+
+  auto open_pick = [&](Slot slot) {
+    uint32_t sid = next_sid;
+    next_sid += 2;
+    slot.msgs_seen = 0;
+    slot.started = Clock::now();
+    std::string block;
+    h2::hpack_literal(&block, ":method", "POST");
+    h2::hpack_literal(&block, ":scheme", "http");
+    h2::hpack_literal(&block, ":path",
+                      "/envoy.service.ext_proc.v3.ExternalProcessor/"
+                      "Process");
+    h2::hpack_literal(&block, ":authority", "localhost");
+    h2::hpack_literal(&block, "content-type", "application/grpc");
+    h2::hpack_literal(&block, "te", "trailers");
+    h2::write_frame(fd, h2::HEADERS, h2::END_HEADERS, sid, block);
+    std::string data = h2::grpc_frame(msg_request_headers()) +
+                       h2::grpc_frame(msg_request_body(
+                           make_body(slot.user, slot.round)));
+    slot.round++;
+    by_sid[sid] = slot;
+    wins.send_data(fd, sid, data, /*end_stream=*/true);
+  };
+
+  for (int s = 0; s < slots; s++) {
+    Slot slot;
+    slot.user = conn_id * slots + s;
+    slot.remaining = picks_per_slot;
+    open_pick(slot);
+  }
+
+  int open = slots;
+  int64_t recv_since_update = 0;
+
+  auto window_update = [&](uint32_t sid, uint32_t inc) {
+    std::string u(4, '\0');
+    u[0] = static_cast<char>((inc >> 24) & 0x7f);
+    u[1] = static_cast<char>((inc >> 16) & 0xff);
+    u[2] = static_cast<char>((inc >> 8) & 0xff);
+    u[3] = static_cast<char>(inc & 0xff);
+    h2::write_frame(fd, h2::WINDOW_UPDATE, 0, sid, u);
+  };
+
+  auto finish_stream = [&](uint32_t sid) {
+    auto it = by_sid.find(sid);
+    if (it == by_sid.end()) return;
+    Slot slot = it->second;
+    by_sid.erase(it);
+    if (slot.remaining > 0) {
+      open_pick(slot);
+    } else {
+      open--;
+    }
+  };
+
+  h2::Frame f;
+  while (open > 0 && h2::read_frame(fd, &f)) {
+    switch (f.type) {
+      case h2::SETTINGS: {
+        if (f.flags & h2::ACK) break;
+        for (size_t i = 0; i + 6 <= f.payload.size(); i += 6) {
+          uint16_t id = (uint8_t(f.payload[i]) << 8) |
+                        uint8_t(f.payload[i + 1]);
+          uint32_t val = (uint8_t(f.payload[i + 2]) << 24) |
+                         (uint8_t(f.payload[i + 3]) << 16) |
+                         (uint8_t(f.payload[i + 4]) << 8) |
+                         uint8_t(f.payload[i + 5]);
+          if (id == 4) wins.on_initial_window(static_cast<int32_t>(val));
+        }
+        h2::write_frame(fd, h2::SETTINGS, h2::ACK, 0, "");
+        wins.flush(fd);
+        break;
+      }
+      case h2::PING:
+        if (!(f.flags & h2::ACK))
+          h2::write_frame(fd, h2::PING, h2::ACK, 0, f.payload);
+        break;
+      case h2::WINDOW_UPDATE: {
+        if (f.payload.size() == 4) {
+          uint32_t inc = (uint8_t(f.payload[0]) << 24) |
+                         (uint8_t(f.payload[1]) << 16) |
+                         (uint8_t(f.payload[2]) << 8) |
+                         uint8_t(f.payload[3]);
+          wins.on_window_update(f.stream, inc & 0x7fffffffu);
+          wins.flush(fd);
+        }
+        break;
+      }
+      case h2::HEADERS:
+        if (f.flags & h2::END_STREAM) finish_stream(f.stream);
+        break;
+      case h2::DATA: {
+        auto it = by_sid.find(f.stream);
+        recv_since_update += static_cast<int64_t>(f.payload.size());
+        if (!f.payload.empty()) {
+          window_update(f.stream, static_cast<uint32_t>(f.payload.size()));
+          if (recv_since_update >= (1 << 14)) {
+            window_update(0, static_cast<uint32_t>(recv_since_update));
+            recv_since_update = 0;
+          }
+        }
+        if (it != by_sid.end()) {
+          Slot& slot = it->second;
+          slot.grpc.feed(f.payload);
+          std::string msg;
+          while (slot.grpc.next(&msg)) {
+            slot.msgs_seen++;
+            if (slot.msgs_seen == 2) {  // the body response = the pick
+              res.lat_ms.push_back(
+                  std::chrono::duration<double, std::milli>(
+                      Clock::now() - slot.started)
+                      .count());
+              res.picks++;
+              slot.remaining--;
+            }
+          }
+        }
+        if (f.flags & h2::END_STREAM) finish_stream(f.stream);
+        break;
+      }
+      case h2::RST_STREAM:
+        finish_stream(f.stream);
+        break;
+      case h2::GOAWAY:
+        open = 0;
+        break;
+      default:
+        break;
+    }
+  }
+  ::close(fd);
+  return res;
+}
+
+double pct(std::vector<double>& v, double p) {
+  if (v.empty()) return 0;
+  std::sort(v.begin(), v.end());
+  size_t i = static_cast<size_t>(p * (v.size() - 1));
+  return v[i];
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* host = "127.0.0.1";
+  int port = 9002;
+  int total_picks = 20000;
+  std::vector<int> levels = {1, 8, 32};
+  for (int i = 1; i < argc; i++) {
+    std::string arg = argv[i];
+    if (arg == "--host" && i + 1 < argc) host = argv[++i];
+    else if (arg == "--port" && i + 1 < argc) port = atoi(argv[++i]);
+    else if (arg == "--picks" && i + 1 < argc) total_picks = atoi(argv[++i]);
+  }
+
+  printf("[");
+  bool first = true;
+  for (int conc : levels) {
+    // concurrency = connections x in-flight streams; 4 streams/conn
+    // (Envoy multiplexes many ext-proc streams per upstream conn).
+    int conns = std::max(conc / 4, 1);
+    int slots = std::max(conc / conns, 1);
+    int per_slot = std::max(total_picks / (conns * slots), 1);
+
+    std::vector<Result> results(conns);
+    auto t0 = Clock::now();
+    std::vector<std::thread> threads;
+    for (int c = 0; c < conns; c++) {
+      threads.emplace_back([&, c]() {
+        results[c] = run_connection(host, port, slots, per_slot, c);
+      });
+    }
+    for (auto& t : threads) t.join();
+    double elapsed =
+        std::chrono::duration<double>(Clock::now() - t0).count();
+    std::vector<double> lat;
+    int picks = 0;
+    for (auto& r : results) {
+      picks += r.picks;
+      lat.insert(lat.end(), r.lat_ms.begin(), r.lat_ms.end());
+    }
+    if (!first) printf(",");
+    first = false;
+    printf(
+        "{\"concurrency\":%d,\"picks\":%d,\"picks_per_sec\":%.1f,"
+        "\"p50_ms\":%.3f,\"p99_ms\":%.3f,\"elapsed_s\":%.2f}",
+        conc, picks, picks / std::max(elapsed, 1e-9), pct(lat, 0.5),
+        pct(lat, 0.99), elapsed);
+    fflush(stdout);
+  }
+  printf("]\n");
+  return 0;
+}

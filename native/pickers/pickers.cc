@@ -266,4 +266,33 @@ uint64_t tpu_xxhash64(const char* data, size_t len) {
   return tpustack::xxhash64(data, len);
 }
 
+// Thread-safe variants writing into a caller buffer (the g_last_result
+// globals above serve the single-threaded ctypes router; a
+// multi-threaded caller — the native EPP — must not share them).
+// Return: result length (0 = no endpoint), or -1 if the buffer is too
+// small.
+static int copy_out(const std::string& s, char* out, size_t cap) {
+  if (s.size() + 1 > cap) return -1;
+  memcpy(out, s.data(), s.size());
+  out[s.size()] = '\0';
+  return static_cast<int>(s.size());
+}
+
+int tpu_picker_pick_roundrobin_buf(void* p, char* out, size_t cap) {
+  return copy_out(static_cast<Picker*>(p)->pick_roundrobin(), out, cap);
+}
+
+int tpu_picker_pick_prefix_buf(void* p, const char* text, size_t len,
+                               char* out, size_t cap) {
+  return copy_out(static_cast<Picker*>(p)->pick_prefix(text, len), out,
+                  cap);
+}
+
+int tpu_picker_pick_kv_buf(void* p, const char* text, size_t len,
+                           size_t* matched_chars, char* out, size_t cap) {
+  return copy_out(
+      static_cast<Picker*>(p)->pick_kv_aware(text, len, matched_chars),
+      out, cap);
+}
+
 }  // extern "C"
