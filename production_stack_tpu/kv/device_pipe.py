@@ -32,15 +32,40 @@ from production_stack_tpu.utils.log import init_logger
 
 logger = init_logger(__name__)
 
-_PROBE_CODE = r"""
-import jax, jax.numpy as jnp
+# The probe runs the REAL topology — offerer and puller in separate
+# processes (engines are separate processes; a same-process loopback
+# pull succeeds on runtimes whose cross-process transport is broken, so
+# probing loopback would steer engines onto a crashing path). Probing
+# the parent's backend explicitly closes the round-4 bug where the
+# subprocess picked the env-default backend (the tunneled TPU plugin)
+# even under a CPU mesh.
+_PROBE_OFFER = r"""
+import sys, time
+import jax
+if sys.argv[1] == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
 from jax.experimental import transfer
-client = jax.devices()[0].client
-s1 = transfer.start_transfer_server(client, "127.0.0.1:0")
-s2 = transfer.start_transfer_server(client, "127.0.0.1:0")
+srv = transfer.start_transfer_server(jax.devices()[0].client)
 x = jnp.arange(2048, dtype=jnp.bfloat16).reshape(2, 32, 32)
-s1.await_pull(1, [x])
-conn = s2.connect(s1.address())
+srv.await_pull(1, [x])
+with open(sys.argv[2], "w") as f:
+    f.write(srv.address())
+time.sleep(60)
+"""
+
+_PROBE_PULL = r"""
+import sys
+import jax
+if sys.argv[1] == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.experimental import transfer
+with open(sys.argv[2]) as f:
+    addr = f.read().strip()
+srv = transfer.start_transfer_server(jax.devices()[0].client)
+conn = srv.connect(addr)
+x = jnp.arange(2048, dtype=jnp.bfloat16).reshape(2, 32, 32)
 spec = jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
 out = conn.pull(1, [spec])
 assert bool(jnp.all(out[0] == x))
@@ -63,14 +88,39 @@ def device_pipe_available(timeout: float = 120.0) -> bool:
         return override not in ("0", "false", "off")
     with _probe_lock:
         if _probe_result is None:
+            offerer = None
             try:
-                proc = subprocess.run(
-                    [sys.executable, "-c", _PROBE_CODE],
-                    capture_output=True, timeout=timeout,
-                )
-                _probe_result = b"DEVICE_PIPE_OK" in proc.stdout
+                import tempfile
+
+                import jax
+
+                platform = jax.devices()[0].platform
+                with tempfile.TemporaryDirectory() as d:
+                    addr_file = os.path.join(d, "addr")
+                    offerer = subprocess.Popen(
+                        [sys.executable, "-c", _PROBE_OFFER, platform,
+                         addr_file],
+                        stdout=subprocess.DEVNULL,
+                        stderr=subprocess.DEVNULL,
+                    )
+                    deadline = time.monotonic() + timeout / 2
+                    while (not os.path.exists(addr_file)
+                           or not open(addr_file).read().strip()):
+                        if (offerer.poll() is not None
+                                or time.monotonic() > deadline):
+                            raise RuntimeError("probe offerer died")
+                        time.sleep(0.1)
+                    proc = subprocess.run(
+                        [sys.executable, "-c", _PROBE_PULL, platform,
+                         addr_file],
+                        capture_output=True, timeout=timeout,
+                    )
+                    _probe_result = b"DEVICE_PIPE_OK" in proc.stdout
             except Exception:  # noqa: BLE001 - treat as unavailable
                 _probe_result = False
+            finally:
+                if offerer is not None and offerer.poll() is None:
+                    offerer.kill()
             logger.info("KV device pipe %s",
                         "available" if _probe_result else
                         "unavailable (falling back to HTTP relay)")

@@ -216,3 +216,33 @@ def test_device_pipe_probe_is_crash_safe(monkeypatch):
     assert dp.device_pipe_available(timeout=180.0) in (True, False)
     # Cached on second call (no new subprocess): still answers.
     assert dp.device_pipe_available() in (True, False)
+
+
+def test_real_transfer_runtime_loopback_pull():
+    """The first RECORDED execution of jax.experimental.transfer in this
+    repo (round 5): a real transfer server, a real await_pull/pull pair,
+    real bytes through the runtime — same-process loopback, which is the
+    shape this CPU runtime supports (the cross-process topology aborts
+    in LocalBulkTransportFactory::RecvBulkTransport; see PARITY.md and
+    benchmarks/transfer_repro.py). Runs in a subprocess: transfer
+    failures can CHECK-abort the host process."""
+    import subprocess
+    import sys
+
+    code = r"""
+import jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.experimental import transfer
+srv = transfer.start_transfer_server(jax.devices()[0].client)
+x = jnp.arange(4096, dtype=jnp.bfloat16).reshape(4, 32, 32)
+srv.await_pull(11, [x])
+conn = srv.connect(srv.address())
+spec = jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+out = conn.pull(11, [spec])
+assert bool(jnp.all(out[0] == x))
+print("LOOPBACK_PULL_OK")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, timeout=180)
+    assert b"LOOPBACK_PULL_OK" in proc.stdout, (
+        proc.stdout[-500:], proc.stderr[-1500:])
