@@ -70,10 +70,15 @@ _CONFIGS = {
     # Pool pinned explicitly: int8 weights (~8.5 GB) + pool sit within
     # ~1 GB of the chip's usable HBM, and the auto-sizer's 0.7 margin
     # lands on the edge depending on residual allocator state.
+    # quantize_embeddings: random-init bench weights make head quality
+    # moot, and the ~1 GB embed/lm_head saving is what keeps the pool
+    # off the OOM edge (real checkpoints on roomier chips should prefer
+    # the bf16-head default).
     "llama8b": dict(model="meta-llama/Llama-3-8B", users=15, rounds=6,
                     answer_tokens=100, sys_prompt_tokens=1000,
                     history_tokens=2000, max_model_len=8192,
                     max_num_seqs=16, quantization="int8",
+                    quantize_embeddings=True,
                     prefill_chunk=1024, num_blocks=440),
     # OPT's (12 kv-heads, 64 head_dim) pages tile-pad 2.7x AND the page
     # scatter materializes a padded pool copy as an HLO temp (no lane
@@ -315,8 +320,15 @@ async def _main() -> dict:
         # fallback can't see the sibling engine's HBM footprint.
         num_blocks=_cfg.get("num_blocks"),
         quantization=_cfg.get("quantization"),
+        quantize_embeddings=bool(_cfg.get("quantize_embeddings", False)),
         prefill_chunk_size=_env_int(
             "BENCH_PREFILL_CHUNK", _cfg.get("prefill_chunk", 1024)),
+        # Storm-scoped batched prefill (round 5). BENCH_PREFILL_BATCH=1
+        # skips its warmup variants (CI's CPU smoke does: parity is
+        # covered by tests/test_prefill_batch.py, and 5 extra 1B-model
+        # compiles on a 1-core runner are minutes).
+        prefill_batch=_env_int(
+            "BENCH_PREFILL_BATCH", _cfg.get("prefill_batch", 4)),
     )
     servers = [EngineServer(config, warmup=True) for _ in range(n_engines)]
     runners, engine_urls = [], []

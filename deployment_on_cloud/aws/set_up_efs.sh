@@ -1,0 +1,78 @@
+#!/bin/bash
+# Provision EFS-backed shared model storage for the EKS tier and wire it
+# into the chart's `sharedStorage` values — the AWS counterpart of the
+# GCP Filestore/NFS leg (the reference does the same for its EKS GPU
+# tier: deployment_on_cloud/aws/set_up_efs.sh — EFS filesystem, mount
+# targets per subnet, an NFS security group, the EFS CSI driver, and a
+# ReadWriteMany StorageClass).
+#
+# Usage: ./set_up_efs.sh <CLUSTER_NAME> <REGION>
+# After it prints the filesystem id, install with:
+#   helm upgrade --install tpu-stack ../../helm -f values-eks-cpu.yaml \
+#     --set sharedStorage.enabled=true \
+#     --set sharedStorage.storageClass=efs-sc
+set -euo pipefail
+
+CLUSTER_NAME=${1:?usage: $0 <CLUSTER_NAME> <REGION>}
+REGION=${2:?usage: $0 <CLUSTER_NAME> <REGION>}
+EFS_NAME="${EFS_NAME:-production-stack-tpu-efs}"
+
+echo ">>> Looking up cluster networking"
+VPC_ID=$(aws eks describe-cluster --name "$CLUSTER_NAME" --region "$REGION" \
+  --query "cluster.resourcesVpcConfig.vpcId" --output text)
+read -r -a SUBNET_IDS <<< "$(aws eks describe-cluster --name "$CLUSTER_NAME" \
+  --region "$REGION" --query "cluster.resourcesVpcConfig.subnetIds" \
+  --output text)"
+CLUSTER_SG=$(aws eks describe-cluster --name "$CLUSTER_NAME" --region "$REGION" \
+  --query "cluster.resourcesVpcConfig.clusterSecurityGroupId" --output text)
+
+echo ">>> Creating NFS security group in $VPC_ID"
+EFS_SG_ID=$(aws ec2 create-security-group \
+  --group-name "${EFS_NAME}-sg" \
+  --description "Allow NFS from EKS nodes" \
+  --vpc-id "$VPC_ID" \
+  --query "GroupId" --output text --region "$REGION")
+aws ec2 authorize-security-group-ingress \
+  --group-id "$EFS_SG_ID" --protocol tcp --port 2049 \
+  --source-group "$CLUSTER_SG" --region "$REGION"
+
+echo ">>> Creating EFS filesystem"
+EFS_ID=$(aws efs create-file-system \
+  --region "$REGION" \
+  --performance-mode generalPurpose \
+  --throughput-mode bursting \
+  --encrypted \
+  --tags "Key=Name,Value=$EFS_NAME" \
+  --query "FileSystemId" --output text)
+aws efs wait file-system-available --file-system-id "$EFS_ID" --region "$REGION" 2>/dev/null || sleep 15
+
+echo ">>> Creating mount targets in every cluster subnet"
+for SUBNET in "${SUBNET_IDS[@]}"; do
+  aws efs create-mount-target \
+    --file-system-id "$EFS_ID" \
+    --subnet-id "$SUBNET" \
+    --security-groups "$EFS_SG_ID" \
+    --region "$REGION" || true   # one per AZ; duplicates are fine
+done
+
+echo ">>> Installing the EFS CSI driver"
+kubectl apply -k \
+  "github.com/kubernetes-sigs/aws-efs-csi-driver/deploy/kubernetes/overlays/stable/?ref=release-2.0"
+
+echo ">>> Creating the efs-sc StorageClass"
+kubectl apply -f - <<EOF
+apiVersion: storage.k8s.io/v1
+kind: StorageClass
+metadata:
+  name: efs-sc
+provisioner: efs.csi.aws.com
+parameters:
+  provisioningMode: efs-ap
+  fileSystemId: $EFS_ID
+  directoryPerms: "700"
+EOF
+
+echo ">>> Done. EFS filesystem: $EFS_ID"
+echo "Install the chart with:"
+echo "  helm upgrade --install tpu-stack ../../helm -f values-eks-cpu.yaml \\"
+echo "    --set sharedStorage.enabled=true --set sharedStorage.storageClass=efs-sc"
