@@ -1,0 +1,15 @@
+#!/bin/bash
+# Round-N config sweep: run every bench preset sequentially on the real
+# chip and collect one JSON row each into $OUT (BENCH_CONFIGS_r{N}.json
+# shape). Usage: OUT=/tmp/rows.jsonl ./benchmarks/run_configs.sh
+set -u
+OUT="${OUT:-/tmp/bench_rows.jsonl}"
+: > "$OUT"
+cd "$(dirname "$0")/.."
+for cfg in flagship llama3b llama8b opt kvaware disagg lora; do
+  echo ">>> $cfg" >&2
+  BENCH_CONFIG=$cfg timeout 2400 python bench.py \
+    2> "/tmp/bench_${cfg}.log" | tail -1 >> "$OUT"
+  echo "<<< $cfg rc=$?" >&2
+done
+cat "$OUT"
