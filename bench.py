@@ -73,12 +73,15 @@ _CONFIGS = {
     # quantize_embeddings: random-init bench weights make head quality
     # moot, and the ~1 GB embed/lm_head saving is what keeps the pool
     # off the OOM edge (real checkpoints on roomier chips should prefer
-    # the bf16-head default).
+    # the bf16-head default). prefill_batch=1: the 4-wide batched
+    # prefill programs add multi-GB activation/compile footprint that an
+    # 8 B model within ~1 GB of the 16 GB chip cannot afford (measured:
+    # all three round-5 attempts OOM'd at warmup with them on).
     "llama8b": dict(model="meta-llama/Llama-3-8B", users=15, rounds=6,
                     answer_tokens=100, sys_prompt_tokens=1000,
                     history_tokens=2000, max_model_len=8192,
                     max_num_seqs=16, quantization="int8",
-                    quantize_embeddings=True,
+                    quantize_embeddings=True, prefill_batch=1,
                     prefill_chunk=1024, num_blocks=440),
     # OPT's (12 kv-heads, 64 head_dim) pages tile-pad 2.7x AND the page
     # scatter materializes a padded pool copy as an HLO temp (no lane
@@ -248,6 +251,7 @@ async def _drive(router_url: str):
                         failures += 1
                         history.pop()
                         continue
+                    finish = None
                     async for line in resp.content:
                         line = line.decode().strip()
                         if not line.startswith("data: "):
@@ -256,8 +260,10 @@ async def _drive(router_url: str):
                         if data == "[DONE]":
                             break
                         chunk = json.loads(data)
-                        delta = chunk["choices"][0].get("delta", {})
-                        content = delta.get("content")
+                        choice = chunk["choices"][0]
+                        if choice.get("finish_reason"):
+                            finish = choice["finish_reason"]
+                        content = choice.get("delta", {}).get("content")
                         if content:
                             if first is None:
                                 first = time.perf_counter()
@@ -266,8 +272,15 @@ async def _drive(router_url: str):
                 failures += 1
                 history.pop()
                 continue
-            if first is not None:
-                ttfts.append(first - t0)
+            if first is None or finish == "error":
+                # Stream finished without content (engine-side error
+                # finish): a FAILED round — counting it as served once
+                # produced a nonsense 749 tok/s row from an engine that
+                # was ResourceExhausted the whole time.
+                failures += 1
+                history.pop()
+                continue
+            ttfts.append(first - t0)
             latencies.append(time.perf_counter() - t0)
             tokens_done += ANSWER_TOKENS
             rounds_done += 1
@@ -318,7 +331,8 @@ async def _main() -> dict:
             float(_cfg.get("kv_offload_gb", 0)) * 1e9),
         # Multi-engine configs size pools explicitly: the capacity
         # fallback can't see the sibling engine's HBM footprint.
-        num_blocks=_cfg.get("num_blocks"),
+        num_blocks=(_env_int("BENCH_NUM_BLOCKS", 0)
+                    or _cfg.get("num_blocks")),
         quantization=_cfg.get("quantization"),
         quantize_embeddings=bool(_cfg.get("quantize_embeddings", False)),
         prefill_chunk_size=_env_int(
@@ -439,6 +453,9 @@ async def _main() -> dict:
         "engine_decode_s": core_stats["decode_time_total"],
         "engine_flush_s": core_stats["flush_time_total"],
         "engine_prefills": core_stats["prefill_count"],
+        "engine_prefill_groups": core_stats.get("prefill_group_count", 0),
+        "engine_prefill_group_rows": core_stats.get(
+            "prefill_group_rows", 0),
         "engine_bursts": core_stats["decode_burst_count"],
         "engine_dispatches": core_stats["dispatch_count_total"],
         "engine_dispatch_enqueue_s": core_stats["dispatch_enqueue_s"],

@@ -2,7 +2,7 @@
 # Round-N config sweep: run every bench preset sequentially on the real
 # chip and collect one JSON row each into $OUT (BENCH_CONFIGS_r{N}.json
 # shape). Usage: OUT=/tmp/rows.jsonl ./benchmarks/run_configs.sh
-set -u
+set -u -o pipefail   # rc must reflect bench.py/timeout, not tail
 OUT="${OUT:-/tmp/bench_rows.jsonl}"
 : > "$OUT"
 cd "$(dirname "$0")/.."

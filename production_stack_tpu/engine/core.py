@@ -290,6 +290,11 @@ class EngineCore:
         self.decode_time_total = 0.0
         self.flush_time_total = 0.0
         self.prefill_count = 0
+        # Storm-scoped batched prefills: groups dispatched / prompts they
+        # carried (tail-latency diagnosis needs to know whether the storm
+        # path actually engaged).
+        self.prefill_group_count = 0
+        self.prefill_group_rows = 0
         self.decode_burst_count = 0
         self.dispatch_count_total = 0
         self.dispatch_enqueue_s = 0.0
@@ -1645,6 +1650,8 @@ class EngineCore:
             "decode_time_total": round(self.decode_time_total, 3),
             "flush_time_total": round(self.flush_time_total, 3),
             "prefill_count": self.prefill_count,
+            "prefill_group_count": self.prefill_group_count,
+            "prefill_group_rows": self.prefill_group_rows,
             "decode_burst_count": self.decode_burst_count,
             "dispatch_count_total": self.dispatch_count_total,
             "dispatch_enqueue_s": round(self.dispatch_enqueue_s, 3),
@@ -1902,19 +1909,51 @@ class EngineCore:
             req.scheduled_steps = len(req.output_token_ids)
         self.flush_time_total += time.perf_counter() - t0
 
+    def _cached_prefix_len(self, tokens: List[int],
+                           adapter: str = "") -> int:
+        """Read-only cached-prefix length estimate: walk the chain hashes
+        through the prefix map — and the offload tier's external_lookup,
+        which ``allocate_prompt`` also counts as cached — WITHOUT
+        allocating. Mirrors allocate_prompt's bound (never reuse past the
+        last token). Callers hold self._lock."""
+        from production_stack_tpu.engine.kvcache import BlockAllocator
+
+        bs = self.config.block_size
+        alloc = self.kv_mgr.allocator
+        ext = self.kv_mgr.external_lookup
+        parent = self.kv_mgr.chain_root(adapter)
+        i = 0
+        while i + bs <= len(tokens) - 1:
+            h = BlockAllocator.chain_hash(parent, tuple(tokens[i:i + bs]))
+            if h not in alloc.prefix_map and not (
+                    ext is not None and alloc.enable_prefix_caching
+                    and ext(h)):
+                break
+            parent = h
+            i += bs
+        return i
+
     def _qualifying_waiting(self) -> int:
         """How many WAITING requests would qualify for a prefill batch
-        row right now (long prompt, table within the batched programs'
-        cap) — the storm signal for storm-scoped batching."""
+        row right now — the storm signal for storm-scoped batching. The
+        qualifier is the UNCACHED span, not total length: at a ~97%
+        hit rate every follow-up round is long-but-cached, and counting
+        those opened the gate at steady state, padding chunk-wide rows
+        for tiny suffixes (measured as a p50/p99 TTFT regression)."""
         cfg = self.config
         chunk = cfg.prefill_chunk_size
         maxb_cap = self._prefill_batch_maxb()
         with self._lock:
-            return sum(
-                1 for cand in self.scheduler.waiting
-                if len(cand.all_token_ids) >= max(chunk // 2, 1)
-                and ((len(cand.all_token_ids) + cfg.block_size - 1)
-                     // cfg.block_size) <= maxb_cap)
+            n = 0
+            for cand in self.scheduler.waiting:
+                toks = cand.all_token_ids
+                if ((len(toks) + cfg.block_size - 1)
+                        // cfg.block_size) > maxb_cap:
+                    continue
+                cached = self._cached_prefix_len(toks, cand.adapter_name)
+                if len(toks) - cached >= max(chunk // 2, 1):
+                    n += 1
+            return n
 
     def _prefill_batch_maxb(self) -> int:
         """Widest block table the batched-prefill programs compile (64
@@ -1931,6 +1970,11 @@ class EngineCore:
         cfg = self.config
         chunk = cfg.prefill_chunk_size
         group = [{"req": req, "block_ids": block_ids, "cached": cached}]
+        # Candidates already walked and rejected this gather: the slot
+        # loop rescans the deque, and re-hashing a 3k-token prompt's
+        # chain per slot would stack milliseconds of host work onto the
+        # storm path this feature exists to shorten.
+        rejected: set = set()
         while len(group) < cfg.prefill_batch:
             with self._lock:
                 free_slots = sum(
@@ -1940,16 +1984,25 @@ class EngineCore:
                 nxt = None
                 maxb_cap = self._prefill_batch_maxb()
                 for cand in list(self.scheduler.waiting):
+                    if cand.request_id in rejected:
+                        continue
                     n_c = len(cand.all_token_ids)
-                    # Long uncached span only (short/cached follow-ups
-                    # would waste a chunk-wide row); the uncached length
-                    # is only known after allocation, so gate on total
-                    # length here and fall back below if it cache-hits.
+                    # Long UNCACHED span only (short/cached follow-ups
+                    # would waste a chunk-wide row): estimate the cached
+                    # prefix with a read-only chain walk — exact at
+                    # selection time; allocation below re-derives it
+                    # authoritatively.
                     blocks_c = (n_c + self.config.block_size - 1) \
                         // self.config.block_size
-                    if n_c >= max(chunk // 2, 1) and blocks_c <= maxb_cap:
+                    if blocks_c > maxb_cap:
+                        rejected.add(cand.request_id)
+                        continue
+                    cached_c = self._cached_prefix_len(
+                        cand.all_token_ids, cand.adapter_name)
+                    if n_c - cached_c >= max(chunk // 2, 1):
                         nxt = cand
                         break
+                    rejected.add(cand.request_id)
                 if nxt is None:
                     break
                 self.scheduler.waiting.remove(nxt)
@@ -1979,6 +2032,11 @@ class EngineCore:
         single-row path."""
         cfg = self.config
         chunk = cfg.prefill_chunk_size
+        self.prefill_group_count += 1
+        self.prefill_group_rows += len(group)
+        logger.info("Storm prefill batch engaged: %d prompts in one "
+                    "[%d, %d] dispatch chain", len(group),
+                    cfg.prefill_batch, chunk)
         spans: "dict[int, list]" = {}
         for m in group:
             n_m = len(m["req"].all_token_ids)
