@@ -85,13 +85,6 @@ class EngineCore:
         # leader's dispatches (see parallel/multihost.py; the reference
         # spans hosts with KubeRay — ref helm/templates/ray-cluster.yaml).
         self._mh = multihost.maybe_context()
-        if self._mh is not None and config.kv_remote_url:
-            raise ValueError(
-                "the remote KV cache tier is not supported in multi-host "
-                "mode (each host stages only its own page shards; the "
-                "cache server expects whole blocks) — host-RAM offload "
-                "(kv_offload_bytes) works: every process spills/restores "
-                "its addressable shards in lockstep")
 
         all_devices = list(devices if devices is not None else jax.devices())
         pp = max(config.pipeline_parallel_size, 1)
@@ -875,9 +868,29 @@ class EngineCore:
             self._pending_offload.clear()
             return
         if self._mh is not None:
-            for prefix_hash, bid in self._pending_offload:
-                self._dispatch("offload_block", {"hash": prefix_hash},
-                               [np.int32(bid)])
+            if self.config.kv_remote_url:
+                # Remote tier configured: the cache server stores WHOLE
+                # blocks, so spill through ONE replicated gather for all
+                # pending blocks (every process joins; only the leader
+                # host-reads and owns the store — offload accounting is
+                # leader-side host state, like the allocator's).
+                if self._mh.is_leader:
+                    bids = np.asarray(
+                        [bid for _, bid in self._pending_offload],
+                        np.int32)
+                    out = self._dispatch("gather_blocks", {}, [bids])
+                    k_all = np.asarray(jax.device_get(out[0]))
+                    v_all = np.asarray(jax.device_get(out[1]))
+                    for n, (prefix_hash, _) in enumerate(
+                            self._pending_offload):
+                        self.offload.put(prefix_hash, k_all[:, n],
+                                         v_all[:, n])
+            else:
+                # Host-RAM tier only: every process stages its own
+                # shards (no cross-host data movement).
+                for prefix_hash, bid in self._pending_offload:
+                    self._dispatch("offload_block", {"hash": prefix_hash},
+                                   [np.int32(bid)])
             self._pending_offload.clear()
             return
         k_pages, v_pages = self.kv
@@ -929,6 +942,23 @@ class EngineCore:
         if self._mh is not None:
             if self.offload is None:
                 return False
+            if self.config.kv_remote_url:
+                # Whole-block leader store (see _drain_offload): fetch
+                # every block host-side FIRST (fail before any
+                # collective dispatch on a miss), then install them all
+                # in one batched write_blocks op.
+                entries = []
+                for _, h in restores:
+                    entry = self.offload.get(h)
+                    if entry is None:
+                        return False
+                    entries.append(entry)
+                self._dispatch(
+                    "write_blocks", {},
+                    [np.asarray([bid for bid, _ in restores], np.int32),
+                     np.stack([k for k, _ in entries], axis=1),
+                     np.stack([v for _, v in entries], axis=1)])
+                return True
             # contains() first: a miss must NOT turn into a collective
             # dispatch half the processes cannot serve.
             if not all(self.offload.contains(h) for _, h in restores):

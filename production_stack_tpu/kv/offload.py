@@ -219,6 +219,7 @@ class HostKVStore:
         # the allocator's eviction hook, under engine locks). Bounded queue:
         # under pressure we drop uploads (cache, not correctness).
         self._remote_queue: "list[Tuple[int, bytes]]" = []
+        self._remote_inflight = 0
         self._remote_cv = threading.Condition()
         self._writer: Optional[threading.Thread] = None
         if self.remote is not None:
@@ -242,16 +243,24 @@ class HostKVStore:
                 while not self._remote_queue:
                     self._remote_cv.wait()
                 prefix_hash, data = self._remote_queue.pop(0)
-            self.remote.put(prefix_hash, data)
+                self._remote_inflight += 1
+            try:
+                self.remote.put(prefix_hash, data)
+            finally:
+                with self._remote_cv:
+                    self._remote_inflight -= 1
+                    self._remote_cv.notify_all()
 
     def flush_remote(self, timeout: float = 10.0) -> None:
-        """Wait for queued remote uploads to drain (tests/shutdown)."""
+        """Wait for queued AND in-flight remote uploads to drain
+        (tests/shutdown): the writer pops before it PUTs, so an empty
+        queue alone does not mean the last upload landed."""
         import time as _time
 
         deadline = _time.time() + timeout
         while _time.time() < deadline:
             with self._remote_cv:
-                if not self._remote_queue:
+                if not self._remote_queue and not self._remote_inflight:
                     return
             _time.sleep(0.02)
 

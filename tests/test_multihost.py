@@ -432,3 +432,137 @@ def _single_process_reference_prompt48():
         return toks
     finally:
         core.stop()
+
+
+# ---- round 5: multi-host REMOTE cache tier (whole-block leader mode) ----
+_REMOTE_WORKER = r"""
+import os, sys, json, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.setdefault("TPU_STACK_LOG_LEVEL", "WARNING")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from production_stack_tpu.parallel import multihost
+
+env = multihost.initialize_from_env()
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.core import EngineCore
+from production_stack_tpu.engine.sampling import SamplingParams
+
+config = EngineConfig(
+    model="tiny-llama", max_model_len=128, max_num_seqs=2,
+    block_size=8, num_blocks=64, max_loras=0,
+    tensor_parallel_size=2, pipeline_parallel_size=2, decode_steps=4,
+    # Whole-block leader offload: ~zero host-RAM capacity forces every
+    # spill straight to the remote cache server.
+    kv_offload_bytes=1,
+    kv_remote_url=os.environ["TPU_STACK_TEST_REMOTE_URL"],
+)
+core = EngineCore(config)
+if env["process_id"] != 0:
+    core.run_follower()
+    sys.exit(0)
+
+import threading
+
+def serve(rid, ids, n=4):
+    done = threading.Event(); toks = []
+    def cb(t, f):
+        if t is not None:
+            toks.append(int(t[0]) if isinstance(t, tuple) else int(t))
+        if f is not None:
+            done.set()
+    core.add_request(rid, ids, SamplingParams(
+        max_tokens=n, temperature=0.0, ignore_eos=True), cb)
+    assert done.wait(180), rid
+    return toks
+
+core.start()
+prompt = list(range(1, 20))
+serve("warm", prompt, n=1)
+
+# Spill a cached block to the remote tier through the replicated gather.
+with core._lock:
+    h, bid = next(iter(core.kv_mgr.allocator.prefix_map.items()))
+before = core.extract_kv(prompt[:8])
+assert before is not None and before["num_tokens"] >= 8
+core._offload_block(h, bid)
+with core._step_lock:
+    core._drain_offload()
+core.offload.flush_remote()
+assert core.offload.remote.contains(h), "block not on the cache server"
+
+# Poison the HBM pages, then restore from the remote tier.
+zero = np.zeros_like(np.asarray(before["k"][0], np.float32))
+core._dispatch("write_block", {}, [np.int32(bid), zero, zero])
+with core._step_lock:
+    ok = core._restore_blocks([(bid, h)])
+assert ok, "remote restore failed"
+after = core.extract_kv(prompt[:8])
+roundtrip = bool(
+    after is not None
+    and np.allclose(np.asarray(after["k"], np.float32)[0],
+                    np.asarray(before["k"], np.float32)[0], atol=1e-5))
+core.stop()
+print("RESULT " + json.dumps({"roundtrip": roundtrip}), flush=True)
+"""
+
+
+def test_multihost_remote_cache_tier(tmp_path):
+    import json as _json
+    import subprocess as _sp
+    import time as _time
+    import urllib.request
+
+    cache_port = _free_port_pair()
+    srv = _sp.Popen(
+        [sys.executable, "-m", "production_stack_tpu.kv.cache_server",
+         "--port", str(cache_port), "--capacity-gb", "1"],
+        stdout=_sp.DEVNULL, stderr=_sp.DEVNULL)
+    try:
+        deadline = _time.time() + 30
+        while True:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{cache_port}/health", timeout=1)
+                break
+            except Exception:  # noqa: BLE001
+                if _time.time() > deadline:
+                    raise
+                _time.sleep(0.2)
+        port = _free_port_pair()
+        env_extra = {
+            "TPU_STACK_TEST_REMOTE_URL": f"http://127.0.0.1:{cache_port}"}
+        procs = []
+        for pid in (0, 1):
+            env = {k: v for k, v in os.environ.items()
+                   if k != "PYTHONPATH"}
+            env.update({
+                "TPU_STACK_COORDINATOR": f"127.0.0.1:{port}",
+                "TPU_STACK_NUM_PROCESSES": "2",
+                "TPU_STACK_PROCESS_ID": str(pid),
+                "TPU_STACK_OP_TOKEN": "test-op-token",
+                **env_extra,
+            })
+            procs.append(_sp.Popen(
+                [sys.executable, "-c", _REMOTE_WORKER], env=env,
+                stdout=_sp.PIPE, stderr=_sp.STDOUT))
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=420)
+                outs.append(out.decode())
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, out[-4000:]
+        line = next(ln for ln in outs[0].splitlines()
+                    if ln.startswith("RESULT "))
+        got = _json.loads(line[len("RESULT "):])
+        assert got["roundtrip"] is True
+    finally:
+        srv.terminate()
+        srv.wait(timeout=10)
