@@ -225,3 +225,147 @@ def test_native_epp_completions_prompt(native_epp):
     dest = _dest(_openai_exchange(pb2, stub, {
         "model": "m", "prompt": "complete me " * 20})[1])
     assert dest in ("10.0.0.4:8000", "10.0.0.5:8000")
+
+
+def _h2_frame(ftype, flags, stream, payload=b""):
+    n = len(payload)
+    return (bytes([(n >> 16) & 0xff, (n >> 8) & 0xff, n & 0xff,
+                   ftype, flags,
+                   (stream >> 24) & 0x7f, (stream >> 16) & 0xff,
+                   (stream >> 8) & 0xff, stream & 0xff]) + payload)
+
+
+def _hpack_lit(name, value):
+    out = b"\x00"
+    out += bytes([len(name)]) + name
+    out += bytes([len(value)]) + value
+    return out
+
+
+def _native_epp_proc(port):
+    import subprocess
+
+    return subprocess.Popen(
+        [_EPP_BIN, "--port", str(port),
+         "--endpoints", "10.0.0.4:8000"],
+        stderr=subprocess.PIPE)
+
+
+def _wait_port(port, timeout=10):
+    import socket as _socket
+    import time as _time
+
+    deadline = _time.time() + timeout
+    while _time.time() < deadline:
+        try:
+            _socket.create_connection(("127.0.0.1", port), 0.2).close()
+            return
+        except OSError:
+            _time.sleep(0.05)
+    raise TimeoutError
+
+
+def test_native_epp_hardening_edges():
+    """Raw-socket pins for the review-driven hardening: a client that
+    opens with SETTINGS INITIAL_WINDOW_SIZE=0 and raises it later still
+    gets its response (flush on SETTINGS); a deeply nested JSON body and
+    an absurd gRPC length are rejected without killing the server."""
+    import socket as _socket
+    import struct
+    import time as _time
+
+    if not os.path.exists(_EPP_BIN):
+        pytest.skip("tpu-stack-epp not built")
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = _native_epp_proc(port)
+    try:
+        _wait_port(port)
+
+        def connect(settings_payload=b""):
+            c = _socket.create_connection(("127.0.0.1", port), 5)
+            c.settimeout(5)
+            c.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
+            c.sendall(_h2_frame(0x4, 0, 0, settings_payload))
+            return c
+
+        def open_stream(c, sid):
+            block = (_hpack_lit(b":method", b"POST")
+                     + _hpack_lit(b":path", b"/x")
+                     + _hpack_lit(b"content-type", b"application/grpc"))
+            c.sendall(_h2_frame(0x1, 0x4, sid, block))
+
+        def grpc_body_msg(body: bytes) -> bytes:
+            # ProcessingRequest{request_body{body, end_of_stream=true}}
+            http_body = (b"\x0a" + _varint(len(body)) + body
+                         + b"\x10\x01")
+            msg = b"\x22" + _varint(len(http_body)) + http_body
+            return b"\x00" + struct.pack(">I", len(msg)) + msg
+
+        def _varint(v):
+            out = b""
+            while v >= 0x80:
+                out += bytes([(v & 0x7f) | 0x80])
+                v >>= 7
+            return out + bytes([v])
+
+        # 1) window-0 open, then raise: the queued response must flush.
+        c = connect(settings_payload=struct.pack(">HI", 4, 0))
+        open_stream(c, 1)
+        c.sendall(_h2_frame(0x0, 0, 1, grpc_body_msg(b'{"prompt":"hi"}')))
+        _time.sleep(0.3)
+        c.sendall(_h2_frame(0x4, 0, 0, struct.pack(">HI", 4, 65535)))
+        got = c.recv(65536)
+        deadline = _time.time() + 5
+        while b"x-gateway-destination-endpoint" not in got:
+            if _time.time() > deadline:
+                raise AssertionError("no response after window raise")
+            got += c.recv(65536)
+        c.close()
+
+        # 2) nesting bomb: parsed safely (empty prompt -> roundrobin
+        # pick), server stays alive.
+        c = connect()
+        open_stream(c, 1)
+        bomb = b"[" * 5000 + b"]" * 5000
+        c.sendall(_h2_frame(0x0, 0, 1, grpc_body_msg(bomb)))
+        got = b""
+        deadline = _time.time() + 5
+        while b"10.0.0.4:8000" not in got:
+            if _time.time() > deadline:
+                raise AssertionError("no pick after nesting bomb")
+            got += c.recv(65536)
+        c.close()
+
+        # 3) absurd claimed gRPC message length: connection dropped,
+        # process survives.
+        c = connect()
+        open_stream(c, 1)
+        c.sendall(_h2_frame(
+            0x0, 0, 1, b"\x00" + struct.pack(">I", 1 << 30) + b"x"))
+        _time.sleep(0.3)
+        try:
+            c.settimeout(3)
+            while c.recv(65536):
+                pass
+        except OSError:
+            pass
+        c.close()
+        assert proc.poll() is None, "EPP died on hostile input"
+
+        # Server still serves a normal pick afterwards.
+        c = connect()
+        open_stream(c, 1)
+        c.sendall(_h2_frame(0x0, 0, 1, grpc_body_msg(b'{"prompt":"ok"}')))
+        got = b""
+        deadline = _time.time() + 5
+        while b"10.0.0.4:8000" not in got:
+            if _time.time() > deadline:
+                raise AssertionError("no pick after hostile clients")
+            got += c.recv(65536)
+        c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
