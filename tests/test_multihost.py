@@ -566,3 +566,74 @@ def test_multihost_remote_cache_tier(tmp_path):
     finally:
         srv.terminate()
         srv.wait(timeout=10)
+
+
+def test_multihost_engine_server_http(tmp_path):
+    """Server-level glue (tutorial 17 §3): two real engine.server
+    processes form the mesh; the leader serves the OpenAI surface and
+    reports the span on /health, the follower serves bare /health."""
+    import json as _json
+    import subprocess as _sp
+    import time as _time
+    import urllib.request
+
+    port = _free_port_pair()
+    http0 = _free_port_pair()
+    http1 = _free_port_pair()
+    procs = []
+    for pid, http in ((0, http0), (1, http1)):
+        env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "TPU_STACK_NUM_PROCESSES": "2",
+            "TPU_STACK_COORDINATOR": f"127.0.0.1:{port}",
+            "TPU_STACK_PROCESS_ID": str(pid),
+            "TPU_STACK_OP_TOKEN": "test-op-token",
+        })
+        procs.append(_sp.Popen(
+            [sys.executable, "-m", "production_stack_tpu.engine.server",
+             "tiny-llama", "--port", str(http), "--max-model-len", "128",
+             "--num-blocks", "64", "--no-warmup",
+             "--tensor-parallel-size", "2",
+             "--pipeline-parallel-size", "2"],
+            env=env, stdout=_sp.DEVNULL, stderr=_sp.STDOUT))
+    try:
+        deadline = _time.time() + 180
+        health = None
+        while _time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{http0}/health",
+                        timeout=2) as r:
+                    health = _json.load(r)
+                break
+            except Exception:  # noqa: BLE001
+                for p in procs:
+                    assert p.poll() is None, "server died during join"
+                _time.sleep(0.5)
+        assert health and health["status"] == "ok", health
+        assert health.get("role") == "leader"
+        assert health.get("num_processes") == 2
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http0}/v1/completions",
+            data=_json.dumps({"model": "tiny-llama", "prompt": "hi",
+                              "max_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            body = _json.load(r)
+        assert body["choices"][0]["finish_reason"] == "length"
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{http1}/health", timeout=5) as r:
+            follower = _json.load(r)
+        assert follower.get("role") == "follower", follower
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except Exception:  # noqa: BLE001
+                p.kill()
