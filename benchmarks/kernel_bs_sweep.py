@@ -13,7 +13,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.abspath(
     os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")))
@@ -27,27 +26,11 @@ from production_stack_tpu.ops.pallas_paged_attention import (  # noqa: E402
     pallas_paged_attention,
 )
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from timing import timed_per_call  # noqa: E402
+
 B = 16
 CTX = int(os.environ.get("CHECK_CTX", "3000"))
-N1, N2 = 2, 12
-
-
-def timed_per_call(fn, *args) -> float:
-    """Per-invocation device time via pipelined differencing (see module
-    docstring)."""
-    out = fn(*args)
-    np.asarray(out[0, 0])  # compile + force real completion
-    walls = {}
-    for n in (N1, N2, N1, N2):  # interleave to average drift
-        t0 = time.perf_counter()
-        last = None
-        for _ in range(n):
-            last = fn(*args)
-        np.asarray(last[0, 0])
-        walls.setdefault(n, []).append(time.perf_counter() - t0)
-    w1 = min(walls[N1])
-    w2 = min(walls[N2])
-    return (w2 - w1) / (N2 - N1)
 
 
 def main():

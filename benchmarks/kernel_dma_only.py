@@ -10,7 +10,6 @@ import functools
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.abspath(
     os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")))
@@ -21,9 +20,11 @@ import numpy as np  # noqa: E402
 from jax.experimental import pallas as pl  # noqa: E402
 from jax.experimental.pallas import tpu as pltpu  # noqa: E402
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from timing import timed_per_call  # noqa: E402
+
 B, MAXB, NB, CTX = 16, 64, 843, 3000
 L, bs, KVH, D = 16, 64, 8, 128
-N1, N2 = 2, 12
 
 
 def _dma_kernel(bt_ref, cl_ref, layer_ref, k_hbm, v_hbm, o_ref,
@@ -98,20 +99,6 @@ def dma_only(k_pages, v_pages, bt, cl, layer, *, pages_per_block=8):
         out_shape=jax.ShapeDtypeStruct((8, D), jnp.float32),
     )(bt.astype(jnp.int32), cl.astype(jnp.int32),
       jnp.asarray(layer, jnp.int32).reshape(1), k_pages, v_pages)
-
-
-def timed_per_call(fn, *args):
-    out = fn(*args)
-    np.asarray(out[0, 0])
-    walls = {}
-    for n in (N1, N2, N1, N2):
-        t0 = time.perf_counter()
-        last = None
-        for _ in range(n):
-            last = fn(*args)
-        np.asarray(last[0, 0])
-        walls.setdefault(n, []).append(time.perf_counter() - t0)
-    return (min(walls[N2]) - min(walls[N1])) / (N2 - N1)
 
 
 def main():

@@ -9,7 +9,6 @@ import functools
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.abspath(
     os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")))
@@ -20,10 +19,12 @@ import numpy as np  # noqa: E402
 from jax.experimental import pallas as pl  # noqa: E402
 from jax.experimental.pallas import tpu as pltpu  # noqa: E402
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from timing import timed_per_call  # noqa: E402
+
 B, MAXB, NB, CTX = 16, 64, 843, 3000
 L, bs, KVH, D = 16, 64, 8, 128
 G = 8  # padded head group rows
-N1, N2 = 2, 12
 RING = 4
 
 
@@ -148,20 +149,6 @@ def build(mode, P=8):
             body, jnp.zeros((8,), jnp.float32), jnp.arange(L))
         return out.reshape(1, 8)
     return run
-
-
-def timed_per_call(fn, *args):
-    out = fn(*args)
-    np.asarray(out[0, 0])
-    walls = {}
-    for n in (N1, N2, N1, N2):
-        t0 = time.perf_counter()
-        last = None
-        for _ in range(n):
-            last = fn(*args)
-        np.asarray(last[0, 0])
-        walls.setdefault(n, []).append(time.perf_counter() - t0)
-    return (min(walls[N2]) - min(walls[N1])) / (N2 - N1)
 
 
 def main():

@@ -131,12 +131,8 @@ Result run_connection(const char* host, int port, int slots,
   int64_t recv_since_update = 0;
 
   auto window_update = [&](uint32_t sid, uint32_t inc) {
-    std::string u(4, '\0');
-    u[0] = static_cast<char>((inc >> 24) & 0x7f);
-    u[1] = static_cast<char>((inc >> 16) & 0xff);
-    u[2] = static_cast<char>((inc >> 8) & 0xff);
-    u[3] = static_cast<char>(inc & 0xff);
-    h2::write_frame(fd, h2::WINDOW_UPDATE, 0, sid, u);
+    h2::write_frame(fd, h2::WINDOW_UPDATE, 0, sid,
+                    h2::window_update_payload(inc));
   };
 
   auto finish_stream = [&](uint32_t sid) {
@@ -156,15 +152,7 @@ Result run_connection(const char* host, int port, int slots,
     switch (f.type) {
       case h2::SETTINGS: {
         if (f.flags & h2::ACK) break;
-        for (size_t i = 0; i + 6 <= f.payload.size(); i += 6) {
-          uint16_t id = (uint8_t(f.payload[i]) << 8) |
-                        uint8_t(f.payload[i + 1]);
-          uint32_t val = (uint8_t(f.payload[i + 2]) << 24) |
-                         (uint8_t(f.payload[i + 3]) << 16) |
-                         (uint8_t(f.payload[i + 4]) << 8) |
-                         uint8_t(f.payload[i + 5]);
-          if (id == 4) wins.on_initial_window(static_cast<int32_t>(val));
-        }
+        h2::apply_settings(f.payload, &wins);
         h2::write_frame(fd, h2::SETTINGS, h2::ACK, 0, "");
         wins.flush(fd);
         break;

@@ -438,6 +438,19 @@ void send_trailers(int fd, uint32_t sid) {
                   h2::END_HEADERS | h2::END_STREAM, sid, block);
 }
 
+// End a stream the gRPC way: response headers (if not yet sent) then
+// grpc-status trailers, and drop its state.
+void close_stream(int fd, uint32_t sid,
+                  std::map<uint32_t, StreamState>& streams) {
+  StreamState& st = streams[sid];
+  if (!st.sent_headers) {
+    send_response_headers(fd, sid);
+    st.sent_headers = true;
+  }
+  send_trailers(fd, sid);
+  streams.erase(sid);
+}
+
 void serve_connection(int fd) {
   char preface[h2::kPrefaceLen];
   if (!h2::read_exact(fd, preface, h2::kPrefaceLen) ||
@@ -460,16 +473,7 @@ void serve_connection(int fd) {
     switch (f.type) {
       case h2::SETTINGS: {
         if (f.flags & h2::ACK) break;
-        // Parse INITIAL_WINDOW_SIZE (id 4) — it rebases stream windows.
-        for (size_t i = 0; i + 6 <= f.payload.size(); i += 6) {
-          uint16_t id = (uint8_t(f.payload[i]) << 8) |
-                        uint8_t(f.payload[i + 1]);
-          uint32_t val = (uint8_t(f.payload[i + 2]) << 24) |
-                         (uint8_t(f.payload[i + 3]) << 16) |
-                         (uint8_t(f.payload[i + 4]) << 8) |
-                         uint8_t(f.payload[i + 5]);
-          if (id == 4) wins.on_initial_window(static_cast<int32_t>(val));
-        }
+        h2::apply_settings(f.payload, &wins);
         h2::write_frame(fd, h2::SETTINGS, h2::ACK, 0, "");
         // A raised INITIAL_WINDOW_SIZE can unblock queued DATA (a client
         // may legally open with window 0 and enable flow later).
@@ -495,15 +499,10 @@ void serve_connection(int fd) {
       case h2::CONTINUATION: {
         // Header blocks are skipped wholesale (see h2grpc.h): every
         // client stream is a Process call. Only the flags matter.
-        StreamState& st = streams[f.stream];
-        if (f.flags & h2::END_STREAM) {
-          if (!st.sent_headers) {
-            send_response_headers(fd, f.stream);
-            st.sent_headers = true;
-          }
-          send_trailers(fd, f.stream);
-          streams.erase(f.stream);
-        }
+        if (f.flags & h2::END_STREAM)
+          close_stream(fd, f.stream, streams);
+        else
+          streams[f.stream];  // ensure stream state exists
         break;
       }
       case h2::DATA: {
@@ -517,23 +516,15 @@ void serve_connection(int fd) {
                      ? payload.size() - 1 - pad : 0);
         }
         // Replenish receive windows promptly (clients block on them).
-        auto upd_bytes = [](uint32_t inc) {
-          std::string u(4, '\0');
-          u[0] = static_cast<char>((inc >> 24) & 0x7f);
-          u[1] = static_cast<char>((inc >> 16) & 0xff);
-          u[2] = static_cast<char>((inc >> 8) & 0xff);
-          u[3] = static_cast<char>(inc & 0xff);
-          return u;
-        };
         recv_since_update += static_cast<int64_t>(f.payload.size());
         if (!f.payload.empty()) {
           h2::write_frame(fd, h2::WINDOW_UPDATE, 0, f.stream,
-                          upd_bytes(static_cast<uint32_t>(
-                              f.payload.size())));
+                          h2::window_update_payload(
+                              static_cast<uint32_t>(f.payload.size())));
           if (recv_since_update >= (1 << 14)) {
             h2::write_frame(fd, h2::WINDOW_UPDATE, 0, 0,
-                            upd_bytes(static_cast<uint32_t>(
-                                recv_since_update)));
+                            h2::window_update_payload(
+                                static_cast<uint32_t>(recv_since_update)));
             recv_since_update = 0;
           }
         }
@@ -577,14 +568,8 @@ void serve_connection(int fd) {
             return;
           }
         }
-        if (f.flags & h2::END_STREAM) {
-          if (!st.sent_headers) {
-            send_response_headers(fd, f.stream);
-            st.sent_headers = true;
-          }
-          send_trailers(fd, f.stream);
-          streams.erase(f.stream);
-        }
+        if (f.flags & h2::END_STREAM)
+          close_stream(fd, f.stream, streams);
         break;
       }
       case h2::RST_STREAM:

@@ -275,6 +275,22 @@ struct GrpcBuf {
   }
 };
 
+// ---- small frame-payload helpers (shared by server and bench) ---------
+inline std::string window_update_payload(uint32_t inc) {
+  std::string u(4, '\0');
+  u[0] = static_cast<char>((inc >> 24) & 0x7f);
+  u[1] = static_cast<char>((inc >> 16) & 0xff);
+  u[2] = static_cast<char>((inc >> 8) & 0xff);
+  u[3] = static_cast<char>(inc & 0xff);
+  return u;
+}
+
+// Apply a SETTINGS payload to the send windows (only
+// INITIAL_WINDOW_SIZE, id 4, affects them) and return true so callers
+// can chain the ACK + flush.
+inline void apply_settings(const std::string& payload,
+                           struct SendWindows* wins);
+
 // ---- flow-controlled sender ------------------------------------------
 // Tracks peer windows and queues DATA that does not fit. HEADERS /
 // trailers are not flow-controlled and bypass the queue.
@@ -341,6 +357,18 @@ struct SendWindows {
     for (auto& kv : stream) kv.second += delta;
   }
 };
+
+inline void apply_settings(const std::string& payload,
+                           SendWindows* wins) {
+  for (size_t i = 0; i + 6 <= payload.size(); i += 6) {
+    uint16_t id = (uint8_t(payload[i]) << 8) | uint8_t(payload[i + 1]);
+    uint32_t val = (uint8_t(payload[i + 2]) << 24) |
+                   (uint8_t(payload[i + 3]) << 16) |
+                   (uint8_t(payload[i + 4]) << 8) |
+                   uint8_t(payload[i + 5]);
+    if (id == 4) wins->on_initial_window(static_cast<int32_t>(val));
+  }
+}
 
 inline int listen_on(int port) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
