@@ -101,3 +101,46 @@ def test_batched_prefill_under_slot_pressure():
     finally:
         core1.stop()
     assert got == want
+
+
+def test_storm_gate_counts_uncached_spans_only():
+    """The storm signal must count waiters by UNCACHED span: at a high
+    hit rate every follow-up round is long-but-cached, and counting
+    those opened the gate at steady state (round-5 regression)."""
+    import threading
+
+    from production_stack_tpu.engine.scheduler import EngineRequest
+
+    core = EngineCore(_config(prefill_batch=4))
+    try:
+        core.start()
+        # Warm the cache with a long prompt.
+        done = threading.Event()
+        warm = list(range(1, 200))
+
+        def cb(t, f):
+            if f is not None:
+                done.set()
+
+        core.add_request("warm", warm, SamplingParams(
+            max_tokens=2, temperature=0.0, ignore_eos=True), cb)
+        assert done.wait(120)
+
+        def fake_wait(rid, ids):
+            return EngineRequest(
+                request_id=rid, prompt_token_ids=ids,
+                sampling=SamplingParams(max_tokens=1),
+                on_token=lambda t, f: None)
+
+        with core._lock:
+            # A fully-warm long prompt (cached follow-up) and a cold
+            # long prompt: only the cold one is a storm qualifier
+            # (chunk=64 -> uncached span must be >= 32).
+            core.scheduler.waiting.append(fake_wait("cached", warm))
+            core.scheduler.waiting.append(
+                fake_wait("cold", list(range(1000, 1199))))
+        assert core._qualifying_waiting() == 1
+        with core._lock:
+            core.scheduler.waiting.clear()
+    finally:
+        core.stop()
