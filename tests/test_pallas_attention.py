@@ -117,3 +117,23 @@ def test_chunked_context_prefill_matches_einsum(monkeypatch):
         scale=0.11)
     np.testing.assert_allclose(
         np.asarray(got_ragged), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_ring_crosses_many_short_sequences():
+    """v3's prefetch window is indexed by a GLOBAL grid step, so with
+    single-chunk sequences the depth-6 ring spans six DIFFERENT
+    sequences at once — mixed tiny/ragged contexts must still match the
+    reference exactly (exercises ring wraparound + the same-predicate
+    issue/wait pairing at every boundary)."""
+    B, H, KVH, D, L, bs, MAXB = 8, 16, 8, 128, 2, 16, 8
+    NB = B * MAXB + 2
+    q, k_pages, v_pages, tables, _ = _setup(B, H, KVH, D, L, NB, bs, MAXB)
+    ctx = jnp.asarray([1, 16, 5, 128, 64, 2, 33, 100], jnp.int32)
+    for layer in (0, L - 1):
+        ref = paged_attention_reference(
+            q, k_pages, v_pages, tables, ctx, jnp.int32(layer), scale=0.1)
+        got = pallas_paged_attention(
+            q, k_pages, v_pages, tables, ctx, jnp.int32(layer),
+            scale=0.1, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-3, atol=2e-3)
