@@ -474,6 +474,27 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    elif ("cpu" not in os.environ.get("JAX_PLATFORMS", "")
+          and os.path.isdir("/root/.axon_site")):
+        # The axon TPU plugin HANGS jax.devices() indefinitely when its
+        # tunnel process is gone (it died mid-round-5 and never
+        # returned). The plugin auto-registers via sitecustomize whether
+        # or not JAX_PLATFORMS is set, so probe the tunnel's compile
+        # port whenever the plugin is present and cpu isn't forced —
+        # a dead tunnel then records a fast, diagnosable failure
+        # instead of a hang.
+        import socket
+
+        try:
+            socket.create_connection(("127.0.0.1", 8103), 5).close()
+        except OSError:
+            print(json.dumps({
+                "metric": "multi_round_qa_gen_throughput",
+                "value": None, "unit": "tok/s", "vs_baseline": None,
+                "error": "axon TPU tunnel is down (port 8103 refused) — "
+                         "the backend would hang; see BASELINE.md round-5 "
+                         "notes"}))
+            raise SystemExit(3)
     import jax
 
     try:
