@@ -300,3 +300,33 @@ def test_multihost_op_token_secret_renders():
     ref = env["TPU_STACK_OP_TOKEN"]["valueFrom"]["secretKeyRef"]
     assert ref["name"] == secrets[0]["metadata"]["name"]
     assert ref["key"] == "token"
+
+
+def test_multihost_disagg_example_composes():
+    """values-08: BASELINE config 4 at its stated size — TWO multi-host
+    units (prefill + decode StatefulSets with op-token secrets) behind
+    the disaggregated-prefill router."""
+    example = os.path.join(
+        CHART, "examples", "values-08-multihost-disagg.yaml")
+    rendered = _render(example)
+
+    stss = {d["metadata"]["name"]: d for d in _docs(rendered, "StatefulSet")}
+    assert len(stss) == 2, list(stss)
+    assert any("prefill" in n for n in stss)
+    assert any("decode" in n for n in stss)
+    for doc in stss.values():
+        assert doc["spec"]["replicas"] == 4  # hosts per unit
+        env = {e["name"]: e for e in
+               doc["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["TPU_STACK_NUM_PROCESSES"]["value"] == "4"
+        assert "TPU_STACK_OP_TOKEN" in env
+
+    secrets = [d for d in _docs(rendered, "Secret")
+               if d["metadata"]["name"].endswith("-op-token")]
+    assert len(secrets) == 2  # one per unit
+
+    router = next(d for d in _docs(rendered, "Deployment")
+                  if d["metadata"]["name"].endswith("-router"))
+    cmd = " ".join(router["spec"]["template"]["spec"]["containers"][0]
+                   ["command"])
+    assert "disaggregated_prefill" in cmd
