@@ -369,3 +369,66 @@ def test_native_epp_hardening_edges():
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_native_epp_endpoints_file_watch(tmp_path):
+    """The native server picks up ConfigMap-style endpoint file changes
+    (5 s poll), matching the Python EPP's watcher semantics."""
+    import socket as _socket
+    import subprocess
+    import time as _time
+
+    import grpc
+
+    from epp_server import SERVICE, ensure_pb2
+
+    if not os.path.exists(_EPP_BIN):
+        pytest.skip("tpu-stack-epp not built")
+    pb2 = ensure_pb2()
+    eps = tmp_path / "endpoints"
+    eps.write_text("10.0.0.9:8000\n")
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = subprocess.Popen(
+        [_EPP_BIN, "--port", str(port), "--algorithm", "roundrobin",
+         "--endpoints-file", str(eps)],
+        stderr=subprocess.PIPE)
+    try:
+        deadline = _time.time() + 10
+        while _time.time() < deadline:
+            try:
+                _socket.create_connection(("127.0.0.1", port), 0.2).close()
+                break
+            except OSError:
+                _time.sleep(0.05)
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        stub = channel.stream_stream(
+            f"/{SERVICE}/Process",
+            request_serializer=pb2.ProcessingRequest.SerializeToString,
+            response_deserializer=pb2.ProcessingResponse.FromString)
+
+        deadline = _time.time() + 15
+        dest = ""
+        while _time.time() < deadline:
+            dest = _dest(_openai_exchange(pb2, stub, {
+                "model": "m", "prompt": "x"})[1])
+            if dest == "10.0.0.9:8000":
+                break
+            _time.sleep(0.5)
+        assert dest == "10.0.0.9:8000", dest
+
+        eps.write_text("10.0.0.10:8000\n")
+        deadline = _time.time() + 15
+        while _time.time() < deadline:
+            dest = _dest(_openai_exchange(pb2, stub, {
+                "model": "m", "prompt": "x"})[1])
+            if dest == "10.0.0.10:8000":
+                break
+            _time.sleep(0.5)
+        assert dest == "10.0.0.10:8000", dest
+        channel.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
