@@ -1463,9 +1463,10 @@ class EngineCore:
     def _sleep_device(self) -> None:
         """Per-process HBM release: stage this process's parameter shards
         to host RAM (keyed by shard index for exact restore) and drop the
-        device references. Works identically single- and multi-host."""
-        if self.params is None:
-            return
+        device references. Works identically single- and multi-host.
+        Mutates params/kv UNDER self._lock — LoRA hot-swap reads
+        self.params more than once inside its own _lock section, so an
+        unlocked null here races it into `{**None}` (stress-test race)."""
 
         def stage(a):
             return _StagedParam(
@@ -1473,10 +1474,13 @@ class EngineCore:
                         for s in a.addressable_shards},
                 shape=a.shape, sharding=a.sharding, dtype=a.dtype)
 
-        self._host_params = jax.tree_util.tree_map(stage, self.params)
-        self.params = None
-        self.kv = None
-        self._sleeping = True
+        with self._lock:
+            if self.params is None:
+                return
+            self._host_params = jax.tree_util.tree_map(stage, self.params)
+            self.params = None
+            self.kv = None
+            self._sleeping = True
 
     def wake_up(self) -> None:
         with self._step_lock:
@@ -1492,21 +1496,24 @@ class EngineCore:
     def _wake_device(self) -> None:
         """Per-process restore: rebuild each parameter's global array
         from the locally staged shards, then reallocate the KV pool
-        (a collective zeros every process joins)."""
-        if self._host_params is None:
-            return
+        (a collective zeros every process joins). Same locking as
+        :meth:`_sleep_device`."""
 
         def unstage(leaf):
             return jax.make_array_from_callback(
                 leaf.shape, leaf.sharding,
                 lambda idx, leaf=leaf: leaf.shards[str(idx)])
 
-        self.params = jax.tree_util.tree_map(
-            unstage, self._host_params,
-            is_leaf=lambda x: isinstance(x, _StagedParam))
-        self._host_params = None
+        with self._lock:
+            if self._host_params is None:
+                return
+            self.params = jax.tree_util.tree_map(
+                unstage, self._host_params,
+                is_leaf=lambda x: isinstance(x, _StagedParam))
+            self._host_params = None
         self.kv = self._alloc_kv()
-        self._sleeping = False
+        with self._lock:
+            self._sleeping = False
 
     @property
     def is_sleeping(self) -> bool:
