@@ -4,9 +4,13 @@ Panel-parity rebuild of the reference Grafana dashboard
 (``observability/vllm-dashboard.json``: 4 rows, 19 panels incl. latency /
 TTFT distribution bargauges) against THIS stack's metric names
 (``vllm_router:*`` from the router, ``vllm:*``/``tpu:*`` from engines),
-plus a TPU-specific KV/offload row the reference doesn't have.
+plus a TPU-specific KV/offload row and a per-request lifecycle row
+(queue/prefill/decode stage decomposition from the engine flight
+recorder) the reference doesn't have.
 
 Run ``python gen_dashboard.py`` from this directory to regenerate.
+``build_dashboard()`` is importable so tests can diff the committed JSON
+against a fresh build (dashboard drift check).
 """
 
 import json
@@ -14,7 +18,6 @@ import os
 
 UID = "tpu-stack"
 _id = [0]
-_y = [0]
 
 
 def nid():
@@ -77,157 +80,220 @@ def panel(ptype, title, targets, gp, unit=None, desc=None, **options):
     return p
 
 
-panels = []
-y = 0
+def build_dashboard():
+    """Deterministic dashboard dict (counters reset on every call)."""
+    _id[0] = 0
+    target.n = 0
+    panels = []
+    y = 0
 
-# ---- Row 1: Overview System Performance (ref panels 1-3) ---------------- #
-panels.append(row("Overview System Performance", y)); y += 1
-panels.append(panel(
-    "stat", "Available engine instances",
-    [target("vllm_router:healthy_pods_total", instant=True)],
-    grid(6, 4, 0, y),
-    desc="Healthy engine endpoints known to the router"))
-panels.append(panel(
-    "stat", "Average e2e latency",
-    [target("sum(vllm_router:e2e_request_latency_seconds_sum) / "
-            "sum(vllm_router:e2e_request_latency_seconds_count)")],
-    grid(6, 4, 4, y), unit="s"))
-panels.append(panel(
-    "bargauge", "Request latency distribution",
-    [target("sum by(le) (vllm_router:e2e_request_latency_seconds_bucket)",
-            legend="{{le}}")],
-    grid(6, 16, 8, y),
-    desc="Histogram of end-to-end request latency observed at the router"))
-y += 6
+    # ---- Row 1: Overview System Performance (ref panels 1-3) ------------ #
+    panels.append(row("Overview System Performance", y)); y += 1
+    panels.append(panel(
+        "stat", "Available engine instances",
+        [target("vllm_router:healthy_pods_total", instant=True)],
+        grid(6, 4, 0, y),
+        desc="Healthy engine endpoints known to the router"))
+    panels.append(panel(
+        "stat", "Average e2e latency",
+        [target("sum(vllm_router:e2e_request_latency_seconds_sum) / "
+                "sum(vllm_router:e2e_request_latency_seconds_count)")],
+        grid(6, 4, 4, y), unit="s"))
+    panels.append(panel(
+        "bargauge", "Request latency distribution",
+        [target("sum by(le) (vllm_router:e2e_request_latency_seconds_bucket)",
+                legend="{{le}}")],
+        grid(6, 16, 8, y),
+        desc="Histogram of end-to-end request latency observed at the router"))
+    y += 6
 
-# ---- Row 2: QoS Information (ref panels 4-8) ---------------------------- #
-panels.append(row("QoS Information", y)); y += 1
-panels.append(panel(
-    "stat", "Current QPS",
-    [target("sum(vllm_router:current_qps)", instant=True)],
-    grid(5, 4, 0, y), unit="reqps"))
-panels.append(panel(
-    "stat", "Average TTFT",
-    [target("sum(vllm_router:time_to_first_token_seconds_sum) / "
-            "sum(vllm_router:time_to_first_token_seconds_count)")],
-    grid(5, 4, 4, y), unit="s"))
-panels.append(panel(
-    "stat", "Average ITL",
-    [target("sum(vllm_router:time_per_output_token_seconds_sum) / "
-            "sum(vllm_router:time_per_output_token_seconds_count)")],
-    grid(5, 4, 8, y), unit="s"))
-panels.append(panel(
-    "bargauge", "Request TTFT distribution",
-    [target("sum by(le) (vllm_router:time_to_first_token_seconds_bucket)",
-            legend="{{le}}")],
-    grid(5, 6, 12, y)))
-panels.append(panel(
-    "bargauge", "Inter-token latency distribution",
-    [target("sum by(le) "
-            "(vllm_router:time_per_output_token_seconds_bucket)",
-            legend="{{le}}")],
-    grid(5, 6, 18, y)))
-y += 5
+    # ---- Row 2: QoS Information (ref panels 4-8) ------------------------ #
+    panels.append(row("QoS Information", y)); y += 1
+    panels.append(panel(
+        "stat", "Current QPS",
+        [target("sum(vllm_router:current_qps)", instant=True)],
+        grid(5, 4, 0, y), unit="reqps"))
+    panels.append(panel(
+        "stat", "Average TTFT",
+        [target("sum(vllm_router:time_to_first_token_seconds_sum) / "
+                "sum(vllm_router:time_to_first_token_seconds_count)")],
+        grid(5, 4, 4, y), unit="s"))
+    panels.append(panel(
+        "stat", "Average ITL",
+        [target("sum(vllm_router:time_per_output_token_seconds_sum) / "
+                "sum(vllm_router:time_per_output_token_seconds_count)")],
+        grid(5, 4, 8, y), unit="s"))
+    panels.append(panel(
+        "bargauge", "Request TTFT distribution",
+        [target("sum by(le) (vllm_router:time_to_first_token_seconds_bucket)",
+                legend="{{le}}")],
+        grid(5, 6, 12, y)))
+    panels.append(panel(
+        "bargauge", "Inter-token latency distribution",
+        [target("sum by(le) "
+                "(vllm_router:time_per_output_token_seconds_bucket)",
+                legend="{{le}}")],
+        grid(5, 6, 18, y)))
+    y += 5
 
-# ---- Row 3: Serving Engine Load (ref panels 9-13, per engine) ----------- #
-panels.append(row("Serving Engine Load", y)); y += 1
-panels.append(panel(
-    "timeseries", "Running requests per engine",
-    [target("vllm_router:num_requests_running", legend="{{server}}")],
-    grid(7, 8, 0, y)))
-panels.append(panel(
-    "timeseries", "Pending requests per engine",
-    [target("vllm_router:num_requests_waiting", legend="{{server}}")],
-    grid(7, 8, 8, y)))
-panels.append(panel(
-    "timeseries", "QPS per engine",
-    [target("vllm_router:current_qps", legend="{{server}}")],
-    grid(7, 8, 16, y), unit="reqps"))
-y += 7
-panels.append(panel(
-    "timeseries", "Average TTFT per engine",
-    [target("vllm_router:avg_ttft", legend="{{server}}")],
-    grid(7, 8, 0, y), unit="s"))
-panels.append(panel(
-    "timeseries", "Average ITL per engine",
-    [target("vllm_router:avg_itl", legend="{{server}}")],
-    grid(7, 8, 8, y), unit="s"))
-panels.append(panel(
-    "stat", "Swapped (preempted) requests",
-    [target("sum(vllm_router:num_swapped_requests)", instant=True)],
-    grid(7, 8, 16, y)))
-y += 7
+    # ---- Row 3: Serving Engine Load (ref panels 9-13, per engine) ------- #
+    panels.append(row("Serving Engine Load", y)); y += 1
+    panels.append(panel(
+        "timeseries", "Running requests per engine",
+        [target("vllm_router:num_requests_running", legend="{{server}}")],
+        grid(7, 8, 0, y)))
+    panels.append(panel(
+        "timeseries", "Pending requests per engine",
+        [target("vllm_router:num_requests_waiting", legend="{{server}}")],
+        grid(7, 8, 8, y)))
+    panels.append(panel(
+        "timeseries", "QPS per engine",
+        [target("vllm_router:current_qps", legend="{{server}}")],
+        grid(7, 8, 16, y), unit="reqps"))
+    y += 7
+    panels.append(panel(
+        "timeseries", "Average TTFT per engine",
+        [target("vllm_router:avg_ttft", legend="{{server}}")],
+        grid(7, 8, 0, y), unit="s"))
+    panels.append(panel(
+        "timeseries", "Average ITL per engine",
+        [target("vllm_router:avg_itl", legend="{{server}}")],
+        grid(7, 8, 8, y), unit="s"))
+    panels.append(panel(
+        "stat", "Swapped (preempted) requests",
+        [target("sum(vllm_router:num_swapped_requests)", instant=True)],
+        grid(7, 8, 16, y)))
+    y += 7
 
-# ---- Row 4: TPU KV cache & offload (TPU-native; beyond the ref) --------- #
-panels.append(row("TPU KV Cache & Offload", y)); y += 1
-panels.append(panel(
-    "timeseries", "TPU HBM KV usage per engine",
-    [target("vllm_router:gpu_cache_usage_perc", legend="{{server}}")],
-    grid(7, 8, 0, y), unit="percentunit",
-    desc="Paged-KV pool occupancy in TPU HBM (engine tpu:hbm_kv_usage_perc "
-         "scraped by the router)"))
-panels.append(panel(
-    "timeseries", "Prefix-cache hit rate per engine",
-    [target("vllm_router:gpu_prefix_cache_hit_rate",
-            legend="{{server}}")],
-    grid(7, 8, 8, y), unit="percentunit"))
-panels.append(panel(
-    "timeseries", "Preemption rate (engine-side)",
-    [target("rate(vllm:num_preemptions_total[5m])",
-            legend="{{instance}}")],
-    grid(7, 8, 16, y),
-    desc="Requires scraping engine /metrics directly "
-         "(observability/prom-adapter.yaml)"))
-y += 7
-panels.append(panel(
-    "timeseries", "Cached prompt tokens served (rate)",
-    [target("rate(tpu:cached_prompt_tokens_total[5m])",
-            legend="{{instance}}")],
-    grid(7, 12, 0, y),
-    desc="Prompt tokens answered from prefix cache instead of prefill"))
-panels.append(panel(
-    "timeseries", "Engine sleep state",
-    [target("tpu:engine_sleeping", legend="{{instance}}")],
-    grid(7, 12, 12, y),
-    desc="1 = engine sleeping (weights offloaded), excluded from routing"))
-y += 7
+    # ---- Row 4: Request lifecycle (engine flight-recorder stages) ------- #
+    panels.append(row("Request Lifecycle", y)); y += 1
+    panels.append(panel(
+        "timeseries", "Average queue wait per engine",
+        [target("rate(tpu:queue_time_seconds_sum[5m]) / "
+                "rate(tpu:queue_time_seconds_count[5m])",
+                legend="{{instance}}")],
+        grid(7, 8, 0, y), unit="s",
+        desc="Admission-to-prefill wait from the engine's per-request "
+             "stage spans (/debug/traces)"))
+    panels.append(panel(
+        "timeseries", "Average prefill time per engine",
+        [target("rate(tpu:prefill_time_seconds_sum[5m]) / "
+                "rate(tpu:prefill_time_seconds_count[5m])",
+                legend="{{instance}}")],
+        grid(7, 8, 8, y), unit="s",
+        desc="Prompt processing (allocation + chunked forward), "
+             "cached prefix excluded"))
+    panels.append(panel(
+        "timeseries", "Average decode time per engine",
+        [target("rate(tpu:decode_time_seconds_sum[5m]) / "
+                "rate(tpu:decode_time_seconds_count[5m])",
+                legend="{{instance}}")],
+        grid(7, 8, 16, y), unit="s",
+        desc="First-token to last-token per request (aggregate of all "
+             "decode steps)"))
+    y += 7
+    panels.append(panel(
+        "timeseries", "Stage time spent (rate)",
+        [target("rate(tpu:queue_time_seconds_sum[5m])", legend="queue"),
+         target("rate(tpu:prefill_time_seconds_sum[5m])", legend="prefill"),
+         target("rate(tpu:decode_time_seconds_sum[5m])", legend="decode")],
+        grid(7, 16, 0, y), unit="s",
+        desc="Where request time goes across the fleet: seconds of each "
+             "stage accumulated per second — the p99 tail decomposition"))
+    panels.append(panel(
+        "stat", "Slow requests (over threshold)",
+        [target("sum(tpu:slow_requests_total)", instant=True)],
+        grid(7, 8, 16, y),
+        desc="Requests slower than --slow-trace-threshold-s; each one "
+             "logged as a structured slow_trace JSON line"))
+    y += 7
 
-# ---- Row 5: Current Resource Usage (ref panels 14-19) ------------------- #
-panels.append(row("Current Resource Usage", y)); y += 1
-panels.append(panel(
-    "timeseries", "Router CPU usage",
-    [target("vllm_router:cpu_usage_pct", legend="router")],
-    grid(7, 8, 0, y), unit="percent"))
-panels.append(panel(
-    "timeseries", "Router memory (RSS)",
-    [target("vllm_router:mem_usage_bytes", legend="router")],
-    grid(7, 8, 8, y), unit="bytes"))
-panels.append(panel(
-    "timeseries", "Disk usage",
-    [target("vllm_router:disk_usage_pct", legend="/")],
-    grid(7, 8, 16, y), unit="percent"))
-y += 7
+    # ---- Row 5: TPU KV cache & offload (TPU-native; beyond the ref) ----- #
+    panels.append(row("TPU KV Cache & Offload", y)); y += 1
+    panels.append(panel(
+        "timeseries", "TPU HBM KV usage per engine",
+        [target("vllm_router:gpu_cache_usage_perc", legend="{{server}}")],
+        grid(7, 8, 0, y), unit="percentunit",
+        desc="Paged-KV pool occupancy in TPU HBM (engine "
+             "tpu:hbm_kv_usage_perc scraped by the router)"))
+    panels.append(panel(
+        "timeseries", "Prefix-cache hit rate per engine",
+        [target("vllm_router:gpu_prefix_cache_hit_rate",
+                legend="{{server}}")],
+        grid(7, 8, 8, y), unit="percentunit"))
+    panels.append(panel(
+        "timeseries", "Preemption rate (engine-side)",
+        [target("rate(vllm:num_preemptions_total[5m])",
+                legend="{{instance}}")],
+        grid(7, 8, 16, y),
+        desc="Requires scraping engine /metrics directly "
+             "(observability/prom-adapter.yaml)"))
+    y += 7
+    panels.append(panel(
+        "timeseries", "Cached prompt tokens served (rate)",
+        [target("rate(tpu:cached_prompt_tokens_total[5m])",
+                legend="{{instance}}")],
+        grid(7, 8, 0, y),
+        desc="Prompt tokens answered from prefix cache instead of prefill"))
+    panels.append(panel(
+        "timeseries", "HBM headroom per engine",
+        [target("tpu:hbm_headroom_bytes", legend="{{instance}}")],
+        grid(7, 8, 8, y), unit="bytes",
+        desc="Free HBM beyond the KV pool + weights (exported even when "
+             "the sample is stale, so alerts never lose the series)"))
+    panels.append(panel(
+        "timeseries", "Engine sleep state",
+        [target("tpu:engine_sleeping", legend="{{instance}}")],
+        grid(7, 8, 16, y),
+        desc="1 = engine sleeping (weights offloaded), excluded from "
+             "routing"))
+    y += 7
 
-dashboard = {
-    "uid": UID,
-    "title": "TPU Production Stack",
-    "tags": ["tpu", "production-stack"],
-    "schemaVersion": 39,
-    "version": 2,
-    "refresh": "10s",
-    "time": {"from": "now-30m", "to": "now"},
-    "templating": {"list": [{
-        "name": "datasource", "type": "datasource", "query": "prometheus",
-        "current": {"selected": False, "text": "Prometheus",
-                    "value": "prometheus"},
-    }]},
-    "panels": panels,
-}
+    # ---- Row 6: Current Resource Usage (ref panels 14-19) --------------- #
+    panels.append(row("Current Resource Usage", y)); y += 1
+    panels.append(panel(
+        "timeseries", "Router CPU usage",
+        [target("vllm_router:cpu_usage_pct", legend="router")],
+        grid(7, 8, 0, y), unit="percent"))
+    panels.append(panel(
+        "timeseries", "Router memory (RSS)",
+        [target("vllm_router:mem_usage_bytes", legend="router")],
+        grid(7, 8, 8, y), unit="bytes"))
+    panels.append(panel(
+        "timeseries", "Disk usage",
+        [target("vllm_router:disk_usage_pct", legend="/")],
+        grid(7, 8, 16, y), unit="percent"))
+    y += 7
 
-out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                   "tpu-stack-dashboard.json")
-with open(out, "w") as f:
-    json.dump(dashboard, f, indent=2)
-    f.write("\n")
-print(f"wrote {out}: {len([p for p in panels if p['type'] != 'row'])} "
-      f"panels in {len([p for p in panels if p['type'] == 'row'])} rows")
+    return {
+        "uid": UID,
+        "title": "TPU Production Stack",
+        "tags": ["tpu", "production-stack"],
+        "schemaVersion": 39,
+        "version": 3,
+        "refresh": "10s",
+        "time": {"from": "now-30m", "to": "now"},
+        "templating": {"list": [{
+            "name": "datasource", "type": "datasource",
+            "query": "prometheus",
+            "current": {"selected": False, "text": "Prometheus",
+                        "value": "prometheus"},
+        }]},
+        "panels": panels,
+    }
+
+
+def main():
+    dashboard = build_dashboard()
+    panels = dashboard["panels"]
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "tpu-stack-dashboard.json")
+    with open(out, "w") as f:
+        json.dump(dashboard, f, indent=2)
+        f.write("\n")
+    print(f"wrote {out}: {len([p for p in panels if p['type'] != 'row'])} "
+          f"panels in {len([p for p in panels if p['type'] == 'row'])} rows")
+
+
+if __name__ == "__main__":
+    main()

@@ -1382,6 +1382,7 @@ class EngineCore:
         sampling: SamplingParams,
         on_token: Callable[[Optional[int], Optional[str]], None],
         adapter_name: Optional[str] = None,
+        trace=None,
     ) -> None:
         if self.fatal_error is not None:
             # The engine loop halted (multi-host lockstep break): nothing
@@ -1397,6 +1398,7 @@ class EngineCore:
             on_token=on_token,
             adapter_id=adapter_id,
             adapter_name=(adapter_name or "") if adapter_id else "",
+            trace=trace,
         )
         with self._lock:
             self.scheduler.add(req)
@@ -1720,6 +1722,8 @@ class EngineCore:
                     if action == "prefill":
                         t0 = time.perf_counter()
                         self._do_prefill(req)
+                        if req.trace is not None and req.trace.prefill_start:
+                            req.trace.prefill_end = time.time()
                         self.prefill_time_total += time.perf_counter() - t0
                         self.prefill_count += 1
                     elif action == "decode":
@@ -1822,6 +1826,13 @@ class EngineCore:
         if got is None:
             return
         block_ids, cached = got
+        if req.trace is not None:
+            # Queue wait ends at the first successful allocation (an
+            # alloc-starved retry stays queued, not "prefilling").
+            if not req.trace.prefill_start:
+                req.trace.prefill_start = time.time()
+            req.trace.cached_tokens = cached
+            req.trace.preemptions = req.num_preemptions
 
         # Big uncached spans batch with other waiting long prompts: the
         # arrival-storm TTFT tail is a QUEUE of first-round prefills, and
@@ -2075,7 +2086,14 @@ class EngineCore:
                     "[%d, %d] dispatch chain", len(group),
                     cfg.prefill_batch, chunk)
         spans: "dict[int, list]" = {}
+        group_start = time.time()
         for m in group:
+            tr = m["req"].trace
+            if tr is not None:
+                if not tr.prefill_start:
+                    tr.prefill_start = group_start
+                tr.cached_tokens = m["cached"]
+                tr.preemptions = m["req"].num_preemptions
             n_m = len(m["req"].all_token_ids)
             s_list = []
             start = m["cached"]
@@ -2099,8 +2117,11 @@ class EngineCore:
         # and the previous prefill while the group executes on device.
         self._flush_pending_burst()
         self._flush_pending_prefills()
+        group_end = time.time()
         for m, sampled, row in finished:
             req_m = m["req"]
+            if req_m.trace is not None:
+                req_m.trace.prefill_end = group_end
             self.prompt_tokens_total += len(req_m.all_token_ids)
             self.cached_tokens_total += m["cached"]
             with self._lock:
@@ -2510,6 +2531,12 @@ class EngineCore:
         otherwise the bare int (the common path stays allocation-free)."""
         req = seq.req
         req.output_token_ids.append(token)
+        if req.trace is not None:
+            now = time.time()
+            if not req.trace.first_token:
+                req.trace.first_token = now
+            req.trace.last_token = now
+            req.trace.tokens += 1
         finish = None
         eos = getattr(self.tokenizer, "eos_token_id", None)
         n_out = len(req.output_token_ids)

@@ -52,6 +52,9 @@ class EngineRequest:
     # Decode steps scheduled so far (may run ahead of emitted tokens while
     # a speculative burst is in flight); engine-thread only.
     scheduled_steps: int = 0
+    # Optional StageClock (obs.trace): the engine thread stamps queue/
+    # prefill/decode boundaries on it; the server reads it afterwards.
+    trace: Optional[object] = None
 
     @property
     def all_token_ids(self) -> List[int]:

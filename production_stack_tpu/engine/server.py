@@ -51,6 +51,7 @@ from production_stack_tpu.engine.tools import (
     render_tools_preamble,
     tool_names,
 )
+from production_stack_tpu.obs.trace import StageClock, TraceRecorder
 from production_stack_tpu.utils.log import init_logger
 
 logger = init_logger(__name__)
@@ -81,7 +82,10 @@ class EngineServer:
                  kv_controller_url: Optional[str] = None,
                  instance_id: Optional[str] = None,
                  advertise_url: Optional[str] = None,
-                 api_key: Optional[str] = None):
+                 api_key: Optional[str] = None,
+                 trace_buffer: int = 512,
+                 slow_trace_threshold_s: float = 0.0,
+                 trace_export: Optional[str] = None):
         # Serving-surface auth (reference tutorial 11 "secure vLLM
         # serve": VLLM_API_KEY): /v1/* requests must carry
         # `Authorization: Bearer <key>`; the intra-stack control plane
@@ -129,6 +133,19 @@ class EngineServer:
         self.kv_transfer_device_seconds = 0.0
         self._device_pipe = None
         self._device_pipe_failed = False
+        # Per-request stage tracing (queue/prefill/decode spans recorded
+        # after each request; served at /debug/traces, rolled up into the
+        # tpu:*_time_seconds exposition).
+        self.trace_recorder = TraceRecorder(
+            "tpu-stack-engine",
+            capacity=trace_buffer,
+            slow_threshold_s=slow_trace_threshold_s,
+            export=trace_export,
+        )
+        # Last HBM headroom sample: the gauge is exported even when the
+        # current stats() sample is missing, so dashboards and alerts
+        # never see the series disappear.
+        self._last_hbm_headroom = 0
 
     async def start_kv_reporting(self, own_url: str) -> None:
         """Register with the router's KV controller (retried lazily on
@@ -364,6 +381,10 @@ class EngineServer:
         r.add_post("/kv/prepare_pull", self.handle_kv_prepare_pull)
         r.add_post("/kv/release", self.handle_kv_release)
         r.add_post("/v1/audio/transcriptions", self.handle_transcriptions)
+        # Flight recorder (engine-side stage spans per request).
+        from production_stack_tpu.obs.debug import add_debug_routes
+
+        add_debug_routes(r, self.trace_recorder)
         app["engine_server"] = self
         return app
 
@@ -382,11 +403,12 @@ class EngineServer:
         )
 
     async def _generate(self, prompt_ids: List[int], sampling: SamplingParams,
-                        request_id: str, adapter: Optional[str]):
+                        request_id: str, adapter: Optional[str],
+                        trace: Optional[StageClock] = None):
         stream = _TokenStream(asyncio.get_running_loop())
         self.core.add_request(
             request_id, prompt_ids, sampling, stream.on_token,
-            adapter_name=adapter,
+            adapter_name=adapter, trace=trace,
         )
         return stream
 
@@ -533,8 +555,63 @@ class EngineServer:
 
     async def _respond(self, request, body, prompt_ids, sampling, rid, model,
                        adapter, *, kind: str) -> web.StreamResponse:
+        """Trace-recording shell around the actual response path: one
+        StageClock rides into EngineCore (which stamps queue/prefill/
+        decode boundaries on the engine thread); the completed timeline is
+        recorded whether the request finishes, errors, or disconnects."""
+        t_recv = time.time()
+        clock = StageClock(arrival=t_recv)
+        clock.prompt_tokens = len(prompt_ids)
+        try:
+            return await self._respond_inner(
+                request, body, prompt_ids, sampling, rid, model, adapter,
+                kind=kind, clock=clock,
+            )
+        finally:
+            self._record_request_trace(request, rid, model, t_recv, clock)
+
+    def _record_request_trace(self, request, rid: str, model: str,
+                              t_recv: float, clock: StageClock) -> None:
+        rec = self.trace_recorder
+        if rec is None:
+            return
+        now = time.time()
+        trace = rec.begin(rid, request.headers.get("traceparent"))
+        root = trace.start_span(
+            "engine.request", start=t_recv, model=model,
+            prompt_tokens=clock.prompt_tokens, tokens=clock.tokens,
+        )
+        queue_end = clock.prefill_start or now
+        trace.add_span("engine.queue", clock.arrival, queue_end, parent=root)
+        if clock.prefill_start:
+            trace.add_span(
+                "engine.prefill", clock.prefill_start,
+                clock.prefill_end or clock.prefill_start, parent=root,
+                prompt_tokens=clock.prompt_tokens,
+                cached_tokens=clock.cached_tokens,
+                uncached_tokens=max(
+                    0, clock.prompt_tokens - clock.cached_tokens),
+                preemptions=clock.preemptions,
+            )
+        if clock.first_token:
+            decode_start = clock.prefill_end or clock.first_token
+            trace.add_span(
+                "engine.decode", decode_start,
+                max(clock.last_token, decode_start), parent=root,
+                steps=clock.tokens, tokens=clock.tokens,
+                time_to_first_token_s=round(
+                    clock.first_token - clock.arrival, 6),
+            )
+        root.finish(end=now, tokens=clock.tokens)
+        rec.record(trace)
+
+    async def _respond_inner(self, request, body, prompt_ids, sampling, rid,
+                             model, adapter, *, kind: str,
+                             clock: Optional[StageClock] = None,
+                             ) -> web.StreamResponse:
         stream_mode = bool(body.get("stream", False))
-        stream = await self._generate(prompt_ids, sampling, rid, adapter)
+        stream = await self._generate(prompt_ids, sampling, rid, adapter,
+                                      trace=clock)
         detok = IncrementalDetokenizer(self.core.tokenizer)
         created = int(time.time())
         obj = "chat.completion" if kind == "chat" else "text_completion"
@@ -1546,6 +1623,32 @@ class EngineServer:
             }}
 
     async def handle_kv_pull(self, request: web.Request) -> web.Response:
+        """Trace shell for :meth:`_kv_pull_impl`: records one
+        ``engine.kv_transfer`` span per pull (path, bytes, seconds) under
+        the router's trace when a ``traceparent`` arrives."""
+        t0 = time.time()
+        resp = await self._kv_pull_impl(request)
+        if self.trace_recorder is not None:
+            rid = (request.headers.get("X-Request-Id")
+                   or f"kvpull-{uuid.uuid4().hex[:12]}")
+            trace = self.trace_recorder.begin(
+                rid, request.headers.get("traceparent"))
+            attrs = {"status": resp.status}
+            try:
+                payload = json.loads(resp.body)
+                attrs["result"] = payload.get("status", "error")
+                attrs["injected_blocks"] = payload.get("injected_blocks", 0)
+                transfer = payload.get("transfer") or {}
+                for k in ("path", "bytes", "total_seconds"):
+                    if k in transfer:
+                        attrs[k] = transfer[k]
+            except (ValueError, TypeError):
+                pass
+            trace.add_span("engine.kv_transfer", t0, time.time(), **attrs)
+            self.trace_recorder.record(trace)
+        return resp
+
+    async def _kv_pull_impl(self, request: web.Request) -> web.Response:
         """Pull the KV for a prompt from another engine and install it —
         the decode-side step of disaggregated prefill. Data moves engine to
         engine; the router only sends this control message. Path
@@ -1656,6 +1759,20 @@ class EngineServer:
         s = self.core.stats()
         model = self.config.model
         labels = f'model_name="{model}"'
+        # HBM headroom: emit last-known (0 before the first sample) rather
+        # than dropping the series — a gauge that disappears breaks
+        # dashboards and alert rules.
+        headroom = s.get("hbm_headroom_bytes")
+        if headroom is None:
+            headroom = self._last_hbm_headroom
+        else:
+            self._last_hbm_headroom = headroom
+        # Request-lifecycle rollups from the flight recorder (avg stage
+        # time = rate(sum)/rate(count) in Grafana).
+        stage = self.trace_recorder.stage_stats()
+        q_sum, q_count = stage.get("engine.queue", (0.0, 0))
+        pf_sum, pf_count = stage.get("engine.prefill", (0.0, 0))
+        dec_sum, dec_count = stage.get("engine.decode", (0.0, 0))
         lines = [
             "# TYPE vllm:num_requests_running gauge",
             f"vllm:num_requests_running{{{labels}}} {s['num_requests_running']}",
@@ -1682,10 +1799,8 @@ class EngineServer:
             f"vllm:num_preemptions_total{{{labels}}} {s['num_preempted_total']}",
             "# TYPE tpu:num_kv_blocks gauge",
             f"tpu:num_kv_blocks{{{labels}}} {s['num_blocks']}",
-            *(["# TYPE tpu:hbm_headroom_bytes gauge",
-               f"tpu:hbm_headroom_bytes{{{labels}}} "
-               f"{s['hbm_headroom_bytes']}"]
-              if s.get("hbm_headroom_bytes") is not None else []),
+            "# TYPE tpu:hbm_headroom_bytes gauge",
+            f"tpu:hbm_headroom_bytes{{{labels}}} {headroom}",
             "# TYPE tpu:engine_sleeping gauge",
             f"tpu:engine_sleeping{{{labels}}} {int(s['is_sleeping'])}",
             "# TYPE tpu:cached_prompt_tokens counter",
@@ -1708,6 +1823,20 @@ class EngineServer:
             "# TYPE tpu:kv_transfer_device_seconds counter",
             f"tpu:kv_transfer_device_seconds_total{{{labels}}} "
             f"{self.kv_transfer_device_seconds:.6f}",
+            # Request lifecycle: queue / prefill / decode stage times
+            # (sum+count pairs, matching the hand-rolled exposition style).
+            "# TYPE tpu:queue_time_seconds summary",
+            f"tpu:queue_time_seconds_sum{{{labels}}} {q_sum:.6f}",
+            f"tpu:queue_time_seconds_count{{{labels}}} {q_count}",
+            "# TYPE tpu:prefill_time_seconds summary",
+            f"tpu:prefill_time_seconds_sum{{{labels}}} {pf_sum:.6f}",
+            f"tpu:prefill_time_seconds_count{{{labels}}} {pf_count}",
+            "# TYPE tpu:decode_time_seconds summary",
+            f"tpu:decode_time_seconds_sum{{{labels}}} {dec_sum:.6f}",
+            f"tpu:decode_time_seconds_count{{{labels}}} {dec_count}",
+            "# TYPE tpu:slow_requests counter",
+            f"tpu:slow_requests_total{{{labels}}} "
+            f"{self.trace_recorder.slow_requests}",
         ]
         if s.get("offload"):
             off = s["offload"]
@@ -1804,6 +1933,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="custom jinja chat-template file (HF checkpoints)")
     p.add_argument("--advertise-url", default=None,
                    help="URL the router should route to for this instance")
+    p.add_argument("--trace-export", default=None,
+                   help="export completed traces as OTLP-JSON: "
+                        "'file:/path/traces.jsonl' (one line per trace) or "
+                        "an 'http(s)://collector:4318/v1/traces' endpoint")
+    p.add_argument("--slow-trace-threshold-s", type=float, default=0.0,
+                   help="log one structured JSON line (full span timeline) "
+                        "for any request slower than this many seconds; "
+                        "0 disables")
+    p.add_argument("--trace-buffer", type=int, default=512,
+                   help="completed traces kept in the in-process flight "
+                        "recorder, served at /debug/traces")
     return p
 
 
@@ -1858,7 +1998,10 @@ def main(argv: Optional[List[str]] = None) -> None:
                           kv_controller_url=args.kv_controller_url,
                           instance_id=args.instance_id,
                           advertise_url=args.advertise_url,
-                          api_key=args.api_key)
+                          api_key=args.api_key,
+                          trace_buffer=args.trace_buffer,
+                          slow_trace_threshold_s=args.slow_trace_threshold_s,
+                          trace_export=args.trace_export)
 
     async def _run():
         await run_engine_server(server, args.host, args.port)

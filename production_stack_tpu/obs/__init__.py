@@ -1,0 +1,19 @@
+"""Dependency-free request tracing (router + engine).
+
+``trace``      -- W3C trace-context propagation, spans, the flight-recorder
+                  ring buffer, OTLP-JSON export, slow-trace logging.
+``debug``      -- aiohttp ``/debug/traces`` handlers shared by the router,
+                  the engine server, and the fake engine.
+"""
+
+from production_stack_tpu.obs.trace import (  # noqa: F401
+    RequestTrace,
+    Span,
+    StageClock,
+    TraceRecorder,
+    format_traceparent,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+    trace_id_from_request_id,
+)

@@ -1,0 +1,47 @@
+"""``/debug/traces`` HTTP surface, shared by router, engine, and fake engine.
+
+- ``GET /debug/traces``                 -- newest-first summaries; filters:
+  ``?min_duration_s=0.25`` and ``?limit=50``.
+- ``GET /debug/traces/{request_id}``    -- full span timeline as JSON;
+  ``?format=otlp`` returns the OTLP-JSON resourceSpans shape instead.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from production_stack_tpu.obs.trace import TraceRecorder
+
+
+def add_debug_routes(router, recorder: TraceRecorder) -> None:
+    """Attach the trace endpoints to an aiohttp ``UrlDispatcher``."""
+
+    async def list_traces(request: web.Request) -> web.Response:
+        try:
+            min_duration = float(request.query.get("min_duration_s", 0) or 0)
+        except ValueError:
+            return web.json_response(
+                {"error": "min_duration_s must be a number"}, status=400)
+        try:
+            limit = int(request.query.get("limit", 100) or 100)
+        except ValueError:
+            return web.json_response(
+                {"error": "limit must be an integer"}, status=400)
+        return web.json_response({
+            "service": recorder.service,
+            "capacity": recorder.capacity,
+            "recorded_total": recorder.recorded_total,
+            "slow_requests": recorder.slow_requests,
+            "traces": recorder.list(min_duration_s=min_duration, limit=limit),
+        })
+
+    async def get_trace(request: web.Request) -> web.Response:
+        trace = recorder.get(request.match_info["request_id"])
+        if trace is None:
+            return web.json_response({"error": "trace not found"}, status=404)
+        if request.query.get("format") == "otlp":
+            return web.json_response({"resourceSpans": [trace.to_otlp()]})
+        return web.json_response(trace.to_dict())
+
+    router.add_get("/debug/traces", list_traces)
+    router.add_get("/debug/traces/{request_id}", get_trace)

@@ -1,0 +1,42 @@
+"""JAX API compatibility shims for the parallel layer.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to ``jax.shard_map``
+and renamed its partial-manual parameter from ``auto`` (axes left automatic)
+to ``axis_names`` (axes made manual). The serving image pins one jax version
+but the test/dev boxes span both spellings, so every call site goes through
+:func:`shard_map` here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Set
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs,
+              axis_names: Optional[Set[str]] = None):
+    """``jax.shard_map`` on new jax, ``jax.experimental.shard_map`` on old.
+
+    ``axis_names`` follows the new-style meaning: the mesh axes the body is
+    manual over (None = all of them).
+    """
+    if hasattr(jax, "shard_map"):
+        kwargs = {} if axis_names is None else {"axis_names": set(axis_names)}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    auto = (frozenset() if axis_names is None
+            else frozenset(mesh.axis_names) - frozenset(axis_names))
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      auto=auto)
+
+
+def pcast(x, axis_names, to="varying"):
+    """``jax.lax.pcast`` when the varying-type system exists, identity
+    otherwise — on old jax every shard_map value is untyped w.r.t. axis
+    variance, so the annotation has nothing to do."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, tuple(axis_names), to=to)
+    return x

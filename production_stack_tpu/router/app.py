@@ -65,6 +65,7 @@ class RouterState:
     file_storage: Any = None
     dynamic_config_watcher: Any = None
     log_stats_thread: Optional[threading.Thread] = None
+    trace_recorder: Any = None
     extra: dict = field(default_factory=dict)
 
 
@@ -383,6 +384,11 @@ def build_app(args) -> web.Application:
     app.router.add_post("/kv/admit", kv_admit)
     app.router.add_post("/kv/evict", kv_evict)
     app.router.add_post("/kv/lookup", kv_lookup)
+    # Flight recorder (router-side spans of every proxied request).
+    if state.trace_recorder is not None:
+        from production_stack_tpu.obs.debug import add_debug_routes
+
+        add_debug_routes(app.router, state.trace_recorder)
 
     async def on_startup(app: web.Application):
         st = app["state"]
@@ -401,6 +407,8 @@ def build_app(args) -> web.Application:
                 result = closable.close()
                 if asyncio.iscoroutine(result):
                     await result
+        if st.trace_recorder is not None:
+            st.trace_recorder.close()
         await AiohttpClientWrapper().close()
 
     app.on_startup.append(on_startup)
@@ -444,6 +452,18 @@ def initialize_all(args) -> RouterState:
     """Wire all singletons (reference app.py:112-272)."""
     state = RouterState()
     _init_sentry(args)
+
+    # Tracing flight recorder (always on: a bounded ring buffer is cheap;
+    # export + slow-trace logging are opt-in flags).
+    from production_stack_tpu.obs.trace import TraceRecorder
+
+    state.trace_recorder = TraceRecorder(
+        "tpu-stack-router",
+        capacity=getattr(args, "trace_buffer", 512),
+        slow_threshold_s=getattr(args, "slow_trace_threshold_s", 0.0),
+        export=getattr(args, "trace_export", None)
+        or getattr(args, "otel_endpoint", None),
+    )
 
     # Service discovery.
     if args.service_discovery == "static":

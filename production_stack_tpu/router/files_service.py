@@ -9,6 +9,7 @@ storing file bytes and metadata under a root directory, addressed by
 from __future__ import annotations
 
 import abc
+import asyncio
 import json
 import os
 import time
@@ -16,11 +17,46 @@ import uuid
 from dataclasses import asdict, dataclass, field
 from typing import Dict, List, Optional
 
-import aiofiles
+try:
+    import aiofiles
+except ImportError:  # serving image pins deps; fall back to the executor
+    aiofiles = None
 
 from production_stack_tpu.utils.log import init_logger
 
 logger = init_logger(__name__)
+
+
+class _ThreadFile:
+    """``aiofiles.open`` stand-in: sync I/O pushed to the default executor
+    so the event loop never blocks on disk."""
+
+    def __init__(self, path: str, mode: str):
+        self._path = path
+        self._mode = mode
+        self._f = None
+
+    async def __aenter__(self) -> "_ThreadFile":
+        loop = asyncio.get_running_loop()
+        self._f = await loop.run_in_executor(None, open, self._path, self._mode)
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await asyncio.get_running_loop().run_in_executor(None, self._f.close)
+
+    async def read(self):
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self._f.read)
+
+    async def write(self, data):
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self._f.write, data)
+
+
+def _aopen(path: str, mode: str = "r"):
+    if aiofiles is not None:
+        return aiofiles.open(path, mode)
+    return _ThreadFile(path, mode)
 
 
 @dataclass
@@ -72,11 +108,11 @@ class FileStorage(Storage):
             id=file_id, bytes=len(content), filename=filename, purpose=purpose
         )
         os.makedirs(self._dir(file_id), exist_ok=True)
-        async with aiofiles.open(
+        async with _aopen(
             os.path.join(self._dir(file_id), filename), "wb"
         ) as f:
             await f.write(content)
-        async with aiofiles.open(
+        async with _aopen(
             os.path.join(self._dir(file_id), "metadata.json"), "w"
         ) as f:
             await f.write(json.dumps(info.metadata()))
@@ -85,14 +121,14 @@ class FileStorage(Storage):
     async def get_file(self, file_id: str) -> FileInfo:
         path = os.path.join(self._dir(file_id), "metadata.json")
         try:
-            async with aiofiles.open(path) as f:
+            async with _aopen(path) as f:
                 return FileInfo(**json.loads(await f.read()))
         except FileNotFoundError:
             raise FileNotFoundError(f"File {file_id} not found")
 
     async def get_file_content(self, file_id: str) -> bytes:
         info = await self.get_file(file_id)
-        async with aiofiles.open(
+        async with _aopen(
             os.path.join(self._dir(file_id), info.filename), "rb"
         ) as f:
             return await f.read()

@@ -94,7 +94,20 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--sentry-profile-session-sample-rate", type=float,
                         default=0.1)
     parser.add_argument("--otel-endpoint", default=None,
-                        help="OTLP endpoint for request span export")
+                        help="OTLP endpoint for request span export "
+                             "(alias for an http(s) --trace-export)")
+    parser.add_argument("--trace-export", default=None,
+                        help="export completed traces as OTLP-JSON: "
+                             "'file:/path/traces.jsonl' (one line per trace) "
+                             "or an 'http(s)://collector:4318/v1/traces' "
+                             "endpoint")
+    parser.add_argument("--slow-trace-threshold-s", type=float, default=0.0,
+                        help="log one structured JSON line (full span "
+                             "timeline) for any request slower than this "
+                             "many seconds; 0 disables")
+    parser.add_argument("--trace-buffer", type=int, default=512,
+                        help="completed traces kept in the in-process "
+                             "flight recorder, served at /debug/traces")
     return parser
 
 

@@ -27,6 +27,7 @@ from typing import AsyncGenerator, Optional, Tuple
 import aiohttp
 from aiohttp import web
 
+from production_stack_tpu.obs.trace import format_traceparent
 from production_stack_tpu.router.httpclient import get_client_session
 from production_stack_tpu.utils.log import init_logger
 
@@ -128,6 +129,15 @@ async def route_general_request(
             request, endpoint, request_json, request_id
         )
 
+    recorder = getattr(state, "trace_recorder", None)
+    trace = root = None
+    if recorder is not None:
+        trace = recorder.begin(request_id, request.headers.get("traceparent"))
+        root = trace.start_span(
+            "router.request", start=in_router_time,
+            endpoint=endpoint, model=requested_model or "",
+        )
+
     endpoints = state.service_discovery.get_endpoint_info()
     if requested_model is not None:
         endpoints = [
@@ -137,6 +147,9 @@ async def route_general_request(
     else:
         endpoints = [ep for ep in endpoints if not ep.sleep]
     if not endpoints:
+        if trace is not None:
+            root.finish(status=400, error="no_endpoints")
+            recorder.record(trace)
         return web.json_response(
             {"error": f"Model {requested_model} not found or all engines sleeping."},
             status=400,
@@ -147,12 +160,19 @@ async def route_general_request(
 
     import inspect
 
+    routing_span = trace.start_span("router.routing") if trace else None
     route_result = state.router.route_request(
         endpoints, engine_stats, request_stats, dict(request.headers), request_json
     )
     server_url = (
         await route_result if inspect.isawaitable(route_result) else route_result
     )
+    if routing_span is not None:
+        routing_span.finish(
+            engine=server_url,
+            logic=type(state.router).__name__,
+            candidates=len(endpoints),
+        )
 
     logger.info(
         "Routing request %s for model %s to %s at %.3f (took %.1f ms)",
@@ -160,46 +180,72 @@ async def route_general_request(
         in_router_time, (time.time() - in_router_time) * 1e3,
     )
 
+    headers = _forward_headers(request)
+    headers["X-Request-Id"] = request_id
+    upstream = None
+    if trace is not None:
+        # The upstream span is the engine-side parent: its id travels in
+        # the traceparent header so engine spans link under it.
+        upstream = trace.start_span("router.upstream", engine=server_url)
+        headers["traceparent"] = format_traceparent(
+            trace.trace_id, upstream.span_id)
+
     stream = process_request(
-        state, request_id, server_url, endpoint, body, _forward_headers(request)
+        state, request_id, server_url, endpoint, body, headers
     )
     response: Optional[web.StreamResponse] = None
     full_response = bytearray()
+    got_first_chunk = False
     try:
-        async for kind, payload in stream:
-            if kind == "headers":
-                status, hdrs = payload
-                response = web.StreamResponse(status=status)
-                ct = hdrs.get("Content-Type")
-                if ct:
-                    response.content_type = ct.split(";")[0]
-                    if "charset=" in ct:
-                        response.charset = ct.split("charset=")[-1]
-                response.headers["X-Request-Id"] = request_id
-                await response.prepare(request)
-            else:
-                full_response.extend(payload)
-                assert response is not None
-                await response.write(payload)
-    except aiohttp.ClientError as e:
-        logger.error("Backend %s failed for %s: %s", server_url, request_id, e)
+        try:
+            async for kind, payload in stream:
+                if kind == "headers":
+                    status, hdrs = payload
+                    response = web.StreamResponse(status=status)
+                    ct = hdrs.get("Content-Type")
+                    if ct:
+                        response.content_type = ct.split(";")[0]
+                        if "charset=" in ct:
+                            response.charset = ct.split("charset=")[-1]
+                    response.headers["X-Request-Id"] = request_id
+                    await response.prepare(request)
+                else:
+                    if trace is not None and not got_first_chunk:
+                        got_first_chunk = True
+                        trace.add_span(
+                            "router.first_chunk", upstream.start, time.time(),
+                            parent=upstream,
+                        )
+                    full_response.extend(payload)
+                    assert response is not None
+                    await response.write(payload)
+        except aiohttp.ClientError as e:
+            logger.error("Backend %s failed for %s: %s", server_url, request_id, e)
+            if upstream is not None:
+                upstream.finish(error=str(e))
+            if response is None:
+                return web.json_response(
+                    {"error": f"Backend connection failed: {e}"}, status=502
+                )
+            raise
         if response is None:
-            return web.json_response(
-                {"error": f"Backend connection failed: {e}"}, status=502
-            )
-        raise
-    if response is None:
-        return web.json_response({"error": "Empty backend response"}, status=502)
-    await response.write_eof()
+            return web.json_response({"error": "Empty backend response"}, status=502)
+        await response.write_eof()
 
-    # Post-request hooks: semantic cache store + callbacks (reference :129-137).
-    if state.semantic_cache is not None and endpoint.endswith("chat/completions"):
-        await state.semantic_cache.maybe_store(request_json, bytes(full_response))
-    if state.callbacks and hasattr(state.callbacks, "post_request"):
-        await _maybe_await(
-            state.callbacks.post_request(request_json, bytes(full_response), request_id)
-        )
-    return response
+        # Post-request hooks: semantic cache store + callbacks (reference :129-137).
+        if state.semantic_cache is not None and endpoint.endswith("chat/completions"):
+            await state.semantic_cache.maybe_store(request_json, bytes(full_response))
+        if state.callbacks and hasattr(state.callbacks, "post_request"):
+            await _maybe_await(
+                state.callbacks.post_request(request_json, bytes(full_response), request_id)
+            )
+        return response
+    finally:
+        if trace is not None:
+            status = response.status if response is not None else 0
+            upstream.finish(status=status, bytes=len(full_response))
+            root.finish(status=status)
+            recorder.record(trace)
 
 
 async def send_request_to_prefiller(
@@ -229,8 +275,22 @@ async def route_disaggregated_prefill_request(
     endpoints = state.service_discovery.get_endpoint_info()
     router = state.router
 
+    recorder = getattr(state, "trace_recorder", None)
+    trace = root = None
+    if recorder is not None:
+        trace = recorder.begin(request_id, request.headers.get("traceparent"))
+        root = trace.start_span(
+            "router.request", endpoint=endpoint, disaggregated=True,
+            model=request_json.get("model") or "",
+        )
+
     prefill_url = router.pick(endpoints, "prefill")
     decode_url = router.pick(endpoints, "decode")
+    if trace is not None:
+        trace.start_span("router.routing").finish(
+            engine=decode_url, prefill_engine=prefill_url,
+            logic=type(router).__name__,
+        )
 
     saved = {
         k: request_json.get(k) for k in ("max_tokens", "max_completion_tokens")
@@ -246,6 +306,12 @@ async def route_disaggregated_prefill_request(
 
     monitor = state.request_stats_monitor
     monitor.on_new_request(prefill_url, request_id, time.time())
+    prefill_span = None
+    if trace is not None:
+        prefill_span = trace.start_span(
+            "router.disagg_prefill", engine=prefill_url)
+        headers["traceparent"] = format_traceparent(
+            trace.trace_id, prefill_span.span_id)
     t0 = time.time()
     try:
         await send_request_to_prefiller(
@@ -253,8 +319,14 @@ async def route_disaggregated_prefill_request(
         )
     except aiohttp.ClientError as e:
         monitor.on_request_complete(prefill_url, request_id, time.time())
+        if trace is not None:
+            prefill_span.finish(error=str(e))
+            root.finish(status=502)
+            recorder.record(trace)
         return web.json_response({"error": f"Prefill failed: {e}"}, status=502)
     ttft = time.time() - t0
+    if prefill_span is not None:
+        prefill_span.finish()
     monitor.on_request_response(prefill_url, request_id, time.time())
     monitor.on_request_complete(prefill_url, request_id, time.time())
     logger.info("Disagg prefill for %s took %.3f s (TTFT)", request_id, ttft)
@@ -264,16 +336,28 @@ async def route_disaggregated_prefill_request(
     # message — the reference's out-of-band NIXL transfer equivalent).
     # Failure is non-fatal: decode recomputes the prefix.
     if prefill_url != decode_url:
+        pull_span = None
+        if trace is not None:
+            pull_span = trace.start_span(
+                "router.kv_pull", source=prefill_url, target=decode_url)
+            headers["traceparent"] = format_traceparent(
+                trace.trace_id, pull_span.span_id)
         try:
             async with session.post(
                 f"{decode_url}/kv/pull",
                 json={"source_url": prefill_url, "request": request_json},
+                headers={k: headers[k] for k in ("X-Request-Id", "traceparent")
+                         if k in headers},
                 timeout=aiohttp.ClientTimeout(total=60),
             ) as pull_resp:
                 pull = await pull_resp.json()
                 logger.info(
                     "Disagg KV pull for %s: %s", request_id, pull)
+            if pull_span is not None:
+                pull_span.finish(status="ok")
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            if pull_span is not None:
+                pull_span.finish(error=str(e))
             logger.warning(
                 "Disagg KV pull failed for %s (decode will recompute): %s",
                 request_id, e)
@@ -285,26 +369,46 @@ async def route_disaggregated_prefill_request(
     body = json.dumps(decode_json).encode()
     headers["Content-Type"] = "application/json"
 
+    upstream = None
+    if trace is not None:
+        upstream = trace.start_span("router.upstream", engine=decode_url)
+        headers["traceparent"] = format_traceparent(
+            trace.trace_id, upstream.span_id)
+
     stream = process_request(
         state, request_id, decode_url, endpoint, body, headers
     )
     response: Optional[web.StreamResponse] = None
-    async for kind, payload in stream:
-        if kind == "headers":
-            status, hdrs = payload
-            response = web.StreamResponse(status=status)
-            ct = hdrs.get("Content-Type")
-            if ct:
-                response.content_type = ct.split(";")[0]
-            response.headers["X-Request-Id"] = request_id
-            await response.prepare(request)
-        else:
-            assert response is not None
-            await response.write(payload)
-    if response is None:
-        return web.json_response({"error": "Empty decode response"}, status=502)
-    await response.write_eof()
-    return response
+    got_first_chunk = False
+    try:
+        async for kind, payload in stream:
+            if kind == "headers":
+                status, hdrs = payload
+                response = web.StreamResponse(status=status)
+                ct = hdrs.get("Content-Type")
+                if ct:
+                    response.content_type = ct.split(";")[0]
+                response.headers["X-Request-Id"] = request_id
+                await response.prepare(request)
+            else:
+                if trace is not None and not got_first_chunk:
+                    got_first_chunk = True
+                    trace.add_span(
+                        "router.first_chunk", upstream.start, time.time(),
+                        parent=upstream,
+                    )
+                assert response is not None
+                await response.write(payload)
+        if response is None:
+            return web.json_response({"error": "Empty decode response"}, status=502)
+        await response.write_eof()
+        return response
+    finally:
+        if trace is not None:
+            status = response.status if response is not None else 0
+            upstream.finish(status=status)
+            root.finish(status=status)
+            recorder.record(trace)
 
 
 async def route_sleep_wakeup_request(

@@ -20,6 +20,8 @@ from typing import List, Optional
 
 from aiohttp import web
 
+from production_stack_tpu.obs.trace import TraceRecorder
+
 
 class FakeEngine:
     def __init__(
@@ -39,6 +41,10 @@ class FakeEngine:
         self.num_waiting = 0
         self.requests_seen: List[dict] = []
         self.kv_usage = 0.42
+        # Same trace surface as the real engine server: synthetic
+        # queue/prefill/decode spans linked under the router's forwarded
+        # traceparent, retrievable at /debug/traces/{request_id}.
+        self.trace_recorder = TraceRecorder("fake-engine")
 
     # -- helpers -----------------------------------------------------------
     def _token_delay(self) -> float:
@@ -58,7 +64,30 @@ class FakeEngine:
         app.router.add_get("/is_sleeping", self.handle_is_sleeping)
         app.router.add_get("/health", self.handle_health)
         app.router.add_post("/v1/audio/transcriptions", self.handle_transcription)
+        from production_stack_tpu.obs.debug import add_debug_routes
+
+        add_debug_routes(app.router, self.trace_recorder)
         return app
+
+    def _record_trace(self, request: web.Request, rid: str, model: str,
+                      t_arrival: float, t_prefill_end: Optional[float],
+                      n_tokens: int) -> None:
+        """Engine-side stage timeline matching the real server's span
+        names: queue (instant here), prefill (the TTFT sleep), decode
+        (the token loop)."""
+        now = time.time()
+        trace = self.trace_recorder.begin(
+            rid, request.headers.get("traceparent"))
+        root = trace.start_span("engine.request", start=t_arrival,
+                                model=model)
+        trace.add_span("engine.queue", t_arrival, t_arrival, parent=root)
+        prefill_end = t_prefill_end if t_prefill_end is not None else now
+        trace.add_span("engine.prefill", t_arrival, prefill_end, parent=root,
+                       prompt_tokens=5, cached_tokens=0, uncached_tokens=5)
+        trace.add_span("engine.decode", prefill_end, now, parent=root,
+                       tokens=n_tokens, steps=n_tokens)
+        root.finish(end=now, tokens=n_tokens)
+        self.trace_recorder.record(trace)
 
     async def handle_models(self, request: web.Request) -> web.Response:
         return web.json_response({
@@ -78,12 +107,16 @@ class FakeEngine:
             or self.max_tokens_default
         )
         stream = bool(body.get("stream", False))
-        rid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
+        rid = (request.headers.get("X-Request-Id")
+               or f"chatcmpl-{uuid.uuid4().hex[:12]}")
         model = body.get("model", self.models[0])
+        t_arrival = time.time()
+        t_prefill_end: Optional[float] = None
         self.num_running += 1
         try:
             if self.ttft > 0:
                 await asyncio.sleep(self.ttft)
+            t_prefill_end = time.time()
             if not stream:
                 for _ in range(n_tokens):
                     await asyncio.sleep(self._token_delay())
@@ -126,6 +159,8 @@ class FakeEngine:
             await resp.write_eof()
             return resp
         finally:
+            self._record_trace(request, rid, model, t_arrival,
+                               t_prefill_end, n_tokens)
             self.num_running -= 1
 
     async def handle_completion(self, request: web.Request) -> web.StreamResponse:
@@ -133,11 +168,16 @@ class FakeEngine:
         self.requests_seen.append(body)
         n_tokens = int(body.get("max_tokens") or self.max_tokens_default)
         stream = bool(body.get("stream", False))
-        rid = f"cmpl-{uuid.uuid4().hex[:12]}"
+        rid = (request.headers.get("X-Request-Id")
+               or f"cmpl-{uuid.uuid4().hex[:12]}")
         model = body.get("model", self.models[0])
+        t_arrival = time.time()
         if self.ttft > 0:
             await asyncio.sleep(self.ttft)
+        t_prefill_end = time.time()
         if not stream:
+            self._record_trace(request, rid, model, t_arrival,
+                               t_prefill_end, n_tokens)
             return web.json_response({
                 "id": rid, "object": "text_completion", "model": model,
                 "created": int(time.time()),
@@ -160,6 +200,8 @@ class FakeEngine:
             await asyncio.sleep(self._token_delay())
         await resp.write(b"data: [DONE]\n\n")
         await resp.write_eof()
+        self._record_trace(request, rid, model, t_arrival,
+                           t_prefill_end, n_tokens)
         return resp
 
     async def handle_embeddings(self, request: web.Request) -> web.Response:

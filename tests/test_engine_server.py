@@ -275,3 +275,58 @@ def test_concurrent_requests(server_url):
             assert status == 200
             assert body["usage"]["completion_tokens"] == 6
     asyncio.run(run())
+
+
+def test_request_trace_and_stage_metrics(server_url):
+    """The real engine records queue/prefill/decode spans from the
+    StageClock the core stamps, links them under the router's traceparent,
+    and feeds the tpu:*_time_seconds exposition."""
+    import re
+
+    rid = "trace-engine-e2e"
+    trace_id, parent_span = "ef" * 16, "12" * 8
+
+    async def run():
+        async with aiohttp.ClientSession() as s:
+            async with s.post(server_url + "/v1/completions", json={
+                "model": "tiny-llama", "prompt": "trace me",
+                "max_tokens": 6, "temperature": 0.0, "ignore_eos": True,
+            }, headers={
+                "X-Request-Id": rid,
+                "traceparent": f"00-{trace_id}-{parent_span}-01",
+            }) as r:
+                assert r.status == 200
+            async with s.get(server_url + f"/debug/traces/{rid}") as r:
+                assert r.status == 200
+                trace = await r.json()
+            async with s.get(server_url + "/metrics") as r:
+                metrics = await r.text()
+        return trace, metrics
+
+    trace, metrics = asyncio.run(run())
+
+    assert trace["trace_id"] == trace_id
+    assert trace["remote_parent_span_id"] == parent_span
+    spans = {sp["name"]: sp for sp in trace["spans"]}
+    assert {"engine.request", "engine.queue", "engine.prefill",
+            "engine.decode"} <= set(spans)
+    root = spans["engine.request"]
+    for name in ("engine.queue", "engine.prefill", "engine.decode"):
+        assert spans[name]["parent_span_id"] == root["span_id"]
+    # Stage ordering and a stage sum consistent with the root duration.
+    assert (spans["engine.queue"]["start_unix"]
+            <= spans["engine.prefill"]["start_unix"]
+            <= spans["engine.decode"]["start_unix"])
+    stage_sum = sum(spans[n]["duration_s"] for n in
+                    ("engine.queue", "engine.prefill", "engine.decode"))
+    assert stage_sum <= root["duration_s"] + 0.1
+    assert spans["engine.decode"]["attributes"]["tokens"] == 6
+    assert spans["engine.prefill"]["attributes"]["prompt_tokens"] > 0
+
+    # The recorder's aggregates reach /metrics as sum/count pairs.
+    for fam in ("tpu:queue_time_seconds", "tpu:prefill_time_seconds",
+                "tpu:decode_time_seconds"):
+        m = re.search(rf"{fam}_count{{[^}}]*}} (\d+)", metrics)
+        assert m and int(m.group(1)) >= 1, fam
+    assert "tpu:slow_requests_total" in metrics
+    assert re.search(r"tpu:hbm_headroom_bytes{[^}]*} \d+", metrics)

@@ -1,0 +1,368 @@
+"""End-to-end request tracing (hermetic): W3C traceparent propagation
+through the router to a fake engine, flight-recorder retrieval on both
+sides, parent/child linkage across the hop, stage ordering, and the
+slow-trace / export toggles.
+
+Span-name contract exercised here (obs/trace.py docstring):
+router.request > router.routing / router.upstream > router.first_chunk
+on the router; engine.request > engine.queue / engine.prefill /
+engine.decode on the engine, with the engine root linked under the
+router's upstream span via the forwarded ``traceparent``.
+"""
+
+import argparse
+import json
+import logging
+import time
+import uuid
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from production_stack_tpu.obs.trace import (
+    TraceRecorder,
+    format_traceparent,
+    parse_traceparent,
+    trace_id_from_request_id,
+)
+from production_stack_tpu.router import routing_logic as rl
+from production_stack_tpu.router.app import build_app
+from production_stack_tpu.router.engine_stats import EngineStatsScraper
+from production_stack_tpu.router.request_stats import RequestStatsMonitor
+from production_stack_tpu.testing.fake_engine import FakeEngine
+from production_stack_tpu.utils.misc import SingletonABCMeta, SingletonMeta
+
+
+# ---------------------------------------------------------------------------
+# Unit: W3C header + recorder primitives
+# ---------------------------------------------------------------------------
+
+
+def test_traceparent_roundtrip_and_rejects():
+    tid, sid = "ab" * 16, "cd" * 8
+    assert parse_traceparent(format_traceparent(tid, sid)) == (tid, sid, 1)
+    # Case-insensitive, whitespace-tolerant.
+    assert parse_traceparent(f"  00-{tid.upper()}-{sid}-01 ") == (tid, sid, 1)
+    for bad in (
+        None, "", "garbage",
+        f"ff-{tid}-{sid}-01",          # forbidden version
+        f"00-{'0' * 32}-{sid}-01",      # all-zero trace id
+        f"00-{tid}-{'0' * 16}-01",      # all-zero span id
+        f"00-{tid[:-1]}-{sid}-01",      # wrong length
+    ):
+        assert parse_traceparent(bad) is None, bad
+
+
+def test_trace_id_fallback_is_deterministic():
+    a = trace_id_from_request_id("req-123")
+    assert a == trace_id_from_request_id("req-123")
+    assert a != trace_id_from_request_id("req-124")
+    assert len(a) == 32 and a != "0" * 32
+    assert parse_traceparent(format_traceparent(a, "ab" * 8)) is not None
+
+
+def _record_one(rec, rid, dur=0.01):
+    t0 = time.time() - dur
+    tr = rec.begin(rid)
+    root = tr.start_span("engine.request", start=t0)
+    tr.add_span("engine.queue", t0, t0 + dur / 2, parent=root)
+    root.finish(end=t0 + dur)
+    rec.record(tr)
+    return tr
+
+
+def test_recorder_ring_eviction_and_stage_stats():
+    rec = TraceRecorder("test", capacity=2)
+    for i in range(3):
+        _record_one(rec, f"r{i}")
+    assert rec.get("r0") is None  # evicted, oldest first
+    assert rec.get("r1") is not None and rec.get("r2") is not None
+    assert rec.recorded_total == 3
+    # Aggregates survive eviction: 3 requests' worth of queue time.
+    q_sum, q_count = rec.stage_stats()["engine.queue"]
+    assert q_count == 3 and q_sum > 0
+    summaries = rec.list()
+    assert [s["request_id"] for s in summaries] == ["r2", "r1"]
+    assert rec.list(min_duration_s=999.0) == []
+
+
+def test_slow_trace_counted_and_logged(caplog):
+    log = logging.getLogger("test-slow-trace")
+    rec = TraceRecorder("test", slow_threshold_s=0.001, log=log)
+    with caplog.at_level(logging.WARNING, logger="test-slow-trace"):
+        _record_one(rec, "slow-1", dur=0.05)
+    assert rec.slow_requests == 1
+    lines = [r.getMessage() for r in caplog.records
+             if "slow_trace" in r.getMessage()]
+    assert lines
+    payload = json.loads(lines[0].split("slow_trace ", 1)[1])
+    assert payload["event"] == "slow_trace"
+    assert payload["request_id"] == "slow-1"
+    assert payload["threshold_s"] == 0.001
+    assert payload["spans"]
+
+
+def test_file_export_writes_otlp_json(tmp_path):
+    out = tmp_path / "traces.jsonl"
+    rec = TraceRecorder("test", export=f"file:{out}")
+    _record_one(rec, "exported-1")
+    rec.close()
+    lines = out.read_text().strip().splitlines()
+    assert len(lines) == 1
+    payload = json.loads(lines[0])
+    rs = payload["resourceSpans"][0]
+    attrs = {a["key"]: a["value"] for a in rs["resource"]["attributes"]}
+    assert attrs["service.name"] == {"stringValue": "test"}
+    spans = rs["scopeSpans"][0]["spans"]
+    assert {s["name"] for s in spans} == {"engine.request", "engine.queue"}
+    for s in spans:
+        assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+
+
+# ---------------------------------------------------------------------------
+# E2E: router -> fake engine over real HTTP
+# ---------------------------------------------------------------------------
+
+
+def _args(**overrides) -> argparse.Namespace:
+    from production_stack_tpu.router.parser import build_parser
+
+    args = build_parser().parse_args([])
+    for k, v in overrides.items():
+        setattr(args, k, v)
+    return args
+
+
+async def _start(app: web.Application):
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    def _reset():
+        for cls in (
+            rl.RoundRobinRouter, rl.SessionRouter, rl.PrefixAwareRouter,
+            rl.KvawareRouter, rl.DisaggregatedPrefillRouter,
+        ):
+            SingletonABCMeta._reset_instance(cls)
+        SingletonMeta._reset_instance(RequestStatsMonitor)
+        SingletonMeta._reset_instance(EngineStatsScraper)
+
+    _reset()
+    yield
+    _reset()
+
+
+async def _router_one_engine(**argover):
+    engine = FakeEngine(model="test-model", ttft=0.05, tokens_per_sec=500.0)
+    erunner, eurl = await _start(engine.make_app())
+    args = _args(
+        static_backends=eurl,
+        static_models="test-model",
+        routing_logic="roundrobin",
+        engine_stats_interval=0.2,
+        **argover,
+    )
+    app = build_app(args)
+    rrunner, rurl = await _start(app)
+    return engine, eurl, app, rurl, [erunner, rrunner]
+
+
+async def _cleanup(runners):
+    for r in reversed(runners):
+        await r.cleanup()
+
+
+async def _get_trace(s, base_url, rid):
+    async with s.get(f"{base_url}/debug/traces/{rid}") as resp:
+        assert resp.status == 200, await resp.text()
+        return await resp.json()
+
+
+def _span(trace, name):
+    matches = [sp for sp in trace["spans"] if sp["name"] == name]
+    assert matches, f"{name} missing from {[s['name'] for s in trace['spans']]}"
+    return matches[0]
+
+
+async def test_trace_propagates_router_to_engine():
+    engine, eurl, app, rurl, runners = await _router_one_engine()
+    client_trace_id = "ab" * 16
+    rid = f"trace-e2e-{uuid.uuid4().hex[:8]}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            t0 = time.time()
+            async with s.post(
+                f"{rurl}/v1/chat/completions",
+                json={"model": "test-model", "max_tokens": 4,
+                      "messages": [{"role": "user", "content": "hi"}]},
+                headers={
+                    "X-Request-Id": rid,
+                    "traceparent": format_traceparent(client_trace_id,
+                                                      "cd" * 8),
+                },
+            ) as resp:
+                assert resp.status == 200
+                await resp.json()
+            e2e_s = time.time() - t0
+
+            rt = await _get_trace(s, rurl, rid)
+            et = await _get_trace(s, eurl, rid)
+
+        # One trace id across client -> router -> engine.
+        assert rt["trace_id"] == client_trace_id
+        assert et["trace_id"] == client_trace_id
+        assert rt["service"] == "tpu-stack-router"
+        assert et["service"] == "fake-engine"
+
+        # Router spans + linkage: the client's span parents the router
+        # root; the router's upstream span parents the engine root.
+        root = _span(rt, "router.request")
+        routing = _span(rt, "router.routing")
+        upstream = _span(rt, "router.upstream")
+        first_chunk = _span(rt, "router.first_chunk")
+        assert rt["remote_parent_span_id"] == "cd" * 8
+        assert root["parent_span_id"] == "cd" * 8
+        assert routing["parent_span_id"] == root["span_id"]
+        assert routing["attributes"]["engine"] == eurl
+        assert routing["attributes"]["logic"] == "RoundRobinRouter"
+        assert upstream["parent_span_id"] == root["span_id"]
+        assert first_chunk["parent_span_id"] == upstream["span_id"]
+
+        eroot = _span(et, "engine.request")
+        assert et["remote_parent_span_id"] == upstream["span_id"]
+        assert eroot["parent_span_id"] == upstream["span_id"]
+
+        # Stage ordering and duration consistency with the e2e latency.
+        queue = _span(et, "engine.queue")
+        prefill = _span(et, "engine.prefill")
+        decode = _span(et, "engine.decode")
+        assert queue["start_unix"] <= prefill["start_unix"] <= decode["start_unix"]
+        for child in (queue, prefill, decode):
+            assert child["parent_span_id"] == eroot["span_id"]
+        stage_sum = (queue["duration_s"] + prefill["duration_s"]
+                     + decode["duration_s"])
+        assert stage_sum <= e2e_s + 0.25
+        assert prefill["duration_s"] >= 0.03  # the fake engine's 50ms TTFT
+        assert eroot["duration_s"] <= root["duration_s"] + 0.05
+        assert root["duration_s"] <= e2e_s + 0.25
+    finally:
+        await _cleanup(runners)
+
+
+async def test_trace_without_traceparent_stitches_via_request_id():
+    engine, eurl, app, rurl, runners = await _router_one_engine()
+    rid = f"no-tp-{uuid.uuid4().hex[:8]}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{rurl}/v1/completions",
+                json={"model": "test-model", "prompt": "hi",
+                      "max_tokens": 2},
+                headers={"X-Request-Id": rid},
+            ) as resp:
+                assert resp.status == 200
+            rt = await _get_trace(s, rurl, rid)
+            et = await _get_trace(s, eurl, rid)
+        # No incoming context: the router derives the trace id from the
+        # request id; the engine continues it via the forwarded header.
+        assert rt["trace_id"] == trace_id_from_request_id(rid)
+        assert et["trace_id"] == rt["trace_id"]
+        assert rt["remote_parent_span_id"] is None
+        assert et["remote_parent_span_id"] == \
+            _span(rt, "router.upstream")["span_id"]
+    finally:
+        await _cleanup(runners)
+
+
+async def test_streaming_records_first_chunk_span():
+    engine, eurl, app, rurl, runners = await _router_one_engine()
+    rid = f"stream-{uuid.uuid4().hex[:8]}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{rurl}/v1/chat/completions",
+                json={"model": "test-model", "max_tokens": 3, "stream": True,
+                      "messages": [{"role": "user", "content": "hi"}]},
+                headers={"X-Request-Id": rid},
+            ) as resp:
+                assert resp.status == 200
+                async for _ in resp.content:
+                    pass
+            rt = await _get_trace(s, rurl, rid)
+        upstream = _span(rt, "router.upstream")
+        first_chunk = _span(rt, "router.first_chunk")
+        # TTFT as seen by the router: the fake engine sleeps 50ms.
+        assert first_chunk["duration_s"] >= 0.03
+        assert first_chunk["duration_s"] <= upstream["duration_s"] + 0.01
+        assert upstream["attributes"]["status"] == 200
+    finally:
+        await _cleanup(runners)
+
+
+async def test_debug_traces_listing_and_filters():
+    engine, eurl, app, rurl, runners = await _router_one_engine()
+    try:
+        async with aiohttp.ClientSession() as s:
+            for i in range(3):
+                async with s.post(
+                    f"{rurl}/v1/completions",
+                    json={"model": "test-model", "prompt": "hi",
+                          "max_tokens": 1},
+                    headers={"X-Request-Id": f"list-{i}"},
+                ) as resp:
+                    assert resp.status == 200
+            async with s.get(f"{rurl}/debug/traces") as resp:
+                assert resp.status == 200
+                body = await resp.json()
+            assert body["service"] == "tpu-stack-router"
+            assert body["recorded_total"] >= 3
+            listed = [t["request_id"] for t in body["traces"]]
+            assert listed[:3] == ["list-2", "list-1", "list-0"]  # newest first
+            async with s.get(f"{rurl}/debug/traces",
+                             params={"min_duration_s": "999"}) as resp:
+                assert (await resp.json())["traces"] == []
+            async with s.get(f"{rurl}/debug/traces",
+                             params={"limit": "1"}) as resp:
+                assert len((await resp.json())["traces"]) == 1
+            async with s.get(f"{rurl}/debug/traces",
+                             params={"min_duration_s": "bogus"}) as resp:
+                assert resp.status == 400
+            async with s.get(f"{rurl}/debug/traces/nope") as resp:
+                assert resp.status == 404
+            # OTLP projection of a single trace.
+            async with s.get(f"{rurl}/debug/traces/list-0",
+                             params={"format": "otlp"}) as resp:
+                otlp = await resp.json()
+            assert otlp["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    finally:
+        await _cleanup(runners)
+
+
+async def test_slow_trace_threshold_via_router_flag(tmp_path):
+    out = tmp_path / "router-traces.jsonl"
+    engine, eurl, app, rurl, runners = await _router_one_engine(
+        slow_trace_threshold_s=0.01, trace_export=f"file:{out}",
+    )
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{rurl}/v1/completions",
+                json={"model": "test-model", "prompt": "hi", "max_tokens": 2},
+                headers={"X-Request-Id": "slow-e2e"},
+            ) as resp:
+                assert resp.status == 200
+        # The 50ms fake TTFT alone exceeds the 10ms threshold.
+        rec = app["state"].trace_recorder
+        assert rec.slow_requests >= 1
+        assert rec.slow_threshold_s == 0.01
+        payload = json.loads(out.read_text().strip().splitlines()[0])
+        assert payload["resourceSpans"]
+    finally:
+        await _cleanup(runners)
