@@ -138,6 +138,20 @@ ADAPTER_NAME = "bench-adapter"
 # rounds after this many seconds (in-flight rounds finish), mirroring the
 # reference's --time per-point cap. 0 = no cap.
 TIME_LIMIT = _env_float("BENCH_TIME_LIMIT", 480.0)
+# Chunked prefill A/B knobs (the tail-latency tentpole): BENCH_CHUNKED=1
+# turns the budgeted scheduler on; BENCH_MAX_NUM_BATCHED_TOKENS overrides
+# the per-step budget (0 = derive from the prefill chunk size).
+CHUNKED = _env_int("BENCH_CHUNKED", int(_cfg.get("chunked", 0)))
+MAX_NUM_BATCHED_TOKENS = _env_int(
+    "BENCH_MAX_NUM_BATCHED_TOKENS",
+    int(_cfg.get("max_num_batched_tokens", 0)))
+# Scripted arrival storm: BENCH_STORM_USERS long-prompt one-shot requests
+# fired together BENCH_STORM_AT seconds into the traffic phase. Storm
+# requests are excluded from throughput/TTFT/gap stats — the stall they
+# cause is measured on the steady streams' max inter-token gap.
+STORM_USERS = _env_int("BENCH_STORM_USERS", 0)
+STORM_AT = _env_float("BENCH_STORM_AT", 10.0)
+STORM_PROMPT_TOKENS = _env_int("BENCH_STORM_PROMPT_TOKENS", 4000)
 
 
 def _load_baseline() -> float:
@@ -202,11 +216,14 @@ async def _drive(router_url: str):
     sys_prompt = _make_prompt(SYS_PROMPT_TOKENS, "ctx")
     ttfts = []
     latencies = []
+    max_itgs = []  # per-steady-request max inter-token gap (decode stall)
     tokens_done = 0
     prompt_tokens_sent = 0
     failures = 0
+    storm_done = [0]
     rounds_done = 0
     t_deadline = [None]
+    t_start_box = [None]
 
     async def one_user(session, uid: int):
         nonlocal tokens_done, failures, rounds_done, prompt_tokens_sent
@@ -234,6 +251,8 @@ async def _drive(router_url: str):
             prompt_tokens_sent += sum(_turn_tokens(m) for m in history)
             t0 = time.perf_counter()
             first = None
+            last_tok = None
+            max_gap = 0.0
             answer = []
             model = ADAPTER_NAME if uid < LORA_USERS else MODEL
             try:
@@ -265,8 +284,12 @@ async def _drive(router_url: str):
                             finish = choice["finish_reason"]
                         content = choice.get("delta", {}).get("content")
                         if content:
+                            now = time.perf_counter()
                             if first is None:
-                                first = time.perf_counter()
+                                first = now
+                            else:
+                                max_gap = max(max_gap, now - last_tok)
+                            last_tok = now
                             answer.append(content)
             except Exception:  # noqa: BLE001 - count and continue
                 failures += 1
@@ -282,9 +305,46 @@ async def _drive(router_url: str):
                 continue
             ttfts.append(first - t0)
             latencies.append(time.perf_counter() - t0)
+            if max_gap > 0:
+                max_itgs.append(max_gap)
             tokens_done += ANSWER_TOKENS
             rounds_done += 1
             history.append({"role": "assistant", "content": "".join(answer)})
+
+    async def storm(session):
+        """Scripted arrival storm: STORM_USERS long cold prompts land at
+        once, STORM_AT seconds into the traffic phase. Each is one
+        non-streaming short-answer request (pure prefill pressure)."""
+        if STORM_USERS <= 0:
+            return
+        while t_start_box[0] is None:
+            await asyncio.sleep(0.05)
+        await asyncio.sleep(STORM_AT)
+
+        async def one_storm(i: int):
+            try:
+                async with session.post(
+                    router_url + "/v1/chat/completions",
+                    json={
+                        "model": MODEL,
+                        "messages": [{
+                            "role": "user",
+                            "content": _make_prompt(
+                                STORM_PROMPT_TOKENS, f"storm{i}_"),
+                        }],
+                        "max_tokens": 4, "temperature": 0.0,
+                        "ignore_eos": True,
+                    },
+                    headers={"x-user-id": f"storm{i}"},
+                    timeout=aiohttp.ClientTimeout(total=900),
+                ) as resp:
+                    await resp.read()
+                    if resp.status == 200:
+                        storm_done[0] += 1
+            except Exception:  # noqa: BLE001 - storm failures are counted
+                pass
+
+        await asyncio.gather(*[one_storm(i) for i in range(STORM_USERS)])
 
     async with aiohttp.ClientSession() as session:
         # Warmup: trigger prefill-bucket + decode compiles before timing
@@ -302,12 +362,15 @@ async def _drive(router_url: str):
             ) as resp:
                 await resp.read()
         t_start = time.perf_counter()
+        t_start_box[0] = t_start
         if TIME_LIMIT > 0:
             t_deadline[0] = t_start + TIME_LIMIT
-        await asyncio.gather(*[one_user(session, u) for u in range(USERS)])
+        await asyncio.gather(
+            *[one_user(session, u) for u in range(USERS)],
+            storm(session))
         elapsed = time.perf_counter() - t_start
     return (tokens_done, elapsed, ttfts, latencies, failures,
-            rounds_done, prompt_tokens_sent)
+            rounds_done, prompt_tokens_sent, max_itgs, storm_done[0])
 
 
 async def _main() -> dict:
@@ -343,6 +406,8 @@ async def _main() -> dict:
         # compiles on a 1-core runner are minutes).
         prefill_batch=_env_int(
             "BENCH_PREFILL_BATCH", _cfg.get("prefill_batch", 4)),
+        enable_chunked_prefill=bool(CHUNKED),
+        max_num_batched_tokens=MAX_NUM_BATCHED_TOKENS,
     )
     servers = [EngineServer(config, warmup=True) for _ in range(n_engines)]
     runners, engine_urls = [], []
@@ -385,7 +450,7 @@ async def _main() -> dict:
 
     try:
         (tokens, elapsed, ttfts, latencies, failures, rounds_done,
-         prompt_tokens) = await _drive(router_url)
+         prompt_tokens, max_itgs, storm_done) = await _drive(router_url)
         core_stats = servers[0].core.stats()
         if n_engines > 1:
             # Aggregate across units: the prefill engine does the real
@@ -459,6 +524,22 @@ async def _main() -> dict:
         "engine_bursts": core_stats["decode_burst_count"],
         "engine_dispatches": core_stats["dispatch_count_total"],
         "engine_dispatch_enqueue_s": core_stats["dispatch_enqueue_s"],
+        # Arrival-storm A/B (chunked-prefill acceptance): the max gap
+        # between consecutive streamed tokens on a steady user is the
+        # decode stall a storm prefill induced.
+        "chunked": bool(CHUNKED),
+        "max_itg_s": round(max(max_itgs), 4) if max_itgs else None,
+        "itg_p99_s": (
+            round(sorted(max_itgs)[
+                min(len(max_itgs) - 1,
+                    max(0, -(-99 * len(max_itgs) // 100) - 1))], 4)
+            if max_itgs else None
+        ),
+        "storm_users": STORM_USERS,
+        "storm_done": storm_done,
+        "engine_prefill_chunks": core_stats.get("prefill_chunks_total", 0),
+        "engine_deferred_prefill_tokens": core_stats.get(
+            "deferred_prefill_tokens_total", 0),
         "backend": None,  # filled below
     }
     return result

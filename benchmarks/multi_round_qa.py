@@ -48,6 +48,12 @@ class RequestRecord:
     completion_tokens: int = 0
     generated_text: str = ""
     error: Optional[str] = None
+    # Longest gap between consecutive streamed content chunks — the
+    # decode-stall measure for the arrival-storm scenario.
+    max_itg: Optional[float] = None
+    # Storm requests create the stall; the stall is measured on the
+    # OTHER (steady) streams, so storms are excluded from gap stats.
+    is_storm: bool = False
 
     @property
     def latency(self) -> Optional[float]:
@@ -69,24 +75,28 @@ class MultiRoundQA:
         self.start_time = 0.0
 
     async def _one_request(self, session: aiohttp.ClientSession,
-                           user: UserSession) -> None:
+                           user: UserSession,
+                           question_len: Optional[int] = None,
+                           is_storm: bool = False) -> None:
         args = self.args
+        qlen = args.question_len if question_len is None else question_len
         messages = (
             [{"role": "system", "content": user.system_prompt}]
             + user.history
             + [{"role": "user",
                 "content": f"user{user.user_id} round{user.rounds_done} "
-                           + words(args.question_len,
+                           + words(qlen,
                                    f"q{user.user_id}_{user.rounds_done}_",
                                    seed=user.user_id * 1000
                                         + user.rounds_done)}]
         )
         rec = RequestRecord(
             user_id=user.user_id, round_id=user.rounds_done,
-            start=time.time(),
+            start=time.time(), is_storm=is_storm,
         )
         self.records.append(rec)
         answer: List[str] = []
+        last_token = rec.start
         try:
             async with session.post(
                 f"{args.base_url}/v1/chat/completions",
@@ -121,8 +131,14 @@ class MultiRoundQA:
                     delta = chunk["choices"][0].get("delta", {})
                     content = delta.get("content")
                     if content:
+                        now = time.time()
                         if rec.ttft is None:
-                            rec.ttft = time.time() - rec.start
+                            rec.ttft = now - rec.start
+                        else:
+                            gap = now - last_token
+                            if rec.max_itg is None or gap > rec.max_itg:
+                                rec.max_itg = gap
+                        last_token = now
                         rec.completion_tokens += 1
                         answer.append(content)
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
@@ -159,6 +175,34 @@ class MultiRoundQA:
                 kept.append(m)
             user.history = list(reversed(kept))
 
+    async def _storm_request(self, session: aiohttp.ClientSession,
+                             storm_id: int) -> None:
+        """One long-prompt request of the scripted arrival storm.
+
+        Storm users are independent of the steady users: each fires a
+        single request with a large question so its prefill occupies the
+        engine.  Their records are flagged ``is_storm`` and excluded
+        from the inter-token-gap stats — the stall they cause shows up
+        on the steady users' streams.
+        """
+        args = self.args
+        user = UserSession(
+            user_id=10_000 + storm_id,
+            system_prompt="",
+        )
+        await self._one_request(
+            session, user,
+            question_len=args.storm_question_len, is_storm=True)
+
+    async def _storm_loop(self, session: aiohttp.ClientSession) -> None:
+        args = self.args
+        if args.storm_users <= 0:
+            return
+        await asyncio.sleep(args.storm_at)
+        await asyncio.gather(*[
+            self._storm_request(session, i) for i in range(args.storm_users)
+        ])
+
     async def _qps_gate_filler(self, gate: asyncio.Semaphore):
         interval = 1.0 / self.args.qps if self.args.qps > 0 else 0.0
         while True:
@@ -179,9 +223,10 @@ class MultiRoundQA:
         async with aiohttp.ClientSession(connector=connector) as session:
             try:
                 await asyncio.wait_for(
-                    asyncio.gather(*[
-                        self._user_loop(session, u, gate) for u in users
-                    ]),
+                    asyncio.gather(*(
+                        [self._user_loop(session, u, gate) for u in users]
+                        + [self._storm_loop(session)]
+                    )),
                     timeout=args.time + args.request_timeout,
                 )
             except asyncio.TimeoutError:
@@ -197,6 +242,11 @@ class MultiRoundQA:
         lats = sorted(r.latency for r in done)
         gen_tokens = sum(r.completion_tokens for r in done)
         prompt_tokens = sum(r.prompt_tokens for r in done)
+        # Inter-token gaps over steady (non-storm) streams only: the
+        # storm requests are the cause of the stall, the steady decodes
+        # are where it is observed.
+        itgs = sorted(r.max_itg for r in done
+                      if not r.is_storm and r.max_itg is not None)
 
         def pct(values, q):
             if not values:
@@ -218,6 +268,8 @@ class MultiRoundQA:
             "ttft_p99_s": pct(ttfts, 0.99),
             "latency_p50_s": pct(lats, 0.50),
             "latency_p90_s": pct(lats, 0.90),
+            "max_itg_s": round(max(itgs), 4) if itgs else None,
+            "itg_p99_s": pct(itgs, 0.99),
         }
 
     def write_csv(self, path: str) -> None:
@@ -225,12 +277,14 @@ class MultiRoundQA:
             w = csv.writer(f)
             w.writerow(["user_id", "round_id", "start", "ttft",
                         "latency", "prompt_tokens", "completion_tokens",
-                        "error"])
+                        "max_itg", "is_storm", "error"])
             for r in self.records:
                 w.writerow([r.user_id, r.round_id, round(r.start, 3),
                             round(r.ttft, 4) if r.ttft else "",
                             round(r.latency, 4) if r.latency else "",
                             r.prompt_tokens, r.completion_tokens,
+                            round(r.max_itg, 4) if r.max_itg else "",
+                            int(r.is_storm),
                             r.error or ""])
 
 
@@ -254,6 +308,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="benchmark duration (seconds)")
     p.add_argument("--request-timeout", type=float, default=120.0)
     p.add_argument("--output", default="summary.csv")
+    p.add_argument("--storm-users", type=int, default=0,
+                   help="number of one-shot long-prompt requests fired "
+                        "together as a scripted arrival storm (0 = off)")
+    p.add_argument("--storm-at", type=float, default=5.0,
+                   help="seconds after start to launch the storm")
+    p.add_argument("--storm-question-len", type=int, default=2000,
+                   help="words per storm question (long prompt => long "
+                        "prefill)")
     return p
 
 

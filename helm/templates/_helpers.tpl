@@ -67,6 +67,13 @@
 - --max-num-seqs
 - {{ .model.maxNumSeqs | quote }}
 {{- end }}
+{{- if .model.maxNumBatchedTokens }}
+- --max-num-batched-tokens
+- {{ .model.maxNumBatchedTokens | quote }}
+{{- end }}
+{{- if .model.enableChunkedPrefill }}
+- --enable-chunked-prefill
+{{- end }}
 {{- if .model.kvOffloadGb }}
 - --kv-offload-gb
 - {{ .model.kvOffloadGb | quote }}

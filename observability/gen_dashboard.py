@@ -208,7 +208,42 @@ def build_dashboard():
              "logged as a structured slow_trace JSON line"))
     y += 7
 
-    # ---- Row 5: TPU KV cache & offload (TPU-native; beyond the ref) ----- #
+    # ---- Row 5: Prefill/Decode interleaving (chunked prefill) ----------- #
+    panels.append(row("Prefill/Decode Interleaving", y)); y += 1
+    panels.append(panel(
+        "timeseries", "Prefill chunks dispatched (rate)",
+        [target("rate(tpu:prefill_chunks_total[5m])",
+                legend="{{instance}}")],
+        grid(7, 8, 0, y),
+        desc="Bucket-snapped prefill chunks per second dispatched by the "
+             "token-budget scheduler (--max-num-batched-tokens / "
+             "--enable-chunked-prefill)"))
+    panels.append(panel(
+        "timeseries", "Deferred prefill tokens (rate)",
+        [target("rate(tpu:deferred_prefill_tokens_total[5m])",
+                legend="{{instance}}")],
+        grid(7, 8, 8, y),
+        desc="Prompt tokens pushed past their step by the per-step token "
+             "budget — sustained high values mean prompts are being "
+             "sliced; zero with chunking on means the budget never binds"))
+    panels.append(panel(
+        "timeseries", "Batched-token budget utilization",
+        [target("tpu:batched_token_utilization", legend="{{instance}}")],
+        grid(7, 8, 16, y), unit="percentunit",
+        desc="Fraction of the per-step token budget filled by the last "
+             "prefill step plan"))
+    y += 7
+    panels.append(panel(
+        "timeseries", "Rejected requests by reason (rate)",
+        [target("rate(tpu:rejected_requests_total[5m])",
+                legend="{{reason}}")],
+        grid(7, 16, 0, y),
+        desc="Admission rejections: length (prompt over --max-model-len, "
+             "HTTP 400) vs kv_capacity (prompt can never fit the KV "
+             "pool, HTTP 503 + Retry-After)"))
+    y += 7
+
+    # ---- Row 6: TPU KV cache & offload (TPU-native; beyond the ref) ----- #
     panels.append(row("TPU KV Cache & Offload", y)); y += 1
     panels.append(panel(
         "timeseries", "TPU HBM KV usage per engine",
@@ -249,7 +284,7 @@ def build_dashboard():
              "routing"))
     y += 7
 
-    # ---- Row 6: Current Resource Usage (ref panels 14-19) --------------- #
+    # ---- Row 7: Current Resource Usage (ref panels 14-19) --------------- #
     panels.append(row("Current Resource Usage", y)); y += 1
     panels.append(panel(
         "timeseries", "Router CPU usage",

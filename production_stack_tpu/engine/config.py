@@ -38,6 +38,19 @@ class EngineConfig:
     # Long prompts prefill in chunks of at most this many tokens (attention
     # memory stays O(chunk * context) instead of O(len^2)); 0 disables.
     prefill_chunk_size: int = 1024
+    # Chunked prefill (Sarathi-style): split each prompt's prefill into
+    # bucket-snapped chunks scheduled across engine steps, interleaved with
+    # decode, so a burst of long prompts cannot starve running sequences.
+    # ``max_num_batched_tokens`` is the per-step prefill token budget
+    # (0 = use prefill_chunk_size); ``enable_chunked_prefill`` turns the
+    # step-plan scheduler on. Both off -> scheduler behavior is byte-
+    # identical to the prefill-OR-decode scheduler.
+    enable_chunked_prefill: bool = False
+    max_num_batched_tokens: int = 0
+    # At most this many consecutive prefill steps while sequences are
+    # decoding; after that the next step is forced to decode (the
+    # decode-starvation cap). Only meaningful with chunked prefill.
+    max_consecutive_prefills: int = 2
     # Up to this many long-prompt prefills share one [prefill_batch,
     # chunk] dispatch (the arrival-storm TTFT tail is a QUEUE of
     # first-round prefills). Round 4 measured always-on batching
@@ -94,6 +107,34 @@ class EngineConfig:
     @property
     def max_blocks_per_seq(self) -> int:
         return (self.max_model_len + self.block_size - 1) // self.block_size
+
+    @property
+    def chunked_prefill_enabled(self) -> bool:
+        return self.enable_chunked_prefill or self.max_num_batched_tokens > 0
+
+    @property
+    def token_budget(self) -> int:
+        """Per-step prefill token budget when chunked prefill is on."""
+        if self.max_num_batched_tokens > 0:
+            return self.max_num_batched_tokens
+        if self.prefill_chunk_size > 0:
+            return self.prefill_chunk_size
+        return self.max_model_len
+
+    def chunk_tokens(self) -> int:
+        """Per-chunk token count: the largest *already-compiled* prefill
+        bucket that fits the budget. Warmup caps buckets at
+        bucket_for(min(prefill_chunk_size, max_model_len)), so respecting
+        both bounds guarantees chunk dispatches hit zero new shapes."""
+        cap = self.token_budget
+        if self.prefill_chunk_size > 0:
+            cap = min(cap, self.prefill_chunk_size)
+        cap = min(cap, self.max_model_len)
+        best = self.min_prefill_bucket
+        for b in self.prefill_buckets():
+            if b <= cap:
+                best = b
+        return best
 
     def prefill_buckets(self) -> "list[int]":
         buckets = []

@@ -219,7 +219,15 @@ class EngineCore:
             namespace=config.model,
         )
         self.scheduler = Scheduler(
-            self.kv_mgr, config.max_num_seqs, config.max_model_len
+            self.kv_mgr, config.max_num_seqs, config.max_model_len,
+            chunked_prefill=config.chunked_prefill_enabled,
+            chunk_tokens=config.chunk_tokens(),
+            token_budget=config.token_budget,
+            max_consecutive_prefills=config.max_consecutive_prefills,
+            # Multi-row chunk steps ride the batched-prefill program, which
+            # warmup only compiles when prefill_batch > 1.
+            max_prefill_rows=(
+                config.prefill_batch if config.prefill_batch > 1 else 1),
         )
 
         # -- KV offload tier (LMCache-equivalent, SURVEY §7 step 4) --------
@@ -288,6 +296,15 @@ class EngineCore:
         # path actually engaged).
         self.prefill_group_count = 0
         self.prefill_group_rows = 0
+        # Chunked prefill: chunks dispatched, prompt tokens deferred to a
+        # later step by the per-step budget, and the last chunked step's
+        # batched-token count (utilization of --max-num-batched-tokens).
+        self.prefill_chunks_total = 0
+        self.deferred_prefill_tokens_total = 0
+        self.last_step_batched_tokens = 0
+        # Mid-prefill sequences evicted by extend-time OOM (distinct from
+        # scheduler-level preemptions, which have their own counter).
+        self.prefill_chunk_requeues_total = 0
         self.decode_burst_count = 0
         self.dispatch_count_total = 0
         self.dispatch_enqueue_s = 0.0
@@ -1667,11 +1684,26 @@ class EngineCore:
             token_ids, positions, slot_mapping, block_tables, seq_lens])
         return np.asarray(jax.device_get(pooled), np.float32)[0].tolist()
 
+    def kv_never_fits(self, n_tokens: int) -> bool:
+        """True when a prompt of this length (+1-token decode headroom)
+        needs more KV pages than the whole pool holds — the scheduler
+        would deterministically reject it, so the server can fail fast
+        with a 503 instead of queueing it."""
+        bs = self.config.block_size
+        needed = (n_tokens + 1 + bs - 1) // bs
+        return needed > self.num_blocks
+
     # -- stats -------------------------------------------------------------
     def stats(self) -> dict:
         alloc = self.kv_mgr.allocator
+        budget = self.scheduler.token_budget if \
+            self.scheduler.chunked_prefill else 0
         return {
-            "num_requests_running": self.scheduler.num_running,
+            # Mid-prefill chunked sequences count as running: they hold KV
+            # pages and will take a slot, and routers treat "running" as
+            # engine load.
+            "num_requests_running": (
+                self.scheduler.num_running + len(self.scheduler.prefilling)),
             "num_requests_waiting": self.scheduler.num_waiting,
             "kv_usage": self.kv_mgr.usage(),
             "prefix_cache_hits": alloc.prefix_hits,
@@ -1691,6 +1723,13 @@ class EngineCore:
             "prefill_count": self.prefill_count,
             "prefill_group_count": self.prefill_group_count,
             "prefill_group_rows": self.prefill_group_rows,
+            "prefill_chunks_total": self.prefill_chunks_total,
+            "deferred_prefill_tokens_total":
+                self.deferred_prefill_tokens_total,
+            "batched_token_utilization": (
+                min(self.last_step_batched_tokens / budget, 1.0)
+                if budget > 0 else 0.0),
+            "rejected_requests": dict(self.scheduler.rejected_total),
             "decode_burst_count": self.decode_burst_count,
             "dispatch_count_total": self.dispatch_count_total,
             "dispatch_enqueue_s": round(self.dispatch_enqueue_s, 3),
@@ -1715,15 +1754,22 @@ class EngineCore:
                         self._flush_pending_burst()
                         # sleep() won the race after next_action popped a
                         # request: requeue it for wake-up instead of failing.
-                        if req is not None:
+                        # (Chunked plans pop nothing — their members stay in
+                        # scheduler.prefilling and resume on wake.)
+                        if action == "prefill" and req is not None:
                             with self._lock:
-                                self.scheduler.waiting.appendleft(req)
+                                self.scheduler.requeue(req)
                         continue
                     if action == "prefill":
                         t0 = time.perf_counter()
                         self._do_prefill(req)
                         if req.trace is not None and req.trace.prefill_start:
                             req.trace.prefill_end = time.time()
+                        self.prefill_time_total += time.perf_counter() - t0
+                        self.prefill_count += 1
+                    elif action == "prefill_step":
+                        t0 = time.perf_counter()
+                        self._do_prefill_step(req)
                         self.prefill_time_total += time.perf_counter() - t0
                         self.prefill_count += 1
                     elif action == "decode":
@@ -1737,8 +1783,22 @@ class EngineCore:
                         time.sleep(0.001)
             except Exception as e:  # noqa: BLE001
                 logger.exception("Engine step failed: %s", e)
-                if req is not None:
-                    req.on_token(None, "error")
+                failed_reqs = []
+                if action == "prefill_step" and req:
+                    with self._lock:
+                        for pc in req:  # req is the [PrefillChunk] plan
+                            if pc.req in self.scheduler.prefilling:
+                                self.scheduler.prefilling.remove(pc.req)
+                                self.kv_mgr.free(pc.req.request_id)
+                                self.scheduler._requests.pop(
+                                    pc.req.request_id, None)
+                                failed_reqs.append(pc.req)
+                elif action == "prefill" and req is not None:
+                    with self._lock:
+                        self.scheduler._requests.pop(req.request_id, None)
+                    failed_reqs.append(req)
+                for r in failed_reqs:
+                    r.on_token(None, "error")
                 if self.fatal_error is not None:
                     # Lockstep is broken (op-channel fan-out failed
                     # mid-send): keeping the loop alive would silently
@@ -1753,32 +1813,34 @@ class EngineCore:
                         self._running = False
                         for seq in self.scheduler.running():
                             self.scheduler.finish(seq, "error")
-                        for r in list(self.scheduler.waiting):
+                        for r in self.scheduler.drain_waiting():
                             r.on_token(None, "error")
-                        self.scheduler.waiting.clear()
                     return
             self.step_count += 1
 
     # -- prefill -----------------------------------------------------------
-    def _allocate_for_prefill(self, req: EngineRequest):
-        """KV allocation + offload-restore for one prompt. Returns
+    def _allocate_for_prefill(self, req: EngineRequest, limit=None):
+        """KV allocation + offload-restore for one prompt (``limit`` bounds
+        fresh allocation to the first chunk under chunked prefill). Returns
         (block_ids, cached) or None after requeuing the request (pool
         exhausted / restore failure retry also failed)."""
         alloc = self.kv_mgr.allocate_prompt(
-            req.request_id, req.all_token_ids, adapter=req.adapter_name
+            req.request_id, req.all_token_ids, adapter=req.adapter_name,
+            limit=limit,
         )
         if alloc is None:
             # Pool tight: settle the in-flight burst (its emission may
             # finish sequences and free pages), then retry once.
             self._flush_pending_burst()
             alloc = self.kv_mgr.allocate_prompt(
-                req.request_id, req.all_token_ids, adapter=req.adapter_name
+                req.request_id, req.all_token_ids, adapter=req.adapter_name,
+                limit=limit,
             )
         self._drain_offload()
         if alloc is None:
             # Raced out of blocks; requeue.
             with self._lock:
-                self.scheduler.waiting.appendleft(req)
+                self.scheduler.requeue(req)
             return None
         block_ids, cached, restores = alloc
         if restores and not self._restore_blocks(restores):
@@ -1799,14 +1861,14 @@ class EngineCore:
             try:
                 alloc = self.kv_mgr.allocate_prompt(
                     req.request_id, req.all_token_ids,
-                    adapter=req.adapter_name
+                    adapter=req.adapter_name, limit=limit,
                 )
             finally:
                 self.kv_mgr.external_lookup = ext
             self._drain_offload()
             if alloc is None:
                 with self._lock:
-                    self.scheduler.waiting.appendleft(req)
+                    self.scheduler.requeue(req)
                 return None
             block_ids, cached, _ = alloc
         return block_ids, cached
@@ -1891,6 +1953,122 @@ class EngineCore:
             seq = self.scheduler.start_running(req, slot)
         self._pending_prefills.append(
             {"req": req, "seq": seq, "slot": slot, "sampled": sampled})
+
+    def _do_prefill_step(self, plan) -> None:
+        """Execute one budgeted chunked-prefill step plan: advance each
+        member by one bucket-snapped chunk. Multiple members' chunks share
+        one batched [PB, chunk] dispatch when the batched-prefill program
+        covers them (consecutive chunks of ONE prompt never share a
+        dispatch — chunk N+1's queries attend to chunk N's pages).
+        Final chunks claim a decode slot and defer their first-token
+        readback exactly like the unchunked path (_pending_prefills)."""
+        cfg = self.config
+        ready = []  # (req, tokens, block_ids, start, end)
+        step_tokens = 0
+        for pc in plan:
+            req = pc.req
+            with self._lock:
+                if req not in self.scheduler.prefilling:
+                    continue  # aborted after the plan was built
+            tokens = req.all_token_ids
+            n = len(tokens)
+            if pc.start == 0:
+                # First chunk: allocate pages for it (the cached-prefix
+                # walk is unbounded, so `cached` can exceed the chunk).
+                got = self._allocate_for_prefill(req, limit=pc.end)
+                if got is None:
+                    continue  # requeued by _allocate_for_prefill
+                block_ids, cached = got
+                if req.trace is not None:
+                    if not req.trace.prefill_start:
+                        req.trace.prefill_start = time.time()
+                    req.trace.cached_tokens = cached
+                    req.trace.preemptions = req.num_preemptions
+                self.cached_tokens_total += cached
+                start = max(pc.start, cached)
+                end = max(pc.end, cached)
+                if start >= end or start >= n:
+                    # Fully covered by cache: skip the dispatch; the next
+                    # step continues from the cached frontier.
+                    with self._lock:
+                        if req in self.scheduler.prefilling:
+                            req.num_computed_tokens = min(max(end, start), n)
+                    continue
+            else:
+                block_ids = self.kv_mgr.extend_tokens(
+                    req.request_id, tokens, pc.end)
+                if block_ids is None:
+                    # Pool tight: settle the in-flight burst (may free
+                    # pages) and retry once, then give the pages back and
+                    # requeue (re-prefills from scratch when readmitted).
+                    self._flush_pending_burst()
+                    block_ids = self.kv_mgr.extend_tokens(
+                        req.request_id, tokens, pc.end)
+                if block_ids is None:
+                    self.kv_mgr.free(req.request_id)
+                    self.prefill_chunk_requeues_total += 1
+                    with self._lock:
+                        self.scheduler.requeue(req)
+                    continue
+                start, end = pc.start, pc.end
+            ready.append((req, tokens, block_ids, start, end))
+            step_tokens += end - start
+
+        if not ready:
+            return
+        # Dispatch: one batched [PB, chunk-bucket] program when compiled
+        # and every row fits its block-table cap, else sequential spans.
+        sampled_for: "dict[int, tuple]" = {}  # id(req) -> (sampled, row)
+        batched = (
+            cfg.prefill_batch > 1 and cfg.prefill_chunk_size > 0
+            and len(ready) > 1
+            and all((end + cfg.block_size - 1) // cfg.block_size
+                    <= self._prefill_batch_maxb()
+                    for (_, _, _, _, end) in ready))
+        if batched:
+            sampled = self._prefill_rows(ready, pad_to=cfg.prefill_batch)
+            for row_i, (req, *_rest) in enumerate(ready):
+                sampled_for[id(req)] = (sampled, row_i)
+        else:
+            for req, tokens, block_ids, start, end in ready:
+                sampled_for[id(req)] = (self._prefill_span(
+                    req, tokens, block_ids, start, end), 0)
+        self.prefill_chunks_total += len(ready)
+        self.last_step_batched_tokens = step_tokens
+
+        # Same pipelining as the unchunked paths: read back the in-flight
+        # burst and the previous prefill while these chunks execute.
+        self._flush_pending_burst()
+        self._flush_pending_prefills()
+
+        now = time.time()
+        for req, tokens, block_ids, start, end in ready:
+            n = len(tokens)
+            if req.trace is not None:
+                req.trace.prefill_chunks += 1
+            if end < n:
+                self.deferred_prefill_tokens_total += n - end
+                with self._lock:
+                    if req in self.scheduler.prefilling:
+                        req.num_computed_tokens = end
+                continue
+            # Final chunk: the sampled token of this dispatch is the
+            # request's first generated token. Claim the decode slot now
+            # (admission guaranteed one stays free per mid-prefill seq).
+            sampled, row = sampled_for[id(req)]
+            with self._lock:
+                if req not in self.scheduler.prefilling:
+                    continue  # aborted while the chunk was in flight
+                self.scheduler.prefilling.remove(req)
+                req.num_computed_tokens = n
+                slot = self.scheduler._free_slot()
+                seq = self.scheduler.start_running(req, slot)
+            if req.trace is not None:
+                req.trace.prefill_end = now
+            self.prompt_tokens_total += n
+            self._pending_prefills.append(
+                {"req": req, "seq": seq, "slot": slot,
+                 "sampled": sampled, "row": row})
 
     def _flush_pending_prefills(self) -> None:
         """Read back and emit deferred prefill first tokens, in dispatch
@@ -1993,7 +2171,7 @@ class EngineCore:
         maxb_cap = self._prefill_batch_maxb()
         with self._lock:
             n = 0
-            for cand in self.scheduler.waiting:
+            for cand in self.scheduler.live_waiting():
                 toks = cand.all_token_ids
                 if ((len(toks) + cfg.block_size - 1)
                         // cfg.block_size) > maxb_cap:
@@ -2031,7 +2209,7 @@ class EngineCore:
                     break
                 nxt = None
                 maxb_cap = self._prefill_batch_maxb()
-                for cand in list(self.scheduler.waiting):
+                for cand in self.scheduler.live_waiting():
                     if cand.request_id in rejected:
                         continue
                     n_c = len(cand.all_token_ids)
@@ -2053,7 +2231,7 @@ class EngineCore:
                     rejected.add(cand.request_id)
                 if nxt is None:
                     break
-                self.scheduler.waiting.remove(nxt)
+                self.scheduler.take_waiting(nxt)
             got = self._allocate_for_prefill(nxt)
             if got is None:
                 break  # pool tight: nxt was requeued; stop growing
@@ -2064,7 +2242,7 @@ class EngineCore:
                 # iteration, re-hitting the prefix cache cheaply.
                 self.kv_mgr.free(nxt.request_id)
                 with self._lock:
-                    self.scheduler.waiting.appendleft(nxt)
+                    self.scheduler.requeue(nxt)
                 break
             group.append(
                 {"req": nxt, "block_ids": bids_c, "cached": cached_c})
@@ -2279,11 +2457,12 @@ class EngineCore:
         # full burst (the big-model TTFT tail — a 3B/8B burst is
         # ~0.5-1 s of wall time).
         with self._lock:
+            waiter = self.scheduler.peek_waiting()
             admissible_waiter = (
-                self.scheduler.num_waiting > 0
+                waiter is not None
                 and self.scheduler._free_slot() is not None
                 and self.kv_mgr.can_allocate(
-                    len(self.scheduler.waiting[0].all_token_ids) + 1))
+                    len(waiter.all_token_ids) + 1))
         if cfg.decode_steps_pressure > 0 and admissible_waiter:
             K = min(K, max(cfg.decode_steps_pressure, 1))
 

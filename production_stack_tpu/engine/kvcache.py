@@ -197,7 +197,8 @@ class KVCacheManager:
         )
 
     def allocate_prompt(
-        self, seq_id: str, tokens: List[int], adapter: str = ""
+        self, seq_id: str, tokens: List[int], adapter: str = "",
+        limit: Optional[int] = None,
     ) -> Optional[Tuple[List[int], int, List[Tuple[int, int]]]]:
         """Allocate blocks for a prompt.
 
@@ -208,9 +209,16 @@ class KVCacheManager:
         be copied back into HBM from the offload tier before use (they count
         as cached). ``adapter`` (a LoRA adapter *name*, stable across
         engines) namespaces the hash chain: adapters alter the V projection,
-        so KV pages are only shareable within one adapter."""
+        so KV pages are only shareable within one adapter.
+
+        ``limit`` (chunked prefill) bounds *fresh* allocation to the first
+        ``limit`` tokens — later chunks grow the table via
+        :meth:`extend_tokens`. The cached-prefix walk is not bounded, so a
+        cache hit can cover more than ``limit`` tokens (the engine skips
+        those chunks entirely)."""
         bs = self.block_size
-        seq = SequenceBlocks(num_tokens=len(tokens))
+        total = len(tokens) if limit is None else min(limit, len(tokens))
+        seq = SequenceBlocks(num_tokens=total)
         parent = self.chain_root(adapter)
         i = 0
         restores: List[Tuple[int, int]] = []
@@ -237,8 +245,11 @@ class KVCacheManager:
             seq.last_full_hash = h
             parent = h
             i += bs
-        # Allocate fresh blocks for the rest.
-        remaining = len(tokens) - i
+        # Allocate fresh blocks for the rest (up to ``total`` tokens; the
+        # cache walk may already have covered more than that).
+        total = max(total, i)
+        seq.num_tokens = total
+        remaining = total - i
         n_new = (remaining + bs - 1) // bs
         fresh: List[int] = []
         for _ in range(n_new):
@@ -257,11 +268,12 @@ class KVCacheManager:
                     self.allocator.release(b)
                 return None
             fresh.append(bid)
-        # Register chain hashes for the new *full* blocks.
+        # Register chain hashes for the new *full* blocks (only blocks whose
+        # pages this chunk actually writes, i.e. within ``total``).
         j = i
         for bid in fresh:
             seq.block_ids.append(bid)
-            if j + bs <= len(tokens):
+            if j + bs <= total:
                 chunk = tuple(tokens[j : j + bs])
                 h = BlockAllocator.chain_hash(parent, chunk)
                 self.allocator.register_full_block(bid, h)
@@ -272,6 +284,50 @@ class KVCacheManager:
         seq.chain_parent = parent
         self.seqs[seq_id] = seq
         return seq.block_ids, seq.num_cached_tokens, restores
+
+    def extend_tokens(
+        self, seq_id: str, tokens: List[int], limit: int
+    ) -> Optional[List[int]]:
+        """Grow a partially prefilled sequence's block table to cover the
+        first ``limit`` of ``tokens`` (chunked prefill continuation).
+
+        Returns the full block-id list, or None on OOM (all newly allocated
+        blocks rolled back — the caller preempts/requeues) or if the
+        sequence is gone (aborted mid-prefill). Continuation blocks extend
+        the prefix-hash chain from the registration frontier; mid-sequence
+        cache *reuse* is not attempted (only the leading-prefix walk in
+        :meth:`allocate_prompt` reuses pages — a deliberate simplification:
+        a mid-prompt match would need its exact chain parent anyway)."""
+        seq = self.seqs.get(seq_id)
+        if seq is None:
+            return None
+        bs = self.block_size
+        limit = min(limit, len(tokens))
+        needed = (limit + bs - 1) // bs
+        fresh: List[int] = []
+        while len(seq.block_ids) + len(fresh) < needed:
+            bid = self.allocator.allocate()
+            if bid is None:
+                for b in fresh:
+                    self.allocator.release(b)
+                return None
+            fresh.append(bid)
+        seq.block_ids.extend(fresh)
+        seq.num_tokens = max(seq.num_tokens, limit)
+        # Register chain hashes over blocks this chunk completes.
+        parent = seq.chain_parent
+        while seq.num_registered + bs <= limit:
+            start = seq.num_registered
+            blk = start // bs
+            if blk >= len(seq.block_ids):
+                break
+            chunk = tuple(tokens[start : start + bs])
+            h = BlockAllocator.chain_hash(parent, chunk)
+            self.allocator.register_full_block(seq.block_ids[blk], h)
+            seq.last_full_hash = h
+            seq.chain_parent = parent = h
+            seq.num_registered = start + bs
+        return seq.block_ids
 
     def register_decode_blocks(self, seq_id: str, all_tokens: List[int]) -> None:
         """Extend the prefix-hash chain over blocks completed by generated

@@ -7,10 +7,20 @@ continuous batching, but shaped for XLA: the decode batch has a fixed width
 power-of-two buckets, so steady-state serving touches exactly two compiled
 programs (SURVEY §7 "continuous batching without recompilation storms").
 
+Chunked prefill (Sarathi-style, OSDI'24): with a per-step token budget the
+scheduler becomes a step-plan builder — ``next_action()`` emits
+``("prefill_step", [PrefillChunk, ...])`` plans that advance each admitted
+prompt by at most one bucket-snapped chunk per step, interleaved with
+decode steps under a decode-starvation cap, so a burst of long prompts
+cannot monopolize the engine. Chunk continuations run through the
+already-compiled ``prefill_cached`` program against KV pages written by
+earlier chunks: zero new compiled shapes. With the flag off the scheduler
+is exactly the prefill-OR-decode machine described above.
+
 Preemption: when a decode step needs a KV page and none is free, the
-youngest running sequence is evicted back to the waiting queue (its pages
-freed, generated tokens kept so re-prefill resumes exactly); the router
-surfaces these as ``num_swapped_requests``.
+youngest running (or mid-prefill) sequence is evicted back to the waiting
+queue (its pages freed, generated tokens kept so re-prefill resumes
+exactly); the router surfaces these as ``num_swapped_requests``.
 """
 
 from __future__ import annotations
@@ -19,7 +29,7 @@ import enum
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, Deque, List, Optional, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from production_stack_tpu.engine.kvcache import KVCacheManager
 from production_stack_tpu.engine.sampling import SamplingParams
@@ -52,6 +62,9 @@ class EngineRequest:
     # Decode steps scheduled so far (may run ahead of emitted tokens while
     # a speculative burst is in flight); engine-thread only.
     scheduled_steps: int = 0
+    # Chunked prefill: prompt tokens whose KV pages have been written by
+    # completed chunks (resets to 0 on preemption / requeue).
+    num_computed_tokens: int = 0
     # Optional StageClock (obs.trace): the engine thread stamps queue/
     # prefill/decode boundaries on it; the server reads it afterwards.
     trace: Optional[object] = None
@@ -64,7 +77,24 @@ class EngineRequest:
 @dataclass
 class RunningSeq:
     req: EngineRequest
-    slot: int  # decode batch slot index
+    slot: int  # decode batch slot index (-1: preempted mid-prefill)
+
+
+@dataclass
+class PrefillChunk:
+    """One bucket-snapped slice of a prompt's prefill, part of a step plan.
+
+    ``start == req.num_computed_tokens`` at plan time; ``end`` is exclusive.
+    The chunk is final when ``end == len(req.all_token_ids)``.
+    """
+
+    req: EngineRequest
+    start: int
+    end: int
+
+    @property
+    def is_final(self) -> bool:
+        return self.end >= len(self.req.all_token_ids)
 
 
 class Scheduler:
@@ -73,33 +103,84 @@ class Scheduler:
         kv_mgr: KVCacheManager,
         max_num_seqs: int,
         max_model_len: int,
+        chunked_prefill: bool = False,
+        chunk_tokens: int = 0,
+        token_budget: int = 0,
+        max_consecutive_prefills: int = 2,
+        max_prefill_rows: int = 1,
     ):
         self.kv_mgr = kv_mgr
         self.max_num_seqs = max_num_seqs
         self.max_model_len = max_model_len
+        self.chunked_prefill = chunked_prefill and chunk_tokens > 0
+        self.chunk_tokens = chunk_tokens
+        self.token_budget = max(token_budget, chunk_tokens)
+        self.max_consecutive_prefills = max(max_consecutive_prefills, 1)
+        self.max_prefill_rows = max(max_prefill_rows, 1)
         self.waiting: Deque[EngineRequest] = deque()
         self.slots: List[Optional[RunningSeq]] = [None] * max_num_seqs
+        # Requests mid-prefill under the chunked scheduler: admitted (KV
+        # pages allocated incrementally) but not yet holding a decode slot.
+        self.prefilling: List[EngineRequest] = []
         self.num_preempted_total = 0
+        # Rejections by finish reason ("length" | "kv_capacity"), exported
+        # as tpu:rejected_requests_total{reason=...}.
+        self.rejected_total: Dict[str, int] = {"length": 0, "kv_capacity": 0}
+        # Request-id index: O(1) abort instead of O(n) queue scans. A
+        # request is indexed from add() until it reaches a terminal state.
+        self._requests: Dict[str, EngineRequest] = {}
+        self._running_by_id: Dict[str, RunningSeq] = {}
+        # Ids known to be in the waiting deque (entries added via add()/
+        # requeue()); lets abort() find queued requests in O(1).
+        self._queued: set = set()
+        # Aborting a queued request marks it FINISHED in place (tombstone);
+        # the deque entry is skipped lazily at the next pop, keeping abort
+        # O(1). This counter keeps num_waiting exact between pops.
+        self._waiting_tombstones = 0
+        self._prefill_streak = 0
+
+    @staticmethod
+    def _is_live(req: EngineRequest) -> bool:
+        return req.status not in (RequestStatus.FINISHED,
+                                  RequestStatus.REJECTED)
 
     # -- queue ops ---------------------------------------------------------
     def add(self, req: EngineRequest) -> None:
         if len(req.prompt_token_ids) >= self.max_model_len:
             req.status = RequestStatus.REJECTED
+            self.rejected_total["length"] += 1
             req.on_token(None, "length")
             return
+        self._requests[req.request_id] = req
+        self._queued.add(req.request_id)
         self.waiting.append(req)
 
     def abort(self, request_id: str) -> bool:
-        for req in list(self.waiting):
-            if req.request_id == request_id:
-                self.waiting.remove(req)
-                req.status = RequestStatus.FINISHED
-                req.on_token(None, "abort")
-                return True
-        for seq in self.running():
-            if seq.req.request_id == request_id:
-                self.finish(seq, "abort")
-                return True
+        seq = self._running_by_id.get(request_id)
+        if seq is not None:
+            self.finish(seq, "abort")
+            return True
+        req = self._requests.get(request_id)
+        if req is None:
+            return False
+        if request_id in self._queued:
+            # Tombstone: the deque entry is skipped at the next pop.
+            self._queued.discard(request_id)
+            del self._requests[request_id]
+            req.status = RequestStatus.FINISHED
+            self._waiting_tombstones += 1
+            req.on_token(None, "abort")
+            return True
+        if req in self.prefilling:
+            # Mid-chunk abort: free the KV pages earlier chunks wrote.
+            self.prefilling.remove(req)
+            del self._requests[request_id]
+            self.kv_mgr.free(request_id)
+            req.status = RequestStatus.FINISHED
+            req.on_token(None, "abort")
+            return True
+        # Popped by the engine loop and in flight between scheduler states:
+        # the core's slot check handles the token already being computed.
         return False
 
     def running(self) -> List[RunningSeq]:
@@ -111,10 +192,11 @@ class Scheduler:
 
     @property
     def num_waiting(self) -> int:
-        return len(self.waiting)
+        return len(self.waiting) - self._waiting_tombstones
 
     def has_work(self) -> bool:
-        return self.num_running > 0 or self.num_waiting > 0
+        return (self.num_running > 0 or self.num_waiting > 0
+                or bool(self.prefilling))
 
     def _free_slot(self) -> Optional[int]:
         for i, s in enumerate(self.slots):
@@ -122,52 +204,189 @@ class Scheduler:
                 return i
         return None
 
-    # -- scheduling decisions ---------------------------------------------
-    def next_action(self) -> Tuple[str, Optional[EngineRequest]]:
-        """Returns ("prefill", req) | ("decode", None) | ("idle", None)."""
-        slot = self._free_slot()
-        if self.waiting and slot is not None:
+    def peek_waiting(self) -> Optional[EngineRequest]:
+        """First live waiting request; drops abort tombstones on the way."""
+        while self.waiting:
             req = self.waiting[0]
+            if self._is_live(req):
+                return req
+            self.waiting.popleft()
+            self._waiting_tombstones = max(0, self._waiting_tombstones - 1)
+        return None
+
+    def live_waiting(self) -> List[EngineRequest]:
+        """Snapshot of live (non-tombstoned) waiting requests, FIFO order."""
+        return [r for r in self.waiting if self._is_live(r)]
+
+    def take_waiting(self, req: EngineRequest) -> None:
+        """Remove a specific live request from the waiting queue (the
+        storm-batch gatherer picks group members out of FIFO order)."""
+        self.waiting.remove(req)
+        self._queued.discard(req.request_id)
+
+    def requeue(self, req: EngineRequest) -> None:
+        """Put a request back at the head of the waiting queue (allocation
+        failure, engine sleep race, chunk preemption). The caller is
+        responsible for freeing any KV pages already written; partial
+        prefill progress is discarded."""
+        if req in self.prefilling:
+            self.prefilling.remove(req)
+        req.num_computed_tokens = 0
+        if req.status is RequestStatus.FINISHED or \
+                req.request_id not in self._requests:
+            return  # aborted while in flight
+        req.status = RequestStatus.WAITING
+        self.waiting.appendleft(req)
+        self._queued.add(req.request_id)
+
+    def drain_waiting(self) -> List[EngineRequest]:
+        """Remove every queued and mid-prefill request (fatal-error path);
+        returns them so the engine can fail their callbacks. Frees KV pages
+        of partially prefilled requests."""
+        reqs = self.live_waiting()
+        for req in self.prefilling:
+            self.kv_mgr.free(req.request_id)
+            reqs.append(req)
+        self.waiting.clear()
+        self._queued.clear()
+        self._waiting_tombstones = 0
+        self.prefilling.clear()
+        for req in reqs:
+            self._requests.pop(req.request_id, None)
+        return reqs
+
+    def _reject(self, req: EngineRequest, reason: str) -> None:
+        self._requests.pop(req.request_id, None)
+        req.status = RequestStatus.REJECTED
+        self.rejected_total[reason] = self.rejected_total.get(reason, 0) + 1
+        req.on_token(None, reason)
+
+    # -- scheduling decisions ---------------------------------------------
+    def next_action(self) -> Tuple[str, object]:
+        """Returns ("prefill", req) | ("prefill_step", [PrefillChunk, ...])
+        | ("decode", None) | ("idle", None)."""
+        if self.chunked_prefill:
+            return self._next_action_chunked()
+        slot = self._free_slot()
+        req = self.peek_waiting()
+        if req is not None and slot is not None:
             # +1 block headroom so the first decode step can't immediately
             # trigger a preemption.
             if self.kv_mgr.can_allocate(len(req.all_token_ids) + 1):
-                return "prefill", self.waiting.popleft()
-            if self.num_running == 0:
-                # Nothing to preempt and it still doesn't fit: reject.
                 self.waiting.popleft()
-                req.status = RequestStatus.REJECTED
-                req.on_token(None, "length")
+                self._queued.discard(req.request_id)
+                return "prefill", req
+            if self.num_running == 0:
+                # Nothing to preempt and it still doesn't fit: the prompt
+                # is within max_model_len but the KV pool can't hold it.
+                self.waiting.popleft()
+                self._queued.discard(req.request_id)
+                self._reject(req, "kv_capacity")
                 return self.next_action()
         if self.num_running > 0:
             return "decode", None
         return "idle", None
+
+    def _next_action_chunked(self) -> Tuple[str, object]:
+        if (self.num_running > 0
+                and self._prefill_streak >= self.max_consecutive_prefills):
+            # Decode-starvation cap: running sequences get a step even
+            # while a prefill backlog drains.
+            self._prefill_streak = 0
+            return "decode", None
+        plan = self._build_prefill_step()
+        if plan:
+            self._prefill_streak += 1
+            return "prefill_step", plan
+        self._prefill_streak = 0
+        if self.num_running > 0:
+            return "decode", None
+        return "idle", None
+
+    def _build_prefill_step(self) -> List[PrefillChunk]:
+        """Budgeted step plan: continuations first (FIFO over mid-prefill
+        requests), then admissions from the waiting queue. At most one
+        chunk per request per step — consecutive chunks of one prompt
+        depend on each other's KV writes and must not share a dispatch."""
+        plan: List[PrefillChunk] = []
+        budget = self.token_budget
+        for req in self.prefilling:
+            if len(plan) >= self.max_prefill_rows or budget <= 0:
+                break
+            total = len(req.all_token_ids)
+            take = min(self.chunk_tokens, budget, total - req.num_computed_tokens)
+            if take <= 0:
+                continue
+            plan.append(PrefillChunk(
+                req, req.num_computed_tokens, req.num_computed_tokens + take))
+            budget -= take
+        while len(plan) < self.max_prefill_rows and budget > 0:
+            if self.num_running + len(self.prefilling) >= self.max_num_seqs:
+                break
+            req = self.peek_waiting()
+            if req is None:
+                break
+            # Same admission gate as the unchunked scheduler: the whole
+            # sequence (+1 block headroom) must fit, even though pages are
+            # allocated chunk by chunk.
+            if not self.kv_mgr.can_allocate(len(req.all_token_ids) + 1):
+                if self.num_running == 0 and not self.prefilling:
+                    self.waiting.popleft()
+                    self._queued.discard(req.request_id)
+                    self._reject(req, "kv_capacity")
+                    continue
+                break
+            self.waiting.popleft()
+            self._queued.discard(req.request_id)
+            req.num_computed_tokens = 0
+            self.prefilling.append(req)
+            total = len(req.all_token_ids)
+            take = min(self.chunk_tokens, budget, total)
+            plan.append(PrefillChunk(req, 0, take))
+            budget -= take
+        return plan
 
     # -- lifecycle ---------------------------------------------------------
     def start_running(self, req: EngineRequest, slot: int) -> RunningSeq:
         seq = RunningSeq(req=req, slot=slot)
         req.status = RequestStatus.RUNNING
         self.slots[slot] = seq
+        self._requests[req.request_id] = req
+        self._running_by_id[req.request_id] = seq
         return seq
 
     def finish(self, seq: RunningSeq, reason: str) -> None:
         self.kv_mgr.free(seq.req.request_id)
-        self.slots[seq.slot] = None
+        if 0 <= seq.slot < len(self.slots) and self.slots[seq.slot] is seq:
+            self.slots[seq.slot] = None
+        self._running_by_id.pop(seq.req.request_id, None)
+        self._requests.pop(seq.req.request_id, None)
         seq.req.status = RequestStatus.FINISHED
         seq.req.on_token(None, reason)
 
     def preempt_youngest(self) -> Optional[RunningSeq]:
-        """Evict the most recent running sequence back to waiting."""
-        running = self.running()
-        if not running:
+        """Evict the most recent running (or mid-prefill) sequence back to
+        waiting."""
+        candidates: List[Tuple[EngineRequest, Optional[RunningSeq]]] = [
+            (s.req, s) for s in self.running()]
+        candidates += [(r, None) for r in self.prefilling]
+        if not candidates:
             return None
-        victim = max(running, key=lambda s: s.req.arrival_time)
-        self.kv_mgr.free(victim.req.request_id)
-        self.slots[victim.slot] = None
-        victim.req.status = RequestStatus.PREEMPTED
-        victim.req.num_preemptions += 1
-        self.waiting.appendleft(victim.req)
+        req, seq = max(candidates, key=lambda c: c[0].arrival_time)
+        self.kv_mgr.free(req.request_id)
+        if seq is not None:
+            self.slots[seq.slot] = None
+            self._running_by_id.pop(req.request_id, None)
+        else:
+            self.prefilling.remove(req)
+            seq = RunningSeq(req=req, slot=-1)
+        req.num_computed_tokens = 0
+        req.status = RequestStatus.PREEMPTED
+        req.num_preemptions += 1
+        self.waiting.appendleft(req)
+        self._queued.add(req.request_id)
         self.num_preempted_total += 1
         logger.info(
-            "Preempted request %s (blocks exhausted)", victim.req.request_id
+            "Preempted request %s (blocks exhausted)", req.request_id
         )
-        return victim
+        return seq

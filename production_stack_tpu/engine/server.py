@@ -592,6 +592,7 @@ class EngineServer:
                 uncached_tokens=max(
                     0, clock.prompt_tokens - clock.cached_tokens),
                 preemptions=clock.preemptions,
+                prefill_chunks=clock.prefill_chunks,
             )
         if clock.first_token:
             decode_start = clock.prefill_end or clock.first_token
@@ -610,6 +611,19 @@ class EngineServer:
                              clock: Optional[StageClock] = None,
                              ) -> web.StreamResponse:
         stream_mode = bool(body.get("stream", False))
+        # KV-capacity pre-check: a prompt that can never fit the engine's
+        # KV pool fails fast — 503 with Retry-After — instead of queueing
+        # until the scheduler rejects it (which historically mislabeled
+        # the rejection as finish_reason "length").
+        if self.core.kv_never_fits(len(prompt_ids)):
+            self.core.scheduler.rejected_total["kv_capacity"] += 1
+            return web.json_response(
+                {"error": {
+                    "message": (
+                        f"prompt ({len(prompt_ids)} tokens) exceeds this "
+                        f"engine's KV cache capacity"),
+                    "type": "ServiceUnavailable",
+                }}, status=503, headers={"Retry-After": "1"})
         stream = await self._generate(prompt_ids, sampling, rid, adapter,
                                       trace=clock)
         detok = IncrementalDetokenizer(self.core.tokenizer)
@@ -665,7 +679,8 @@ class EngineServer:
                         f"data: {json.dumps(payload)}\n\n".encode())
                 async for raw_tok, finish in stream:
                     if raw_tok is None:
-                        if finish in ("stop", "length", "abort"):
+                        if finish in ("stop", "length", "abort",
+                                      "kv_capacity"):
                             finish_reason = finish
                         if finish == "error":
                             finish_reason = "stop"
@@ -761,7 +776,19 @@ class EngineServer:
                                 f"{self.config.max_model_len}"),
                             "type": "BadRequestError",
                         }}, status=400)
-                if finish in ("stop", "length", "abort"):
+                if finish == "kv_capacity" and n_generated == 0:
+                    # Async scheduler rejection (pool transiently pinned
+                    # below the prompt's footprint): retryable, not a
+                    # client error.
+                    return web.json_response(
+                        {"error": {
+                            "message": (
+                                f"prompt ({len(prompt_ids)} tokens) "
+                                f"exceeds currently available KV cache "
+                                f"capacity"),
+                            "type": "ServiceUnavailable",
+                        }}, status=503, headers={"Retry-After": "1"})
+                if finish in ("stop", "length", "abort", "kv_capacity"):
                     finish_reason = finish
                 break
             token_id, lp = self._split_token(raw_tok)
@@ -1837,7 +1864,28 @@ class EngineServer:
             "# TYPE tpu:slow_requests counter",
             f"tpu:slow_requests_total{{{labels}}} "
             f"{self.trace_recorder.slow_requests}",
+            # Chunked prefill (--enable-chunked-prefill /
+            # --max-num-batched-tokens).
+            "# TYPE tpu:prefill_chunks counter",
+            f"tpu:prefill_chunks_total{{{labels}}} "
+            f"{s.get('prefill_chunks_total', 0)}",
+            "# TYPE tpu:deferred_prefill_tokens counter",
+            f"tpu:deferred_prefill_tokens_total{{{labels}}} "
+            f"{s.get('deferred_prefill_tokens_total', 0)}",
+            "# TYPE tpu:batched_token_utilization gauge",
+            f"tpu:batched_token_utilization{{{labels}}} "
+            f"{s.get('batched_token_utilization', 0.0):.6f}",
         ]
+        # Admission rejections by reason; both reasons always emitted so
+        # rate() queries never see a vanishing series.
+        rejected = s.get("rejected_requests") or {}
+        lines.append("# TYPE tpu:rejected_requests counter")
+        for reason in sorted(set(rejected) | {"length", "kv_capacity"}):
+            reason_labels = f'{labels},reason="{reason}"' if labels \
+                else f'reason="{reason}"'
+            lines.append(
+                f"tpu:rejected_requests_total{{{reason_labels}}} "
+                f"{rejected.get(reason, 0)}")
         if s.get("offload"):
             off = s["offload"]
             lines += [
@@ -1918,6 +1966,22 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--prefill-chunk-size", type=int, default=1024,
                    help="long prompts prefill in chunks of this many "
                         "tokens (0 disables chunking)")
+    p.add_argument("--enable-chunked-prefill", action="store_true",
+                   default=False,
+                   help="Sarathi-style chunked prefill: schedule prompt "
+                        "prefills as bucket-snapped chunks interleaved "
+                        "with decode steps, bounded per step by "
+                        "--max-num-batched-tokens, so arrival bursts "
+                        "cannot stall running decodes")
+    p.add_argument("--max-num-batched-tokens", type=int, default=0,
+                   help="per-step prefill token budget for chunked "
+                        "prefill (0 with --enable-chunked-prefill: use "
+                        "--prefill-chunk-size; setting this > 0 also "
+                        "enables chunked prefill)")
+    p.add_argument("--max-consecutive-prefills", type=int, default=2,
+                   help="chunked prefill: force a decode step after this "
+                        "many consecutive prefill steps while sequences "
+                        "are running (the decode-starvation cap)")
     p.add_argument("--prefill-batch", type=int, default=1,
                    help="batch up to N queued long-prompt prefills into "
                         "one dispatch (1 disables; see EngineConfig."
@@ -1973,6 +2037,9 @@ def main(argv: Optional[List[str]] = None) -> None:
         quantization=args.quantization,
         prefill_chunk_size=args.prefill_chunk_size,
         prefill_batch=args.prefill_batch,
+        enable_chunked_prefill=args.enable_chunked_prefill,
+        max_num_batched_tokens=args.max_num_batched_tokens,
+        max_consecutive_prefills=args.max_consecutive_prefills,
         max_model_len=args.max_model_len,
         max_num_seqs=args.max_num_seqs,
         block_size=args.block_size,

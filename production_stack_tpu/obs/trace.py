@@ -278,7 +278,7 @@ class StageClock:
 
     __slots__ = ("arrival", "prefill_start", "prefill_end", "first_token",
                  "last_token", "tokens", "prompt_tokens", "cached_tokens",
-                 "preemptions")
+                 "preemptions", "prefill_chunks")
 
     def __init__(self, arrival: Optional[float] = None):
         self.arrival = time.time() if arrival is None else arrival
@@ -290,6 +290,8 @@ class StageClock:
         self.prompt_tokens = 0
         self.cached_tokens = 0
         self.preemptions = 0
+        # Chunked prefill: scheduler chunks dispatched for this prompt.
+        self.prefill_chunks = 0
 
 
 # ---------------------------------------------------------------------------

@@ -31,11 +31,28 @@ class FakeEngine:
         tokens_per_sec: float = 0.0,
         max_tokens_default: int = 16,
         models: Optional[List[str]] = None,
+        simulate_contention: bool = False,
+        enable_chunked_prefill: bool = False,
+        prefill_chunks: int = 4,
     ):
         self.models = models or [model]
         self.ttft = ttft
         self.tokens_per_sec = tokens_per_sec
         self.max_tokens_default = max_tokens_default
+        # Single-device contention model (default OFF — existing timing-
+        # sensitive router tests rely on concurrent requests overlapping
+        # freely): prefill work and decode token emission serialize on one
+        # lock, like one TPU stepping one program at a time. An unchunked
+        # prefill holds the lock for the full TTFT (so a concurrent
+        # decode's inter-token gap can stall by up to that); chunked
+        # prefill (``enable_chunked_prefill``) splits it into
+        # ``prefill_chunks`` lock acquisitions, bounding any stall to
+        # ttft / prefill_chunks.
+        self.simulate_contention = simulate_contention
+        self.enable_chunked_prefill = enable_chunked_prefill
+        self.prefill_chunks = max(prefill_chunks, 1)
+        self.prefill_chunks_total = 0
+        self._engine_lock = asyncio.Lock()
         self.sleeping = False
         self.num_running = 0
         self.num_waiting = 0
@@ -49,6 +66,30 @@ class FakeEngine:
     # -- helpers -----------------------------------------------------------
     def _token_delay(self) -> float:
         return 1.0 / self.tokens_per_sec if self.tokens_per_sec > 0 else 0.0
+
+    async def _prefill_sleep(self) -> int:
+        """TTFT wait; under the contention model it holds the engine lock
+        in 1 (unchunked) or ``prefill_chunks`` (chunked) slices. Returns
+        the chunk count."""
+        if not self.simulate_contention:
+            if self.ttft > 0:
+                await asyncio.sleep(self.ttft)
+            return 1
+        chunks = self.prefill_chunks if self.enable_chunked_prefill else 1
+        for _ in range(chunks):
+            async with self._engine_lock:
+                if self.ttft > 0:
+                    await asyncio.sleep(self.ttft / chunks)
+        self.prefill_chunks_total += chunks
+        return chunks
+
+    async def _decode_step(self) -> None:
+        """Per-token wait; under the contention model the emission also
+        waits for the engine lock (a prefill in progress stalls it)."""
+        await asyncio.sleep(self._token_delay())
+        if self.simulate_contention:
+            async with self._engine_lock:
+                pass
 
     def make_app(self) -> web.Application:
         app = web.Application()
@@ -82,8 +123,11 @@ class FakeEngine:
                                 model=model)
         trace.add_span("engine.queue", t_arrival, t_arrival, parent=root)
         prefill_end = t_prefill_end if t_prefill_end is not None else now
+        chunks = (self.prefill_chunks if self.enable_chunked_prefill else 1) \
+            if self.simulate_contention else 1
         trace.add_span("engine.prefill", t_arrival, prefill_end, parent=root,
-                       prompt_tokens=5, cached_tokens=0, uncached_tokens=5)
+                       prompt_tokens=5, cached_tokens=0, uncached_tokens=5,
+                       prefill_chunks=chunks)
         trace.add_span("engine.decode", prefill_end, now, parent=root,
                        tokens=n_tokens, steps=n_tokens)
         root.finish(end=now, tokens=n_tokens)
@@ -114,12 +158,11 @@ class FakeEngine:
         t_prefill_end: Optional[float] = None
         self.num_running += 1
         try:
-            if self.ttft > 0:
-                await asyncio.sleep(self.ttft)
+            await self._prefill_sleep()
             t_prefill_end = time.time()
             if not stream:
                 for _ in range(n_tokens):
-                    await asyncio.sleep(self._token_delay())
+                    await self._decode_step()
                 return web.json_response({
                     "id": rid, "object": "chat.completion", "model": model,
                     "created": int(time.time()),
@@ -148,7 +191,7 @@ class FakeEngine:
                     }],
                 }
                 await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
-                await asyncio.sleep(self._token_delay())
+                await self._decode_step()
             final = {
                 "id": rid, "object": "chat.completion.chunk",
                 "created": int(time.time()), "model": model,
@@ -238,6 +281,8 @@ class FakeEngine:
             "vllm:gpu_prefix_cache_hits_total 30\n"
             "# TYPE vllm:gpu_prefix_cache_queries counter\n"
             "vllm:gpu_prefix_cache_queries_total 100\n"
+            "# TYPE tpu:prefill_chunks counter\n"
+            f"tpu:prefill_chunks_total {self.prefill_chunks_total}\n"
         )
         return web.Response(text=text, content_type="text/plain")
 
@@ -275,10 +320,23 @@ def main() -> None:
     parser.add_argument("--model", default="fake-model")
     parser.add_argument("--ttft", type=float, default=0.0)
     parser.add_argument("--tokens-per-sec", type=float, default=0.0)
+    parser.add_argument("--simulate-contention", action="store_true",
+                        default=False,
+                        help="serialize prefill/decode on one lock (one "
+                             "fake device) so arrival storms stall decode")
+    parser.add_argument("--enable-chunked-prefill", action="store_true",
+                        default=False,
+                        help="with --simulate-contention: prefills yield "
+                             "the device between chunks")
+    parser.add_argument("--prefill-chunks", type=int, default=4)
     args = parser.parse_args()
 
     async def _run():
-        engine = FakeEngine(args.model, args.ttft, args.tokens_per_sec)
+        engine = FakeEngine(
+            args.model, args.ttft, args.tokens_per_sec,
+            simulate_contention=args.simulate_contention,
+            enable_chunked_prefill=args.enable_chunked_prefill,
+            prefill_chunks=args.prefill_chunks)
         await run_fake_engine(engine, args.host, args.port)
         while True:
             await asyncio.sleep(3600)

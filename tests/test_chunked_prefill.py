@@ -1,0 +1,442 @@
+"""Chunked prefill: token-budget scheduler simulation (deterministic, no
+JAX) plus engine-level stream-equality and KV-pressure tests, and the
+kv_capacity rejection surface (HTTP 503 + metrics).
+
+The simulation drives Scheduler.next_action() against a real
+KVCacheManager exactly the way EngineCore does — allocate on the first
+chunk, extend_tokens on continuations, claim a decode slot on the final
+chunk — so the scheduling invariants (budget, starvation cap, abort /
+preempt bookkeeping) are asserted without a model in the loop.
+"""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from production_stack_tpu.engine.kvcache import KVCacheManager
+from production_stack_tpu.engine.sampling import SamplingParams
+from production_stack_tpu.engine.scheduler import (
+    EngineRequest,
+    RequestStatus,
+    Scheduler,
+)
+
+# ---------------------------------------------------------------------------
+# Deterministic scheduler simulation (no JAX)
+# ---------------------------------------------------------------------------
+
+
+def mk_req(rid, n_prompt, finishes=None, arrival=None):
+    events = []
+
+    def on_token(token, finish):
+        events.append((token, finish))
+        if finishes is not None and finish is not None:
+            finishes.append((rid, finish))
+
+    req = EngineRequest(
+        request_id=rid,
+        prompt_token_ids=list(range(1, n_prompt + 1)),
+        sampling=SamplingParams(max_tokens=4, temperature=0.0),
+        on_token=on_token,
+    )
+    if arrival is not None:
+        req.arrival_time = arrival
+    req.events = events
+    return req
+
+
+def mk_sched(num_blocks=64, block_size=4, max_num_seqs=4, chunk_tokens=16,
+             token_budget=16, cap=2, prefix_caching=False):
+    kv = KVCacheManager(num_blocks, block_size,
+                        enable_prefix_caching=prefix_caching)
+    sched = Scheduler(
+        kv, max_num_seqs=max_num_seqs, max_model_len=512,
+        chunked_prefill=True, chunk_tokens=chunk_tokens,
+        token_budget=token_budget, max_consecutive_prefills=cap,
+    )
+    return sched, kv
+
+
+def exec_plan(sched, kv, plan):
+    """Apply a prefill_step plan to the KV manager the way the engine
+    does: allocate/extend pages, advance num_computed_tokens, claim a
+    decode slot on the final chunk."""
+    for pc in plan:
+        req = pc.req
+        tokens = req.all_token_ids
+        if pc.start == 0:
+            res = kv.allocate_prompt(req.request_id, tokens, limit=pc.end)
+            assert res is not None, "sim never overcommits"
+        else:
+            assert kv.extend_tokens(req.request_id, tokens, pc.end) \
+                is not None
+        req.num_computed_tokens = pc.end
+        if pc.is_final:
+            sched.prefilling.remove(req)
+            slot = sched._free_slot()
+            assert slot is not None, (
+                "admission invariant guarantees a free slot at the final "
+                "chunk")
+            sched.start_running(req, slot)
+
+
+def test_chunks_respect_budget_and_drain():
+    sched, kv = mk_sched(chunk_tokens=16, token_budget=16)
+    req = mk_req("r1", 100)
+    sched.add(req)
+    steps = 0
+    while req.status is not RequestStatus.RUNNING:
+        action, payload = sched.next_action()
+        assert action == "prefill_step", action
+        assert sum(pc.end - pc.start for pc in payload) <= 16
+        for pc in payload:
+            assert pc.end - pc.start <= 16
+            assert pc.start == pc.req.num_computed_tokens
+        exec_plan(sched, kv, payload)
+        steps += 1
+        assert steps < 50
+    # 100 tokens / 16-token chunks -> 7 steps, last one partial.
+    assert steps == 7
+    assert req.num_computed_tokens == 100
+    assert sched.next_action()[0] == "decode"
+
+
+def test_decode_starvation_cap_bounds_prefill_streaks():
+    sched, kv = mk_sched(num_blocks=256, chunk_tokens=16, token_budget=16,
+                         cap=2, max_num_seqs=8)
+    # One sequence already decoding...
+    first = mk_req("warm", 8)
+    sched.add(first)
+    action, plan = sched.next_action()
+    assert action == "prefill_step"
+    exec_plan(sched, kv, plan)
+    assert sched.num_running == 1
+    # ... then a storm of long prompts lands.
+    backlog = [mk_req(f"s{i}", 64) for i in range(6)]
+    for r in backlog:
+        sched.add(r)
+    streak, max_streak, decodes = 0, 0, 0
+    for _ in range(200):
+        if not sched.has_work():
+            break
+        action, payload = sched.next_action()
+        if action == "prefill_step":
+            streak += 1
+            max_streak = max(max_streak, streak)
+            exec_plan(sched, kv, payload)
+        elif action == "decode":
+            streak = 0
+            decodes += 1
+        else:
+            break
+        if all(r.status is RequestStatus.RUNNING for r in backlog):
+            break
+    # The cap held while the backlog drained, and decode steps actually
+    # interleaved (no starvation).
+    assert max_streak <= 2
+    assert decodes >= len(backlog) * (64 // 16) // 2 - 1
+    assert all(r.status is RequestStatus.RUNNING for r in backlog)
+
+
+def test_kv_capacity_rejection_reason_chunked_and_unchunked():
+    finishes = []
+    # Pool of 8 blocks * 4 = 32 tokens; prompt of 60 < max_model_len can
+    # never fit even on an idle engine.
+    for chunked in (True, False):
+        kv = KVCacheManager(8, 4, enable_prefix_caching=False)
+        sched = Scheduler(kv, max_num_seqs=4, max_model_len=512,
+                          chunked_prefill=chunked, chunk_tokens=16,
+                          token_budget=16)
+        req = mk_req("big", 60, finishes=finishes)
+        sched.add(req)
+        action, _ = sched.next_action()
+        assert action == "idle"
+        assert req.status is RequestStatus.REJECTED
+        assert sched.rejected_total["kv_capacity"] == 1
+        assert sched.rejected_total["length"] == 0
+    assert finishes == [("big", "kv_capacity"), ("big", "kv_capacity")]
+
+
+def test_length_rejection_still_distinct():
+    kv = KVCacheManager(64, 4, enable_prefix_caching=False)
+    sched = Scheduler(kv, max_num_seqs=4, max_model_len=32)
+    finishes = []
+    sched.add(mk_req("toolong", 40, finishes=finishes))
+    assert finishes == [("toolong", "length")]
+    assert sched.rejected_total["length"] == 1
+    assert sched.rejected_total["kv_capacity"] == 0
+
+
+def test_abort_mid_chunk_frees_kv_pages():
+    sched, kv = mk_sched(chunk_tokens=16, token_budget=16)
+    free0 = kv.allocator.num_free
+    req = mk_req("r1", 100)
+    sched.add(req)
+    # Run two chunks: 32 of 100 tokens prefilled, pages held.
+    for _ in range(2):
+        action, plan = sched.next_action()
+        assert action == "prefill_step"
+        exec_plan(sched, kv, plan)
+    assert req.num_computed_tokens == 32
+    assert kv.allocator.num_free < free0
+    assert sched.abort("r1")
+    assert kv.allocator.num_free == free0, "mid-chunk abort leaked pages"
+    assert not sched.prefilling
+    assert req.events[-1] == (None, "abort")
+    assert not sched.has_work()
+    # Terminal: the id is gone from the index; a second abort is a no-op.
+    assert not sched.abort("r1")
+
+
+def test_abort_queued_is_tombstoned_o1():
+    sched, kv = mk_sched()
+    reqs = [mk_req(f"r{i}", 8) for i in range(4)]
+    for r in reqs:
+        sched.add(r)
+    assert sched.num_waiting == 4
+    assert sched.abort("r1") and sched.abort("r2")
+    assert sched.num_waiting == 2
+    # Tombstones are skipped at pop: the next plan admits r0 and r3 only.
+    admitted = []
+    while sched.num_waiting or sched.prefilling:
+        action, plan = sched.next_action()
+        assert action == "prefill_step"
+        admitted += [pc.req.request_id for pc in plan]
+        exec_plan(sched, kv, plan)
+    assert admitted == ["r0", "r3"]
+
+
+def test_preempt_youngest_mid_chunk_resets_and_requeues():
+    sched, kv = mk_sched(chunk_tokens=16, token_budget=16)
+    free0 = kv.allocator.num_free
+    old = mk_req("old", 8, arrival=1.0)
+    sched.add(old)
+    action, plan = sched.next_action()
+    exec_plan(sched, kv, plan)  # old is running
+    young = mk_req("young", 100, arrival=2.0)
+    sched.add(young)
+    # Interleave until young has some chunks in flight.
+    while young.num_computed_tokens < 32:
+        action, plan = sched.next_action()
+        if action == "prefill_step":
+            exec_plan(sched, kv, plan)
+    kv_young = kv.allocator.num_free
+    seq = sched.preempt_youngest()
+    assert seq is not None and seq.req is young
+    assert seq.slot == -1, "mid-prefill victim holds no decode slot"
+    assert young.num_computed_tokens == 0
+    assert young.status is RequestStatus.PREEMPTED
+    assert young.num_preemptions == 1
+    assert not sched.prefilling
+    assert kv.allocator.num_free > kv_young, "preempt freed the pages"
+    assert sched.peek_waiting() is young, "victim requeued at the head"
+    # Resume: the next plans re-chunk young from token 0 to completion.
+    while young.status is not RequestStatus.RUNNING:
+        action, plan = sched.next_action()
+        if action == "prefill_step":
+            exec_plan(sched, kv, plan)
+    assert young.num_computed_tokens == 100
+    # Cleanup accounting still exact.
+    sched.finish(sched._running_by_id["young"], "stop")
+    sched.finish(sched._running_by_id["old"], "stop")
+    assert kv.allocator.num_free == free0
+
+
+def test_flag_off_matches_legacy_action_machine():
+    """chunked_prefill off => next_action is the plain prefill-OR-decode
+    machine: whole prompts, no plans, no partial state."""
+    kv = KVCacheManager(64, 4, enable_prefix_caching=False)
+    sched = Scheduler(kv, max_num_seqs=2, max_model_len=512)
+    a, b, c = mk_req("a", 20), mk_req("b", 20), mk_req("c", 20)
+    for r in (a, b, c):
+        sched.add(r)
+    action, req = sched.next_action()
+    assert (action, req) == ("prefill", a)
+    kv.allocate_prompt("a", a.all_token_ids)
+    sched.start_running(a, 0)
+    action, req = sched.next_action()
+    assert (action, req) == ("prefill", b)
+    kv.allocate_prompt("b", b.all_token_ids)
+    sched.start_running(b, 1)
+    # Slots full: decode, c stays whole in the queue.
+    assert sched.next_action() == ("decode", None)
+    assert not sched.prefilling
+    assert c.num_computed_tokens == 0
+
+
+# ---------------------------------------------------------------------------
+# Engine-level equality (real model, CPU)
+# ---------------------------------------------------------------------------
+
+from test_engine_core import make_engine  # noqa: E402
+
+
+def run_requests(engine, prompts, max_tokens):
+    """Submit all prompts at once; return {rid: (tokens, finish)}."""
+    results = {}
+    queues = {}
+    for i, prompt in enumerate(prompts):
+        rid = f"r{i}"
+        q = queue.Queue()
+        queues[rid] = q
+
+        def on_token(token, finish, q=q):
+            q.put((token, finish))
+
+        engine.add_request(rid, list(prompt), SamplingParams(
+            max_tokens=max_tokens[i], temperature=0.0, ignore_eos=True),
+            on_token)
+    for rid, q in queues.items():
+        tokens = []
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            try:
+                token, finish = q.get(timeout=10)
+            except queue.Empty:
+                continue
+            if token is not None:
+                tokens.append(token)
+            if finish is not None:
+                results[rid] = (tokens, finish)
+                break
+        else:
+            raise TimeoutError(rid)
+    return results
+
+
+def test_chunked_streams_equal_unchunked():
+    """Same prompts, greedy: the chunked engine emits exactly the token
+    streams the flag-off engine does (the tentpole's correctness bar)."""
+    prompts = [
+        list(range(1, 60)),
+        list(range(7, 19)),
+        list(range(101, 140)),
+    ]
+    max_tokens = [12, 12, 12]
+    ref = make_engine()
+    try:
+        expected = run_requests(ref, prompts, max_tokens)
+    finally:
+        ref.stop()
+    eng = make_engine(enable_chunked_prefill=True,
+                      max_num_batched_tokens=32)
+    try:
+        got = run_requests(eng, prompts, max_tokens)
+        assert eng.prefill_chunks_total >= 4, (
+            "long prompts should have been sliced")
+        assert eng.deferred_prefill_tokens_total > 0
+    finally:
+        eng.stop()
+    assert got == expected
+
+
+def test_chunked_preempt_resume_equals_ample_reference():
+    """Tight KV pool, chunked scheduler: combined decode growth of two
+    requests (17 + 27 = 44 blocks) exceeds the 30-block pool, so the
+    younger one is guaranteed to be preempted and later resumed via a
+    chunked re-prefill that includes its generated tokens. Streams must
+    still match a flag-off engine with ample KV."""
+    prompts = [list(range(1, 9)), list(range(11, 59))]  # 8 and 48 tokens
+    max_tokens = [60, 60]
+    ref = make_engine(num_blocks=96)
+    try:
+        expected = run_requests(ref, prompts, max_tokens)
+    finally:
+        ref.stop()
+    eng = make_engine(num_blocks=30, enable_chunked_prefill=True,
+                      max_num_batched_tokens=16)
+    try:
+        got = run_requests(eng, prompts, max_tokens)
+        assert eng.scheduler.num_preempted_total >= 1, (
+            "44 blocks of demand against a 30-block pool must preempt")
+    finally:
+        eng.stop()
+    assert got == expected
+
+
+def test_kv_never_fits_precheck():
+    eng = make_engine(num_blocks=16)  # 16*4 = 64 token pool
+    try:
+        assert eng.kv_never_fits(80)
+        assert not eng.kv_never_fits(40)
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# kv_capacity over HTTP: 503 + Retry-After + metrics
+# ---------------------------------------------------------------------------
+
+
+def test_kv_capacity_http_503_and_metric():
+    import asyncio
+
+    import aiohttp
+
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.server import (
+        EngineServer,
+        run_engine_server,
+    )
+
+    config = EngineConfig(
+        model="tiny-llama", max_model_len=128, max_num_seqs=4,
+        block_size=4, num_blocks=16, min_prefill_bucket=16, max_loras=4,
+    )
+    server = EngineServer(config)
+    loop = asyncio.new_event_loop()
+    holder = {}
+    started = threading.Event()
+
+    async def _boot():
+        runner = await run_engine_server(server, "127.0.0.1", 0)
+        port = list(runner.sites)[0]._server.sockets[0].getsockname()[1]
+        holder["runner"] = runner
+        return f"http://127.0.0.1:{port}"
+
+    def _run():
+        asyncio.set_event_loop(loop)
+        holder["url"] = loop.run_until_complete(_boot())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    started.wait(timeout=60)
+    url = holder["url"]
+    try:
+        async def run():
+            async with aiohttp.ClientSession() as s:
+                # 80 words -> well over the 64-token KV pool but under
+                # max_model_len: capacity, not length.
+                prompt = " ".join(f"w{i}" for i in range(80))
+                async with s.post(url + "/v1/completions", json={
+                    "model": "tiny-llama", "prompt": prompt,
+                    "max_tokens": 4,
+                }) as r:
+                    assert r.status == 503, await r.text()
+                    assert r.headers.get("Retry-After") == "1"
+                    body = await r.json()
+                    assert body["error"]["type"] == "ServiceUnavailable"
+                # A small prompt still serves.
+                async with s.post(url + "/v1/completions", json={
+                    "model": "tiny-llama", "prompt": "hello world",
+                    "max_tokens": 2,
+                }) as r:
+                    assert r.status == 200, await r.text()
+                async with s.get(url + "/metrics") as r:
+                    text = await r.text()
+
+                lines = [ln for ln in text.splitlines()
+                         if ln.startswith("tpu:rejected_requests_total")]
+                assert any('reason="kv_capacity"' in ln and ln.endswith(" 1")
+                           for ln in lines), lines
+                assert any('reason="length"' in ln for ln in lines), lines
+        asyncio.run(run())
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=5)
+        server.core.stop()

@@ -330,3 +330,39 @@ def test_multihost_disagg_example_composes():
     cmd = " ".join(router["spec"]["template"]["spec"]["containers"][0]
                    ["command"])
     assert "disaggregated_prefill" in cmd
+
+
+def test_chunked_prefill_flags_plumb_into_engine_command():
+    """maxNumBatchedTokens / enableChunkedPrefill render as engine args
+    (and stay absent when unset), and the schema accepts them."""
+    import copy
+    import json
+
+    import jsonschema
+
+    values = copy.deepcopy(load_values(CHART, os.path.join(
+        CHART, "examples", "values-01-minimal.yaml")))
+    spec = values["servingEngineSpec"]["modelSpec"][0]
+    spec["maxNumBatchedTokens"] = 512
+    spec["enableChunkedPrefill"] = True
+    with open(os.path.join(CHART, "values.schema.json")) as f:
+        jsonschema.validate(values, json.load(f))
+
+    rendered = MiniHelm(CHART).render(values)
+    deps = [d for d in _docs(rendered, "Deployment")
+            if d["metadata"]["name"].endswith("-engine")]
+    assert deps, "engine deployment missing"
+    cmd = deps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--max-num-batched-tokens" in cmd
+    assert cmd[cmd.index("--max-num-batched-tokens") + 1] == "512"
+    assert "--enable-chunked-prefill" in cmd
+
+    # Default (flags unset): neither flag renders — chart default is
+    # today's unchunked behavior.
+    base = _render(os.path.join(CHART, "examples",
+                                "values-01-minimal.yaml"))
+    bdeps = [d for d in _docs(base, "Deployment")
+             if d["metadata"]["name"].endswith("-engine")]
+    bcmd = bdeps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--max-num-batched-tokens" not in bcmd
+    assert "--enable-chunked-prefill" not in bcmd
