@@ -152,6 +152,18 @@ MAX_NUM_BATCHED_TOKENS = _env_int(
 STORM_USERS = _env_int("BENCH_STORM_USERS", 0)
 STORM_AT = _env_float("BENCH_STORM_AT", 10.0)
 STORM_PROMPT_TOKENS = _env_int("BENCH_STORM_PROMPT_TOKENS", 4000)
+# Speculative-decoding knobs: BENCH_SPEC sets --speculative-num-tokens
+# (0 = off). BENCH_REPETITIVE=1 swaps the incompressible prompt text for
+# highly repetitive text AND pins greedy answers to one token via
+# logit_bias so the generation itself is draftable (the prompt-lookup
+# best case even on random bench weights). BENCH_SPEC_AB=1
+# runs the whole bench twice — spec off, then spec on at BENCH_SPEC
+# (default 4) — and writes BENCH_SPEC_OUT (default BENCH_SPEC.json) with
+# tokens/s + acceptance rate for both legs.
+SPEC = _env_int("BENCH_SPEC", int(_cfg.get("spec", 0)))
+REPETITIVE = _env_int("BENCH_REPETITIVE", 0)
+SPEC_AB = _env_int("BENCH_SPEC_AB", 0)
+SPEC_OUT = os.environ.get("BENCH_SPEC_OUT", "BENCH_SPEC.json")
 
 
 def _load_baseline() -> float:
@@ -188,6 +200,11 @@ def _make_prompt(tokens: int, tag: str) -> str:
     1 token per UTF-8 byte), so emit exactly `tokens` ASCII chars; with a
     real HF tokenizer the same text is a comparable-or-smaller token count.
     """
+    if REPETITIVE:
+        # Prompt-lookup best case: the text is one phrase repeated, so
+        # the n-gram index finds a continuation for almost every tail.
+        phrase = f"repeat {tag[:4]} the same words again and again. "
+        return (phrase * (tokens // len(phrase) + 1))[:tokens]
     rng = random.Random(tag)
     alphabet = "abcdefghijklmnopqrstuvwxyz "
     return "".join(rng.choice(alphabet) for _ in range(tokens))
@@ -255,14 +272,20 @@ async def _drive(router_url: str):
             max_gap = 0.0
             answer = []
             model = ADAPTER_NAME if uid < LORA_USERS else MODEL
+            body = {
+                "model": model, "messages": history,
+                "max_tokens": ANSWER_TOKENS, "stream": True,
+                "temperature": 0.0, "ignore_eos": True,
+            }
+            if REPETITIVE:
+                # Pin greedy output to one token: the generation echoes
+                # itself, so prompt-lookup drafts always accept — the
+                # speculation best case, independent of model weights.
+                body["logit_bias"] = {"104": 100.0}
             try:
                 async with session.post(
                     router_url + "/v1/chat/completions",
-                    json={
-                        "model": model, "messages": history,
-                        "max_tokens": ANSWER_TOKENS, "stream": True,
-                        "temperature": 0.0, "ignore_eos": True,
-                    },
+                    json=body,
                     headers={"x-user-id": str(uid)},
                     timeout=aiohttp.ClientTimeout(total=900),
                 ) as resp:
@@ -373,7 +396,7 @@ async def _drive(router_url: str):
             rounds_done, prompt_tokens_sent, max_itgs, storm_done[0])
 
 
-async def _main() -> dict:
+async def _main(spec_tokens: int = SPEC) -> dict:
     from production_stack_tpu.engine.config import EngineConfig
     from production_stack_tpu.engine.server import (
         EngineServer,
@@ -408,6 +431,7 @@ async def _main() -> dict:
             "BENCH_PREFILL_BATCH", _cfg.get("prefill_batch", 4)),
         enable_chunked_prefill=bool(CHUNKED),
         max_num_batched_tokens=MAX_NUM_BATCHED_TOKENS,
+        speculative_num_tokens=spec_tokens,
     )
     servers = [EngineServer(config, warmup=True) for _ in range(n_engines)]
     runners, engine_urls = [], []
@@ -464,7 +488,11 @@ async def _main() -> dict:
                             "prefill_time_total", "decode_time_total",
                             "flush_time_total", "prefill_count",
                             "decode_burst_count", "dispatch_count_total",
-                            "dispatch_enqueue_s"):
+                            "dispatch_enqueue_s",
+                            "decode_forward_steps_total",
+                            "spec_proposed_tokens_total",
+                            "spec_accepted_tokens_total",
+                            "spec_disabled_requests_total"):
                     core_stats[key] += s[key]
     finally:
         await router_runner.cleanup()
@@ -540,6 +568,25 @@ async def _main() -> dict:
         "engine_prefill_chunks": core_stats.get("prefill_chunks_total", 0),
         "engine_deferred_prefill_tokens": core_stats.get(
             "deferred_prefill_tokens_total", 0),
+        # Speculative decoding A/B surface: the engine-side win is
+        # generated tokens per model forward (1.0 = plain decode).
+        "speculative_num_tokens": spec_tokens,
+        "repetitive": bool(REPETITIVE),
+        "engine_forward_steps": core_stats.get(
+            "decode_forward_steps_total", 0),
+        "tokens_per_forward": round(
+            core_stats["generation_tokens_total"]
+            / max(core_stats.get("decode_forward_steps_total", 0), 1), 3),
+        "engine_spec_proposed": core_stats.get(
+            "spec_proposed_tokens_total", 0),
+        "engine_spec_accepted": core_stats.get(
+            "spec_accepted_tokens_total", 0),
+        "engine_spec_acceptance_rate": (
+            round(core_stats.get("spec_accepted_tokens_total", 0)
+                  / core_stats["spec_proposed_tokens_total"], 4)
+            if core_stats.get("spec_proposed_tokens_total") else None),
+        "engine_spec_disabled": core_stats.get(
+            "spec_disabled_requests_total", 0),
         "backend": None,  # filled below
     }
     return result
@@ -579,6 +626,38 @@ def main() -> None:
     import jax
 
     try:
+        if SPEC_AB:
+            # Spec-on vs spec-off A/B on the same workload (run
+            # BENCH_REPETITIVE=1 for the prompt-lookup best case). Both
+            # legs run in this process back to back; the JSON artifact
+            # carries both so the speedup is attributable.
+            off = asyncio.run(_main(0))
+            on = asyncio.run(_main(SPEC or 4))
+            for leg in (off, on):
+                leg["backend"] = jax.devices()[0].platform
+            result = {
+                "metric": f"spec_decode_ab({MODEL})",
+                "value": on["value"],
+                "unit": "tok/s",
+                "vs_baseline": (
+                    round(on["value"] / off["value"], 3)
+                    if off["value"] else None),
+                "config": CONFIG_KEY,
+                "spec_off_tok_s": off["value"],
+                "spec_on_tok_s": on["value"],
+                "spec_off_tokens_per_forward": off["tokens_per_forward"],
+                "spec_on_tokens_per_forward": on["tokens_per_forward"],
+                "acceptance_rate": on["engine_spec_acceptance_rate"],
+                "spec_disabled_requests": on["engine_spec_disabled"],
+                "repetitive": bool(REPETITIVE),
+                "spec_off": off,
+                "spec_on": on,
+            }
+            with open(os.path.join(REPO, SPEC_OUT), "w") as f:
+                json.dump(result, f, indent=2)
+                f.write("\n")
+            print(json.dumps(result))
+            return
         result = asyncio.run(_main())
     except Exception as e:  # noqa: BLE001
         # The tunneled dev runtime leaks residual HBM across processes:

@@ -74,6 +74,10 @@
 {{- if .model.enableChunkedPrefill }}
 - --enable-chunked-prefill
 {{- end }}
+{{- if .model.speculativeNumTokens }}
+- --speculative-num-tokens
+- {{ .model.speculativeNumTokens | quote }}
+{{- end }}
 {{- if .model.kvOffloadGb }}
 - --kv-offload-gb
 - {{ .model.kvOffloadGb | quote }}

@@ -243,7 +243,47 @@ def build_dashboard():
              "pool, HTTP 503 + Retry-After)"))
     y += 7
 
-    # ---- Row 6: TPU KV cache & offload (TPU-native; beyond the ref) ----- #
+    # ---- Row 6: Speculative decoding (prompt-lookup drafts) ------------- #
+    panels.append(row("Speculative Decoding", y)); y += 1
+    panels.append(panel(
+        "timeseries", "Draft tokens proposed (rate)",
+        [target("rate(tpu:spec_proposed_tokens_total[5m])",
+                legend="{{instance}}")],
+        grid(7, 6, 0, y),
+        desc="Prompt-lookup draft tokens sent to verification per second "
+             "(--speculative-num-tokens)"))
+    panels.append(panel(
+        "timeseries", "Draft tokens accepted (rate)",
+        [target("rate(tpu:spec_accepted_tokens_total[5m])",
+                legend="{{instance}}")],
+        grid(7, 6, 6, y),
+        desc="Draft tokens that matched what plain decode would have "
+             "sampled — each one saved a forward pass"))
+    panels.append(panel(
+        "timeseries", "Draft acceptance rate",
+        [target("tpu:spec_acceptance_rate", legend="{{instance}}")],
+        grid(7, 6, 12, y), unit="percentunit",
+        desc="Lifetime accepted/proposed; per-request adaptive fallback "
+             "disables drafting below the configured threshold"))
+    panels.append(panel(
+        "stat", "Requests with speculation disabled",
+        [target("sum(tpu:spec_disabled_requests_total)", instant=True)],
+        grid(7, 6, 18, y),
+        desc="Requests whose rolling acceptance fell below the threshold "
+             "(adversarial / non-repetitive text) and latched back to "
+             "plain decode"))
+    y += 7
+    panels.append(panel(
+        "timeseries", "Generated tokens per model forward",
+        [target("rate(vllm:generation_tokens_total[5m]) / "
+                "rate(tpu:decode_forward_steps_total[5m])",
+                legend="{{instance}}")],
+        grid(7, 16, 0, y),
+        desc="The speculation win: >1 means verify bursts are emitting "
+             "multiple tokens per forward pass (1.0 = plain decode)"))
+    y += 7
+
+    # ---- Row 7: TPU KV cache & offload (TPU-native; beyond the ref) ----- #
     panels.append(row("TPU KV Cache & Offload", y)); y += 1
     panels.append(panel(
         "timeseries", "TPU HBM KV usage per engine",
@@ -284,7 +324,7 @@ def build_dashboard():
              "routing"))
     y += 7
 
-    # ---- Row 7: Current Resource Usage (ref panels 14-19) --------------- #
+    # ---- Row 8: Current Resource Usage (ref panels 14-19) --------------- #
     panels.append(row("Current Resource Usage", y)); y += 1
     panels.append(panel(
         "timeseries", "Router CPU usage",

@@ -81,6 +81,23 @@ class EngineConfig:
     # so the default is OFF; the knob remains for decode-dominated
     # workloads with sparse arrivals.
     decode_steps_pressure: int = 0
+    # Prompt-lookup speculative decoding: each decode burst may verify a
+    # host-proposed draft (n-gram matched against the request's own
+    # prompt + generated tokens) in ONE batched forward pass instead of
+    # K sequential scan steps. The value is the verify width K: one
+    # burst consumes the last emitted token plus up to K-1 draft tokens
+    # and emits between 1 and K tokens. 0 disables (default).
+    speculative_num_tokens: int = 0
+    # n-gram length matched against the request context to find a draft
+    # continuation (Saxena, "Prompt Lookup Decoding").
+    speculative_ngram_size: int = 3
+    # Adaptive fallback: once at least ``speculative_accept_window``
+    # draft tokens have been judged for a request, stop proposing for it
+    # when the rolling acceptance rate is below this threshold — so
+    # adversarial (match-free or mismatching) text pays at most the
+    # warmup window before reverting to plain fused decode bursts.
+    speculative_accept_threshold: float = 0.35
+    speculative_accept_window: int = 32
     # Sampling safety cap
     max_top_k: int = 64
     seed: int = 0
@@ -103,6 +120,14 @@ class EngineConfig:
             raise ValueError(
                 f"unsupported quantization {self.quantization!r} "
                 f"(supported: int8)")
+        if self.speculative_num_tokens < 0:
+            raise ValueError("speculative_num_tokens must be >= 0")
+        if self.speculative_num_tokens == 1:
+            # K=1 would verify zero draft tokens per burst: all cost, no win.
+            raise ValueError(
+                "speculative_num_tokens must be 0 (off) or >= 2")
+        if self.speculative_ngram_size < 1:
+            raise ValueError("speculative_ngram_size must be >= 1")
 
     @property
     def max_blocks_per_seq(self) -> int:

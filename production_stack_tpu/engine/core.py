@@ -28,6 +28,7 @@ from production_stack_tpu.engine.sampling import (
     MAX_LOGIT_BIAS,
     MAX_STOP_IDS,
     SamplingParams,
+    accepted_prefix_len,
     logprob_outputs,
     make_rng_keys,
     sample_tokens,
@@ -36,6 +37,7 @@ from production_stack_tpu.engine.scheduler import (
     EngineRequest,
     RunningSeq,
     Scheduler,
+    SpecState,
 )
 from production_stack_tpu.engine.tokenizer import build_tokenizer
 from production_stack_tpu.models import build_model, get_model_config
@@ -272,6 +274,10 @@ class EngineCore:
         # Decode always runs through the fused burst program (K ==
         # decode_steps; K=1 degenerates to single-step).
         self._multi_decode_fns: Dict[int, Callable] = {}
+        # Speculative verify program (prompt-lookup decoding): one jit fn
+        # for the configured verify width; XLA lowers one variant per
+        # block-table bucket, mirroring the decode variants.
+        self._spec_verify_fns: Dict[int, Callable] = {}
         self._embed_fns: Dict[int, Callable] = {}
         self._write_block_fn = self._make_write_block()
         self._write_blocks_fn = self._make_write_blocks()
@@ -308,6 +314,20 @@ class EngineCore:
         self.decode_burst_count = 0
         self.dispatch_count_total = 0
         self.dispatch_enqueue_s = 0.0
+        # Speculative decoding (prompt lookup): draft tokens sent to the
+        # verify program / accepted by it, requests latched back to plain
+        # decode by the adaptive fallback, verify bursts dispatched, and
+        # the model-forward-step count behind them (a plain K-step burst
+        # is K sequential forwards; a verify burst is ONE — generation
+        # tokens per forward step is the speedup speculation buys).
+        self.spec_proposed_tokens_total = 0
+        self.spec_accepted_tokens_total = 0
+        self.spec_disabled_requests_total = 0
+        self.spec_verify_bursts_total = 0
+        self.decode_forward_steps_total = 0
+        # Warmup variant counts per program family (compile-budget
+        # regression tests read this; also logged at the end of warmup).
+        self.warmup_variants: Dict[str, int] = {}
         self._sleeping = False
         self._sleep_level = 1
         self._host_params = None
@@ -691,6 +711,91 @@ class EngineCore:
             self._multi_decode_fns[K] = fn
         return fn
 
+    def _make_spec_verify(self, K: int):
+        """Speculative verify: score K draft positions in ONE forward.
+
+        Input row s carries [last_emitted, d1, .., d_{K-1}] at positions
+        base-1 .. base+K-2; the cached-prefill path writes each token's
+        KV page before attention, and the causal mask over the block
+        table means position base-1+s attends exactly the pages the
+        plain decode scan's step s would (its own just-written token
+        included). Each position's logits then get the SAME per-step
+        shaping and rng-key schedule as the decode scan (bias, min_tokens
+        EOS/stop masking, make_rng_keys(seed, 0, seed_base + s)), so the
+        sample at position s IS what plain decode would have emitted at
+        that step given the same prefix — acceptance reduces to the
+        longest prefix where sample == draft, and emitting the samples
+        themselves keeps the stream identical to non-speculative
+        decoding at ANY temperature (exact for greedy; for sampled
+        requests the match holds through the shared rng schedule).
+
+        Presence/frequency penalties need cross-step device counts that
+        a single-pass verify cannot update mid-pass; requests using them
+        are spec-ineligible (the scheduler never proposes for them), so
+        this program omits the counts state entirely — for eligible rows
+        the decode scan's penalty term is an exact zero subtraction.
+        """
+        apply = self._apply
+        cfg = self.model_config
+        max_top_k = self.config.max_top_k
+        seed = self.config.seed
+
+        _eos = getattr(self.tokenizer, "eos_token_id", None)
+        eos_id = int(_eos) if _eos is not None else -1  # 0 is a valid id
+
+        def fwd(params, kv, tokens, positions0, slot_mat, block_tables,
+                context0, adapter_ids, temperature, top_k, top_p,
+                seed_base, min_tokens, out_len0, bias_ids, bias_vals,
+                stop_ids, stop_valid):
+            B = tokens.shape[0]
+            positions = positions0[:, None] + jnp.arange(K)[None, :]
+            logits, kv = apply(
+                params, cfg, tokens, positions, kv, slot_mat,
+                block_tables, context0 + K - 1,
+                jnp.full((B,), K, jnp.int32),
+                mode="prefill_cached", adapter_ids=adapter_ids,
+            )
+            # Per-position logit shaping + sampling, identical to the
+            # decode scan body (K is small — unrolled).
+            outs, lp_l, top_lp_l, top_id_l = [], [], [], []
+            for s in range(K):
+                penalized = logits[:, s].at[
+                    jnp.arange(B)[:, None], bias_ids].add(bias_vals)
+                suppress = (out_len0 + s) < min_tokens  # [B]
+                if eos_id >= 0:
+                    penalized = jnp.where(
+                        suppress[:, None]
+                        & (jnp.arange(penalized.shape[1])[None, :]
+                           == eos_id),
+                        -jnp.inf, penalized)
+                penalized = penalized.at[
+                    jnp.arange(B)[:, None], stop_ids].add(
+                    -1e30 * stop_valid
+                    * suppress.astype(jnp.float32)[:, None])
+                keys = make_rng_keys(seed, 0, seed_base + s)
+                sampled = sample_tokens(
+                    penalized, keys, temperature, top_k, top_p,
+                    max_top_k=max_top_k,
+                )
+                lp, top_lp, top_ids = logprob_outputs(penalized, sampled)
+                outs.append(sampled)
+                lp_l.append(lp)
+                top_lp_l.append(top_lp)
+                top_id_l.append(top_ids)
+            return (jnp.stack(outs, 1), jnp.stack(lp_l, 1),
+                    jnp.stack(top_lp_l, 1), jnp.stack(top_id_l, 1)), kv
+
+        return jax.jit(
+            fwd, donate_argnums=(1,),
+            out_shardings=((self._repl,) * 4, self._kv_sharding))
+
+    def _spec_verify_fn(self, K: int):
+        fn = self._spec_verify_fns.get(K)
+        if fn is None:
+            fn = self._make_spec_verify(K)
+            self._spec_verify_fns[K] = fn
+        return fn
+
     def _make_write_block(self):
         """Jitted single-block page write (offload restore / KV inject)."""
 
@@ -801,6 +906,14 @@ class EngineCore:
             # The feedback tokens for the NEXT burst live on device on
             # every process (the host never sees them mid-pipeline).
             self._last_burst_tokens = outs[0]
+            return outs
+        if name == "spec_verify":
+            # Speculative verify burst. Does NOT touch _last_burst_tokens:
+            # spec-mode bursts always flush before dispatching, so the
+            # next burst feeds from host tokens, never from device
+            # feedback (use_prev is False throughout spec mode).
+            fn = self._spec_verify_fn(static["K"])
+            outs, self.kv = fn(self.params, self.kv, *arrays)
             return outs
         if name == "set_counts_row":
             self._token_counts = self._set_counts_row_fn(
@@ -1389,8 +1502,45 @@ class EngineCore:
                     if maxb_w >= cfg.max_blocks_per_seq:
                         break
                     maxb_w *= 2
-        logger.info("Warmup compiled %d prefill + %d decode variants "
-                    "in %.1f s", n_prefill, n_decode, time.time() - t0)
+
+            # Speculative verify: ONE extra program per block-table
+            # bucket (single width K = speculative_num_tokens), so spec
+            # decoding adds at most one compiled variant per decode
+            # variant — the compile-budget contract.
+            n_spec = 0
+            if cfg.speculative_num_tokens > 0:
+                Ks = cfg.speculative_num_tokens
+                fn = self._spec_verify_fn(Ks)
+                maxb_w = 4
+                while True:
+                    maxb_w = min(maxb_w, cfg.max_blocks_per_seq)
+                    _, self.kv = fn(
+                        self.params, self.kv,
+                        np.zeros((B, Ks), np.int32),     # tokens
+                        np.zeros((B,), np.int32),        # positions0
+                        np.full((B, Ks), -1, np.int64),  # slot_mat
+                        np.zeros((B, maxb_w), np.int32),
+                        np.ones((B,), np.int32),         # context0
+                        np.zeros((B,), np.int32),        # adapter_ids
+                        np.zeros((B,), np.float32), np.zeros((B,), np.int32),
+                        np.ones((B,), np.float32), np.zeros((B,), np.int64),
+                        np.zeros((B,), np.int32),        # min_tokens
+                        np.zeros((B,), np.int32),        # out_len0
+                        np.zeros((B, MAX_LOGIT_BIAS), np.int32),
+                        np.zeros((B, MAX_LOGIT_BIAS), np.float32),
+                        np.zeros((B, MAX_STOP_IDS), np.int32),
+                        np.zeros((B, MAX_STOP_IDS), np.float32),
+                    )
+                    n_spec += 1
+                    if maxb_w >= cfg.max_blocks_per_seq:
+                        break
+                    maxb_w *= 2
+        self.warmup_variants = {
+            "prefill": n_prefill, "decode": n_decode, "spec": n_spec,
+        }
+        logger.info("Warmup compiled %d prefill + %d decode + %d spec-verify "
+                    "variants in %.1f s", n_prefill, n_decode, n_spec,
+                    time.time() - t0)
 
     def add_request(
         self,
@@ -1733,6 +1883,11 @@ class EngineCore:
             "decode_burst_count": self.decode_burst_count,
             "dispatch_count_total": self.dispatch_count_total,
             "dispatch_enqueue_s": round(self.dispatch_enqueue_s, 3),
+            "decode_forward_steps_total": self.decode_forward_steps_total,
+            "spec_proposed_tokens_total": self.spec_proposed_tokens_total,
+            "spec_accepted_tokens_total": self.spec_accepted_tokens_total,
+            "spec_disabled_requests_total": self.spec_disabled_requests_total,
+            "spec_verify_bursts_total": self.spec_verify_bursts_total,
         }
 
     # ------------------------------------------------------------------ #
@@ -2449,6 +2604,17 @@ class EngineCore:
         # Deferred prefill first-tokens must land before the burst is
         # built (feedback tokens / positions depend on them).
         self._flush_pending_prefills()
+        if cfg.speculative_num_tokens > 0:
+            # Prompt-lookup speculation: host drafts need the TRUE last
+            # token, so spec mode collapses the dispatch/readback
+            # pipeline (flush first, then dispatch; use_prev stays
+            # False). That trades the one-burst overlap for verifying
+            # up to K tokens per model forward when drafts accept.
+            self._flush_pending_burst()
+            plan = self._propose_spec_drafts()
+            if plan:
+                self._do_decode_spec(plan)
+                return
         B = cfg.max_num_seqs
         K = max(cfg.decode_steps, 1)
         # Prompts waiting AND admissible (free slot — a slot-blocked
@@ -2600,10 +2766,165 @@ class EngineCore:
                 top_k, top_p, seed_base, presence, frequency,
                 min_tok, out_len0, bias_ids, bias_vals, stop_ids, stop_valid,
             ])
+        self.decode_forward_steps_total += K
         # Read back the PREVIOUS burst (overlaps this burst's execution).
         self._flush_pending_burst()
         self._pending_burst = {
             "out": outs, "active": active, "allows": allows,
+        }
+
+    def _propose_spec_drafts(self):
+        """Prompt-lookup drafting for the next burst. Returns a list of
+        ``(seq, draft)`` covering EVERY running row, or None.
+
+        All-or-nothing: a verify burst replaces the whole batched decode
+        step, so it only pays when every live row brings at least one
+        draft token and is eligible. Any row that is draft-less,
+        adaptively disabled, or spec-ineligible (presence/frequency
+        penalties need the in-scan device token counts the verify
+        program omits) sends the whole batch down the plain path — which
+        is exactly the no-worse-than-baseline fallback for adversarial
+        text."""
+        cfg = self.config
+        K = cfg.speculative_num_tokens
+        with self._lock:
+            active = [s for s in self.scheduler.running()
+                      if self.scheduler.slots[s.slot] is s]
+        if not active:
+            return None
+        plan = []
+        for seq in active:
+            r = seq.req
+            if r.sampling.presence_penalty or r.sampling.frequency_penalty:
+                return None
+            if r.spec is None:
+                r.spec = SpecState(cfg.speculative_ngram_size)
+            if r.spec.disabled:
+                return None
+            allow = max(1, min(
+                K,
+                r.sampling.max_tokens - len(r.output_token_ids),
+                cfg.max_model_len - len(r.all_token_ids) + 1,
+            ))
+            draft = (r.spec.propose(r.all_token_ids, allow - 1)
+                     if allow >= 2 else [])
+            if not draft:
+                return None
+            plan.append((seq, list(draft)))
+        return plan
+
+    def _do_decode_spec(self, plan) -> None:
+        """Dispatch one speculative verify burst: ONE model forward scores
+        the last emitted token plus each row's host drafts at their true
+        positions; the flush accepts the longest draft prefix matching
+        what plain decode would have sampled and rolls back the KV tail
+        appended for rejected positions. Not pipelined — acceptance is
+        data-dependent, so the next burst's drafts need this one's
+        tokens on the host first."""
+        cfg = self.config
+        B = cfg.max_num_seqs
+        K = cfg.speculative_num_tokens
+        drafts = {s.req.request_id: d for s, d in plan}
+        with self._lock:
+            active0_ids = {id(s) for s, _ in plan}
+            allows: Dict[str, int] = {}
+            # Account the about-to-be-written tokens; preempt on OOM
+            # (mirrors _do_decode: the loop ends fully appended or
+            # self-preempted, so surviving rows have exactly `allow`
+            # pages committed — the flush's rollback relies on that).
+            for seq, draft in plan:
+                if self.scheduler.slots[seq.slot] is not seq:
+                    continue  # already preempted this pass
+                need = len(draft) + 1
+                allows[seq.req.request_id] = need
+                while need > 0:
+                    ok = self.kv_mgr.append_token(
+                        seq.req.request_id, seq.req.all_token_ids[-1]
+                    )
+                    if ok:
+                        need -= 1
+                        continue
+                    victim = self.scheduler.preempt_youngest()
+                    if victim is None or victim.req is seq.req:
+                        break
+            active = [
+                s for s in self.scheduler.running() if id(s) in active0_ids
+            ]
+        self._drain_offload()
+        if not active:
+            return
+
+        max_blocks = max(
+            (len(self.kv_mgr.block_table(s.req.request_id)) for s in active),
+        )
+        maxb = 4
+        while maxb < max_blocks:
+            maxb *= 2
+        maxb = min(maxb, cfg.max_blocks_per_seq)
+
+        tokens = np.zeros((B, K), np.int32)
+        positions0 = np.zeros((B,), np.int32)
+        slot_mat = np.full((B, K), -1, np.int64)
+        block_table = np.zeros((B, maxb), np.int32)
+        context0 = np.ones((B,), np.int32)
+        adapter_ids = np.zeros((B,), np.int32)
+        temperature = np.zeros((B,), np.float32)
+        top_k = np.zeros((B,), np.int32)
+        top_p = np.ones((B,), np.float32)
+        seed_base = np.zeros((B,), np.int64)
+        min_tok = np.zeros((B,), np.int32)
+        out_len0 = np.zeros((B,), np.int32)
+        bias_ids = np.zeros((B, MAX_LOGIT_BIAS), np.int32)
+        bias_vals = np.zeros((B, MAX_LOGIT_BIAS), np.float32)
+        stop_ids = np.zeros((B, MAX_STOP_IDS), np.int32)
+        stop_valid = np.zeros((B, MAX_STOP_IDS), np.float32)
+
+        for seq in active:
+            i = seq.slot
+            r = seq.req
+            draft = drafts[r.request_id]
+            allow = allows.get(r.request_id, 1)
+            base = len(r.prompt_token_ids) + r.scheduled_steps
+            row = [r.all_token_ids[-1]] + draft
+            tokens[i, :len(row)] = row
+            positions0[i] = base - 1
+            context0[i] = base
+            bids = self.kv_mgr.block_table(r.request_id)
+            use = min(len(bids), maxb)
+            block_table[i, :use] = bids[:use]
+            bid_arr = np.asarray(bids, np.int64)
+            pos = base - 1 + np.arange(allow)
+            slot_mat[i, :allow] = (
+                bid_arr[pos // cfg.block_size] * cfg.block_size
+                + pos % cfg.block_size
+            )
+            adapter_ids[i] = r.adapter_id
+            t, k_, p_, seed = self._sampling_for(r)
+            temperature[i] = t
+            top_k[i] = k_
+            top_p[i] = p_
+            seed_base[i] = seed + r.scheduled_steps
+            min_tok[i] = r.sampling.min_tokens
+            out_len0[i] = r.scheduled_steps
+            self._fill_bias_row(bias_ids[i], bias_vals[i],
+                                r.sampling.logit_bias)
+            self._fill_stop_row(stop_ids[i], stop_valid[i],
+                                r.sampling.stop_token_ids)
+            # scheduled_steps advances at FLUSH by the emitted count —
+            # acceptance is data-dependent, unlike the plain burst.
+
+        outs = self._dispatch(
+            "spec_verify", {"K": K}, [
+                tokens, positions0, slot_mat, block_table, context0,
+                adapter_ids, temperature, top_k, top_p, seed_base,
+                min_tok, out_len0, bias_ids, bias_vals, stop_ids,
+                stop_valid,
+            ])
+        self.spec_verify_bursts_total += 1
+        self.decode_forward_steps_total += 1
+        self._pending_burst = {
+            "out": outs, "active": active, "allows": allows,
+            "spec": True, "drafts": drafts,
         }
 
     def _flush_pending_burst(self) -> None:
@@ -2617,6 +2938,9 @@ class EngineCore:
             np.asarray(a) for a in jax.device_get(pending["out"])
         )  # [B, K], [B, K], [B, K, LOGPROB_K] x2
         self.flush_time_total += time.perf_counter() - t0
+        if pending.get("spec"):
+            self._flush_spec_burst(pending, sampled, lps, top_lps, top_idxs)
+            return
         emitted_seqs = []
         for seq in pending["active"]:
             allow = pending["allows"].get(seq.req.request_id, 1)
@@ -2646,6 +2970,63 @@ class EngineCore:
                     self.kv_mgr.register_decode_blocks(
                         seq.req.request_id, seq.req.all_token_ids
                     )
+
+    def _flush_spec_burst(self, pending, sampled, lps, top_lps,
+                          top_idxs) -> None:
+        """Emit a verify burst: accept the longest draft prefix whose
+        tokens match what plain decode would have sampled, then emit the
+        SAMPLES themselves — the accepted drafts ARE those samples, and
+        the first mismatch position doubles as the corrected/bonus token
+        (so every verify burst makes at least one step of progress).
+        Rolls back the worst-case KV tail appended for rejected
+        positions and feeds the per-request adaptive latch."""
+        cfg = self.config
+        emitted_seqs = []
+        rollbacks = []
+        for seq in pending["active"]:
+            r = seq.req
+            allow = pending["allows"].get(r.request_id, 1)
+            draft = pending["drafts"].get(r.request_id, [])
+            if self.scheduler.slots[seq.slot] is not seq:
+                # Finished/aborted/preempted between dispatch and flush:
+                # its KV was freed wholesale, nothing to roll back.
+                continue
+            j = accepted_prefix_len(draft, sampled[seq.slot])
+            want_lp = r.sampling.logprobs
+            emitted = 0
+            for s in range(j + 1):
+                if self.scheduler.slots[seq.slot] is not seq:
+                    break  # finished mid-burst (EOS / stop / max_tokens)
+                lp = None
+                if want_lp is not None:
+                    k = min(want_lp, top_lps.shape[2])
+                    lp = {"logprob": float(lps[seq.slot, s]),
+                          "top": [(int(top_idxs[seq.slot, s, jj]),
+                                   float(top_lps[seq.slot, s, jj]))
+                                  for jj in range(k)]}
+                self._emit_token(seq, int(sampled[seq.slot, s]), lp)
+                emitted += 1
+            r.scheduled_steps += emitted
+            self.generation_tokens_total += emitted
+            self.spec_proposed_tokens_total += len(draft)
+            self.spec_accepted_tokens_total += j
+            if r.spec is not None and r.spec.judge(
+                    len(draft), j, cfg.speculative_accept_window,
+                    cfg.speculative_accept_threshold):
+                self.spec_disabled_requests_total += 1
+            rollbacks.append((r.request_id, allow - emitted))
+            if emitted and self.scheduler.slots[seq.slot] is seq:
+                emitted_seqs.append(seq)
+        with self._lock:
+            for rid, n in rollbacks:
+                # Stale device pages past the accepted tail are fine:
+                # each decode/verify step writes its own position before
+                # any attention can read it.
+                self.kv_mgr.rollback_tokens(rid, n)
+            for seq in emitted_seqs:
+                self.kv_mgr.register_decode_blocks(
+                    seq.req.request_id, seq.req.all_token_ids
+                )
 
     def _fill_stop_row(self, row_ids, row_valid,
                        stop_token_ids: "list | None") -> None:

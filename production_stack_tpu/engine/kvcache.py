@@ -368,6 +368,26 @@ class KVCacheManager:
         seq.num_tokens += 1
         return True
 
+    def rollback_tokens(self, seq_id: str, n: int) -> None:
+        """Un-account the last ``n`` appended tokens (speculative decode:
+        the verify burst appends worst-case tokens up front; rejected
+        draft positions roll back here). Tail pages that become empty are
+        released — they were appended by this burst, so they are fresh,
+        unregistered (``register_decode_blocks`` runs strictly behind the
+        written frontier) and ref==1; their stale device contents are
+        overwritten by any later owner before its attention can read
+        them (the standard speculative-write invariant)."""
+        if n <= 0:
+            return
+        seq = self.seqs.get(seq_id)
+        if seq is None:
+            return  # finished/preempted between dispatch and flush
+        seq.num_tokens -= n
+        bs = self.block_size
+        keep = max(-(-seq.num_tokens // bs), seq.num_registered // bs)
+        while len(seq.block_ids) > keep:
+            self.allocator.release(seq.block_ids.pop())
+
     def free(self, seq_id: str) -> None:
         seq = self.seqs.pop(seq_id, None)
         if seq is None:

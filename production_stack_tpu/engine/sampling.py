@@ -152,6 +152,25 @@ def logprob_outputs(logits: jax.Array, sampled: jax.Array,
     return chosen, top_lp, top_ids
 
 
+def accepted_prefix_len(draft, sampled_row) -> int:
+    """Speculative-verify acceptance: number of draft tokens accepted.
+
+    ``sampled_row[s]`` is what the verify program sampled at draft
+    position ``s`` using the SAME rng key / logit shaping the plain
+    decode scan would use for that step — so a draft token is correct
+    exactly when it equals that sample, and the longest matching prefix
+    is the set of drafts whose acceptance keeps the emitted stream
+    identical to non-speculative decoding. The caller emits
+    ``sampled_row[:j + 1]`` (the ``j`` accepted drafts ARE those
+    samples, plus the first mismatch as the corrected/bonus token)."""
+    j = 0
+    for d in draft:
+        if int(sampled_row[j]) != int(d):
+            break
+        j += 1
+    return j
+
+
 def make_rng_keys(seed: int, step: int, seq_seeds: jax.Array) -> jax.Array:
     """Per-sequence PRNG keys derived from (engine seed, step, seq seed)."""
     base = jax.random.key(seed)

@@ -38,6 +38,60 @@ from production_stack_tpu.utils.log import init_logger
 logger = init_logger(__name__)
 
 
+class SpecState:
+    """Per-request prompt-lookup speculative-decode state.
+
+    Holds the host-side n-gram index over prompt + generated tokens
+    (n-gram tuple -> its latest start position, grown incrementally as
+    tokens arrive) and the acceptance stats behind the adaptive
+    fallback: once ``proposed`` reaches the configured window with an
+    acceptance rate below the threshold, the request latches
+    ``disabled`` and reverts to plain decode bursts for its remaining
+    lifetime. The index survives preemption untouched — positions are
+    absolute in ``all_token_ids``, which re-prefill reproduces exactly.
+    """
+
+    __slots__ = ("ngram", "index", "indexed_upto",
+                 "proposed", "accepted", "disabled")
+
+    def __init__(self, ngram: int):
+        self.ngram = ngram
+        self.index: Dict[tuple, int] = {}
+        self.indexed_upto = 0
+        self.proposed = 0
+        self.accepted = 0
+        self.disabled = False
+
+    def propose(self, tokens: List[int], max_draft: int) -> List[int]:
+        """Draft up to ``max_draft`` tokens: index any new n-grams, then
+        look up the context's tail n-gram and return the tokens that
+        followed its most recent earlier occurrence (Saxena's prompt
+        lookup). Empty list when the tail has no earlier match."""
+        n = self.ngram
+        if self.disabled or max_draft <= 0 or len(tokens) <= n:
+            return []
+        # Index every n-gram starting strictly before the tail n-gram.
+        for start in range(self.indexed_upto, len(tokens) - n):
+            self.index[tuple(tokens[start:start + n])] = start
+        self.indexed_upto = max(self.indexed_upto, len(tokens) - n)
+        pos = self.index.get(tuple(tokens[len(tokens) - n:]))
+        if pos is None:
+            return []
+        return tokens[pos + n:pos + n + max_draft]
+
+    def judge(self, proposed: int, accepted: int,
+              window: int, threshold: float) -> bool:
+        """Record one verify outcome; returns True when this call tripped
+        the adaptive-fallback latch."""
+        self.proposed += proposed
+        self.accepted += accepted
+        if (not self.disabled and self.proposed >= window
+                and self.accepted < threshold * self.proposed):
+            self.disabled = True
+            return True
+        return False
+
+
 class RequestStatus(enum.Enum):
     WAITING = "waiting"
     RUNNING = "running"
@@ -68,6 +122,9 @@ class EngineRequest:
     # Optional StageClock (obs.trace): the engine thread stamps queue/
     # prefill/decode boundaries on it; the server reads it afterwards.
     trace: Optional[object] = None
+    # Prompt-lookup speculative decoding (engine-thread only; created
+    # lazily by the engine when --speculative-num-tokens > 0).
+    spec: Optional[SpecState] = None
 
     @property
     def all_token_ids(self) -> List[int]:

@@ -1800,6 +1800,9 @@ class EngineServer:
         q_sum, q_count = stage.get("engine.queue", (0.0, 0))
         pf_sum, pf_count = stage.get("engine.prefill", (0.0, 0))
         dec_sum, dec_count = stage.get("engine.decode", (0.0, 0))
+        spec_proposed = s.get("spec_proposed_tokens_total", 0)
+        spec_rate = (s.get("spec_accepted_tokens_total", 0) / spec_proposed
+                     if spec_proposed else 0.0)
         lines = [
             "# TYPE vllm:num_requests_running gauge",
             f"vllm:num_requests_running{{{labels}}} {s['num_requests_running']}",
@@ -1875,6 +1878,25 @@ class EngineServer:
             "# TYPE tpu:batched_token_utilization gauge",
             f"tpu:batched_token_utilization{{{labels}}} "
             f"{s.get('batched_token_utilization', 0.0):.6f}",
+            # Speculative decoding (--speculative-num-tokens): prompt-lookup
+            # drafts verified in single-pass batched bursts.
+            "# TYPE tpu:spec_proposed_tokens counter",
+            f"tpu:spec_proposed_tokens_total{{{labels}}} "
+            f"{s.get('spec_proposed_tokens_total', 0)}",
+            "# TYPE tpu:spec_accepted_tokens counter",
+            f"tpu:spec_accepted_tokens_total{{{labels}}} "
+            f"{s.get('spec_accepted_tokens_total', 0)}",
+            "# TYPE tpu:spec_acceptance_rate gauge",
+            f"tpu:spec_acceptance_rate{{{labels}}} {spec_rate:.6f}",
+            "# TYPE tpu:spec_disabled_requests counter",
+            f"tpu:spec_disabled_requests_total{{{labels}}} "
+            f"{s.get('spec_disabled_requests_total', 0)}",
+            "# TYPE tpu:spec_verify_bursts counter",
+            f"tpu:spec_verify_bursts_total{{{labels}}} "
+            f"{s.get('spec_verify_bursts_total', 0)}",
+            "# TYPE tpu:decode_forward_steps counter",
+            f"tpu:decode_forward_steps_total{{{labels}}} "
+            f"{s.get('decode_forward_steps_total', 0)}",
         ]
         # Admission rejections by reason; both reasons always emitted so
         # rate() queries never see a vanishing series.
@@ -1982,6 +2004,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="chunked prefill: force a decode step after this "
                         "many consecutive prefill steps while sequences "
                         "are running (the decode-starvation cap)")
+    p.add_argument("--speculative-num-tokens", type=int, default=0,
+                   help="prompt-lookup speculative decoding: verify up to "
+                        "this many tokens per forward pass (the drafts come "
+                        "from an n-gram index over each request's own "
+                        "prompt+output; 0 disables)")
+    p.add_argument("--speculative-ngram-size", type=int, default=3,
+                   help="n-gram length matched by the prompt-lookup "
+                        "draft index")
     p.add_argument("--prefill-batch", type=int, default=1,
                    help="batch up to N queued long-prompt prefills into "
                         "one dispatch (1 disables; see EngineConfig."
@@ -2052,6 +2082,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         max_loras=args.max_loras,
         max_lora_rank=args.max_lora_rank,
         seed=args.seed,
+        speculative_num_tokens=args.speculative_num_tokens,
+        speculative_ngram_size=args.speculative_ngram_size,
         kv_offload_bytes=int(args.kv_offload_gb * (1 << 30)),
         kv_remote_url=args.kv_remote_url,
         chat_template=args.chat_template,

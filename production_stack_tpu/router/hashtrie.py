@@ -45,19 +45,48 @@ class HashTrie:
 
     async def insert(self, text: str, endpoint: str) -> None:
         async with self._lock:
-            node = self.root
+            hashes = list(self._chunk_hashes(text))
+            if not hashes:
+                return
             now = time.monotonic()
-            for h in self._chunk_hashes(text):
-                nxt = node.children.get(h)
-                if nxt is None:
-                    if self.node_count >= self.max_nodes:
-                        self._evict_oldest_locked()
-                    nxt = TrieNode()
-                    node.children[h] = nxt
-                    self.node_count += 1
-                nxt.last_access = now
-                nxt.endpoints.add(endpoint)
-                node = nxt
+            restarted = False
+            while True:
+                node = self.root
+                top: Optional[TrieNode] = None
+                detached = False
+                for h in hashes:
+                    nxt = node.children.get(h)
+                    if nxt is None:
+                        if self.node_count >= self.max_nodes:
+                            # Eviction drops whole top-level subtrees.
+                            # If it drops the one THIS insert is standing
+                            # in, ``node`` is detached and every later
+                            # chunk (plus its node_count increment) would
+                            # land on an unreachable subtree, so
+                            # node_count could never drain back down.
+                            # First pass: evict freely but restart the
+                            # walk if our subtree was the victim; on the
+                            # retry pin it with ``exclude`` so the loop
+                            # terminates (at worst overshooting
+                            # max_nodes by one path length).
+                            self._evict_oldest_locked(
+                                exclude=hashes[0] if restarted else None)
+                            if (top is not None
+                                    and self.root.children.get(hashes[0])
+                                    is not top):
+                                detached = True
+                                break
+                        nxt = TrieNode()
+                        node.children[h] = nxt
+                        self.node_count += 1
+                    nxt.last_access = now
+                    nxt.endpoints.add(endpoint)
+                    node = nxt
+                    if top is None:
+                        top = node
+                if not detached:
+                    return
+                restarted = True
 
     async def longest_prefix_match(
         self, text: str, available_endpoints: Set[str]
@@ -95,11 +124,21 @@ class HashTrie:
                 node.endpoints.discard(endpoint)
                 stack.extend(node.children.values())
 
-    def _evict_oldest_locked(self, fraction: float = 0.1) -> None:
-        """Evict the oldest-accessed top-level subtrees to free space."""
+    def _evict_oldest_locked(
+        self, fraction: float = 0.1, exclude: Optional[int] = None
+    ) -> None:
+        """Evict the oldest-accessed top-level subtrees to free space.
+
+        ``exclude`` names the top-level child a restarted insert is
+        walking through; it is never evicted (see ``insert``). If it is
+        the only subtree, nothing is evicted this round.
+        """
         items = sorted(
-            self.root.children.items(), key=lambda kv: kv[1].last_access
+            (kv for kv in self.root.children.items() if kv[0] != exclude),
+            key=lambda kv: kv[1].last_access,
         )
+        if not items:
+            return
         n_evict = max(1, int(len(items) * fraction))
         for h, child in items[:n_evict]:
             self.node_count -= _count_nodes(child)

@@ -52,6 +52,11 @@ class FakeEngine:
         self.enable_chunked_prefill = enable_chunked_prefill
         self.prefill_chunks = max(prefill_chunks, 1)
         self.prefill_chunks_total = 0
+        # Speculative-decoding counters (static here: the fake engine does
+        # no real drafting, it just exposes the tpu:spec_* scrape surface).
+        self.spec_proposed_tokens_total = 0
+        self.spec_accepted_tokens_total = 0
+        self.spec_disabled_requests_total = 0
         self._engine_lock = asyncio.Lock()
         self.sleeping = False
         self.num_running = 0
@@ -283,6 +288,15 @@ class FakeEngine:
             "vllm:gpu_prefix_cache_queries_total 100\n"
             "# TYPE tpu:prefill_chunks counter\n"
             f"tpu:prefill_chunks_total {self.prefill_chunks_total}\n"
+            "# TYPE tpu:spec_proposed_tokens counter\n"
+            f"tpu:spec_proposed_tokens_total {self.spec_proposed_tokens_total}\n"
+            "# TYPE tpu:spec_accepted_tokens counter\n"
+            f"tpu:spec_accepted_tokens_total {self.spec_accepted_tokens_total}\n"
+            "# TYPE tpu:spec_acceptance_rate gauge\n"
+            f"tpu:spec_acceptance_rate "
+            f"{(self.spec_accepted_tokens_total / self.spec_proposed_tokens_total) if self.spec_proposed_tokens_total else 0.0}\n"
+            "# TYPE tpu:spec_disabled_requests counter\n"
+            f"tpu:spec_disabled_requests_total {self.spec_disabled_requests_total}\n"
         )
         return web.Response(text=text, content_type="text/plain")
 

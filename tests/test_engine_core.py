@@ -218,3 +218,28 @@ def test_lora_load_changes_output_and_unload_restores():
         assert eng.unload_lora_adapter("my-adapter")
     finally:
         eng.stop()
+
+
+def test_spec_verify_compile_budget():
+    """Speculative decoding's compile-budget contract: warmup adds ONE
+    verify program per block-table bucket (single width K), never more
+    than the decode-variant count, and nothing at all when the flag is
+    off."""
+    eng = make_engine(speculative_num_tokens=4, max_loras=0)
+    try:
+        eng.warmup()
+        wv = eng.warmup_variants
+        assert wv["spec"] >= 1
+        assert wv["spec"] <= wv["decode"], wv
+        assert len(eng._spec_verify_fns) == 1, (
+            "a single speculative width must compile a single verify "
+            "program family")
+    finally:
+        eng.stop()
+    off = make_engine(max_loras=0)
+    try:
+        off.warmup()
+        assert off.warmup_variants["spec"] == 0
+        assert not off._spec_verify_fns
+    finally:
+        off.stop()

@@ -50,3 +50,41 @@ async def test_eviction_bounds_nodes():
     for i in range(100):
         await trie.insert(f"pref{i:04d}suffix{i:04d}", "e1")
     assert trie.node_count <= 60
+
+
+def _reachable_nodes(trie: HashTrie) -> int:
+    total = 0
+    stack = list(trie.root.children.values())
+    while stack:
+        n = stack.pop()
+        total += 1
+        stack.extend(n.children.values())
+    return total
+
+
+async def test_eviction_never_detaches_active_insert_path():
+    """Regression: mid-insert eviction must not evict the subtree the
+    insert is walking. Previously a long insert that crossed the
+    max_nodes threshold partway down could have its own top-level
+    subtree evicted (it is the oldest once fresher inserts exist),
+    attaching all later chunks to a detached node: node_count counted
+    unreachable nodes and drifted up forever."""
+    trie = HashTrie(chunk_size=2, max_nodes=12)
+    # One long (old) chain, then fresher short chains, so the long
+    # chain's top-level subtree is the LRU eviction candidate.
+    await trie.insert("aa" * 6, "e1")
+    await trie.insert("bb", "e1")
+    await trie.insert("cc", "e1")
+    # 8 nodes so far. This 8-chunk insert shares the "aa" top-level
+    # child and crosses max_nodes mid-walk, triggering eviction while
+    # standing inside the "aa" subtree.
+    await trie.insert("aa" * 8, "e1")
+    assert trie.node_count == _reachable_nodes(trie)
+    # The just-inserted path must be fully reachable.
+    matched, eps = await trie.longest_prefix_match("aa" * 8, {"e1"})
+    assert matched == 8 and eps == {"e1"}
+    # And repeated pressure keeps the invariant.
+    for i in range(50):
+        await trie.insert(f"zz{i:02d}" * 4, "e2")
+        assert trie.node_count == _reachable_nodes(trie)
+    assert trie.node_count <= 12 + 8  # bounded: threshold + one path

@@ -366,3 +366,35 @@ def test_chunked_prefill_flags_plumb_into_engine_command():
     bcmd = bdeps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
     assert "--max-num-batched-tokens" not in bcmd
     assert "--enable-chunked-prefill" not in bcmd
+
+
+def test_speculative_num_tokens_plumbs_into_engine_command():
+    """speculativeNumTokens renders as --speculative-num-tokens (and stays
+    absent when unset — spec decoding is opt-in), and the schema accepts
+    it."""
+    import copy
+    import json
+
+    import jsonschema
+
+    values = copy.deepcopy(load_values(CHART, os.path.join(
+        CHART, "examples", "values-01-minimal.yaml")))
+    spec = values["servingEngineSpec"]["modelSpec"][0]
+    spec["speculativeNumTokens"] = 4
+    with open(os.path.join(CHART, "values.schema.json")) as f:
+        jsonschema.validate(values, json.load(f))
+
+    rendered = MiniHelm(CHART).render(values)
+    deps = [d for d in _docs(rendered, "Deployment")
+            if d["metadata"]["name"].endswith("-engine")]
+    assert deps, "engine deployment missing"
+    cmd = deps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--speculative-num-tokens" in cmd
+    assert cmd[cmd.index("--speculative-num-tokens") + 1] == "4"
+
+    base = _render(os.path.join(CHART, "examples",
+                                "values-01-minimal.yaml"))
+    bdeps = [d for d in _docs(base, "Deployment")
+             if d["metadata"]["name"].endswith("-engine")]
+    bcmd = bdeps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--speculative-num-tokens" not in bcmd

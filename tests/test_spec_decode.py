@@ -1,0 +1,311 @@
+"""Prompt-lookup speculative decoding (engine-level, real model on CPU).
+
+Covers the tentpole's acceptance bars:
+
+- stream equality: spec-on token streams are byte-identical to spec-off
+  for the same seed — greedy, temperature > 0 (matched RNG schedule),
+  across preemption/resume, and under chunked-prefill interleaving;
+- measured A/B on tokens-per-forward (generated tokens per decode-path
+  model forward): a repetitive workload gains >= 1.3x with speculation
+  on, and an adversarial workload never falls below spec-off because
+  the per-request adaptive fallback latches drafting off;
+- the /metrics surface exports the tpu:spec_* counters and the
+  acceptance-rate gauge.
+"""
+
+import asyncio
+import queue
+import threading
+import time
+
+from production_stack_tpu.engine.sampling import SamplingParams
+
+from test_engine_core import make_engine  # noqa: E402
+
+# Known-good tiny config for multi-token CPU decode runs: 2 slots keeps
+# the batch small, 64 x 8-token blocks leave room for the long-output
+# equality runs below.
+SPEC_CFG = dict(max_model_len=256, max_num_seqs=2, block_size=8,
+                num_blocks=64, max_loras=0)
+
+
+def run(engine, reqs, timeout=300):
+    """Submit (prompt, sampling) pairs at once; return {rid: (tokens,
+    finish)}."""
+    results = {}
+    queues = {}
+    for i, (prompt, sampling) in enumerate(reqs):
+        rid = f"r{i}"
+        q = queue.Queue()
+        queues[rid] = q
+
+        def on_token(token, finish, q=q):
+            q.put((token, finish))
+
+        engine.add_request(rid, list(prompt), sampling, on_token)
+    for rid, q in queues.items():
+        tokens = []
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                token, finish = q.get(timeout=10)
+            except queue.Empty:
+                continue
+            if token is not None:
+                tokens.append(token)
+            if finish is not None:
+                results[rid] = (tokens, finish)
+                break
+        else:
+            raise TimeoutError(rid)
+    return results
+
+
+def greedy(max_tokens):
+    return SamplingParams(max_tokens=max_tokens, temperature=0.0,
+                          ignore_eos=True)
+
+
+def tokens_per_forward(engine):
+    return (engine.generation_tokens_total
+            / max(engine.decode_forward_steps_total, 1))
+
+
+def de_bruijn(alphabet, n):
+    """de Bruijn sequence of order n over the alphabet, wrapped so every
+    n-gram (cyclically) appears as a contiguous window."""
+    k = len(alphabet)
+    a = [0] * (k * n)
+    seq = []
+
+    def db(t, p):
+        if t > n:
+            if n % p == 0:
+                seq.extend(a[1:p + 1])
+        else:
+            a[t] = a[t - p]
+            db(t + 1, p)
+            for j in range(a[t - p] + 1, k):
+                a[t] = j
+                db(t + 1, t)
+
+    db(1, 1)
+    s = seq + seq[:n - 1]
+    return [alphabet[i] for i in s]
+
+
+# ---------------------------------------------------------------------------
+# Stream equality
+# ---------------------------------------------------------------------------
+
+
+def test_spec_streams_equal_greedy():
+    """Repetitive prompts, greedy: the speculative engine must emit
+    exactly the token streams the plain engine does, while actually
+    running verify bursts (not vacuously falling back)."""
+    reqs = [
+        ([5, 6, 7, 8] * 6, greedy(24)),
+        ([9, 10, 11] * 8, greedy(24)),
+        ([3, 4] * 10, greedy(24)),
+    ]
+    ref = make_engine(**SPEC_CFG)
+    try:
+        expected = run(ref, reqs)
+    finally:
+        ref.stop()
+    eng = make_engine(speculative_num_tokens=4, **SPEC_CFG)
+    try:
+        got = run(eng, reqs)
+        assert eng.spec_verify_bursts_total >= 1, (
+            "repetitive prompts must trigger at least one verify burst")
+        assert eng.spec_proposed_tokens_total > 0
+    finally:
+        eng.stop()
+    assert got == expected
+
+
+def test_spec_preempt_resume_streams_equal():
+    """Tight KV pool with speculation on: verify bursts reserve
+    worst-case pages, so the pool overcommits, a sequence is preempted
+    and later resumed via re-prefill — and the streams still match a
+    spec-off engine with ample KV."""
+    reqs = [
+        ([5, 6, 7, 8] * 2, greedy(60)),
+        ([9, 10, 11, 12] * 12, greedy(60)),
+    ]
+    ref = make_engine(**SPEC_CFG)
+    try:
+        expected = run(ref, reqs)
+    finally:
+        ref.stop()
+    tight = dict(SPEC_CFG, num_blocks=16)  # 128-token pool < 176 demand
+    eng = make_engine(speculative_num_tokens=4, **tight)
+    try:
+        got = run(eng, reqs)
+        assert eng.scheduler.num_preempted_total >= 1, (
+            "176 tokens of demand against a 128-token pool must preempt")
+    finally:
+        eng.stop()
+    assert got == expected
+
+
+def test_spec_chunked_prefill_streams_equal():
+    """Speculation composes with chunked prefill: long prompts are
+    sliced and decode (including verify bursts) interleaves between
+    chunks without perturbing the streams."""
+    reqs = [
+        ([5, 6, 7, 8] * 15, greedy(16)),  # 60 tokens -> sliced
+        ([9, 10, 11] * 4, greedy(16)),
+        ([3, 4] * 8, greedy(16)),
+    ]
+    ref = make_engine(**SPEC_CFG)
+    try:
+        expected = run(ref, reqs)
+    finally:
+        ref.stop()
+    eng = make_engine(speculative_num_tokens=4, enable_chunked_prefill=True,
+                      max_num_batched_tokens=32, **SPEC_CFG)
+    try:
+        got = run(eng, reqs)
+        assert eng.prefill_chunks_total >= 2, (
+            "the 60-token prompt should have been sliced")
+        assert eng.spec_verify_bursts_total >= 1
+    finally:
+        eng.stop()
+    assert got == expected
+
+
+# ---------------------------------------------------------------------------
+# Measured A/B: tokens per model forward
+# ---------------------------------------------------------------------------
+
+
+def test_spec_repetitive_ab_tokens_per_forward():
+    """Repetitive workload (logit bias pins greedy output to one token,
+    so prompt-lookup drafts are always right): speculation must deliver
+    >= 1.3x generated-tokens-per-forward over plain decode."""
+    sampling = SamplingParams(max_tokens=32, temperature=0.0,
+                              ignore_eos=True, logit_bias={17: 100.0})
+    reqs = [([17] * 8, sampling)]
+    off = make_engine(**SPEC_CFG)
+    try:
+        expected = run(off, reqs)
+        off_tpf = tokens_per_forward(off)
+    finally:
+        off.stop()
+    on = make_engine(speculative_num_tokens=4, **SPEC_CFG)
+    try:
+        got = run(on, reqs)
+        on_tpf = tokens_per_forward(on)
+        assert on.spec_proposed_tokens_total > 0
+        assert on.spec_accepted_tokens_total == on.spec_proposed_tokens_total, (
+            "a constant stream must accept every draft")
+    finally:
+        on.stop()
+    assert got == expected
+    assert on_tpf >= 1.3 * off_tpf, (on_tpf, off_tpf)
+
+
+def test_spec_adversarial_latch_never_below_plain():
+    """Adversarial workload: a de Bruijn prompt makes every generated
+    trigram look up a draft, but temperature-1.0 sampling over a biased
+    4-token alphabet rarely matches it. The per-request fallback must
+    latch drafting off, and tokens-per-forward must never fall below
+    the spec-off engine. Streams stay byte-identical (the verify pass
+    replays the decode RNG schedule, so temperature > 0 is exact)."""
+    alphabet = [21, 22, 23, 24]
+    prompt = de_bruijn(alphabet, 3)  # 66 tokens, every trigram present
+    sampling = SamplingParams(
+        max_tokens=32, temperature=1.0, seed=7, ignore_eos=True,
+        logit_bias={t: 100.0 for t in alphabet})
+    reqs = [(prompt, sampling)]
+    off = make_engine(**SPEC_CFG)
+    try:
+        expected = run(off, reqs)
+        off_tpf = tokens_per_forward(off)
+    finally:
+        off.stop()
+    on = make_engine(speculative_num_tokens=4, speculative_accept_window=6,
+                     **SPEC_CFG)
+    try:
+        got = run(on, reqs)
+        on_tpf = tokens_per_forward(on)
+        assert on.spec_proposed_tokens_total > 0, (
+            "the de Bruijn prompt must have produced drafts")
+        assert on.spec_disabled_requests_total >= 1, (
+            "low acceptance must latch the adaptive fallback")
+    finally:
+        on.stop()
+    assert got == expected
+    assert on_tpf >= off_tpf - 1e-9, (on_tpf, off_tpf)
+
+
+# ---------------------------------------------------------------------------
+# /metrics surface
+# ---------------------------------------------------------------------------
+
+
+def test_spec_metrics_exported_over_http():
+    import aiohttp
+
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.server import (
+        EngineServer,
+        run_engine_server,
+    )
+
+    config = EngineConfig(
+        model="tiny-llama", max_model_len=128, max_num_seqs=2,
+        block_size=8, num_blocks=32, min_prefill_bucket=16, max_loras=0,
+        speculative_num_tokens=4,
+    )
+    server = EngineServer(config)
+    loop = asyncio.new_event_loop()
+    holder = {}
+    started = threading.Event()
+
+    async def _boot():
+        runner = await run_engine_server(server, "127.0.0.1", 0)
+        port = list(runner.sites)[0]._server.sockets[0].getsockname()[1]
+        holder["runner"] = runner
+        return f"http://127.0.0.1:{port}"
+
+    def _run():
+        asyncio.set_event_loop(loop)
+        holder["url"] = loop.run_until_complete(_boot())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    started.wait(timeout=60)
+    url = holder["url"]
+    try:
+        async def go():
+            async with aiohttp.ClientSession() as s:
+                async with s.post(url + "/v1/completions", json={
+                    "model": "tiny-llama",
+                    "prompt": "hello hello hello hello hello",
+                    "max_tokens": 8,
+                }) as r:
+                    assert r.status == 200, await r.text()
+                async with s.get(url + "/metrics") as r:
+                    text = await r.text()
+            metrics = {}
+            for ln in text.splitlines():
+                if ln.startswith(("tpu:spec_", "tpu:decode_forward_steps")):
+                    metrics[ln.split("{")[0]] = float(ln.rsplit(" ", 1)[1])
+            for name in ("tpu:spec_proposed_tokens_total",
+                         "tpu:spec_accepted_tokens_total",
+                         "tpu:spec_acceptance_rate",
+                         "tpu:spec_disabled_requests_total",
+                         "tpu:spec_verify_bursts_total",
+                         "tpu:decode_forward_steps_total"):
+                assert name in metrics, (name, sorted(metrics))
+            assert metrics["tpu:decode_forward_steps_total"] > 0
+            assert 0.0 <= metrics["tpu:spec_acceptance_rate"] <= 1.0
+        asyncio.run(go())
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=5)
+        server.core.stop()
