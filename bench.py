@@ -164,6 +164,18 @@ SPEC = _env_int("BENCH_SPEC", int(_cfg.get("spec", 0)))
 REPETITIVE = _env_int("BENCH_REPETITIVE", 0)
 SPEC_AB = _env_int("BENCH_SPEC_AB", 0)
 SPEC_OUT = os.environ.get("BENCH_SPEC_OUT", "BENCH_SPEC.json")
+# Multi-tenant QoS noisy-neighbor A/B: BENCH_QOS=1 runs the hermetic
+# two-tenant harness (production_stack_tpu/testing/qos_ab.py — fake
+# contention engine, no TPU, no jax import) in three legs: unloaded,
+# batch flood with QoS on, batch flood with QoS off. Writes
+# BENCH_QOS_OUT (default BENCH_QOS.json) with interactive p99 TTFT for
+# all legs. Acceptance: QoS-on p99 TTFT within 1.5x unloaded.
+QOS = _env_int("BENCH_QOS", 0)
+QOS_OUT = os.environ.get("BENCH_QOS_OUT", "BENCH_QOS.json")
+QOS_FLOOD = _env_int("BENCH_QOS_FLOOD", 16)
+QOS_INTERACTIVE_REQS = _env_int("BENCH_QOS_INTERACTIVE_REQS", 6)
+QOS_TTFT = _env_float("BENCH_QOS_TTFT", 0.3)
+QOS_PREFILL_CHUNKS = _env_int("BENCH_QOS_PREFILL_CHUNKS", 8)
 
 
 def _load_baseline() -> float:
@@ -592,11 +604,37 @@ async def _main(spec_tokens: int = SPEC) -> dict:
     return result
 
 
+def _qos_main() -> None:
+    """BENCH_QOS=1: the noisy-neighbor A/B. Fully hermetic (fake
+    engines), so this branch never imports jax or touches a device."""
+    import tempfile
+
+    from production_stack_tpu.testing.qos_ab import (
+        run_qos_ab,
+        write_tenants_file,
+    )
+
+    with tempfile.TemporaryDirectory() as tmp:
+        tenants = write_tenants_file(os.path.join(tmp, "tenants.json"))
+        result = asyncio.run(run_qos_ab(
+            tenants, flood=QOS_FLOOD,
+            interactive_requests=QOS_INTERACTIVE_REQS,
+            ttft_s=QOS_TTFT, prefill_chunks=QOS_PREFILL_CHUNKS))
+    result["backend"] = "fake"
+    with open(os.path.join(REPO, QOS_OUT), "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result))
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--cpu", action="store_true",
                         help="force CPU backend (for smoke testing)")
     args = parser.parse_args()
+    if QOS:
+        _qos_main()
+        return
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax

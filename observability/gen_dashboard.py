@@ -324,7 +324,54 @@ def build_dashboard():
              "routing"))
     y += 7
 
-    # ---- Row 8: Current Resource Usage (ref panels 14-19) --------------- #
+    # ---- Row 8: Tenants & QoS (multi-tenant admission + fair queue) ----- #
+    panels.append(row("Tenants & QoS", y)); y += 1
+    panels.append(panel(
+        "timeseries", "Admitted requests per tenant (rate)",
+        [target("rate(vllm_router:tenant_admitted_total[5m])",
+                legend="{{tenant}}")],
+        grid(7, 8, 0, y), unit="reqps",
+        desc="Requests that passed token-bucket admission and got a "
+             "fair-queue dispatch slot (--qos-tenants-file)"))
+    panels.append(panel(
+        "timeseries", "Rejected requests per tenant (rate)",
+        [target("rate(vllm_router:tenant_rejected_total[5m])",
+                legend="{{tenant}}/{{reason}}")],
+        grid(7, 8, 8, y), unit="reqps",
+        desc="429s from per-tenant token buckets, split by exhausted "
+             "bucket: requests/s vs estimated tokens/s"))
+    panels.append(panel(
+        "timeseries", "Shed batch requests per tenant (rate)",
+        [target("rate(vllm_router:tenant_shed_total[5m])",
+                legend="{{tenant}}")],
+        grid(7, 8, 16, y), unit="reqps",
+        desc="Batch-class requests turned away with 503 because the "
+             "fair queue's batch backlog hit --qos-shed-queue-depth"))
+    y += 7
+    panels.append(panel(
+        "timeseries", "Fair-queue wait per tenant",
+        [target("rate(vllm_router:tenant_queue_wait_seconds_sum[5m]) / "
+                "rate(vllm_router:tenant_queue_wait_seconds_count[5m])",
+                legend="{{tenant}}")],
+        grid(7, 8, 0, y), unit="s",
+        desc="Average time a request waited for a weighted-fair "
+             "dispatch slot (deficit round-robin over tenants)"))
+    panels.append(panel(
+        "bargauge", "Queue wait distribution",
+        [target("sum by(le) (vllm_router:tenant_queue_wait_seconds_bucket)",
+                legend="{{le}}")],
+        grid(7, 8, 8, y)))
+    panels.append(panel(
+        "timeseries", "Preemptions by priority (engine-side)",
+        [target("rate(tpu:preempted_requests_total[5m])",
+                legend="{{priority}}")],
+        grid(7, 8, 16, y),
+        desc="KV-pressure victims by class: batch-class requests are "
+             "preempted before interactive ones (requires scraping "
+             "engine /metrics directly)"))
+    y += 7
+
+    # ---- Row 9: Current Resource Usage (ref panels 14-19) --------------- #
     panels.append(row("Current Resource Usage", y)); y += 1
     panels.append(panel(
         "timeseries", "Router CPU usage",

@@ -1550,6 +1550,7 @@ class EngineCore:
         on_token: Callable[[Optional[int], Optional[str]], None],
         adapter_name: Optional[str] = None,
         trace=None,
+        priority: int = 0,
     ) -> None:
         if self.fatal_error is not None:
             # The engine loop halted (multi-host lockstep break): nothing
@@ -1565,6 +1566,7 @@ class EngineCore:
             on_token=on_token,
             adapter_id=adapter_id,
             adapter_name=(adapter_name or "") if adapter_id else "",
+            priority=priority,
             trace=trace,
         )
         with self._lock:
@@ -1605,7 +1607,7 @@ class EngineCore:
                 self._sleep_level = level
                 # Preempt everything so wake-up re-prefills from scratch.
                 while self.scheduler.running():
-                    self.scheduler.preempt_youngest()
+                    self.scheduler.preempt_victim()
                 # The pool is about to be discarded: spill every cached
                 # block to the offload tier (when configured) so prefix
                 # hits survive the nap via the restore path...
@@ -1880,6 +1882,8 @@ class EngineCore:
                 min(self.last_step_batched_tokens / budget, 1.0)
                 if budget > 0 else 0.0),
             "rejected_requests": dict(self.scheduler.rejected_total),
+            "preempted_by_priority":
+                dict(self.scheduler.preempted_by_priority),
             "decode_burst_count": self.decode_burst_count,
             "dispatch_count_total": self.dispatch_count_total,
             "dispatch_enqueue_s": round(self.dispatch_enqueue_s, 3),
@@ -2666,7 +2670,7 @@ class EngineCore:
                     if ok:
                         need -= 1
                         continue
-                    victim = self.scheduler.preempt_youngest()
+                    victim = self.scheduler.preempt_victim()
                     if victim is None or victim.req is seq.req:
                         break
                     # (victim's pages are back; retry this append)
@@ -2844,7 +2848,7 @@ class EngineCore:
                     if ok:
                         need -= 1
                         continue
-                    victim = self.scheduler.preempt_youngest()
+                    victim = self.scheduler.preempt_victim()
                     if victim is None or victim.req is seq.req:
                         break
             active = [

@@ -37,6 +37,26 @@ from production_stack_tpu.utils.log import init_logger
 
 logger = init_logger(__name__)
 
+# Priority classes (QoS): lower number = more important. 0 is both the
+# "interactive" class and the default for priority-less traffic, so a
+# deployment that never sends X-Priority schedules exactly FCFS.
+PRIORITY_INTERACTIVE = 0
+PRIORITY_BATCH = 1
+_PRIORITY_NAMES = {"interactive": PRIORITY_INTERACTIVE,
+                   "batch": PRIORITY_BATCH}
+
+
+def parse_priority(value: Optional[str]) -> int:
+    """Map an X-Priority header value to a class; unknown -> interactive."""
+    if value:
+        return _PRIORITY_NAMES.get(value.strip().lower(),
+                                   PRIORITY_INTERACTIVE)
+    return PRIORITY_INTERACTIVE
+
+
+def priority_label(priority: int) -> str:
+    return "batch" if priority >= PRIORITY_BATCH else "interactive"
+
 
 class SpecState:
     """Per-request prompt-lookup speculative-decode state.
@@ -109,6 +129,9 @@ class EngineRequest:
     on_token: Callable[[Optional[int], Optional[str]], None]
     adapter_id: int = 0  # LoRA slot (engine-local, selects weights)
     adapter_name: str = ""  # stable name (namespaces the KV hash chain)
+    # QoS class (X-Priority): 0 interactive (default), 1 batch. Orders
+    # waiting-queue admission and marks preemption victims.
+    priority: int = 0
     arrival_time: float = field(default_factory=time.time)
     output_token_ids: List[int] = field(default_factory=list)
     status: RequestStatus = RequestStatus.WAITING
@@ -180,6 +203,10 @@ class Scheduler:
         # pages allocated incrementally) but not yet holding a decode slot.
         self.prefilling: List[EngineRequest] = []
         self.num_preempted_total = 0
+        # Preemptions by victim class, exported as
+        # tpu:preempted_requests_total{priority=...}.
+        self.preempted_by_priority: Dict[str, int] = {
+            "interactive": 0, "batch": 0}
         # Rejections by finish reason ("length" | "kv_capacity"), exported
         # as tpu:rejected_requests_total{reason=...}.
         self.rejected_total: Dict[str, int] = {"length": 0, "kv_capacity": 0}
@@ -194,6 +221,9 @@ class Scheduler:
         # the deque entry is skipped lazily at the next pop, keeping abort
         # O(1). This counter keeps num_waiting exact between pops.
         self._waiting_tombstones = 0
+        # Live waiting requests with non-default priority. While zero the
+        # queue is scanned-free pure FIFO — the pre-QoS fast path.
+        self._nondefault_waiting = 0
         self._prefill_streak = 0
 
     @staticmethod
@@ -211,6 +241,8 @@ class Scheduler:
         self._requests[req.request_id] = req
         self._queued.add(req.request_id)
         self.waiting.append(req)
+        if req.priority:
+            self._nondefault_waiting += 1
 
     def abort(self, request_id: str) -> bool:
         seq = self._running_by_id.get(request_id)
@@ -226,6 +258,8 @@ class Scheduler:
             del self._requests[request_id]
             req.status = RequestStatus.FINISHED
             self._waiting_tombstones += 1
+            if req.priority:
+                self._nondefault_waiting -= 1
             req.on_token(None, "abort")
             return True
         if req in self.prefilling:
@@ -262,14 +296,43 @@ class Scheduler:
         return None
 
     def peek_waiting(self) -> Optional[EngineRequest]:
-        """First live waiting request; drops abort tombstones on the way."""
+        """Next waiting request by (priority, queue order); drops abort
+        tombstones at the head on the way.
+
+        With every queued request at default priority (the pre-QoS case)
+        this is exactly the old FIFO head — same object, same order.
+        Otherwise the deque is scanned for the first request of the most
+        important class; deque order within a class preserves both
+        arrival order and requeue-at-head resume semantics."""
         while self.waiting:
             req = self.waiting[0]
             if self._is_live(req):
-                return req
+                break
             self.waiting.popleft()
             self._waiting_tombstones = max(0, self._waiting_tombstones - 1)
-        return None
+        if not self.waiting:
+            return None
+        if self._nondefault_waiting <= 0:
+            return self.waiting[0]
+        best: Optional[EngineRequest] = None
+        for req in self.waiting:
+            if not self._is_live(req):
+                continue
+            if best is None or req.priority < best.priority:
+                best = req
+                if best.priority <= PRIORITY_INTERACTIVE:
+                    break  # nothing outranks the top class
+        return best
+
+    def _pop_waiting(self, req: EngineRequest) -> None:
+        """Remove the request peek_waiting() returned from the queue."""
+        if self.waiting and self.waiting[0] is req:
+            self.waiting.popleft()
+        else:
+            self.waiting.remove(req)
+        self._queued.discard(req.request_id)
+        if req.priority:
+            self._nondefault_waiting -= 1
 
     def live_waiting(self) -> List[EngineRequest]:
         """Snapshot of live (non-tombstoned) waiting requests, FIFO order."""
@@ -280,6 +343,8 @@ class Scheduler:
         storm-batch gatherer picks group members out of FIFO order)."""
         self.waiting.remove(req)
         self._queued.discard(req.request_id)
+        if req.priority:
+            self._nondefault_waiting -= 1
 
     def requeue(self, req: EngineRequest) -> None:
         """Put a request back at the head of the waiting queue (allocation
@@ -295,6 +360,8 @@ class Scheduler:
         req.status = RequestStatus.WAITING
         self.waiting.appendleft(req)
         self._queued.add(req.request_id)
+        if req.priority:
+            self._nondefault_waiting += 1
 
     def drain_waiting(self) -> List[EngineRequest]:
         """Remove every queued and mid-prefill request (fatal-error path);
@@ -307,6 +374,7 @@ class Scheduler:
         self.waiting.clear()
         self._queued.clear()
         self._waiting_tombstones = 0
+        self._nondefault_waiting = 0
         self.prefilling.clear()
         for req in reqs:
             self._requests.pop(req.request_id, None)
@@ -330,14 +398,12 @@ class Scheduler:
             # +1 block headroom so the first decode step can't immediately
             # trigger a preemption.
             if self.kv_mgr.can_allocate(len(req.all_token_ids) + 1):
-                self.waiting.popleft()
-                self._queued.discard(req.request_id)
+                self._pop_waiting(req)
                 return "prefill", req
             if self.num_running == 0:
                 # Nothing to preempt and it still doesn't fit: the prompt
                 # is within max_model_len but the KV pool can't hold it.
-                self.waiting.popleft()
-                self._queued.discard(req.request_id)
+                self._pop_waiting(req)
                 self._reject(req, "kv_capacity")
                 return self.next_action()
         if self.num_running > 0:
@@ -388,13 +454,11 @@ class Scheduler:
             # allocated chunk by chunk.
             if not self.kv_mgr.can_allocate(len(req.all_token_ids) + 1):
                 if self.num_running == 0 and not self.prefilling:
-                    self.waiting.popleft()
-                    self._queued.discard(req.request_id)
+                    self._pop_waiting(req)
                     self._reject(req, "kv_capacity")
                     continue
                 break
-            self.waiting.popleft()
-            self._queued.discard(req.request_id)
+            self._pop_waiting(req)
             req.num_computed_tokens = 0
             self.prefilling.append(req)
             total = len(req.all_token_ids)
@@ -421,15 +485,17 @@ class Scheduler:
         seq.req.status = RequestStatus.FINISHED
         seq.req.on_token(None, reason)
 
-    def preempt_youngest(self) -> Optional[RunningSeq]:
-        """Evict the most recent running (or mid-prefill) sequence back to
-        waiting."""
+    def preempt_victim(self) -> Optional[RunningSeq]:
+        """Evict the lowest-priority-then-youngest running (or mid-prefill)
+        sequence back to waiting.  With every candidate at default
+        priority this degrades to the original youngest-first rule."""
         candidates: List[Tuple[EngineRequest, Optional[RunningSeq]]] = [
             (s.req, s) for s in self.running()]
         candidates += [(r, None) for r in self.prefilling]
         if not candidates:
             return None
-        req, seq = max(candidates, key=lambda c: c[0].arrival_time)
+        req, seq = max(candidates,
+                       key=lambda c: (c[0].priority, c[0].arrival_time))
         self.kv_mgr.free(req.request_id)
         if seq is not None:
             self.slots[seq.slot] = None
@@ -442,8 +508,16 @@ class Scheduler:
         req.num_preemptions += 1
         self.waiting.appendleft(req)
         self._queued.add(req.request_id)
+        if req.priority:
+            self._nondefault_waiting += 1
         self.num_preempted_total += 1
+        self.preempted_by_priority[priority_label(req.priority)] += 1
         logger.info(
-            "Preempted request %s (blocks exhausted)", req.request_id
+            "Preempted request %s (priority=%s, blocks exhausted)",
+            req.request_id, priority_label(req.priority)
         )
         return seq
+
+    # Pre-QoS name, kept as an alias: equal-priority victim selection is
+    # still youngest-first.
+    preempt_youngest = preempt_victim

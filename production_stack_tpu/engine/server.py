@@ -45,6 +45,7 @@ from aiohttp import web
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.core import EngineCore
 from production_stack_tpu.engine.sampling import MAX_LOGIT_BIAS, SamplingParams
+from production_stack_tpu.engine.scheduler import parse_priority
 from production_stack_tpu.engine.tokenizer import IncrementalDetokenizer
 from production_stack_tpu.engine.tools import (
     parse_tool_calls,
@@ -91,9 +92,10 @@ class EngineServer:
         # `Authorization: Bearer <key>`; the intra-stack control plane
         # (probes, /metrics, /kv/*, sleep admin) stays open — see
         # utils/auth.py. None disables.
-        from production_stack_tpu.utils.auth import resolve_api_key
+        from production_stack_tpu.utils.auth import resolve_api_keys
 
-        self.api_key = resolve_api_key(api_key)
+        self.api_keys = resolve_api_keys(api_key)
+        self.api_key = self.api_keys[0] if self.api_keys else None
         self.config = config
         self.core = EngineCore(config)
         if warmup:
@@ -347,8 +349,8 @@ class EngineServer:
         # engines) keeps its kvaware reporting channel.
         gated = (auth.is_gated(request.path)
                  or request.path.startswith("/kv/"))
-        if self.api_key and gated and not auth.check_bearer(
-                request.headers.get("Authorization"), self.api_key):
+        if self.api_keys and gated and not auth.check_bearer(
+                request.headers.get("Authorization"), self.api_keys):
             return auth.unauthorized_response()
         return await handler(request)
 
@@ -404,11 +406,12 @@ class EngineServer:
 
     async def _generate(self, prompt_ids: List[int], sampling: SamplingParams,
                         request_id: str, adapter: Optional[str],
-                        trace: Optional[StageClock] = None):
+                        trace: Optional[StageClock] = None,
+                        priority: int = 0):
         stream = _TokenStream(asyncio.get_running_loop())
         self.core.add_request(
             request_id, prompt_ids, sampling, stream.on_token,
-            adapter_name=adapter, trace=trace,
+            adapter_name=adapter, trace=trace, priority=priority,
         )
         return stream
 
@@ -624,8 +627,9 @@ class EngineServer:
                         f"engine's KV cache capacity"),
                     "type": "ServiceUnavailable",
                 }}, status=503, headers={"Retry-After": "1"})
+        priority = parse_priority(request.headers.get("X-Priority"))
         stream = await self._generate(prompt_ids, sampling, rid, adapter,
-                                      trace=clock)
+                                      trace=clock, priority=priority)
         detok = IncrementalDetokenizer(self.core.tokenizer)
         created = int(time.time())
         obj = "chat.completion" if kind == "chat" else "text_completion"
@@ -912,7 +916,8 @@ class EngineServer:
         for i in range(1, n):
             s_i = dataclasses.replace(sampling, seed=base_seed + i, n=1)
             streams.append(await self._generate(
-                prompt_ids, s_i, choice_rid(i), adapter))
+                prompt_ids, s_i, choice_rid(i), adapter,
+                priority=parse_priority(request.headers.get("X-Priority"))))
         detoks = [IncrementalDetokenizer(self.core.tokenizer)
                   for _ in range(n)]
         texts = [""] * n
@@ -1827,6 +1832,13 @@ class EngineServer:
             f"vllm:request_success_total{{{labels}}} {s['requests_finished_total']}",
             "# TYPE vllm:num_preemptions counter",
             f"vllm:num_preemptions_total{{{labels}}} {s['num_preempted_total']}",
+            # Per-priority preemption counts (QoS victim selection picks
+            # batch-class requests before interactive ones).
+            "# TYPE tpu:preempted_requests counter",
+            f"tpu:preempted_requests_total{{{labels},priority=\"interactive\"}} "
+            f"{s['preempted_by_priority']['interactive']}",
+            f"tpu:preempted_requests_total{{{labels},priority=\"batch\"}} "
+            f"{s['preempted_by_priority']['batch']}",
             "# TYPE tpu:num_kv_blocks gauge",
             f"tpu:num_kv_blocks{{{labels}}} {s['num_blocks']}",
             "# TYPE tpu:hbm_headroom_bytes gauge",

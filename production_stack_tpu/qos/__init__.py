@@ -1,0 +1,26 @@
+"""Multi-tenant QoS: admission control, weighted-fair queuing, priority.
+
+The router maps API keys to named tenants (`tenants.py`), enforces
+per-tenant token-bucket limits (`token_bucket.py`), and dispatches
+admitted requests through a deficit-round-robin weighted-fair queue with
+two priority classes (`fair_queue.py`).  `gate.py` ties the three
+together behind a single `QoSGate` that the router's request service
+consults; with no tenants file configured the gate is never constructed
+and the hot path is byte-identical to a QoS-less router.
+
+Priority propagates to the engine tier as an `X-Priority` header
+(`interactive` | `batch`); the engine scheduler admits by
+(priority, arrival) and preempts lowest-priority-then-youngest.
+"""
+
+from .fair_queue import (  # noqa: F401
+    PRIORITY_BATCH,
+    PRIORITY_INTERACTIVE,
+    FairDispatchQueue,
+    QueueLease,
+    ShedError,
+    priority_class,
+)
+from .gate import AdmitResult, QoSGate, estimate_tokens  # noqa: F401
+from .tenants import TenantRegistry, TenantSpec  # noqa: F401
+from .token_bucket import TokenBucket  # noqa: F401

@@ -1,0 +1,208 @@
+"""Weighted-fair dispatch queue: deficit round-robin over tenants.
+
+Admitted requests wait here for a dispatch slot before the router
+proxies them upstream.  Two priority classes exist:
+
+- `interactive` dispatches whenever fewer than `max_concurrency`
+  interactive requests are in flight — it never queues behind `batch`
+  (batch may have filled the shared slots; interactive is allowed to
+  overshoot so an interactive burst rides on top of a batch flood
+  instead of behind it).
+- `batch` dispatches only while *total* in-flight stays under
+  `max_concurrency`, and new batch arrivals are shed with `ShedError`
+  once `shed_queue_depth` batch requests are already waiting.
+
+Within a class, tenants are served by deficit round-robin: each visit
+tops a tenant's deficit up by `quantum * weight` and the tenant sends
+requests while its deficit covers their cost (cost = estimated tokens),
+so a tenant with weight 4 drains ~4x the token volume per round of a
+weight-1 tenant regardless of how many requests each has queued.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import time
+from typing import Deque, Dict, Optional
+
+PRIORITY_INTERACTIVE = "interactive"
+PRIORITY_BATCH = "batch"
+# Engine-side integer encoding (lower = more important, 0 = default so
+# priority-less traffic behaves exactly like today's FCFS scheduler).
+PRIORITY_CLASS_NUM = {PRIORITY_INTERACTIVE: 0, PRIORITY_BATCH: 1}
+
+
+def priority_class(value: Optional[str], default: str = PRIORITY_INTERACTIVE) -> str:
+    """Normalize a priority string (e.g. an X-Priority header value)."""
+    if value:
+        v = value.strip().lower()
+        if v in PRIORITY_CLASS_NUM:
+            return v
+    return default
+
+
+class ShedError(Exception):
+    """Batch backlog exceeded shed_queue_depth; caller should 503."""
+
+    def __init__(self, retry_after: float = 1.0):
+        super().__init__("batch queue saturated")
+        self.retry_after = retry_after
+
+
+class QueueLease:
+    """Held while a dispatched request is in flight; release() frees it."""
+
+    __slots__ = ("priority", "wait_s", "_queue", "_released")
+
+    def __init__(self, queue: "FairDispatchQueue", priority: str, wait_s: float):
+        self.priority = priority
+        self.wait_s = wait_s
+        self._queue = queue
+        self._released = False
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._queue._release(self.priority)
+
+
+class _Waiter:
+    __slots__ = ("fut", "cost", "cancelled")
+
+    def __init__(self, cost: float):
+        self.fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.cost = cost
+        self.cancelled = False
+
+
+class _TenantQ:
+    __slots__ = ("waiters", "deficit", "weight")
+
+    def __init__(self, weight: float):
+        self.waiters: Deque[_Waiter] = collections.deque()
+        self.deficit = 0.0
+        self.weight = weight
+
+
+class FairDispatchQueue:
+    def __init__(self, max_concurrency: int = 8, shed_queue_depth: int = 64,
+                 quantum: float = 256.0):
+        self.max_concurrency = max(int(max_concurrency), 1)
+        self.shed_queue_depth = max(int(shed_queue_depth), 0)
+        self.quantum = max(float(quantum), 1.0)
+        self._inflight_total = 0
+        self._inflight_interactive = 0
+        # Per class: tenant name -> _TenantQ, plus DRR rotation order.
+        self._queues: Dict[str, Dict[str, _TenantQ]] = {
+            PRIORITY_INTERACTIVE: {}, PRIORITY_BATCH: {}}
+        self._rr: Dict[str, Deque[str]] = {
+            PRIORITY_INTERACTIVE: collections.deque(),
+            PRIORITY_BATCH: collections.deque()}
+        self._queued: Dict[str, int] = {PRIORITY_INTERACTIVE: 0,
+                                        PRIORITY_BATCH: 0}
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def inflight(self) -> int:
+        return self._inflight_total
+
+    def queued(self, priority: Optional[str] = None) -> int:
+        if priority is None:
+            return sum(self._queued.values())
+        return self._queued.get(priority, 0)
+
+    # -- dispatch ---------------------------------------------------------
+    def _can_dispatch(self, priority: str) -> bool:
+        if priority == PRIORITY_INTERACTIVE:
+            return self._inflight_interactive < self.max_concurrency
+        return self._inflight_total < self.max_concurrency
+
+    def _purge_head(self, tq: _TenantQ) -> None:
+        while tq.waiters and tq.waiters[0].cancelled:
+            tq.waiters.popleft()
+
+    def _pick(self, priority: str) -> Optional[_Waiter]:
+        """DRR-select the next waiter of a class, or None if class idle."""
+        rr, queues = self._rr[priority], self._queues[priority]
+        # Each full rotation adds quantum*weight to some tenant's deficit,
+        # so this terminates in O(max_cost / quantum) rotations.
+        while rr:
+            name = rr[0]
+            tq = queues[name]
+            self._purge_head(tq)
+            if not tq.waiters:
+                rr.popleft()
+                del queues[name]
+                continue
+            head = tq.waiters[0]
+            if tq.deficit < head.cost:
+                tq.deficit += self.quantum * tq.weight
+                rr.rotate(-1)
+                continue
+            tq.deficit -= head.cost
+            tq.waiters.popleft()
+            if not tq.waiters:
+                rr.popleft()
+                del queues[name]
+            return head
+        return None
+
+    def _pump(self) -> None:
+        while True:
+            dispatched = False
+            for priority in (PRIORITY_INTERACTIVE, PRIORITY_BATCH):
+                if not self._queued[priority] or not self._can_dispatch(priority):
+                    continue
+                waiter = self._pick(priority)
+                if waiter is None:  # only cancelled entries were queued
+                    self._queued[priority] = 0
+                    continue
+                self._queued[priority] -= 1
+                self._inflight_total += 1
+                if priority == PRIORITY_INTERACTIVE:
+                    self._inflight_interactive += 1
+                if not waiter.fut.done():
+                    waiter.fut.set_result(None)
+                dispatched = True
+                break  # re-evaluate interactive first
+            if not dispatched:
+                return
+
+    async def acquire(self, tenant: str, weight: float = 1.0,
+                      priority: str = PRIORITY_INTERACTIVE,
+                      cost: float = 1.0) -> QueueLease:
+        priority = priority_class(priority)
+        if (priority == PRIORITY_BATCH and self.shed_queue_depth
+                and self._queued[PRIORITY_BATCH] >= self.shed_queue_depth):
+            raise ShedError(retry_after=1.0)
+        queues = self._queues[priority]
+        tq = queues.get(tenant)
+        if tq is None:
+            tq = queues[tenant] = _TenantQ(max(weight, 1e-6))
+            self._rr[priority].append(tenant)
+        else:
+            tq.weight = max(weight, 1e-6)
+        waiter = _Waiter(max(cost, 1.0))
+        tq.waiters.append(waiter)
+        self._queued[priority] += 1
+        t0 = time.monotonic()
+        self._pump()
+        try:
+            await waiter.fut
+        except asyncio.CancelledError:
+            if waiter.fut.done() and not waiter.fut.cancelled():
+                # Dispatched, but the awaiting task was cancelled before it
+                # observed the slot — hand the slot straight back.
+                self._release(priority)
+            else:
+                waiter.cancelled = True
+                self._queued[priority] -= 1
+            raise
+        return QueueLease(self, priority, time.monotonic() - t0)
+
+    def _release(self, priority: str) -> None:
+        self._inflight_total = max(0, self._inflight_total - 1)
+        if priority == PRIORITY_INTERACTIVE:
+            self._inflight_interactive = max(0, self._inflight_interactive - 1)
+        self._pump()

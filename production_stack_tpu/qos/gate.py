@@ -1,0 +1,217 @@
+"""QoSGate: the router-side admission controller.
+
+One gate per router process, constructed only when `--qos-tenants-file`
+is set (no tenants file -> no gate -> the request path is untouched).
+The gate owns the tenant registry snapshot, per-tenant token buckets,
+and the weighted-fair dispatch queue, and knows how to hot-reload the
+tenants file (driven by the dynamic-config watcher's poll loop, or
+lazily from the admission path as a fallback).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+from .fair_queue import FairDispatchQueue, QueueLease, priority_class
+from .tenants import TenantRegistry, TenantSpec
+from .token_bucket import TokenBucket
+
+logger = logging.getLogger("uvicorn")
+
+# Fallback completion-token estimate when the request carries no
+# max_tokens: matches the OpenAI-API default of "short".
+_DEFAULT_COMPLETION_TOKENS = 64
+_CHARS_PER_TOKEN = 4
+
+
+def estimate_tokens(request_json: dict) -> int:
+    """Cheap prompt+completion token estimate for tokens/s accounting.
+
+    ~4 chars/token on the prompt side (no tokenizer on the router), plus
+    the requested max_tokens.  Deliberately rough: buckets only need the
+    estimate to scale with request size, not to match the engine's count.
+    """
+    chars = 0
+    msgs = request_json.get("messages")
+    if isinstance(msgs, list):
+        for m in msgs:
+            content = m.get("content") if isinstance(m, dict) else m
+            if isinstance(content, list):  # multimodal parts
+                for part in content:
+                    chars += len(str(part.get("text", "")) if isinstance(part, dict) else str(part))
+            elif content is not None:
+                chars += len(str(content))
+    prompt = request_json.get("prompt")
+    if isinstance(prompt, str):
+        chars += len(prompt)
+    elif isinstance(prompt, list):
+        for p in prompt:
+            chars += len(p) if isinstance(p, (str, list)) else 1
+    prompt_tokens = chars // _CHARS_PER_TOKEN + 1
+    max_tokens = request_json.get("max_tokens",
+                                  request_json.get("max_completion_tokens"))
+    if not isinstance(max_tokens, (int, float)) or max_tokens <= 0:
+        max_tokens = _DEFAULT_COMPLETION_TOKENS
+    return int(prompt_tokens + max_tokens)
+
+
+class AdmitResult:
+    """Token-bucket verdict plus the x-ratelimit-* header set."""
+
+    __slots__ = ("admitted", "reason", "retry_after", "headers")
+
+    def __init__(self, admitted: bool, reason: str = "",
+                 retry_after: float = 0.0, headers: Optional[dict] = None):
+        self.admitted = admitted
+        self.reason = reason  # "" | "requests" | "tokens"
+        self.retry_after = retry_after
+        self.headers = headers or {}
+
+
+class _TenantState:
+    __slots__ = ("spec", "req_bucket", "tok_bucket")
+
+    def __init__(self, spec: TenantSpec):
+        self.spec = spec
+        self.req_bucket = TokenBucket(
+            spec.requests_per_second,
+            spec.requests_per_second * spec.burst_seconds)
+        self.tok_bucket = TokenBucket(
+            spec.tokens_per_second,
+            spec.tokens_per_second * spec.burst_seconds)
+
+
+def _fmt_remaining(value: float) -> str:
+    return "unlimited" if value == float("inf") else str(int(value))
+
+
+class QoSGate:
+    def __init__(self, tenants_file: str,
+                 max_concurrency: Optional[int] = None,
+                 shed_queue_depth: Optional[int] = None,
+                 reload_interval_s: float = 2.0):
+        self.tenants_file = tenants_file
+        self._max_concurrency_override = max_concurrency
+        self._shed_depth_override = shed_queue_depth
+        self.reload_interval_s = reload_interval_s
+        self._mtime: float = -1.0
+        self._last_check = 0.0
+        self.registry: TenantRegistry = TenantRegistry([])
+        self._states: Dict[str, _TenantState] = {}
+        self.queue = FairDispatchQueue()
+        self._load(initial=True)
+
+    # -- config reload ----------------------------------------------------
+    def _load(self, initial: bool = False) -> None:
+        registry = TenantRegistry.from_file(self.tenants_file)
+        self.registry = registry
+        # Rebuild bucket state only for tenants whose spec changed, so a
+        # reload does not hand every tenant a fresh (full) bucket.
+        states: Dict[str, _TenantState] = {}
+        for spec in registry.tenants + [registry.default_tenant]:
+            prev = self._states.get(spec.name)
+            states[spec.name] = prev if prev and prev.spec == spec \
+                else _TenantState(spec)
+        self._states = states
+        max_conc = self._max_concurrency_override or registry.max_concurrency
+        shed = self._shed_depth_override if self._shed_depth_override is not None \
+            else registry.shed_queue_depth
+        self.queue.max_concurrency = max(int(max_conc), 1)
+        self.queue.shed_queue_depth = max(int(shed), 0)
+        try:
+            self._mtime = os.stat(self.tenants_file).st_mtime
+        except OSError:
+            self._mtime = -1.0
+        if not initial:
+            logger.info("QoS tenants reloaded from %s: %s",
+                        self.tenants_file, self.registry.names())
+
+    def maybe_reload(self, force: bool = False) -> bool:
+        """mtime-based hot reload; returns True when a reload happened."""
+        now = time.monotonic()
+        if not force and now - self._last_check < self.reload_interval_s:
+            return False
+        self._last_check = now
+        try:
+            mtime = os.stat(self.tenants_file).st_mtime
+        except OSError:
+            return False
+        if mtime == self._mtime:
+            return False
+        try:
+            self._load()
+            return True
+        except (ValueError, OSError, json.JSONDecodeError) as e:
+            logger.error("QoS tenants reload failed (%s); keeping previous "
+                         "config: %s", self.tenants_file, e)
+            self._mtime = mtime  # don't re-log every poll
+            return False
+
+    # -- admission --------------------------------------------------------
+    def resolve(self, authorization: Optional[str]) -> TenantSpec:
+        return self.registry.resolve(authorization)
+
+    def request_priority(self, spec: TenantSpec,
+                         header_value: Optional[str]) -> str:
+        """Per-request X-Priority header overrides the tenant default."""
+        return priority_class(header_value, default=spec.priority)
+
+    def _state(self, spec: TenantSpec) -> _TenantState:
+        st = self._states.get(spec.name)
+        if st is None or st.spec != spec:
+            st = self._states[spec.name] = _TenantState(spec)
+        return st
+
+    def admit(self, spec: TenantSpec, request_json: dict) -> AdmitResult:
+        st = self._state(spec)
+        est = estimate_tokens(request_json)
+        headers = {
+            "x-ratelimit-limit-requests": _fmt_remaining(
+                spec.requests_per_second if spec.requests_per_second > 0
+                else float("inf")),
+            "x-ratelimit-limit-tokens": _fmt_remaining(
+                spec.tokens_per_second if spec.tokens_per_second > 0
+                else float("inf")),
+        }
+        ok_req, retry_req = st.req_bucket.try_acquire(1.0)
+        if not ok_req:
+            headers["x-ratelimit-remaining-requests"] = "0"
+            headers["x-ratelimit-remaining-tokens"] = _fmt_remaining(
+                st.tok_bucket.remaining())
+            headers["x-ratelimit-reset-requests"] = f"{retry_req:.3f}s"
+            return AdmitResult(False, "requests", retry_req, headers)
+        ok_tok, retry_tok = st.tok_bucket.try_acquire(float(est))
+        if not ok_tok:
+            # Refund the request-bucket token the failed attempt consumed.
+            st.req_bucket._tokens = min(st.req_bucket.burst,
+                                        st.req_bucket._tokens + 1.0)
+            headers["x-ratelimit-remaining-requests"] = _fmt_remaining(
+                st.req_bucket.remaining())
+            headers["x-ratelimit-remaining-tokens"] = "0"
+            headers["x-ratelimit-reset-tokens"] = f"{retry_tok:.3f}s"
+            return AdmitResult(False, "tokens", retry_tok, headers)
+        headers["x-ratelimit-remaining-requests"] = _fmt_remaining(
+            st.req_bucket.remaining())
+        headers["x-ratelimit-remaining-tokens"] = _fmt_remaining(
+            st.tok_bucket.remaining())
+        return AdmitResult(True, "", 0.0, headers)
+
+    async def lease(self, spec: TenantSpec, priority: str,
+                    request_json: dict) -> QueueLease:
+        """Wait for a weighted-fair dispatch slot (may raise ShedError)."""
+        return await self.queue.acquire(
+            tenant=spec.name, weight=spec.weight, priority=priority,
+            cost=float(estimate_tokens(request_json)))
+
+    def health(self) -> dict:
+        return {
+            "tenants": self.registry.names(),
+            "max_concurrency": self.queue.max_concurrency,
+            "shed_queue_depth": self.queue.shed_queue_depth,
+            "inflight": self.queue.inflight,
+            "queued": self.queue.queued(),
+        }

@@ -1,0 +1,134 @@
+"""Tenant declarations: API-key -> named tenant with limits and class.
+
+Tenants live in a YAML/JSON file (hot-reloaded by the router's dynamic
+config watcher):
+
+```yaml
+tenants:
+  - name: acme
+    api_keys: ["sk-acme-prod", "sk-acme-staging"]
+    weight: 4                  # weighted-fair-queue share (DRR quantum)
+    priority: interactive      # default class: interactive | batch
+    requests_per_second: 10    # 0 / absent = unlimited
+    tokens_per_second: 4000    # estimated prompt+completion tokens
+    burst_seconds: 2.0         # bucket capacity = rate * burst_seconds
+default_tenant:                # requests whose key matches no tenant
+  name: default
+  weight: 1
+  priority: interactive
+max_concurrency: 8             # fair-queue dispatch slots
+shed_queue_depth: 64           # queued batch requests before shedding
+```
+
+Key lookup is by SHA-256 digest of the presented bearer token, so a
+miss costs one hash regardless of how many tenants are declared and
+no code path branches on secret bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Dict, List, Optional
+
+_VALID_PRIORITIES = ("interactive", "batch")
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    name: str
+    api_keys: tuple = ()
+    weight: float = 1.0
+    priority: str = "interactive"  # default class; X-Priority may override
+    requests_per_second: float = 0.0  # 0 = unlimited
+    tokens_per_second: float = 0.0  # 0 = unlimited
+    burst_seconds: float = 2.0
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "TenantSpec":
+        name = str(raw.get("name") or "").strip()
+        if not name:
+            raise ValueError("tenant entry missing 'name'")
+        priority = str(raw.get("priority", "interactive")).lower()
+        if priority not in _VALID_PRIORITIES:
+            raise ValueError(
+                f"tenant {name!r}: priority must be one of "
+                f"{_VALID_PRIORITIES}, got {priority!r}")
+        keys = raw.get("api_keys", raw.get("api_key", ()))
+        if isinstance(keys, str):
+            keys = [k.strip() for k in keys.split(",") if k.strip()]
+        weight = float(raw.get("weight", 1.0))
+        if weight <= 0:
+            raise ValueError(f"tenant {name!r}: weight must be > 0")
+        return cls(
+            name=name,
+            api_keys=tuple(str(k) for k in keys),
+            weight=weight,
+            priority=priority,
+            requests_per_second=float(raw.get("requests_per_second", 0.0)),
+            tokens_per_second=float(raw.get("tokens_per_second", 0.0)),
+            burst_seconds=max(float(raw.get("burst_seconds", 2.0)), 0.1),
+        )
+
+
+def _digest(key: str) -> str:
+    return hashlib.sha256(key.encode("utf-8", "surrogatepass")).hexdigest()
+
+
+class TenantRegistry:
+    """Immutable snapshot of the tenants file (swap wholesale on reload)."""
+
+    def __init__(self, tenants: List[TenantSpec],
+                 default_tenant: Optional[TenantSpec] = None,
+                 max_concurrency: int = 8,
+                 shed_queue_depth: int = 64):
+        self.tenants = list(tenants)
+        self.default_tenant = default_tenant or TenantSpec(name="default")
+        self.max_concurrency = max(int(max_concurrency), 1)
+        self.shed_queue_depth = max(int(shed_queue_depth), 0)
+        self._by_digest: Dict[str, TenantSpec] = {}
+        for spec in self.tenants:
+            for key in spec.api_keys:
+                self._by_digest[_digest(key)] = spec
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "TenantRegistry":
+        tenants = [TenantSpec.from_dict(t) for t in raw.get("tenants", [])]
+        names = [t.name for t in tenants]
+        if len(names) != len(set(names)):
+            raise ValueError("duplicate tenant names in tenants file")
+        default = None
+        if raw.get("default_tenant"):
+            default = TenantSpec.from_dict(raw["default_tenant"])
+        return cls(
+            tenants,
+            default_tenant=default,
+            max_concurrency=raw.get("max_concurrency", 8),
+            shed_queue_depth=raw.get("shed_queue_depth", 64),
+        )
+
+    @classmethod
+    def from_file(cls, path: str) -> "TenantRegistry":
+        with open(path, encoding="utf-8") as f:
+            if path.endswith((".yaml", ".yml")):
+                import yaml
+
+                raw = yaml.safe_load(f) or {}
+            else:
+                raw = json.load(f)
+        if not isinstance(raw, dict):
+            raise ValueError(f"tenants file {path}: expected a mapping")
+        return cls.from_dict(raw)
+
+    def resolve(self, authorization: Optional[str]) -> TenantSpec:
+        """Map an `Authorization: Bearer <key>` header to a tenant."""
+        if authorization and authorization.startswith("Bearer "):
+            token = authorization[len("Bearer "):]
+            spec = self._by_digest.get(_digest(token))
+            if spec is not None:
+                return spec
+        return self.default_tenant
+
+    def names(self) -> List[str]:
+        return [t.name for t in self.tenants] + [self.default_tenant.name]

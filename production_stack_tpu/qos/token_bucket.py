@@ -1,0 +1,52 @@
+"""Token bucket used for per-tenant requests/s and tokens/s limits.
+
+Lazy-refill: tokens accrue at `rate` per second up to `burst`; an
+acquire that cannot be covered leaves the bucket untouched and reports
+how long the caller should wait (`Retry-After`).  `rate <= 0` means
+unlimited.  Single-threaded by construction — the router's event loop
+is the only caller — so no locking.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+
+class TokenBucket:
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = max(float(burst), 1.0)
+        self._tokens = self.burst
+        self._last = time.monotonic()
+
+    @property
+    def unlimited(self) -> bool:
+        return self.rate <= 0
+
+    def _refill(self, now: float) -> None:
+        elapsed = now - self._last
+        if elapsed > 0:
+            self._tokens = min(self.burst, self._tokens + elapsed * self.rate)
+            self._last = now
+
+    def try_acquire(self, amount: float = 1.0,
+                    now: Optional[float] = None) -> Tuple[bool, float]:
+        """Returns (granted, retry_after_seconds)."""
+        if self.unlimited:
+            return True, 0.0
+        now = time.monotonic() if now is None else now
+        self._refill(now)
+        if self._tokens >= amount:
+            self._tokens -= amount
+            return True, 0.0
+        # Oversized request (amount > burst) would never clear; quote the
+        # time to a full bucket so the client backs off instead of spinning.
+        deficit = min(amount, self.burst) - self._tokens
+        return False, max(deficit / self.rate, 0.0)
+
+    def remaining(self, now: Optional[float] = None) -> float:
+        if self.unlimited:
+            return float("inf")
+        self._refill(time.monotonic() if now is None else now)
+        return self._tokens

@@ -66,6 +66,7 @@ class RouterState:
     dynamic_config_watcher: Any = None
     log_stats_thread: Optional[threading.Thread] = None
     trace_recorder: Any = None
+    qos: Any = None  # QoSGate when --qos-tenants-file is set, else None
     extra: dict = field(default_factory=dict)
 
 
@@ -115,14 +116,17 @@ async def show_engines(request: web.Request) -> web.Response:
 async def health(request: web.Request) -> web.Response:
     """Reference main_router.py:201-236: check threads are alive."""
     state = request.app["state"]
+    # 503s carry Retry-After for client-backoff consistency with the
+    # engine tier's kv-capacity 503 (engine/server.py).
     if not state.service_discovery.get_health():
         return web.json_response(
-            {"status": "unhealthy", "reason": "service discovery down"}, status=503
+            {"status": "unhealthy", "reason": "service discovery down"},
+            status=503, headers={"Retry-After": "1"}
         )
     if state.engine_stats_scraper and not state.engine_stats_scraper.get_health():
         return web.json_response(
             {"status": "unhealthy", "reason": "engine stats scraper down"},
-            status=503,
+            status=503, headers={"Retry-After": "1"},
         )
     if (
         state.dynamic_config_watcher is not None
@@ -130,7 +134,7 @@ async def health(request: web.Request) -> web.Response:
     ):
         return web.json_response(
             {"status": "unhealthy", "reason": "dynamic config watcher down"},
-            status=503,
+            status=503, headers={"Retry-After": "1"},
         )
     return web.json_response({"status": "healthy"})
 
@@ -318,14 +322,14 @@ def build_app(args) -> web.Application:
     # deployment_auth_headers().
     from production_stack_tpu.utils import auth
 
-    api_key = auth.resolve_api_key(getattr(args, "api_key", None))
-    auth.set_deployment_key(api_key)
+    api_keys = auth.resolve_api_keys(getattr(args, "api_key", None))
+    auth.set_deployment_key(api_keys[0] if api_keys else None)
 
     @web.middleware
     async def auth_middleware(request: web.Request, handler):
-        if api_key and auth.is_gated(request.path) and \
+        if api_keys and auth.is_gated(request.path) and \
                 not auth.check_bearer(
-                    request.headers.get("Authorization"), api_key):
+                    request.headers.get("Authorization"), api_keys):
             return auth.unauthorized_response()
         return await handler(request)
 
@@ -582,6 +586,23 @@ def initialize_all(args) -> RouterState:
         state.file_storage = initialize_storage(
             "local_file", getattr(args, "file_storage_path", "/tmp/tpu_stack_files")
         )
+
+    # Multi-tenant QoS gate (production_stack_tpu/qos/): built only when a
+    # tenants file is configured — without one the request path carries no
+    # QoS code at all.
+    if getattr(args, "qos_tenants_file", None):
+        from production_stack_tpu.qos import QoSGate
+
+        state.qos = QoSGate(
+            args.qos_tenants_file,
+            max_concurrency=getattr(args, "qos_max_concurrency", None),
+            shed_queue_depth=getattr(args, "qos_shed_queue_depth", None),
+            reload_interval_s=getattr(args, "qos_reload_interval", 2.0),
+        )
+        logger.info("QoS gate enabled: tenants=%s max_concurrency=%d "
+                    "shed_queue_depth=%d", state.qos.registry.names(),
+                    state.qos.queue.max_concurrency,
+                    state.qos.queue.shed_queue_depth)
 
     # Dynamic config watcher.
     if getattr(args, "dynamic_config_json", None):

@@ -47,6 +47,7 @@ class DynamicRouterConfig:
     prefill_model_labels: Optional[str] = None
     decode_model_labels: Optional[str] = None
     callbacks: Optional[str] = None
+    qos_tenants_file: Optional[str] = None
 
     @staticmethod
     def from_file(path: str) -> "DynamicRouterConfig":
@@ -108,9 +109,26 @@ def reconfigure_routing_logic(config: DynamicRouterConfig, state) -> None:
     )
 
 
+def reconfigure_qos(config: DynamicRouterConfig, state) -> None:
+    """Point the QoS gate at a (new) tenants file, building one if the
+    dynamic config introduces QoS on a router started without it."""
+    if config.qos_tenants_file is None:
+        return
+    if state.qos is not None and \
+            state.qos.tenants_file == config.qos_tenants_file:
+        state.qos.maybe_reload(force=True)
+        return
+    from production_stack_tpu.qos import QoSGate
+
+    state.qos = QoSGate(config.qos_tenants_file)
+    logger.info("QoS gate (re)configured from dynamic config: tenants=%s",
+                state.qos.registry.names())
+
+
 def reconfigure_all(config: DynamicRouterConfig, state) -> None:
     reconfigure_service_discovery(config, state)
     reconfigure_routing_logic(config, state)
+    reconfigure_qos(config, state)
     if config.callbacks:
         from production_stack_tpu.router.callbacks import configure_custom_callbacks
 
@@ -158,6 +176,14 @@ class DynamicConfigWatcher:
                 )
             except Exception as e:  # noqa: BLE001
                 logger.error("Dynamic config reload failed: %s", e)
+            try:
+                # The tenants file is watched from the same poll loop: a
+                # gate built at startup (--qos-tenants-file) hot-reloads
+                # here even when the dynamic config itself never changes.
+                if getattr(self.state, "qos", None) is not None:
+                    self.state.qos.maybe_reload(force=True)
+            except Exception as e:  # noqa: BLE001
+                logger.error("QoS tenants reload failed: %s", e)
             for _ in range(int(self.poll_interval * 10)):
                 if not self._running:
                     return

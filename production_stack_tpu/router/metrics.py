@@ -14,6 +14,7 @@ from typing import Dict
 import psutil
 from prometheus_client import (
     CollectorRegistry,
+    Counter,
     Gauge,
     Histogram,
     generate_latest,
@@ -64,6 +65,33 @@ healthy_pods = Gauge("vllm_router:healthy_pods_total", "Healthy engine endpoints
 router_cpu_pct = Gauge("vllm_router:cpu_usage_pct", "Router process CPU percent", registry=REGISTRY)
 router_mem_bytes = Gauge("vllm_router:mem_usage_bytes", "Router process RSS bytes", registry=REGISTRY)
 router_disk_pct = Gauge("vllm_router:disk_usage_pct", "Disk usage percent of /", registry=REGISTRY)
+
+# --- Multi-tenant QoS (production_stack_tpu/qos/) -------------------------
+# Labeled by tenant name; series appear only once a tenant sends traffic,
+# so a QoS-less deployment exports nothing here.
+tenant_admitted = Counter(
+    "vllm_router:tenant_admitted_total",
+    "Requests admitted past the tenant token buckets and dispatched",
+    ["tenant"], registry=REGISTRY)
+tenant_rejected = Counter(
+    "vllm_router:tenant_rejected_total",
+    "Requests rejected 429 by a tenant token bucket",
+    ["tenant", "reason"], registry=REGISTRY)
+tenant_shed = Counter(
+    "vllm_router:tenant_shed_total",
+    "Batch requests shed 503 at the saturated fair queue",
+    ["tenant"], registry=REGISTRY)
+tenant_queued = Counter(
+    "vllm_router:tenant_queued_total",
+    "Requests that entered the weighted-fair dispatch queue",
+    ["tenant"], registry=REGISTRY)
+tenant_queue_wait = Histogram(
+    "vllm_router:tenant_queue_wait_seconds",
+    "Time spent waiting for a fair-queue dispatch slot (s)",
+    ["tenant"],
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+             5.0, 10.0, 30.0, 60.0),
+    registry=REGISTRY)
 
 _PROCESS = psutil.Process()
 

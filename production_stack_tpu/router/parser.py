@@ -66,6 +66,22 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--file-storage-class", default="local_file")
     parser.add_argument("--file-storage-path", default="/tmp/tpu_stack_files")
     parser.add_argument("--batch-processor", default="local")
+    # Multi-tenant QoS (production_stack_tpu/qos/)
+    parser.add_argument("--qos-tenants-file", type=str, default=None,
+                        help="YAML/JSON tenants file (API-key -> tenant, "
+                             "weights, token-bucket limits, priority "
+                             "class); enables admission control and the "
+                             "weighted-fair queue. Hot-reloaded. Unset = "
+                             "QoS fully off (today's behavior)")
+    parser.add_argument("--qos-max-concurrency", type=int, default=None,
+                        help="fair-queue dispatch slots (overrides the "
+                             "tenants file's max_concurrency)")
+    parser.add_argument("--qos-shed-queue-depth", type=int, default=None,
+                        help="queued batch requests before new batch "
+                             "traffic is shed with 503 (overrides the "
+                             "tenants file's shed_queue_depth)")
+    parser.add_argument("--qos-reload-interval", type=float, default=2.0,
+                        help="seconds between tenants-file mtime checks")
     # Dynamic config
     parser.add_argument("--kv-admit-ttl", type=float, default=600.0,
                         help="seconds a KV admission claim stays routable "
@@ -129,6 +145,12 @@ def validate_args(args: argparse.Namespace) -> None:
             "disaggregated_prefill routing requires --prefill-model-labels "
             "and --decode-model-labels"
         )
+    if getattr(args, "qos_max_concurrency", None) is not None \
+            and args.qos_max_concurrency < 1:
+        raise ValueError("--qos-max-concurrency must be >= 1")
+    if getattr(args, "qos_shed_queue_depth", None) is not None \
+            and args.qos_shed_queue_depth < 0:
+        raise ValueError("--qos-shed-queue-depth must be >= 0")
     if not 0.0 <= args.sentry_traces_sample_rate <= 1.0:
         raise ValueError("--sentry-traces-sample-rate must be in [0, 1]")
     if not 0.0 <= args.sentry_profile_session_sample_rate <= 1.0:

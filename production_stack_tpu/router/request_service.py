@@ -93,6 +93,37 @@ async def route_general_request(
             {"error": "Request body is not JSON parsable."}, status=400
         )
 
+    # Multi-tenant QoS admission (production_stack_tpu/qos/): resolve the
+    # caller's tenant from its bearer key and run the token buckets.  With
+    # no tenants file configured state.qos is None and the path below is
+    # untouched (today's behavior, byte-identical streams).
+    qos = getattr(state, "qos", None)
+    tenant = priority = None
+    qos_headers: dict = {}
+    if qos is not None:
+        from production_stack_tpu.router import metrics as router_metrics
+
+        qos.maybe_reload()
+        tenant = qos.resolve(request.headers.get("Authorization"))
+        priority = qos.request_priority(
+            tenant, request.headers.get("X-Priority"))
+        verdict = qos.admit(tenant, request_json)
+        qos_headers = dict(verdict.headers)
+        qos_headers["x-tenant"] = tenant.name
+        if not verdict.admitted:
+            router_metrics.tenant_rejected.labels(
+                tenant=tenant.name, reason=verdict.reason).inc()
+            reject_headers = dict(qos_headers)
+            reject_headers["Retry-After"] = str(int(verdict.retry_after) + 1)
+            return web.json_response(
+                {"error": {
+                    "message": (
+                        f"Rate limit exceeded for tenant {tenant.name!r}"
+                        f" ({verdict.reason}/s); retry after"
+                        f" {verdict.retry_after:.2f}s."),
+                    "type": "RateLimitError"}},
+                status=429, headers=reject_headers)
+
     # Optional user callbacks (reference :174-180).
     if state.callbacks and hasattr(state.callbacks, "pre_request"):
         result = await _maybe_await(
@@ -155,97 +186,142 @@ async def route_general_request(
             status=400,
         )
 
-    engine_stats = state.engine_stats_scraper.get_engine_stats()
-    request_stats = state.request_stats_monitor.get_request_stats()
+    # Weighted-fair dispatch: wait for a slot before picking a backend so
+    # the routing decision sees fresh stats.  The lease is held for the
+    # whole upstream exchange (streaming included) and released in the
+    # outer finally, so concurrency accounting survives client aborts.
+    lease = None
+    if qos is not None:
+        from production_stack_tpu.qos import ShedError
+        from production_stack_tpu.router import metrics as router_metrics
 
-    import inspect
+        router_metrics.tenant_queued.labels(tenant=tenant.name).inc()
+        queue_t0 = time.time()
+        try:
+            lease = await qos.lease(tenant, priority, request_json)
+        except ShedError as e:
+            router_metrics.tenant_shed.labels(tenant=tenant.name).inc()
+            if trace is not None:
+                root.finish(status=503, error="qos_shed")
+                recorder.record(trace)
+            shed_headers = dict(qos_headers)
+            shed_headers["Retry-After"] = str(max(1, int(e.retry_after)))
+            return web.json_response(
+                {"error": {
+                    "message": ("Saturated: batch traffic is being shed;"
+                                " retry later."),
+                    "type": "ServerOverloadedError"}},
+                status=503, headers=shed_headers)
+        router_metrics.tenant_queue_wait.labels(
+            tenant=tenant.name).observe(lease.wait_s)
+        router_metrics.tenant_admitted.labels(tenant=tenant.name).inc()
+        if trace is not None and lease.wait_s > 0:
+            trace.add_span(
+                "router.qos_queue", queue_t0, queue_t0 + lease.wait_s,
+                parent=root, tenant=tenant.name, priority=priority)
 
-    routing_span = trace.start_span("router.routing") if trace else None
-    route_result = state.router.route_request(
-        endpoints, engine_stats, request_stats, dict(request.headers), request_json
-    )
-    server_url = (
-        await route_result if inspect.isawaitable(route_result) else route_result
-    )
-    if routing_span is not None:
-        routing_span.finish(
-            engine=server_url,
-            logic=type(state.router).__name__,
-            candidates=len(endpoints),
+    try:
+        engine_stats = state.engine_stats_scraper.get_engine_stats()
+        request_stats = state.request_stats_monitor.get_request_stats()
+
+        import inspect
+
+        routing_span = trace.start_span("router.routing") if trace else None
+        route_result = state.router.route_request(
+            endpoints, engine_stats, request_stats, dict(request.headers), request_json
+        )
+        server_url = (
+            await route_result if inspect.isawaitable(route_result) else route_result
+        )
+        if routing_span is not None:
+            routing_span.finish(
+                engine=server_url,
+                logic=type(state.router).__name__,
+                candidates=len(endpoints),
+            )
+
+        logger.info(
+            "Routing request %s for model %s to %s at %.3f (took %.1f ms)",
+            request_id, requested_model, server_url,
+            in_router_time, (time.time() - in_router_time) * 1e3,
         )
 
-    logger.info(
-        "Routing request %s for model %s to %s at %.3f (took %.1f ms)",
-        request_id, requested_model, server_url,
-        in_router_time, (time.time() - in_router_time) * 1e3,
-    )
-
-    headers = _forward_headers(request)
-    headers["X-Request-Id"] = request_id
-    upstream = None
-    if trace is not None:
-        # The upstream span is the engine-side parent: its id travels in
-        # the traceparent header so engine spans link under it.
-        upstream = trace.start_span("router.upstream", engine=server_url)
-        headers["traceparent"] = format_traceparent(
-            trace.trace_id, upstream.span_id)
-
-    stream = process_request(
-        state, request_id, server_url, endpoint, body, headers
-    )
-    response: Optional[web.StreamResponse] = None
-    full_response = bytearray()
-    got_first_chunk = False
-    try:
-        try:
-            async for kind, payload in stream:
-                if kind == "headers":
-                    status, hdrs = payload
-                    response = web.StreamResponse(status=status)
-                    ct = hdrs.get("Content-Type")
-                    if ct:
-                        response.content_type = ct.split(";")[0]
-                        if "charset=" in ct:
-                            response.charset = ct.split("charset=")[-1]
-                    response.headers["X-Request-Id"] = request_id
-                    await response.prepare(request)
-                else:
-                    if trace is not None and not got_first_chunk:
-                        got_first_chunk = True
-                        trace.add_span(
-                            "router.first_chunk", upstream.start, time.time(),
-                            parent=upstream,
-                        )
-                    full_response.extend(payload)
-                    assert response is not None
-                    await response.write(payload)
-        except aiohttp.ClientError as e:
-            logger.error("Backend %s failed for %s: %s", server_url, request_id, e)
-            if upstream is not None:
-                upstream.finish(error=str(e))
-            if response is None:
-                return web.json_response(
-                    {"error": f"Backend connection failed: {e}"}, status=502
-                )
-            raise
-        if response is None:
-            return web.json_response({"error": "Empty backend response"}, status=502)
-        await response.write_eof()
-
-        # Post-request hooks: semantic cache store + callbacks (reference :129-137).
-        if state.semantic_cache is not None and endpoint.endswith("chat/completions"):
-            await state.semantic_cache.maybe_store(request_json, bytes(full_response))
-        if state.callbacks and hasattr(state.callbacks, "post_request"):
-            await _maybe_await(
-                state.callbacks.post_request(request_json, bytes(full_response), request_id)
-            )
-        return response
-    finally:
+        headers = _forward_headers(request)
+        headers["X-Request-Id"] = request_id
+        if qos is not None:
+            # Priority travels to the engine scheduler; the tenant name
+            # rides along for per-tenant engine-side accounting.
+            headers["X-Priority"] = priority
+            headers["X-Tenant"] = tenant.name
+        upstream = None
         if trace is not None:
-            status = response.status if response is not None else 0
-            upstream.finish(status=status, bytes=len(full_response))
-            root.finish(status=status)
-            recorder.record(trace)
+            # The upstream span is the engine-side parent: its id travels in
+            # the traceparent header so engine spans link under it.
+            upstream = trace.start_span("router.upstream", engine=server_url)
+            headers["traceparent"] = format_traceparent(
+                trace.trace_id, upstream.span_id)
+
+        stream = process_request(
+            state, request_id, server_url, endpoint, body, headers
+        )
+        response: Optional[web.StreamResponse] = None
+        full_response = bytearray()
+        got_first_chunk = False
+        try:
+            try:
+                async for kind, payload in stream:
+                    if kind == "headers":
+                        status, hdrs = payload
+                        response = web.StreamResponse(status=status)
+                        ct = hdrs.get("Content-Type")
+                        if ct:
+                            response.content_type = ct.split(";")[0]
+                            if "charset=" in ct:
+                                response.charset = ct.split("charset=")[-1]
+                        response.headers["X-Request-Id"] = request_id
+                        for k, v in qos_headers.items():
+                            response.headers[k] = v
+                        await response.prepare(request)
+                    else:
+                        if trace is not None and not got_first_chunk:
+                            got_first_chunk = True
+                            trace.add_span(
+                                "router.first_chunk", upstream.start, time.time(),
+                                parent=upstream,
+                            )
+                        full_response.extend(payload)
+                        assert response is not None
+                        await response.write(payload)
+            except aiohttp.ClientError as e:
+                logger.error("Backend %s failed for %s: %s", server_url, request_id, e)
+                if upstream is not None:
+                    upstream.finish(error=str(e))
+                if response is None:
+                    return web.json_response(
+                        {"error": f"Backend connection failed: {e}"}, status=502
+                    )
+                raise
+            if response is None:
+                return web.json_response({"error": "Empty backend response"}, status=502)
+            await response.write_eof()
+
+            # Post-request hooks: semantic cache store + callbacks (reference :129-137).
+            if state.semantic_cache is not None and endpoint.endswith("chat/completions"):
+                await state.semantic_cache.maybe_store(request_json, bytes(full_response))
+            if state.callbacks and hasattr(state.callbacks, "post_request"):
+                await _maybe_await(
+                    state.callbacks.post_request(request_json, bytes(full_response), request_id)
+                )
+            return response
+        finally:
+            if trace is not None:
+                status = response.status if response is not None else 0
+                upstream.finish(status=status, bytes=len(full_response))
+                root.finish(status=status)
+                recorder.record(trace)
+    finally:
+        if lease is not None:
+            lease.release()
 
 
 async def send_request_to_prefiller(

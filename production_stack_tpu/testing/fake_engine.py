@@ -16,7 +16,7 @@ import asyncio
 import json
 import time
 import uuid
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from aiohttp import web
 
@@ -58,6 +58,16 @@ class FakeEngine:
         self.spec_accepted_tokens_total = 0
         self.spec_disabled_requests_total = 0
         self._engine_lock = asyncio.Lock()
+        # QoS surface: the router's X-Priority / X-Tenant headers are
+        # honored the way the real scheduler honors them — batch prefill
+        # chunks defer while any interactive prefill is in flight — and
+        # counted per tenant/priority for hermetic assertions.
+        self._interactive_prefills = 0
+        self._no_interactive = asyncio.Event()
+        self._no_interactive.set()
+        self.tenant_requests: Dict[str, int] = {}
+        self.priority_requests: Dict[str, int] = {
+            "interactive": 0, "batch": 0}
         self.sleeping = False
         self.num_running = 0
         self.num_waiting = 0
@@ -72,19 +82,49 @@ class FakeEngine:
     def _token_delay(self) -> float:
         return 1.0 / self.tokens_per_sec if self.tokens_per_sec > 0 else 0.0
 
-    async def _prefill_sleep(self) -> int:
+    def _count_request(self, request: web.Request) -> str:
+        """Record the router's QoS headers; returns the priority class."""
+        priority = (request.headers.get("X-Priority") or "interactive").lower()
+        if priority not in ("interactive", "batch"):
+            priority = "interactive"
+        self.priority_requests[priority] = \
+            self.priority_requests.get(priority, 0) + 1
+        tenant = request.headers.get("X-Tenant")
+        if tenant:
+            self.tenant_requests[tenant] = \
+                self.tenant_requests.get(tenant, 0) + 1
+        return priority
+
+    async def _prefill_sleep(self, priority: str = "interactive") -> int:
         """TTFT wait; under the contention model it holds the engine lock
         in 1 (unchunked) or ``prefill_chunks`` (chunked) slices. Returns
-        the chunk count."""
+        the chunk count.
+
+        Batch-class prefills defer between chunks while any interactive
+        prefill is in flight — the fake-device analog of the real
+        scheduler's priority admission + preemption, so the noisy-neighbor
+        A/B observes the same TTFT protection hermetically."""
         if not self.simulate_contention:
             if self.ttft > 0:
                 await asyncio.sleep(self.ttft)
             return 1
         chunks = self.prefill_chunks if self.enable_chunked_prefill else 1
-        for _ in range(chunks):
-            async with self._engine_lock:
-                if self.ttft > 0:
-                    await asyncio.sleep(self.ttft / chunks)
+        interactive = priority != "batch"
+        if interactive:
+            self._interactive_prefills += 1
+            self._no_interactive.clear()
+        try:
+            for _ in range(chunks):
+                if not interactive:
+                    await self._no_interactive.wait()
+                async with self._engine_lock:
+                    if self.ttft > 0:
+                        await asyncio.sleep(self.ttft / chunks)
+        finally:
+            if interactive:
+                self._interactive_prefills -= 1
+                if self._interactive_prefills == 0:
+                    self._no_interactive.set()
         self.prefill_chunks_total += chunks
         return chunks
 
@@ -161,9 +201,10 @@ class FakeEngine:
         model = body.get("model", self.models[0])
         t_arrival = time.time()
         t_prefill_end: Optional[float] = None
+        priority = self._count_request(request)
         self.num_running += 1
         try:
-            await self._prefill_sleep()
+            await self._prefill_sleep(priority)
             t_prefill_end = time.time()
             if not stream:
                 for _ in range(n_tokens):
@@ -220,8 +261,8 @@ class FakeEngine:
                or f"cmpl-{uuid.uuid4().hex[:12]}")
         model = body.get("model", self.models[0])
         t_arrival = time.time()
-        if self.ttft > 0:
-            await asyncio.sleep(self.ttft)
+        priority = self._count_request(request)
+        await self._prefill_sleep(priority)
         t_prefill_end = time.time()
         if not stream:
             self._record_trace(request, rid, model, t_arrival,

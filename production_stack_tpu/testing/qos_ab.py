@@ -1,0 +1,253 @@
+"""Hermetic two-tenant noisy-neighbor A/B: QoS on vs off vs unloaded.
+
+The physics, with no TPU and no model: a :class:`FakeEngine` in
+contention mode serializes prefill chunks on one lock (one device). A
+batch tenant floods it with concurrent prefills while an interactive
+tenant sends one request at a time and measures TTFT.
+
+- **unloaded** leg: interactive requests alone — the TTFT floor.
+- **qos_on** leg: the router runs with a tenants file.  The batch
+  tenant's requests carry ``X-Priority: batch`` (assigned by the router
+  from tenant config — the flood clients never set the header
+  themselves), the fair queue caps how many reach the engine at once,
+  and the engine defers batch prefill chunks while an interactive
+  prefill is in flight.  Interactive TTFT stays near the floor.
+- **qos_off** leg: same traffic, no tenants file.  Every request is
+  equal, the flood serializes the device, and interactive TTFT degrades
+  by roughly the number of concurrent prefills.
+
+Used by ``bench.py`` (BENCH_QOS=1) and
+``tests/test_qos_noisy_neighbor.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import statistics
+import time
+from typing import List, Optional
+
+MODEL = "qos-model"
+INTERACTIVE_KEY = "sk-qos-interactive"
+BATCH_KEY = "sk-qos-batch"
+
+
+def write_tenants_file(path: str, *, max_concurrency: int = 2,
+                       shed_queue_depth: int = 256) -> str:
+    """Two-tenant config: a weighted interactive tenant and a batch
+    tenant whose requests are classed batch without any client header."""
+    config = {
+        "max_concurrency": max_concurrency,
+        "shed_queue_depth": shed_queue_depth,
+        "tenants": [
+            {"name": "interactive-tenant",
+             "api_keys": [INTERACTIVE_KEY],
+             "weight": 4,
+             "priority": "interactive"},
+            {"name": "batch-tenant",
+             "api_keys": [BATCH_KEY],
+             "weight": 1,
+             "priority": "batch"},
+        ],
+    }
+    with open(path, "w") as f:
+        json.dump(config, f)
+    return path
+
+
+def _reset_router_singletons() -> None:
+    from production_stack_tpu.router import routing_logic as rl
+    from production_stack_tpu.router.engine_stats import EngineStatsScraper
+    from production_stack_tpu.router.request_stats import RequestStatsMonitor
+    from production_stack_tpu.utils.misc import SingletonABCMeta, SingletonMeta
+
+    for cls in (
+        rl.RoundRobinRouter, rl.SessionRouter, rl.PrefixAwareRouter,
+        rl.KvawareRouter, rl.DisaggregatedPrefillRouter,
+    ):
+        SingletonABCMeta._reset_instance(cls)
+    SingletonMeta._reset_instance(RequestStatsMonitor)
+    SingletonMeta._reset_instance(EngineStatsScraper)
+
+
+async def _start(app):
+    from aiohttp import web
+
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+def _p99(values: List[float]) -> Optional[float]:
+    if not values:
+        return None
+    return sorted(values)[
+        min(len(values) - 1, max(0, -(-99 * len(values) // 100) - 1))]
+
+
+async def _interactive_ttft(session, router_url: str) -> float:
+    """One streamed interactive request; returns TTFT (first content
+    chunk). Raises on any non-200."""
+    import aiohttp
+
+    t0 = time.perf_counter()
+    ttft = None
+    async with session.post(
+        router_url + "/v1/chat/completions",
+        json={"model": MODEL, "max_tokens": 2, "stream": True,
+              "messages": [{"role": "user", "content": "quick question"}]},
+        headers={"Authorization": f"Bearer {INTERACTIVE_KEY}"},
+        timeout=aiohttp.ClientTimeout(total=300),
+    ) as resp:
+        if resp.status != 200:
+            raise RuntimeError(
+                f"interactive request failed: {resp.status}")
+        async for line in resp.content:
+            line = line.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            chunk = json.loads(line[len("data: "):])
+            if ttft is None and \
+                    chunk["choices"][0].get("delta", {}).get("content"):
+                ttft = time.perf_counter() - t0
+    if ttft is None:
+        raise RuntimeError("stream produced no content")
+    return ttft
+
+
+async def _run_leg(*, qos_on: bool, tenants_file: Optional[str],
+                   flood: int, interactive_requests: int, ttft_s: float,
+                   prefill_chunks: int) -> dict:
+    import aiohttp
+
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.parser import build_parser
+    from production_stack_tpu.testing.fake_engine import FakeEngine
+
+    _reset_router_singletons()
+    engine = FakeEngine(
+        model=MODEL, ttft=ttft_s, tokens_per_sec=0.0,
+        max_tokens_default=2, simulate_contention=True,
+        enable_chunked_prefill=True, prefill_chunks=prefill_chunks)
+    engine_runner, engine_url = await _start(engine.make_app())
+    args = build_parser().parse_args([])
+    args.static_backends = engine_url
+    args.static_models = MODEL
+    args.routing_logic = "roundrobin"
+    args.engine_stats_interval = 60
+    if qos_on:
+        args.qos_tenants_file = tenants_file
+    router_app = build_app(args)
+    router_runner, router_url = await _start(router_app)
+
+    stop = asyncio.Event()
+    flood_stats = {"completed": 0, "failed": 0}
+
+    async def one_flood(session):
+        # Continuous batch pressure: each client re-fires as soon as its
+        # previous request finishes, for the whole interactive phase.
+        # No X-Priority header — with QoS on the router classes these
+        # batch from tenant config; with QoS off they are plain traffic.
+        while not stop.is_set():
+            try:
+                async with session.post(
+                    router_url + "/v1/chat/completions",
+                    json={"model": MODEL, "max_tokens": 2,
+                          "messages": [{"role": "user",
+                                        "content": "offline batch job " * 4}]},
+                    headers={"Authorization": f"Bearer {BATCH_KEY}"},
+                    timeout=aiohttp.ClientTimeout(total=300),
+                ) as resp:
+                    await resp.read()
+                    key = "completed" if resp.status == 200 else "failed"
+                    flood_stats[key] += 1
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                flood_stats["failed"] += 1
+
+    ttfts: List[float] = []
+    errors = 0
+    try:
+        async with aiohttp.ClientSession() as session:
+            # Warm connections / compile-free first hop before timing.
+            await _interactive_ttft(session, router_url)
+            flood_tasks = [asyncio.ensure_future(one_flood(session))
+                           for _ in range(flood)]
+            if flood:
+                await asyncio.sleep(ttft_s)  # let the flood saturate
+            try:
+                for _ in range(interactive_requests):
+                    try:
+                        ttfts.append(
+                            await _interactive_ttft(session, router_url))
+                    except RuntimeError:
+                        errors += 1
+            finally:
+                stop.set()
+                # Drain in-flight flood requests (cancelling mid-stream
+                # just litters the log with closed-transport errors);
+                # cancellation is only the hang backstop.
+                if flood_tasks:
+                    _, pending = await asyncio.wait(
+                        flood_tasks, timeout=ttft_s * flood + 10)
+                    for t in pending:
+                        t.cancel()
+                    await asyncio.gather(
+                        *flood_tasks, return_exceptions=True)
+    finally:
+        await router_runner.cleanup()
+        await engine_runner.cleanup()
+        _reset_router_singletons()
+
+    return {
+        "qos_on": qos_on,
+        "flood": flood,
+        "requests": len(ttfts),
+        "errors": errors,
+        "p50_ttft_s": round(statistics.median(ttfts), 4) if ttfts else None,
+        "p99_ttft_s": round(_p99(ttfts), 4) if ttfts else None,
+        "flood_completed": flood_stats["completed"],
+        "flood_failed": flood_stats["failed"],
+        "engine_priority_requests": dict(engine.priority_requests),
+        "engine_tenant_requests": dict(engine.tenant_requests),
+    }
+
+
+async def run_qos_ab(tenants_file: str, *, flood: int = 16,
+                     interactive_requests: int = 6, ttft_s: float = 0.3,
+                     prefill_chunks: int = 8) -> dict:
+    """Run the three legs back to back; returns the A/B result dict.
+
+    ``tenants_file`` must already exist (see :func:`write_tenants_file`).
+    """
+    unloaded = await _run_leg(
+        qos_on=False, tenants_file=None, flood=0,
+        interactive_requests=interactive_requests, ttft_s=ttft_s,
+        prefill_chunks=prefill_chunks)
+    qos_on = await _run_leg(
+        qos_on=True, tenants_file=tenants_file, flood=flood,
+        interactive_requests=interactive_requests, ttft_s=ttft_s,
+        prefill_chunks=prefill_chunks)
+    qos_off = await _run_leg(
+        qos_on=False, tenants_file=None, flood=flood,
+        interactive_requests=interactive_requests, ttft_s=ttft_s,
+        prefill_chunks=prefill_chunks)
+    base = unloaded["p99_ttft_s"] or 1e-9
+    return {
+        "metric": "qos_noisy_neighbor_ab",
+        "unit": "p99_ttft_ratio_vs_unloaded",
+        "value": round(qos_on["p99_ttft_s"] / base, 3)
+        if qos_on["p99_ttft_s"] else None,
+        "qos_off_ratio": round(qos_off["p99_ttft_s"] / base, 3)
+        if qos_off["p99_ttft_s"] else None,
+        "ttft_s": ttft_s,
+        "prefill_chunks": prefill_chunks,
+        "batch_flood": flood,
+        "interactive_requests": interactive_requests,
+        "unloaded": unloaded,
+        "qos_on": qos_on,
+        "qos_off": qos_off,
+    }

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import hmac
 import os
-from typing import Optional
+from typing import Iterable, Optional, Tuple, Union
 
 # Non-/v1 aliases of gated inference endpoints.
 _GATED_EXACT = frozenset({"/score", "/rerank", "/tokenize", "/detokenize"})
@@ -26,17 +26,55 @@ def is_gated(path: str) -> bool:
     return path.startswith("/v1/") or path in _GATED_EXACT
 
 
+def _split_keys(value: str) -> Tuple[str, ...]:
+    return tuple(k.strip() for k in value.split(",") if k.strip())
+
+
+def resolve_api_keys(explicit: Optional[str] = None) -> Tuple[str, ...]:
+    """All accepted deployment keys, in declaration order.
+
+    Sources, first match wins: the explicit flag value, the
+    vLLM-compatible env vars, or a keyfile (`VLLM_API_KEY_FILE` /
+    `TPU_STACK_API_KEY_FILE`, one key per line, `#` comments).  Flag and
+    env values may hold several comma-separated keys; every key opens
+    the same gated surface (rotation windows, per-team keys)."""
+    raw = (explicit or os.environ.get("VLLM_API_KEY")
+           or os.environ.get("TPU_STACK_API_KEY") or None)
+    if raw:
+        return _split_keys(raw)
+    keyfile = (os.environ.get("VLLM_API_KEY_FILE")
+               or os.environ.get("TPU_STACK_API_KEY_FILE") or None)
+    if keyfile:
+        try:
+            with open(keyfile, encoding="utf-8") as f:
+                lines = [ln.strip() for ln in f]
+            return tuple(ln for ln in lines if ln and not ln.startswith("#"))
+        except OSError:
+            return ()
+    return ()
+
+
 def resolve_api_key(explicit: Optional[str] = None) -> Optional[str]:
-    """Explicit flag value, else the vLLM-compatible env vars."""
-    return (explicit or os.environ.get("VLLM_API_KEY")
-            or os.environ.get("TPU_STACK_API_KEY") or None)
+    """First accepted key (the one this deployment presents outbound)."""
+    keys = resolve_api_keys(explicit)
+    return keys[0] if keys else None
 
 
-def check_bearer(authorization: Optional[str], key: str) -> bool:
-    """Constant-time check of an `Authorization: Bearer <key>` header."""
+def check_bearer(authorization: Optional[str],
+                 key: Union[str, Iterable[str]]) -> bool:
+    """Constant-time check of an `Authorization: Bearer <key>` header.
+
+    `key` may be a single key or an iterable of accepted keys; every
+    candidate is compared (no early exit on match) so timing does not
+    reveal which configured key a probe collided with."""
     if not authorization or not authorization.startswith("Bearer "):
         return False
-    return hmac.compare_digest(authorization[len("Bearer "):], key)
+    presented = authorization[len("Bearer "):]
+    keys = (key,) if isinstance(key, str) else tuple(key)
+    ok = False
+    for k in keys:
+        ok |= hmac.compare_digest(presented, k)
+    return ok
 
 
 def auth_headers(key: Optional[str]) -> dict:

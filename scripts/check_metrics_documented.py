@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Fail if an emitted metric is missing from observability/README.md.
+
+Scans ``production_stack_tpu/**/*.py`` for string literals that look like
+metric names in this stack's namespaces (``tpu:*`` emitted by the engine,
+``vllm_router:*`` emitted by the router's prometheus registry) and checks
+each one appears in ``observability/README.md``.
+
+Normalization, both sides:
+
+- ``_total`` / ``_count`` / ``_sum`` / ``_bucket`` suffixes are stripped —
+  documenting ``tpu:queue_time_seconds`` covers its sum/count pair, and
+  ``X`` vs ``X_total`` count as the same metric.
+- Source names ending ``_`` are skipped (f-string prefixes like
+  ``tpu:spec_`` that are completed at runtime).
+- README brace shorthand is expanded (``tpu:kv_offload_{hits,misses}``
+  documents both) and a trailing ``*`` is a prefix wildcard
+  (``tpu:kv_offload_*`` covers the family).
+
+Run from the repo root; exits non-zero listing undocumented metrics.
+Wired into the test suite via tests/test_observability.py.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "production_stack_tpu")
+README = os.path.join(REPO, "observability", "README.md")
+
+METRIC_RE = re.compile(r"\b((?:vllm_router|tpu):[a-zA-Z0-9_]+)")
+SUFFIXES = ("_total", "_count", "_sum", "_bucket")
+
+
+def normalize(name: str) -> str:
+    for suffix in SUFFIXES:
+        if name.endswith(suffix):
+            return name[: -len(suffix)]
+    return name
+
+
+def emitted_metrics() -> set:
+    names = set()
+    for dirpath, dirnames, filenames in os.walk(PKG):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fname), encoding="utf-8") as f:
+                source = f.read()
+            for match in METRIC_RE.findall(source):
+                if match.endswith("_"):  # f-string prefix, completed later
+                    continue
+                names.add(normalize(match))
+    return names
+
+
+def documented_metrics() -> tuple:
+    """(exact normalized names, wildcard prefixes) from the README."""
+    with open(README, encoding="utf-8") as f:
+        text = f.read()
+    exact, prefixes = set(), []
+    # Expand {a,b,c} brace shorthand before tokenizing.
+    brace = re.compile(
+        r"((?:vllm_router|tpu):[a-zA-Z0-9_]*)\{([a-zA-Z0-9_,]+)\}"
+        r"([a-zA-Z0-9_]*)")
+    for head, alts, tail in brace.findall(text):
+        for alt in alts.split(","):
+            exact.add(normalize(head + alt + tail))
+    for match in METRIC_RE.findall(text):
+        if text[text.find(match) + len(match):][:1] == "*":
+            pass  # handled by the wildcard scan below
+        exact.add(normalize(match))
+    for match in re.findall(r"\b((?:vllm_router|tpu):[a-zA-Z0-9_]+_)\*",
+                            text):
+        prefixes.append(match)
+    return exact, prefixes
+
+
+def main() -> int:
+    exact, prefixes = documented_metrics()
+    missing = sorted(
+        name for name in emitted_metrics()
+        if name not in exact
+        and not any(name.startswith(p) for p in prefixes)
+    )
+    if missing:
+        print("Emitted metrics missing from observability/README.md:")
+        for name in missing:
+            print(f"  {name}")
+        return 1
+    print(f"all {len(emitted_metrics())} emitted tpu:/vllm_router: metrics "
+          f"documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -120,3 +120,40 @@ def test_router_edge_auth_and_shared_key_passthrough():
 
     asyncio.run(run())
     engine.core.stop()
+
+
+def test_multi_key_resolution_and_constant_time_check(tmp_path,
+                                                      monkeypatch):
+    """Several deployment keys open the same surface: comma-separated
+    flag/env values and one-per-line keyfiles all resolve, and
+    check_bearer accepts any configured key (rotation windows)."""
+    from production_stack_tpu.utils import auth
+
+    monkeypatch.delenv("VLLM_API_KEY", raising=False)
+    monkeypatch.delenv("TPU_STACK_API_KEY", raising=False)
+    monkeypatch.delenv("VLLM_API_KEY_FILE", raising=False)
+    monkeypatch.delenv("TPU_STACK_API_KEY_FILE", raising=False)
+
+    assert auth.resolve_api_keys("sk-a, sk-b,sk-c") == \
+        ("sk-a", "sk-b", "sk-c")
+    assert auth.resolve_api_key("sk-a, sk-b") == "sk-a"
+
+    monkeypatch.setenv("VLLM_API_KEY", "sk-env1,sk-env2")
+    assert auth.resolve_api_keys() == ("sk-env1", "sk-env2")
+    # Explicit flag value wins over the env.
+    assert auth.resolve_api_keys("sk-flag") == ("sk-flag",)
+
+    monkeypatch.delenv("VLLM_API_KEY")
+    keyfile = tmp_path / "keys.txt"
+    keyfile.write_text("# rotation window\nsk-old\n\nsk-new\n")
+    monkeypatch.setenv("VLLM_API_KEY_FILE", str(keyfile))
+    assert auth.resolve_api_keys() == ("sk-old", "sk-new")
+
+    keys = ("sk-old", "sk-new")
+    assert auth.check_bearer("Bearer sk-old", keys)
+    assert auth.check_bearer("Bearer sk-new", keys)
+    assert not auth.check_bearer("Bearer sk-other", keys)
+    assert not auth.check_bearer("sk-old", keys)  # missing Bearer prefix
+    assert not auth.check_bearer(None, keys)
+    # Single-key string form still works.
+    assert auth.check_bearer("Bearer sk-old", "sk-old")

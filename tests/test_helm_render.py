@@ -398,3 +398,64 @@ def test_speculative_num_tokens_plumbs_into_engine_command():
              if d["metadata"]["name"].endswith("-engine")]
     bcmd = bdeps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
     assert "--speculative-num-tokens" not in bcmd
+
+
+def test_qos_tenants_render_configmap_and_router_flags():
+    """routerSpec.qos.enabled renders the tenants ConfigMap, mounts it
+    at /etc/qos, and passes --qos-* flags to the router; disabled (the
+    default) renders none of it."""
+    import copy
+
+    import jsonschema
+
+    values = copy.deepcopy(load_values(CHART))
+    values["routerSpec"]["qos"] = {
+        "enabled": True,
+        "tenants": {
+            "tenants": [
+                {"name": "acme", "api_keys": ["sk-acme"], "weight": 4,
+                 "priority": "interactive", "requests_per_second": 10},
+                {"name": "crawler", "api_keys": ["sk-crawl"],
+                 "weight": 1, "priority": "batch"},
+            ],
+            "max_concurrency": 8,
+            "shed_queue_depth": 64,
+        },
+        "maxConcurrency": 4,
+        "shedQueueDepth": 32,
+        "reloadInterval": 2,
+    }
+    import json
+    with open(os.path.join(CHART, "values.schema.json")) as f:
+        jsonschema.validate(values, json.load(f))
+
+    rendered = MiniHelm(CHART).render(values)
+    cms = [d for d in _docs(rendered, "ConfigMap")
+           if d["metadata"]["name"].endswith("-router-qos-tenants")]
+    assert len(cms) == 1
+    import yaml
+    tenants = yaml.safe_load(cms[0]["data"]["tenants.yaml"])
+    assert tenants["tenants"][0]["name"] == "acme"
+    assert tenants["max_concurrency"] == 8
+
+    deps = [d for d in _docs(rendered, "Deployment")
+            if d["metadata"]["name"].endswith("-router")]
+    spec = deps[0]["spec"]["template"]["spec"]
+    cmd = spec["containers"][0]["command"]
+    assert cmd[cmd.index("--qos-tenants-file") + 1] == "/etc/qos/tenants.yaml"
+    assert cmd[cmd.index("--qos-max-concurrency") + 1] == "4"
+    assert cmd[cmd.index("--qos-shed-queue-depth") + 1] == "32"
+    assert "--qos-reload-interval" in cmd
+    mounts = spec["containers"][0]["volumeMounts"]
+    assert any(m["mountPath"] == "/etc/qos" for m in mounts)
+    assert any(v["configMap"]["name"].endswith("-router-qos-tenants")
+               for v in spec["volumes"])
+
+    # Default chart: QoS fully absent (flag-off parity).
+    base = _render()
+    assert not [d for d in _docs(base, "ConfigMap")
+                if "qos" in d["metadata"]["name"]]
+    bdeps = [d for d in _docs(base, "Deployment")
+             if d["metadata"]["name"].endswith("-router")]
+    bcmd = bdeps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--qos-tenants-file" not in bcmd

@@ -1,0 +1,584 @@
+"""Multi-tenant QoS: token buckets, weighted-fair queue, tenant
+registry, scheduler priority admission/preemption, and router-level
+429 / shed / header behavior (hermetic, fake engines).
+
+The flag-off contract is load-bearing: without --qos-tenants-file the
+router must behave byte-identically to a stack without the subsystem
+(state.qos is None, no qos headers, no X-Priority toward the engine).
+"""
+
+import argparse
+import asyncio
+import json
+import os
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from production_stack_tpu.engine.kvcache import KVCacheManager
+from production_stack_tpu.engine.sampling import SamplingParams
+from production_stack_tpu.engine.scheduler import (
+    EngineRequest,
+    RequestStatus,
+    Scheduler,
+    parse_priority,
+    priority_label,
+)
+from production_stack_tpu.qos import QoSGate, ShedError
+from production_stack_tpu.qos.fair_queue import (
+    FairDispatchQueue,
+    priority_class,
+)
+from production_stack_tpu.qos.gate import estimate_tokens
+from production_stack_tpu.qos.tenants import TenantRegistry, TenantSpec
+from production_stack_tpu.qos.token_bucket import TokenBucket
+from production_stack_tpu.router import routing_logic as rl
+from production_stack_tpu.router.app import build_app
+from production_stack_tpu.router.engine_stats import EngineStatsScraper
+from production_stack_tpu.router.request_stats import RequestStatsMonitor
+from production_stack_tpu.testing.fake_engine import FakeEngine
+from production_stack_tpu.utils.misc import SingletonABCMeta, SingletonMeta
+
+# ---------------------------------------------------------------------------
+# Token bucket
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_drain_refill_and_retry_after():
+    b = TokenBucket(rate=10, burst=10)
+    t0 = b._last
+    ok, retry = b.try_acquire(10, now=t0)
+    assert ok and retry == 0.0
+    ok, retry = b.try_acquire(1, now=t0)
+    assert not ok
+    assert retry == pytest.approx(0.1)
+    # A denied acquire leaves the bucket untouched; refill clears it.
+    ok, _ = b.try_acquire(1, now=t0 + 0.2)
+    assert ok
+    # Refill caps at burst no matter how long the idle gap.
+    assert b.remaining(now=t0 + 1000) == pytest.approx(10)
+
+
+def test_token_bucket_unlimited_and_oversized():
+    assert TokenBucket(rate=0, burst=0).try_acquire(10**9) == (True, 0.0)
+    b = TokenBucket(rate=1, burst=5)
+    t0 = b._last
+    # amount > burst can never clear: quote time-to-full, don't spin.
+    ok, retry = b.try_acquire(50, now=t0)
+    assert not ok and retry == pytest.approx(0.0)
+    b.try_acquire(5, now=t0)
+    ok, retry = b.try_acquire(50, now=t0)
+    assert not ok and retry == pytest.approx(5.0)
+
+
+# ---------------------------------------------------------------------------
+# Fair dispatch queue
+# ---------------------------------------------------------------------------
+
+
+async def _drain(q, leases, order, n):
+    """Release leases one at a time, recording dispatch order."""
+    for _ in range(n):
+        lease = await asyncio.wait_for(leases.get(), 5)
+        lease.release()
+    await asyncio.sleep(0)
+    return order
+
+
+async def test_drr_weighted_share():
+    q = FairDispatchQueue(max_concurrency=1, shed_queue_depth=0,
+                          quantum=256.0)
+    order, leases = [], asyncio.Queue()
+
+    async def worker(name, weight):
+        lease = await q.acquire(name, weight=weight, priority="batch",
+                                cost=256.0)
+        order.append(name)
+        await leases.put(lease)
+
+    tasks = [asyncio.create_task(worker("heavy", 4.0)) for _ in range(12)]
+    await asyncio.sleep(0)  # heavy queues first (one dispatches)
+    tasks += [asyncio.create_task(worker("light", 1.0)) for _ in range(12)]
+    await asyncio.sleep(0)
+    await _drain(q, leases, order, 24)
+    await asyncio.gather(*tasks)
+    assert len(order) == 24
+    # weight 4 drains ~4x the token volume per DRR round (the steady
+    # pattern is 4 heavy dispatches per light one), so the heavy tenant
+    # is nearly drained before the light one gets its third slot.
+    assert order[:5] == ["heavy"] * 5
+    assert order[:16].count("heavy") >= 11
+
+
+async def test_interactive_not_starved_by_batch_flood():
+    q = FairDispatchQueue(max_concurrency=1, shed_queue_depth=64)
+    batch_lease = await q.acquire("crawler", priority="batch")
+    # Total in-flight == max_concurrency, but interactive rides on top.
+    inter_lease = await asyncio.wait_for(
+        q.acquire("acme", priority="interactive"), 1)
+    assert q.inflight == 2
+    # A second interactive waits for an interactive slot, not the batch.
+    second = asyncio.ensure_future(q.acquire("acme", priority="interactive"))
+    await asyncio.sleep(0.01)
+    assert not second.done()
+    inter_lease.release()
+    (await asyncio.wait_for(second, 1)).release()
+    batch_lease.release()
+    assert q.inflight == 0
+
+
+async def test_batch_shed_at_queue_depth():
+    q = FairDispatchQueue(max_concurrency=1, shed_queue_depth=1)
+    lease = await q.acquire("crawler", priority="batch")
+    queued = asyncio.ensure_future(q.acquire("crawler", priority="batch"))
+    await asyncio.sleep(0)
+    assert q.queued("batch") == 1
+    with pytest.raises(ShedError) as ei:
+        await q.acquire("crawler", priority="batch")
+    assert ei.value.retry_after > 0
+    # Interactive is never shed.
+    (await q.acquire("acme", priority="interactive")).release()
+    lease.release()
+    (await asyncio.wait_for(queued, 1)).release()
+
+
+async def test_cancelled_waiter_releases_cleanly():
+    q = FairDispatchQueue(max_concurrency=1)
+    lease = await q.acquire("a", priority="batch")
+    waiter = asyncio.ensure_future(q.acquire("a", priority="batch"))
+    await asyncio.sleep(0)
+    waiter.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await waiter
+    lease.release()
+    assert q.inflight == 0
+    # Queue still functional after the cancellation.
+    (await asyncio.wait_for(q.acquire("a", priority="batch"), 1)).release()
+
+
+# ---------------------------------------------------------------------------
+# Tenant registry + gate
+# ---------------------------------------------------------------------------
+
+_TENANTS = {
+    "tenants": [
+        {"name": "acme", "api_keys": ["sk-acme"], "weight": 4,
+         "priority": "interactive", "requests_per_second": 2,
+         "burst_seconds": 1.0},
+        {"name": "crawler", "api_key": "sk-c1, sk-c2", "weight": 1,
+         "priority": "batch"},
+    ],
+    "max_concurrency": 3,
+    "shed_queue_depth": 5,
+}
+
+
+def test_registry_resolves_keys_and_defaults():
+    reg = TenantRegistry.from_dict(_TENANTS)
+    assert reg.resolve("Bearer sk-acme").name == "acme"
+    # api_key accepts a comma-separated string too.
+    assert reg.resolve("Bearer sk-c2").name == "crawler"
+    assert reg.resolve("Bearer sk-c2").priority == "batch"
+    assert reg.resolve("Bearer unknown").name == "default"
+    assert reg.resolve(None).name == "default"
+    assert reg.max_concurrency == 3 and reg.shed_queue_depth == 5
+
+
+def test_registry_rejects_bad_config():
+    with pytest.raises(ValueError):
+        TenantSpec.from_dict({"name": "x", "priority": "vip"})
+    with pytest.raises(ValueError):
+        TenantSpec.from_dict({"name": "x", "weight": 0})
+    with pytest.raises(ValueError):
+        TenantSpec.from_dict({"priority": "batch"})
+    with pytest.raises(ValueError):
+        TenantRegistry.from_dict(
+            {"tenants": [{"name": "x"}, {"name": "x"}]})
+
+
+def test_estimate_tokens_scales_with_request():
+    small = estimate_tokens({"messages": [
+        {"role": "user", "content": "hi"}], "max_tokens": 5})
+    big = estimate_tokens({"messages": [
+        {"role": "user", "content": "x" * 4000}], "max_tokens": 5})
+    assert big - small == pytest.approx(1000, abs=2)
+    # No max_tokens -> default completion estimate, not zero.
+    assert estimate_tokens({"prompt": "hello"}) > 60
+
+
+def test_gate_admit_429_headers_and_hot_reload(tmp_path):
+    path = tmp_path / "tenants.json"
+    path.write_text(json.dumps(_TENANTS))
+    gate = QoSGate(str(path), reload_interval_s=0.0)
+    acme = gate.resolve("Bearer sk-acme")
+    # burst = 2 req/s * 1 s: two immediate admits, the third 429s.
+    assert gate.admit(acme, {}).admitted
+    r2 = gate.admit(acme, {})
+    assert r2.admitted
+    assert r2.headers["x-ratelimit-remaining-requests"] == "0"
+    r3 = gate.admit(acme, {})
+    assert not r3.admitted and r3.reason == "requests"
+    assert r3.retry_after > 0
+    assert r3.headers["x-ratelimit-reset-requests"].endswith("s")
+    # X-Priority header overrides the tenant default class.
+    assert gate.request_priority(acme, None) == "interactive"
+    assert gate.request_priority(acme, "batch") == "batch"
+    assert gate.request_priority(acme, "bogus") == "interactive"
+    # Hot reload: rewrite the file, force mtime change, pick up new spec.
+    data = dict(_TENANTS)
+    data["tenants"] = [dict(_TENANTS["tenants"][0], name="acme2")]
+    path.write_text(json.dumps(data))
+    os.utime(path, (1, 1))
+    assert gate.maybe_reload(force=True)
+    assert gate.resolve("Bearer sk-acme").name == "acme2"
+    # A broken rewrite keeps the previous config.
+    path.write_text("{not json")
+    os.utime(path, (2, 2))
+    assert not gate.maybe_reload(force=True)
+    assert gate.resolve("Bearer sk-acme").name == "acme2"
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: priority admission + preemption victims
+# ---------------------------------------------------------------------------
+
+
+def test_parse_priority_and_label():
+    assert parse_priority(None) == 0
+    assert parse_priority("interactive") == 0
+    assert parse_priority("batch") == 1
+    assert parse_priority(" Batch ") == 1
+    assert parse_priority("junk") == 0
+    assert priority_label(0) == "interactive"
+    assert priority_label(1) == "batch"
+    assert priority_class("BATCH") == "batch"
+    assert priority_class(None) == "interactive"
+
+
+def _mk_req(rid, n_prompt, priority=0, arrival=None):
+    req = EngineRequest(
+        request_id=rid,
+        prompt_token_ids=list(range(1, n_prompt + 1)),
+        sampling=SamplingParams(max_tokens=4, temperature=0.0),
+        on_token=lambda token, finish: None,
+        priority=priority,
+    )
+    if arrival is not None:
+        req.arrival_time = arrival
+    return req
+
+
+def test_waiting_queue_admits_by_priority_then_arrival():
+    kv = KVCacheManager(64, 4, enable_prefix_caching=False)
+    sched = Scheduler(kv, max_num_seqs=4, max_model_len=512)
+    b1 = _mk_req("b1", 8, priority=1, arrival=1.0)
+    b2 = _mk_req("b2", 8, priority=1, arrival=2.0)
+    i1 = _mk_req("i1", 8, priority=0, arrival=3.0)
+    for r in (b1, b2, i1):
+        sched.add(r)
+    # The interactive arrival jumps the queued batch requests...
+    assert sched.peek_waiting() is i1
+    sched._pop_waiting(i1)
+    # ...and batch requests drain in arrival order afterwards.
+    assert sched.peek_waiting() is b1
+    sched._pop_waiting(b1)
+    assert sched.peek_waiting() is b2
+
+
+def test_default_priority_keeps_fifo_order():
+    kv = KVCacheManager(64, 4, enable_prefix_caching=False)
+    sched = Scheduler(kv, max_num_seqs=4, max_model_len=512)
+    reqs = [_mk_req(f"r{i}", 8, arrival=float(i)) for i in range(3)]
+    for r in reqs:
+        sched.add(r)
+    for r in reqs:
+        assert sched.peek_waiting() is r
+        sched._pop_waiting(r)
+
+
+def test_preempt_victim_prefers_batch_over_older_interactive():
+    kv = KVCacheManager(64, 4, enable_prefix_caching=False)
+    sched = Scheduler(kv, max_num_seqs=4, max_model_len=512)
+    # Batch request is OLDER: priority still dominates arrival time.
+    batch = _mk_req("batch", 8, priority=1, arrival=1.0)
+    inter = _mk_req("inter", 8, priority=0, arrival=2.0)
+    for req, slot in ((batch, 0), (inter, 1)):
+        sched.add(req)
+        sched._pop_waiting(req)
+        kv.allocate_prompt(req.request_id, req.all_token_ids)
+        sched.start_running(req, slot)
+    seq = sched.preempt_victim()
+    assert seq is not None and seq.req is batch
+    assert batch.status is RequestStatus.PREEMPTED
+    assert sched.preempted_by_priority == {"interactive": 0, "batch": 1}
+    # Among equals, youngest-first (the pre-QoS rule) still holds.
+    seq2 = sched.preempt_victim()
+    assert seq2.req is inter
+    assert sched.preempted_by_priority["interactive"] == 1
+
+
+def test_preempt_victim_mid_chunked_prefill_batch():
+    """A batch request mid-chunked-prefill is the victim even while an
+    interactive request is decoding, and resumes from token 0."""
+    kv = KVCacheManager(64, 4, enable_prefix_caching=False)
+    sched = Scheduler(kv, max_num_seqs=4, max_model_len=512,
+                      chunked_prefill=True, chunk_tokens=16,
+                      token_budget=16, max_consecutive_prefills=2)
+    inter = _mk_req("inter", 8, priority=0, arrival=1.0)
+    sched.add(inter)
+    action, plan = sched.next_action()
+    assert action == "prefill_step"
+    kv.allocate_prompt("inter", inter.all_token_ids)
+    inter.num_computed_tokens = 8
+    sched.prefilling.remove(inter)
+    sched.start_running(inter, sched._free_slot())
+    batch = _mk_req("batch", 64, priority=1, arrival=2.0)
+    sched.add(batch)
+    while batch.num_computed_tokens < 32:
+        action, plan = sched.next_action()
+        if action != "prefill_step":
+            continue
+        for pc in plan:
+            if pc.start == 0:
+                kv.allocate_prompt(pc.req.request_id,
+                                   pc.req.all_token_ids, limit=pc.end)
+            else:
+                kv.extend_tokens(pc.req.request_id,
+                                 pc.req.all_token_ids, pc.end)
+            pc.req.num_computed_tokens = pc.end
+    free_before = kv.allocator.num_free
+    seq = sched.preempt_victim()
+    assert seq.req is batch and seq.slot == -1
+    assert batch.num_computed_tokens == 0
+    assert kv.allocator.num_free > free_before
+    assert sched.preempted_by_priority["batch"] == 1
+    # Requeued at the head of its class; interactive still outranks it.
+    assert sched.peek_waiting() is batch
+    sched.add(_mk_req("i2", 8, priority=0, arrival=3.0))
+    assert sched.peek_waiting().request_id == "i2"
+
+
+# ---------------------------------------------------------------------------
+# Router end-to-end (fake engines)
+# ---------------------------------------------------------------------------
+
+
+def _args(**overrides) -> argparse.Namespace:
+    from production_stack_tpu.router.parser import build_parser
+
+    args = build_parser().parse_args([])
+    for k, v in overrides.items():
+        setattr(args, k, v)
+    return args
+
+
+async def _start(app: web.Application):
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    def _reset():
+        for cls in (
+            rl.RoundRobinRouter, rl.SessionRouter, rl.PrefixAwareRouter,
+            rl.KvawareRouter, rl.DisaggregatedPrefillRouter,
+        ):
+            SingletonABCMeta._reset_instance(cls)
+        SingletonMeta._reset_instance(RequestStatsMonitor)
+        SingletonMeta._reset_instance(EngineStatsScraper)
+
+    _reset()
+    yield
+    _reset()
+
+
+async def _qos_router(tmp_path, tenants=None, engine_kwargs=None,
+                      **argover):
+    tenants_file = None
+    if tenants is not None:
+        tenants_file = str(tmp_path / "tenants.json")
+        with open(tenants_file, "w") as f:
+            json.dump(tenants, f)
+    engine = FakeEngine(model="test-model", **(engine_kwargs or {}))
+    eng_runner, eng_url = await _start(engine.make_app())
+    args = _args(
+        static_backends=eng_url,
+        static_models="test-model",
+        engine_stats_interval=60,
+        qos_tenants_file=tenants_file,
+        **argover,
+    )
+    app = build_app(args)
+    router_runner, router_url = await _start(app)
+    return engine, app, router_url, [eng_runner, router_runner]
+
+
+async def _cleanup(runners):
+    for r in reversed(runners):
+        await r.cleanup()
+
+
+def _chat(max_tokens=2):
+    return {"model": "test-model", "max_tokens": max_tokens,
+            "messages": [{"role": "user", "content": "hi"}]}
+
+
+async def test_router_429_with_ratelimit_headers(tmp_path):
+    tenants = {"tenants": [
+        {"name": "acme", "api_keys": ["sk-acme"], "weight": 1,
+         "priority": "interactive", "requests_per_second": 1,
+         "burst_seconds": 1.0}]}
+    engine, app, url, runners = await _qos_router(tmp_path, tenants)
+    try:
+        hdrs = {"Authorization": "Bearer sk-acme"}
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{url}/v1/chat/completions",
+                              json=_chat(), headers=hdrs) as resp:
+                assert resp.status == 200
+                assert resp.headers["x-tenant"] == "acme"
+                assert "x-ratelimit-remaining-requests" in resp.headers
+            async with s.post(f"{url}/v1/chat/completions",
+                              json=_chat(), headers=hdrs) as resp:
+                assert resp.status == 429
+                body = await resp.json()
+                assert body["error"]["type"] == "RateLimitError"
+                assert int(resp.headers["Retry-After"]) >= 1
+                assert resp.headers[
+                    "x-ratelimit-remaining-requests"] == "0"
+            # Another tenant (default) is not rate limited.
+            async with s.post(f"{url}/v1/chat/completions",
+                              json=_chat()) as resp:
+                assert resp.status == 200
+                assert resp.headers["x-tenant"] == "default"
+            await asyncio.sleep(0)
+            async with s.get(f"{url}/metrics") as resp:
+                text = await resp.text()
+        assert 'vllm_router:tenant_admitted_total{tenant="acme"} 1.0' in text
+        assert ('vllm_router:tenant_rejected_total'
+                '{reason="requests",tenant="acme"} 1.0') in text
+    finally:
+        await _cleanup(runners)
+
+
+async def test_router_sheds_batch_under_concurrency(tmp_path):
+    tenants = {
+        "tenants": [{"name": "crawler", "api_keys": ["sk-c"],
+                     "weight": 1, "priority": "batch"}],
+        "max_concurrency": 1, "shed_queue_depth": 1,
+    }
+    engine, app, url, runners = await _qos_router(
+        tmp_path, tenants, engine_kwargs={"ttft": 0.4})
+    try:
+        hdrs = {"Authorization": "Bearer sk-c"}
+
+        async def one(s):
+            async with s.post(f"{url}/v1/chat/completions",
+                              json=_chat(), headers=hdrs) as resp:
+                await resp.read()
+                return resp.status, dict(resp.headers)
+
+        async with aiohttp.ClientSession() as s:
+            # Stagger so arrival order is deterministic: 1 in flight,
+            # 1 queued, the third is shed with 503 + Retry-After.
+            t1 = asyncio.ensure_future(one(s))
+            await asyncio.sleep(0.1)
+            t2 = asyncio.ensure_future(one(s))
+            await asyncio.sleep(0.1)
+            t3 = asyncio.ensure_future(one(s))
+            results = await asyncio.gather(t1, t2, t3)
+            statuses = [r[0] for r in results]
+            assert statuses.count(200) == 2
+            assert statuses.count(503) == 1
+            shed_headers = results[statuses.index(503)][1]
+            assert int(shed_headers["Retry-After"]) >= 1
+            async with s.get(f"{url}/metrics") as resp:
+                text = await resp.text()
+        assert ('vllm_router:tenant_shed_total{tenant="crawler"} 1.0'
+                in text)
+        assert engine.priority_requests["batch"] == 2
+        assert engine.tenant_requests == {"crawler": 2}
+    finally:
+        await _cleanup(runners)
+
+
+async def test_priority_header_overrides_tenant_class(tmp_path):
+    tenants = {"tenants": [
+        {"name": "acme", "api_keys": ["sk-acme"], "weight": 1,
+         "priority": "interactive"}]}
+    engine, app, url, runners = await _qos_router(tmp_path, tenants)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{url}/v1/chat/completions", json=_chat(),
+                headers={"Authorization": "Bearer sk-acme",
+                         "X-Priority": "batch"}) as resp:
+                assert resp.status == 200
+        assert engine.priority_requests == {"interactive": 0, "batch": 1}
+        assert engine.tenant_requests == {"acme": 1}
+    finally:
+        await _cleanup(runners)
+
+
+async def test_no_tenants_file_leaves_request_path_untouched(tmp_path):
+    """Flag-off parity: state.qos is None, responses carry no qos
+    headers, and the engine sees no X-Priority / X-Tenant."""
+    engine, app, url, runners = await _qos_router(tmp_path, tenants=None)
+    try:
+        assert app["state"].qos is None
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{url}/v1/chat/completions",
+                              json=_chat(max_tokens=3)) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+                for h in resp.headers:
+                    assert not h.lower().startswith("x-ratelimit")
+                assert "x-tenant" not in resp.headers
+        # Priority defaulted from the ABSENCE of the header, and no
+        # tenant header reached the engine.
+        assert engine.priority_requests == {"interactive": 1, "batch": 0}
+        assert engine.tenant_requests == {}
+        assert "Hello" in body["choices"][0]["message"]["content"]
+
+        # Same request through a QoS-enabled router: identical body.
+        tenants = {"tenants": [{"name": "acme", "api_keys": ["sk-acme"]}]}
+        self_runners = []
+        try:
+            for cls in (rl.RoundRobinRouter,):
+                SingletonABCMeta._reset_instance(cls)
+            SingletonMeta._reset_instance(RequestStatsMonitor)
+            SingletonMeta._reset_instance(EngineStatsScraper)
+            engine2, app2, url2, self_runners = await _qos_router(
+                tmp_path, tenants)
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"{url2}/v1/chat/completions",
+                    json=_chat(max_tokens=3),
+                    headers={"Authorization": "Bearer sk-acme"}) as resp:
+                    assert resp.status == 200
+                    body2 = await resp.json()
+            assert body2["choices"] == body["choices"]
+        finally:
+            await _cleanup(self_runners)
+    finally:
+        await _cleanup(runners)
+
+
+async def test_health_reports_qos_state(tmp_path):
+    tenants = {"tenants": [{"name": "acme", "api_keys": ["sk-acme"]}],
+               "max_concurrency": 7}
+    engine, app, url, runners = await _qos_router(tmp_path, tenants)
+    try:
+        qos = app["state"].qos
+        assert qos is not None
+        health = qos.health()
+        assert health["tenants"] == ["acme", "default"]
+        assert health["max_concurrency"] == 7
+        assert health["inflight"] == 0
+    finally:
+        await _cleanup(runners)
