@@ -68,12 +68,16 @@ class QueueLease:
 
 
 class _Waiter:
-    __slots__ = ("fut", "cost", "cancelled")
+    # A waiter is dead as soon as `fut` is cancelled: Task.cancel() on the
+    # task awaiting acquire() cancels the future *synchronously*, while the
+    # task's except-branch cleanup only runs at its next scheduling.  Any
+    # _pump() in that window must therefore judge liveness by the future
+    # itself, never by a flag set from the cleanup path.
+    __slots__ = ("fut", "cost")
 
     def __init__(self, cost: float):
         self.fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self.cost = cost
-        self.cancelled = False
 
 
 class _TenantQ:
@@ -118,9 +122,10 @@ class FairDispatchQueue:
             return self._inflight_interactive < self.max_concurrency
         return self._inflight_total < self.max_concurrency
 
-    def _purge_head(self, tq: _TenantQ) -> None:
-        while tq.waiters and tq.waiters[0].cancelled:
+    def _purge_head(self, priority: str, tq: _TenantQ) -> None:
+        while tq.waiters and tq.waiters[0].fut.cancelled():
             tq.waiters.popleft()
+            self._queued[priority] -= 1
 
     def _pick(self, priority: str) -> Optional[_Waiter]:
         """DRR-select the next waiter of a class, or None if class idle."""
@@ -130,7 +135,7 @@ class FairDispatchQueue:
         while rr:
             name = rr[0]
             tq = queues[name]
-            self._purge_head(tq)
+            self._purge_head(priority, tq)
             if not tq.waiters:
                 rr.popleft()
                 del queues[name]
@@ -156,14 +161,16 @@ class FairDispatchQueue:
                     continue
                 waiter = self._pick(priority)
                 if waiter is None:  # only cancelled entries were queued
-                    self._queued[priority] = 0
                     continue
                 self._queued[priority] -= 1
                 self._inflight_total += 1
                 if priority == PRIORITY_INTERACTIVE:
                     self._inflight_interactive += 1
-                if not waiter.fut.done():
-                    waiter.fut.set_result(None)
+                # _purge_head() guarantees a picked waiter is live, and no
+                # await separates the pick from here — set unconditionally
+                # so an accounting bug surfaces as InvalidStateError instead
+                # of a silently leaked slot.
+                waiter.fut.set_result(None)
                 dispatched = True
                 break  # re-evaluate interactive first
             if not dispatched:
@@ -196,8 +203,15 @@ class FairDispatchQueue:
                 # observed the slot — hand the slot straight back.
                 self._release(priority)
             else:
-                waiter.cancelled = True
-                self._queued[priority] -= 1
+                # Not dispatched.  A _pump() run between Task.cancel() and
+                # this cleanup may already have purged the waiter (and its
+                # _queued count), so only correct the books if it is still
+                # enqueued.  Re-look up the tenant queue: the one we
+                # appended to may have drained and been rebuilt since.
+                tq_now = self._queues[priority].get(tenant)
+                if tq_now is not None and waiter in tq_now.waiters:
+                    tq_now.waiters.remove(waiter)
+                    self._queued[priority] -= 1
             raise
         return QueueLease(self, priority, time.monotonic() - t0)
 

@@ -16,7 +16,8 @@ import os
 import time
 from typing import Dict, Optional, Tuple
 
-from .fair_queue import FairDispatchQueue, QueueLease, priority_class
+from .fair_queue import (PRIORITY_CLASS_NUM, FairDispatchQueue, QueueLease,
+                         priority_class)
 from .tenants import TenantRegistry, TenantSpec
 from .token_bucket import TokenBucket
 
@@ -157,8 +158,20 @@ class QoSGate:
 
     def request_priority(self, spec: TenantSpec,
                          header_value: Optional[str]) -> str:
-        """Per-request X-Priority header overrides the tenant default."""
-        return priority_class(header_value, default=spec.priority)
+        """Per-request class: X-Priority may downgrade the tenant default.
+
+        An upgrade (batch tenant requesting interactive — a lower class
+        number) is ignored unless the tenant is configured with
+        `allow_priority_upgrade`; honoring it unconditionally would let a
+        noisy batch tenant stamp every request interactive and bypass the
+        shedding / slot-yielding / preemption ordering this gate exists
+        to enforce.
+        """
+        requested = priority_class(header_value, default=spec.priority)
+        if (PRIORITY_CLASS_NUM[requested] < PRIORITY_CLASS_NUM[spec.priority]
+                and not spec.allow_priority_upgrade):
+            return spec.priority
+        return requested
 
     def _state(self, spec: TenantSpec) -> _TenantState:
         st = self._states.get(spec.name)

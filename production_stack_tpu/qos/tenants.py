@@ -9,6 +9,7 @@ tenants:
     api_keys: ["sk-acme-prod", "sk-acme-staging"]
     weight: 4                  # weighted-fair-queue share (DRR quantum)
     priority: interactive      # default class: interactive | batch
+    allow_priority_upgrade: false  # X-Priority may only downgrade unless true
     requests_per_second: 10    # 0 / absent = unlimited
     tokens_per_second: 4000    # estimated prompt+completion tokens
     burst_seconds: 2.0         # bucket capacity = rate * burst_seconds
@@ -40,10 +41,15 @@ class TenantSpec:
     name: str
     api_keys: tuple = ()
     weight: float = 1.0
-    priority: str = "interactive"  # default class; X-Priority may override
+    priority: str = "interactive"  # default class; X-Priority may downgrade
     requests_per_second: float = 0.0  # 0 = unlimited
     tokens_per_second: float = 0.0  # 0 = unlimited
     burst_seconds: float = 2.0
+    # Honor an X-Priority header that is MORE privileged than `priority`
+    # (batch tenant asking for interactive).  Off by default: otherwise a
+    # batch-classed tenant could set the header on every request and walk
+    # around shedding, slot yielding, and preemption ordering.
+    allow_priority_upgrade: bool = False
 
     @classmethod
     def from_dict(cls, raw: dict) -> "TenantSpec":
@@ -69,6 +75,8 @@ class TenantSpec:
             requests_per_second=float(raw.get("requests_per_second", 0.0)),
             tokens_per_second=float(raw.get("tokens_per_second", 0.0)),
             burst_seconds=max(float(raw.get("burst_seconds", 2.0)), 0.1),
+            allow_priority_upgrade=bool(raw.get("allow_priority_upgrade",
+                                                False)),
         )
 
 

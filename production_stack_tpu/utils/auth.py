@@ -37,7 +37,11 @@ def resolve_api_keys(explicit: Optional[str] = None) -> Tuple[str, ...]:
     vLLM-compatible env vars, or a keyfile (`VLLM_API_KEY_FILE` /
     `TPU_STACK_API_KEY_FILE`, one key per line, `#` comments).  Flag and
     env values may hold several comma-separated keys; every key opens
-    the same gated surface (rotation windows, per-team keys)."""
+    the same gated surface (rotation windows, per-team keys).
+
+    A configured-but-unreadable keyfile raises instead of returning no
+    keys: returning () would silently disable the bearer gate on every
+    gated endpoint (fail open) over a typo or missing mount."""
     raw = (explicit or os.environ.get("VLLM_API_KEY")
            or os.environ.get("TPU_STACK_API_KEY") or None)
     if raw:
@@ -48,9 +52,11 @@ def resolve_api_keys(explicit: Optional[str] = None) -> Tuple[str, ...]:
         try:
             with open(keyfile, encoding="utf-8") as f:
                 lines = [ln.strip() for ln in f]
-            return tuple(ln for ln in lines if ln and not ln.startswith("#"))
-        except OSError:
-            return ()
+        except OSError as e:
+            raise RuntimeError(
+                f"API keyfile {keyfile!r} is configured but unreadable "
+                f"({e}); refusing to start with auth disabled") from e
+        return tuple(ln for ln in lines if ln and not ln.startswith("#"))
     return ()
 
 

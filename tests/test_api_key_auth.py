@@ -5,6 +5,8 @@ forwarding lets one shared deployment key authenticate end to end."""
 
 import asyncio
 
+import pytest
+
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.server import EngineServer, run_engine_server
 
@@ -148,6 +150,13 @@ def test_multi_key_resolution_and_constant_time_check(tmp_path,
     keyfile.write_text("# rotation window\nsk-old\n\nsk-new\n")
     monkeypatch.setenv("VLLM_API_KEY_FILE", str(keyfile))
     assert auth.resolve_api_keys() == ("sk-old", "sk-new")
+
+    # A configured-but-unreadable keyfile fails closed (refuses startup)
+    # instead of silently disabling the bearer gate.
+    monkeypatch.setenv("VLLM_API_KEY_FILE", str(tmp_path / "missing.txt"))
+    with pytest.raises(RuntimeError, match="unreadable"):
+        auth.resolve_api_keys()
+    monkeypatch.setenv("VLLM_API_KEY_FILE", str(keyfile))
 
     keys = ("sk-old", "sk-new")
     assert auth.check_bearer("Bearer sk-old", keys)

@@ -157,6 +157,28 @@ async def test_cancelled_waiter_releases_cleanly():
     (await asyncio.wait_for(q.acquire("a", priority="batch"), 1)).release()
 
 
+async def test_cancel_vs_pump_race_does_not_leak_slots():
+    """Task.cancel() marks the waiter's future cancelled immediately, but
+    acquire()'s cleanup only runs when the cancelled task is next
+    scheduled.  A lease release in that window runs _pump(), which must
+    skip the dead waiter without consuming a dispatch slot — repeated
+    client disconnects used to leak max_concurrency slots this way."""
+    q = FairDispatchQueue(max_concurrency=1)
+    for _ in range(3):  # a leak compounds; three rounds would deadlock
+        lease = await asyncio.wait_for(
+            q.acquire("a", priority="batch"), 1)
+        waiter = asyncio.ensure_future(q.acquire("a", priority="batch"))
+        await asyncio.sleep(0)  # waiter is enqueued
+        waiter.cancel()   # fut cancelled synchronously...
+        lease.release()   # ...and _pump() runs before acquire()'s cleanup
+        with pytest.raises(asyncio.CancelledError):
+            await waiter
+        assert q.inflight == 0
+        assert q.queued() == 0
+    (await asyncio.wait_for(q.acquire("a", priority="batch"), 1)).release()
+    assert q.inflight == 0
+
+
 # ---------------------------------------------------------------------------
 # Tenant registry + gate
 # ---------------------------------------------------------------------------
@@ -197,6 +219,30 @@ def test_registry_rejects_bad_config():
             {"tenants": [{"name": "x"}, {"name": "x"}]})
 
 
+def test_request_priority_upgrade_gated(tmp_path):
+    """X-Priority only downgrades: a batch-classed tenant cannot stamp
+    its flood `interactive` to bypass shedding / slot yielding /
+    preemption ordering, unless allow_priority_upgrade is set."""
+    path = tmp_path / "tenants.json"
+    data = dict(_TENANTS)
+    data["tenants"] = list(_TENANTS["tenants"]) + [
+        {"name": "bulk-vip", "api_keys": ["sk-vip"], "priority": "batch",
+         "allow_priority_upgrade": True}]
+    path.write_text(json.dumps(data))
+    gate = QoSGate(str(path))
+    crawler = gate.resolve("Bearer sk-c1")
+    assert crawler.priority == "batch"
+    assert gate.request_priority(crawler, None) == "batch"
+    assert gate.request_priority(crawler, "interactive") == "batch"
+    # Opt-in flag restores the upgrade path for trusted tenants.
+    vip = gate.resolve("Bearer sk-vip")
+    assert gate.request_priority(vip, None) == "batch"
+    assert gate.request_priority(vip, "interactive") == "interactive"
+    # Downgrades stay honored either way.
+    acme = gate.resolve("Bearer sk-acme")
+    assert gate.request_priority(acme, "batch") == "batch"
+
+
 def test_estimate_tokens_scales_with_request():
     small = estimate_tokens({"messages": [
         {"role": "user", "content": "hi"}], "max_tokens": 5})
@@ -221,7 +267,7 @@ def test_gate_admit_429_headers_and_hot_reload(tmp_path):
     assert not r3.admitted and r3.reason == "requests"
     assert r3.retry_after > 0
     assert r3.headers["x-ratelimit-reset-requests"].endswith("s")
-    # X-Priority header overrides the tenant default class.
+    # X-Priority header may downgrade the tenant default class.
     assert gate.request_priority(acme, None) == "interactive"
     assert gate.request_priority(acme, "batch") == "batch"
     assert gate.request_priority(acme, "bogus") == "interactive"
