@@ -30,6 +30,7 @@ import json
 import os
 import random
 import statistics
+import sys
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
@@ -164,6 +165,12 @@ SPEC = _env_int("BENCH_SPEC", int(_cfg.get("spec", 0)))
 REPETITIVE = _env_int("BENCH_REPETITIVE", 0)
 SPEC_AB = _env_int("BENCH_SPEC_AB", 0)
 SPEC_OUT = os.environ.get("BENCH_SPEC_OUT", "BENCH_SPEC.json")
+# Int8 KV cache A/B: BENCH_KV_QUANT=1 runs the whole bench twice —
+# --kv-cache-dtype bf16, then int8 — and writes BENCH_KV_QUANT_OUT
+# (default BENCH_KV_QUANT.json) with tok/s, decode time, KV bytes per
+# token, and pool capacity (blocks) for both legs.
+KV_QUANT = _env_int("BENCH_KV_QUANT", 0)
+KV_QUANT_OUT = os.environ.get("BENCH_KV_QUANT_OUT", "BENCH_KV_QUANT.json")
 # Multi-tenant QoS noisy-neighbor A/B: BENCH_QOS=1 runs the hermetic
 # two-tenant harness (production_stack_tpu/testing/qos_ab.py — fake
 # contention engine, no TPU, no jax import) in three legs: unloaded,
@@ -408,7 +415,8 @@ async def _drive(router_url: str):
             rounds_done, prompt_tokens_sent, max_itgs, storm_done[0])
 
 
-async def _main(spec_tokens: int = SPEC) -> dict:
+async def _main(spec_tokens: int = SPEC,
+                kv_cache_dtype: str = "bf16") -> dict:
     from production_stack_tpu.engine.config import EngineConfig
     from production_stack_tpu.engine.server import (
         EngineServer,
@@ -444,6 +452,7 @@ async def _main(spec_tokens: int = SPEC) -> dict:
         enable_chunked_prefill=bool(CHUNKED),
         max_num_batched_tokens=MAX_NUM_BATCHED_TOKENS,
         speculative_num_tokens=spec_tokens,
+        kv_cache_dtype=kv_cache_dtype,
     )
     servers = [EngineServer(config, warmup=True) for _ in range(n_engines)]
     runners, engine_urls = [], []
@@ -599,8 +608,38 @@ async def _main(spec_tokens: int = SPEC) -> dict:
             if core_stats.get("spec_proposed_tokens_total") else None),
         "engine_spec_disabled": core_stats.get(
             "spec_disabled_requests_total", 0),
+        # Int8 KV cache A/B surface: per-token KV storage cost and the
+        # pool size that bought (engine_num_blocks above).
+        "kv_cache_dtype": kv_cache_dtype,
+        "engine_kv_bytes_per_token": core_stats.get(
+            "kv_cache_bytes_per_token", 0),
         "backend": None,  # filled below
     }
+    return result
+
+
+def _run_scenario(factory, name: str, partial_out=None, partials=None):
+    """Run one bench scenario (an async ``_main`` leg), retrying ONCE
+    with backoff on transient connection errors (local socket hiccups /
+    slow engine startup on shared dev hosts). When ``partials`` is given,
+    the completed leg is flushed to ``partial_out`` immediately so a
+    crash later in an A/B still leaves the finished legs on disk."""
+    import aiohttp
+
+    transient = (aiohttp.ClientConnectionError, ConnectionError,
+                 OSError, asyncio.TimeoutError)
+    try:
+        result = asyncio.run(factory())
+    except transient as e:
+        print(f"scenario {name}: transient {type(e).__name__}: {e}; "
+              f"retrying once after backoff", file=sys.stderr)
+        time.sleep(10)
+        result = asyncio.run(factory())
+    if partials is not None and partial_out is not None:
+        partials[name] = result
+        with open(os.path.join(REPO, partial_out), "w") as f:
+            json.dump({"partial": True, "scenarios": partials}, f, indent=2)
+            f.write("\n")
     return result
 
 
@@ -669,8 +708,11 @@ def main() -> None:
             # BENCH_REPETITIVE=1 for the prompt-lookup best case). Both
             # legs run in this process back to back; the JSON artifact
             # carries both so the speedup is attributable.
-            off = asyncio.run(_main(0))
-            on = asyncio.run(_main(SPEC or 4))
+            partials = {}
+            off = _run_scenario(lambda: _main(0), "spec_off",
+                                SPEC_OUT, partials)
+            on = _run_scenario(lambda: _main(SPEC or 4), "spec_on",
+                               SPEC_OUT, partials)
             for leg in (off, on):
                 leg["backend"] = jax.devices()[0].platform
             result = {
@@ -696,7 +738,49 @@ def main() -> None:
                 f.write("\n")
             print(json.dumps(result))
             return
-        result = asyncio.run(_main())
+        if KV_QUANT:
+            # Int8 KV cache A/B: same workload, bf16 pages vs int8
+            # pages + per-token scales. Token-level greedy agreement is
+            # covered by tests/test_kv_quant.py; the A/B surfaces
+            # throughput, decode time, per-token KV bytes, and the
+            # capacity win (blocks at equal HBM budget when the pool is
+            # auto-sized).
+            partials = {}
+            bf16 = _run_scenario(lambda: _main(SPEC, "bf16"), "kv_bf16",
+                                 KV_QUANT_OUT, partials)
+            int8 = _run_scenario(lambda: _main(SPEC, "int8"), "kv_int8",
+                                 KV_QUANT_OUT, partials)
+            for leg in (bf16, int8):
+                leg["backend"] = jax.devices()[0].platform
+            result = {
+                "metric": f"kv_quant_ab({MODEL})",
+                "value": int8["value"],
+                "unit": "tok/s",
+                "vs_baseline": (
+                    round(int8["value"] / bf16["value"], 3)
+                    if bf16["value"] else None),
+                "config": CONFIG_KEY,
+                "bf16_tok_s": bf16["value"],
+                "int8_tok_s": int8["value"],
+                "bf16_kv_bytes_per_token":
+                    bf16["engine_kv_bytes_per_token"],
+                "int8_kv_bytes_per_token":
+                    int8["engine_kv_bytes_per_token"],
+                "bf16_num_blocks": bf16["engine_num_blocks"],
+                "int8_num_blocks": int8["engine_num_blocks"],
+                "bf16_decode_s": bf16["engine_decode_s"],
+                "int8_decode_s": int8["engine_decode_s"],
+                "bf16_p50_ttft_s": bf16["p50_ttft_s"],
+                "int8_p50_ttft_s": int8["p50_ttft_s"],
+                "kv_bf16": bf16,
+                "kv_int8": int8,
+            }
+            with open(os.path.join(REPO, KV_QUANT_OUT), "w") as f:
+                json.dump(result, f, indent=2)
+                f.write("\n")
+            print(json.dumps(result))
+            return
+        result = _run_scenario(lambda: _main(), "single")
     except Exception as e:  # noqa: BLE001
         # The tunneled dev runtime leaks residual HBM across processes:
         # configs near the ceiling (llama8b: weights+pool ~13 GB of a

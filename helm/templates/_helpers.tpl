@@ -90,6 +90,10 @@
 - --quantization
 - {{ .model.quantization | quote }}
 {{- end }}
+{{- if .model.kvCacheDtype }}
+- --kv-cache-dtype
+- {{ .model.kvCacheDtype | quote }}
+{{- end }}
 {{- if .model.chatTemplate }}
 - --chat-template
 - /templates/chat-template.jinja

@@ -323,6 +323,21 @@ def build_dashboard():
         desc="1 = engine sleeping (weights offloaded), excluded from "
              "routing"))
     y += 7
+    panels.append(panel(
+        "timeseries", "KV cache bytes per token",
+        [target("tpu:kv_cache_bytes_per_token",
+                legend="{{instance}} ({{kv_cache_dtype}})")],
+        grid(7, 8, 0, y), unit="bytes",
+        desc="HBM cost of one KV token slot (--kv-cache-dtype: int8 "
+             "stores quantized pages + per-token scales, roughly "
+             "halving this vs bf16)"))
+    panels.append(panel(
+        "timeseries", "KV pool size per engine",
+        [target("tpu:num_kv_blocks", legend="{{instance}}")],
+        grid(7, 8, 8, y),
+        desc="Paged-KV pool size in blocks — int8 KV cache roughly "
+             "doubles this at equal HBM budget"))
+    y += 7
 
     # ---- Row 8: Tenants & QoS (multi-tenant admission + fair queue) ----- #
     panels.append(row("Tenants & QoS", y)); y += 1

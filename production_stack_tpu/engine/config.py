@@ -114,12 +114,22 @@ class EngineConfig:
     # output quality for ~1 GB of savings on an 8 B model; turn on when
     # HBM is the binding constraint.
     quantize_embeddings: bool = False
+    # KV-cache storage dtype: "int8" stores K/V pages as int8 plus a
+    # per-slot, per-kv-head float32 scale (symmetric amax/127) — decode's
+    # KV HBM read halves and the same HBM budget holds ~2x the blocks.
+    # "bf16" (default) keeps the request path byte-identical to before
+    # the flag existed.
+    kv_cache_dtype: str = "bf16"
 
     def __post_init__(self):
         if self.quantization not in (None, "int8"):
             raise ValueError(
                 f"unsupported quantization {self.quantization!r} "
                 f"(supported: int8)")
+        if self.kv_cache_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"unsupported kv_cache_dtype {self.kv_cache_dtype!r} "
+                f"(supported: bf16, int8)")
         if self.speculative_num_tokens < 0:
             raise ValueError("speculative_num_tokens must be >= 0")
         if self.speculative_num_tokens == 1:

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import gc
 import os
 import threading
 import time
@@ -46,6 +47,8 @@ from production_stack_tpu.parallel.mesh import build_mesh
 from production_stack_tpu.parallel.sharding import (
     kv_block_sharding,
     kv_pages_sharding,
+    kv_scale_block_sharding,
+    kv_scale_sharding,
     param_shardings,
 )
 from production_stack_tpu.utils.log import init_logger
@@ -61,6 +64,115 @@ class _StagedParam:
     shape: tuple
     sharding: object
     dtype: object
+
+
+def kv_bytes_per_block(model_config, block_size: int,
+                       kv_cache_dtype: str = "bf16") -> int:
+    """Per-block HBM bytes INCLUDING XLA's tile padding. When head_dim
+    is lane-aligned (multiple of 128) the trailing (KVH, D) dims flatten
+    onto the lanes and occupy exactly their unpadded size (llama-family:
+    8x128). Otherwise the minor dim pads to 128 and the kv-head dim to
+    the sublane granularity — e.g. OPT's (12, 64) stores as (16, 128), a
+    2.7x expansion that OOMed compile when the pool was sized from
+    unpadded bytes.
+
+    ``int8`` stores one byte per K/V element (sublane granularity 32
+    when head_dim needs lane padding) plus the per-slot per-kv-head f32
+    scale rows, whose flat [bs*KVH] minor dim pads to the 128-lane tile
+    — ~1.94x the blocks of bf16 at an equal HBM budget for llama-family
+    shapes."""
+    mc = model_config
+    kvh, d = mc.num_kv_heads, mc.head_dim
+    if kv_cache_dtype == "int8":
+        if d % 128 != 0:
+            d = -(-d // 128) * 128
+            kvh = -(-kvh // 32) * 32
+        scale_lanes = -(-(block_size * mc.num_kv_heads) // 128) * 128
+        return mc.num_layers * (
+            2 * block_size * kvh * d + 2 * scale_lanes * 4)
+    itemsize = jnp.dtype(mc.dtype).itemsize
+    if d % 128 != 0:
+        d = -(-d // 128) * 128
+        sublane = 16 if itemsize == 2 else 8
+        kvh = -(-kvh // sublane) * sublane
+    return mc.num_layers * 2 * block_size * kvh * d * itemsize
+
+
+# -- KV pool leaf helpers --------------------------------------------------
+# Each of the pool's k/v leaves is a bare [L, NB, bs, KVH, D] array (bf16)
+# or a (data, scales) tuple (int8; scales [L, NB, bs*KVH] f32 — see
+# ops/attention.quantize_kv). Block payloads mirror that minus the NB axis.
+# These helpers keep every slice/stack/transfer site one code path.
+
+def _kv_set(pages, bid, new):
+    """Scatter one block (scalar bid) or a batch of blocks (bid array)
+    into a pool leaf."""
+    if isinstance(pages, tuple):
+        data, scales = pages
+        nd, ns = new
+        return (data.at[:, bid].set(nd.astype(data.dtype)),
+                scales.at[:, bid].set(ns.astype(scales.dtype)))
+    return pages.at[:, bid].set(new.astype(pages.dtype))
+
+
+def _kv_leaf_index(x, idx):
+    """``x[:, idx]`` over a leaf (the block axis is axis 1 for both the
+    pages and the scale layouts)."""
+    if isinstance(x, tuple):
+        return tuple(e[:, idx] for e in x)
+    return x[:, idx]
+
+
+def _kv_leaf_np(x):
+    if isinstance(x, (tuple, list)):
+        return tuple(np.asarray(e) for e in x)
+    return np.asarray(x)
+
+
+def _kv_leaf_jnp(x):
+    if isinstance(x, (tuple, list)):
+        return tuple(jnp.asarray(e) for e in x)
+    return jnp.asarray(x)
+
+
+def _kv_leaf_get(x):
+    """device_get a leaf to host numpy."""
+    if isinstance(x, tuple):
+        return tuple(np.asarray(jax.device_get(e)) for e in x)
+    return np.asarray(jax.device_get(x))
+
+
+def _kv_leaf_swap01(x):
+    if isinstance(x, tuple):
+        return tuple(e.swapaxes(0, 1) for e in x)
+    return x.swapaxes(0, 1)
+
+
+def _kv_leaf_stack(parts, axis):
+    """np.stack per-block payloads along ``axis`` (tuple-aware)."""
+    if isinstance(parts[0], (tuple, list)):
+        return tuple(
+            np.stack([p[i] for p in parts], axis=axis)
+            for i in range(len(parts[0])))
+    return np.stack(parts, axis=axis)
+
+
+def _flatten_kv_payload(head, k, v):
+    """Op-channel wire order for write_block/write_blocks: int8 tuple
+    payloads ship flattened as [head, kd, ks, vd, vs] (the channel
+    carries flat numpy lists); bf16 stays [head, k, v]."""
+    if isinstance(k, (tuple, list)):
+        return [head, k[0], k[1], v[0], v[1]]
+    return [head, k, v]
+
+
+def _regroup_kv_payload(arrays):
+    """Inverse of :func:`_flatten_kv_payload` (by payload length)."""
+    if len(arrays) == 5:
+        head, kd, ks, vd, vs = arrays
+        return head, (kd, ks), (vd, vs)
+    head, k, v = arrays
+    return head, k, v
 
 
 class EngineCore:
@@ -189,9 +301,24 @@ class EngineCore:
             if self._mh is not None:
                 self._mh.channel.send(
                     ("cfg", {"num_blocks": self.num_blocks}, []))
-        self._kv_sharding = kv_pages_sharding(self.model_config, self.mesh)
-        self._block_sharding = kv_block_sharding(
-            self.model_config, self.mesh)
+        # Per-LEAF shardings: a bare NamedSharding for bf16 pools, a
+        # (pages, scales) tuple for int8 — matching the pool's leaf
+        # structure exactly. The (k, v) pair variant below is spelled
+        # out because with tuple leaves a single sharding is no longer a
+        # broadcastable out_shardings prefix (it would pair the page
+        # spec with the 3-dim scale array).
+        pages_sh = kv_pages_sharding(self.model_config, self.mesh)
+        block_sh = kv_block_sharding(self.model_config, self.mesh)
+        if config.kv_cache_dtype == "int8":
+            self._kv_sharding = (
+                pages_sh, kv_scale_sharding(self.model_config, self.mesh))
+            self._block_sharding = (
+                block_sh,
+                kv_scale_block_sharding(self.model_config, self.mesh))
+        else:
+            self._kv_sharding = pages_sh
+            self._block_sharding = block_sh
+        self._kv_pair_sharding = (self._kv_sharding, self._kv_sharding)
         # HBM headroom left on this device AFTER the pool: exported as
         # tpu:hbm_headroom_bytes so near-OOM deployments (llama8b-int8
         # on 16 GB) are visible before they flip to ResourceExhausted
@@ -212,9 +339,12 @@ class EngineCore:
         self.kv = self._alloc_kv()
         # Replicated block gather (disagg extract): every process runs
         # the same gather; the replicated output is host-readable from
-        # any of them.
+        # any of them. (A bare _repl per (k, v) component is a valid
+        # out_shardings prefix even for int8 tuple leaves — it
+        # broadcasts over the subtree.)
         self._gather_blocks_fn = jax.jit(
-            lambda kv, idx: (kv[0][:, idx], kv[1][:, idx]),
+            lambda kv, idx: (_kv_leaf_index(kv[0], idx),
+                             _kv_leaf_index(kv[1], idx)),
             out_shardings=(self._repl, self._repl))
         self.kv_mgr = KVCacheManager(
             self.num_blocks, config.block_size, config.enable_prefix_caching,
@@ -419,26 +549,22 @@ class EngineCore:
             params.pop("lm_head", None)
             params.pop("lm_head_scale", None)
         self.params = params
+        # The host staging tree holds the FULL checkpoint (bf16 unless
+        # quantize_loaded already shrank it) — on an 8B model that is
+        # ~16 GB of host RAM pinned for the rest of the process if left
+        # to the GC's leisure, and it shows up as "residual HBM" when
+        # the runtime backs host buffers with device-adjacent memory.
+        # Drop it eagerly, before warmup starts compiling.
+        del loaded
+        gc.collect()
         logger.info("Loaded checkpoint weights from %s", self.config.model)
 
     def _kv_bytes_per_block(self) -> int:
-        """Per-block HBM bytes INCLUDING XLA's tile padding. When
-        head_dim is lane-aligned (multiple of 128) the trailing
-        (KVH, D) dims flatten onto the lanes and occupy exactly their
-        unpadded size (llama-family: 8x128). Otherwise the minor dim
-        pads to 128 and the kv-head dim to the sublane granularity —
-        e.g. OPT's (12, 64) stores as (16, 128), a 2.7x expansion that
-        OOMed compile when the pool was sized from unpadded bytes."""
-        mc = self.model_config
-        itemsize = jnp.dtype(mc.dtype).itemsize
-        kvh, d = mc.num_kv_heads, mc.head_dim
-        if d % 128 != 0:
-            d = -(-d // 128) * 128
-            sublane = 16 if itemsize == 2 else 8
-            kvh = -(-kvh // sublane) * sublane
-        return (
-            mc.num_layers * 2 * self.config.block_size * kvh * d * itemsize
-        )
+        """See module-level :func:`kv_bytes_per_block` (tests and the
+        server's capacity gauge call that directly)."""
+        return kv_bytes_per_block(
+            self.model_config, self.config.block_size,
+            self.config.kv_cache_dtype)
 
     # Known per-chip HBM capacities, used when the runtime does not expose
     # memory_stats (e.g. tunneled/experimental platforms return None).
@@ -525,6 +651,23 @@ class EngineCore:
             mc.num_layers, self.num_blocks, self.config.block_size,
             mc.num_kv_heads, mc.head_dim,
         )
+        if self.config.kv_cache_dtype == "int8":
+            sshape = (mc.num_layers, self.num_blocks,
+                      self.config.block_size * mc.num_kv_heads)
+
+            @functools.partial(
+                jax.jit,
+                out_shardings=(self._kv_sharding, self._kv_sharding))
+            def zeros_q():
+                # Scales init to 1 (not 0): a never-written slot must
+                # dequantize its zero int8 data to exact zeros without
+                # a 0*0-vs-NaN hazard anywhere downstream.
+                return ((jnp.zeros(shape, jnp.int8),
+                         jnp.ones(sshape, jnp.float32)),
+                        (jnp.zeros(shape, jnp.int8),
+                         jnp.ones(sshape, jnp.float32)))
+
+            return zeros_q()
 
         @functools.partial(jax.jit, out_shardings=(self._kv_sharding, self._kv_sharding))
         def zeros():
@@ -590,7 +733,7 @@ class EngineCore:
         # multi-host mesh (and is a no-copy local read).
         return jax.jit(
             fwd, donate_argnums=(1,),
-            out_shardings=((self._repl,) * 4, self._kv_sharding))
+            out_shardings=((self._repl,) * 4, self._kv_pair_sharding))
 
     def _make_multi_decode(self, K: int):
         """Fused K-step decode: forward + on-device sampling (keys derived
@@ -701,7 +844,7 @@ class EngineCore:
 
         return jax.jit(
             fwd, donate_argnums=(1, 2),
-            out_shardings=((self._repl,) * 4, self._kv_sharding,
+            out_shardings=((self._repl,) * 4, self._kv_pair_sharding,
                            self._repl))
 
     def _multi_decode_fn(self, K: int):
@@ -787,7 +930,7 @@ class EngineCore:
 
         return jax.jit(
             fwd, donate_argnums=(1,),
-            out_shardings=((self._repl,) * 4, self._kv_sharding))
+            out_shardings=((self._repl,) * 4, self._kv_pair_sharding))
 
     def _spec_verify_fn(self, K: int):
         fn = self._spec_verify_fns.get(K)
@@ -804,9 +947,7 @@ class EngineCore:
             out_shardings=(self._kv_sharding, self._kv_sharding))
         def write_block(kv, bid, k, v):
             k_pages, v_pages = kv
-            k_pages = k_pages.at[:, bid].set(k.astype(k_pages.dtype))
-            v_pages = v_pages.at[:, bid].set(v.astype(v_pages.dtype))
-            return k_pages, v_pages
+            return _kv_set(k_pages, bid, k), _kv_set(v_pages, bid, v)
 
         return write_block
 
@@ -831,9 +972,7 @@ class EngineCore:
             out_shardings=(self._kv_sharding, self._kv_sharding))
         def write_blocks(kv, bids, k, v):
             k_pages, v_pages = kv
-            k_pages = k_pages.at[:, bids].set(k.astype(k_pages.dtype))
-            v_pages = v_pages.at[:, bids].set(v.astype(v_pages.dtype))
-            return k_pages, v_pages
+            return _kv_set(k_pages, bids, k), _kv_set(v_pages, bids, v)
 
         return write_blocks
 
@@ -920,10 +1059,16 @@ class EngineCore:
                 self._token_counts, *arrays)
             return None
         if name == "write_block":
-            self.kv = self._write_block_fn(self.kv, *arrays)
+            # int8 payloads arrive flattened over the op channel
+            # ([bid, kd, ks, vd, vs]); regroup into (data, scales)
+            # tuple leaves (single-host dispatch passes tuples through
+            # untouched — _regroup_kv_payload is shape-stable there).
+            self.kv = self._write_block_fn(
+                self.kv, *_regroup_kv_payload(arrays))
             return None
         if name == "write_blocks":
-            self.kv = self._write_blocks_fn(self.kv, *arrays)
+            self.kv = self._write_blocks_fn(
+                self.kv, *_regroup_kv_payload(arrays))
             return None
         if name == "embed":
             fn = self._embed_fn(static["bucket"])
@@ -1009,12 +1154,13 @@ class EngineCore:
                         [bid for _, bid in self._pending_offload],
                         np.int32)
                     out = self._dispatch("gather_blocks", {}, [bids])
-                    k_all = np.asarray(jax.device_get(out[0]))
-                    v_all = np.asarray(jax.device_get(out[1]))
+                    k_all = _kv_leaf_get(out[0])
+                    v_all = _kv_leaf_get(out[1])
                     for n, (prefix_hash, _) in enumerate(
                             self._pending_offload):
-                        self.offload.put(prefix_hash, k_all[:, n],
-                                         v_all[:, n])
+                        self.offload.put(prefix_hash,
+                                         _kv_leaf_index(k_all, n),
+                                         _kv_leaf_index(v_all, n))
             else:
                 # Host-RAM tier only: every process stages its own
                 # shards (no cross-host data movement).
@@ -1025,8 +1171,8 @@ class EngineCore:
             return
         k_pages, v_pages = self.kv
         for prefix_hash, bid in self._pending_offload:
-            k = np.asarray(jax.device_get(k_pages[:, bid]))
-            v = np.asarray(jax.device_get(v_pages[:, bid]))
+            k = _kv_leaf_get(_kv_leaf_index(k_pages, bid))
+            v = _kv_leaf_get(_kv_leaf_index(v_pages, bid))
             self.offload.put(prefix_hash, k, v)
         self._pending_offload.clear()
 
@@ -1036,13 +1182,16 @@ class EngineCore:
         reassembly in :meth:`_restore_block_local`."""
         if self.offload is None or self.kv is None:
             return
+
+        def stage(leaf_block):
+            if isinstance(leaf_block, tuple):
+                return tuple(stage(e) for e in leaf_block)
+            return {str(s.index): np.asarray(s.data)
+                    for s in leaf_block.addressable_shards}
+
         k_pages, v_pages = self.kv
-        kb = k_pages[:, bid]
-        vb = v_pages[:, bid]
-        k_sh = {str(s.index): np.asarray(s.data)
-                for s in kb.addressable_shards}
-        v_sh = {str(s.index): np.asarray(s.data)
-                for s in vb.addressable_shards}
+        k_sh = stage(_kv_leaf_index(k_pages, bid))
+        v_sh = stage(_kv_leaf_index(v_pages, bid))
         self.offload.put(prefix_hash, k_sh, v_sh)
 
     def _restore_block_local(self, prefix_hash: int, bid: int) -> None:
@@ -1061,10 +1210,22 @@ class EngineCore:
         mc = self.model_config
         shape = (mc.num_layers, self.config.block_size,
                  mc.num_kv_heads, mc.head_dim)
-        k = jax.make_array_from_callback(
-            shape, self._block_sharding, lambda idx: k_sh[str(idx)])
-        v = jax.make_array_from_callback(
-            shape, self._block_sharding, lambda idx: v_sh[str(idx)])
+
+        def unstage(sh_dict, shp, sharding):
+            return jax.make_array_from_callback(
+                shp, sharding, lambda idx: sh_dict[str(idx)])
+
+        if isinstance(k_sh, tuple):
+            sshape = (mc.num_layers,
+                      self.config.block_size * mc.num_kv_heads)
+            pg_sh, sc_sh = self._block_sharding
+            k = (unstage(k_sh[0], shape, pg_sh),
+                 unstage(k_sh[1], sshape, sc_sh))
+            v = (unstage(v_sh[0], shape, pg_sh),
+                 unstage(v_sh[1], sshape, sc_sh))
+        else:
+            k = unstage(k_sh, shape, self._block_sharding)
+            v = unstage(v_sh, shape, self._block_sharding)
         self.kv = self._write_block_fn(self.kv, jnp.int32(bid), k, v)
 
     def _restore_blocks(self, restores) -> bool:
@@ -1085,9 +1246,10 @@ class EngineCore:
                     entries.append(entry)
                 self._dispatch(
                     "write_blocks", {},
-                    [np.asarray([bid for bid, _ in restores], np.int32),
-                     np.stack([k for k, _ in entries], axis=1),
-                     np.stack([v for _, v in entries], axis=1)])
+                    _flatten_kv_payload(
+                        np.asarray([bid for bid, _ in restores], np.int32),
+                        _kv_leaf_stack([k for k, _ in entries], axis=1),
+                        _kv_leaf_stack([v for _, v in entries], axis=1)))
                 return True
             # contains() first: a miss must NOT turn into a collective
             # dispatch half the processes cannot serve.
@@ -1143,17 +1305,17 @@ class EngineCore:
                 # Collective replicated gather; leader reads locally.
                 out = self._dispatch("gather_blocks", {},
                                      [np.asarray(bids, np.int32)])
-                k = np.asarray(jax.device_get(out[0])).swapaxes(0, 1)
-                v = np.asarray(jax.device_get(out[1])).swapaxes(0, 1)
+                k = _kv_leaf_swap01(_kv_leaf_get(out[0]))
+                v = _kv_leaf_swap01(_kv_leaf_get(out[1]))
             else:
                 k_pages, v_pages = self.kv
                 idx = jnp.asarray(bids)
                 # [L, N, bs, KVH, D] -> [N, L, bs, KVH, D] (per-block
                 # payloads)
-                k = np.asarray(
-                    jax.device_get(k_pages[:, idx])).swapaxes(0, 1)
-                v = np.asarray(
-                    jax.device_get(v_pages[:, idx])).swapaxes(0, 1)
+                k = _kv_leaf_swap01(
+                    _kv_leaf_get(_kv_leaf_index(k_pages, idx)))
+                v = _kv_leaf_swap01(
+                    _kv_leaf_get(_kv_leaf_index(v_pages, idx)))
         return {
             "hashes": hashes,
             "num_tokens": len(hashes) * bs,
@@ -1200,8 +1362,8 @@ class EngineCore:
             idx = jnp.asarray(bids)
             # Dispatched under _step_lock so the gather reads self.kv
             # before any later engine step donates the buffer.
-            k = k_pages[:, idx]
-            v = v_pages[:, idx]
+            k = _kv_leaf_index(k_pages, idx)
+            v = _kv_leaf_index(v_pages, idx)
         return {
             "hashes": hashes,
             "num_tokens": len(hashes) * bs,
@@ -1246,22 +1408,26 @@ class EngineCore:
                         # decode/prefill dispatch for the whole transfer;
                         # 4-block chunks bound the pause.
                         take = np.asarray(fresh_idx)
-                        kk = np.asarray(k)[:, take]
-                        vv = np.asarray(v)[:, take]
+                        kk = _kv_leaf_index(_kv_leaf_np(k), take)
+                        vv = _kv_leaf_index(_kv_leaf_np(v), take)
                         bids_np = np.asarray(fresh_bids, np.int32)
                         step = 4
                         for s0 in range(0, len(fresh_bids), step):
                             sl = slice(s0, s0 + step)
                             self._dispatch(
                                 "write_blocks", {},
-                                [bids_np[sl], kk[:, sl], vv[:, sl]])
+                                _flatten_kv_payload(
+                                    bids_np[sl],
+                                    _kv_leaf_index(kk, sl),
+                                    _kv_leaf_index(vv, sl)))
                     else:
-                        k_arr = jnp.asarray(k)
-                        v_arr = jnp.asarray(v)
+                        k_arr = _kv_leaf_jnp(k)
+                        v_arr = _kv_leaf_jnp(v)
                         take = np.asarray(fresh_idx)
                         self.kv = self._write_blocks_fn(
                             self.kv, np.asarray(fresh_bids, np.int32),
-                            k_arr[:, take], v_arr[:, take],
+                            _kv_leaf_index(k_arr, take),
+                            _kv_leaf_index(v_arr, take),
                         )
                 except Exception:
                     # Bad payload shape/dtype: give the blocks back
@@ -1287,6 +1453,11 @@ class EngineCore:
         TKV2 relay. Returns #blocks installed. Unsupported in multi-host
         mode (see extract_kv)."""
         if self._mh is not None or src._mh is not None:
+            return 0
+        if src.config.kv_cache_dtype != self.config.kv_cache_dtype:
+            # Pools disagree on leaf structure (bf16 array vs int8
+            # tuple): the direct HBM copy cannot convert — fall back to
+            # the relay rungs, which re-encode host-side.
             return 0
         from production_stack_tpu.engine.kvcache import BlockAllocator
 
@@ -1338,7 +1509,8 @@ class EngineCore:
                         [src_bids[n] for n in take_idx], np.int32)
                     self.kv = self._write_blocks_fn(
                         self.kv, np.asarray(dst_bids, np.int32),
-                        src_k[:, sel], src_v[:, sel],
+                        _kv_leaf_index(src_k, sel),
+                        _kv_leaf_index(src_v, sel),
                     )
                 except Exception:
                     with self._lock:
@@ -1357,10 +1529,10 @@ class EngineCore:
         The [N, L] -> [L, N] transpose happens on device inside the jit."""
         if not hashes:
             return 0
-        k = np.asarray(k_blocks)
-        v = np.asarray(v_blocks)
+        k = _kv_leaf_np(k_blocks)
+        v = _kv_leaf_np(v_blocks)
         return self.inject_kv_blocks(
-            list(hashes), k.swapaxes(0, 1), v.swapaxes(0, 1))
+            list(hashes), _kv_leaf_swap01(k), _kv_leaf_swap01(v))
 
     # ------------------------------------------------------------------ #
     # public API (thread-safe)
@@ -1461,6 +1633,13 @@ class EngineCore:
                         break
                     maxb_b *= 2
 
+            # Compile-phase boundary: the prefill warmups above staged
+            # host-side dummy operands and XLA left per-compile host
+            # scratch behind — collect now so peak host RSS during the
+            # decode compiles doesn't stack on the prefill phase's
+            # garbage (matters on 8B+ models whose compile scratch is
+            # GB-scale).
+            gc.collect()
             # Decode: the full burst width plus the pressure width
             # (decode_steps_pressure, used while prompts wait), one
             # variant per block-table bucket (4 doubling to
@@ -1503,6 +1682,7 @@ class EngineCore:
                         break
                     maxb_w *= 2
 
+            gc.collect()  # phase boundary (see above)
             # Speculative verify: ONE extra program per block-table
             # bucket (single width K = speculative_num_tokens), so spec
             # decoding adds at most one compiled variant per decode
@@ -1868,6 +2048,9 @@ class EngineCore:
             "num_preempted_total": self.scheduler.num_preempted_total,
             "num_blocks": self.num_blocks,
             "hbm_headroom_bytes": self.hbm_headroom_bytes,
+            "kv_cache_dtype": self.config.kv_cache_dtype,
+            "kv_cache_bytes_per_token": (
+                self._kv_bytes_per_block() // self.config.block_size),
             "is_sleeping": self._sleeping,
             "prefill_time_total": round(self.prefill_time_total, 3),
             "decode_time_total": round(self.decode_time_total, 3),

@@ -1808,6 +1808,8 @@ class EngineServer:
         spec_proposed = s.get("spec_proposed_tokens_total", 0)
         spec_rate = (s.get("spec_accepted_tokens_total", 0) / spec_proposed
                      if spec_proposed else 0.0)
+        kv_dtype_labels = (
+            f'{labels},kv_cache_dtype="{s.get("kv_cache_dtype", "bf16")}"')
         lines = [
             "# TYPE vllm:num_requests_running gauge",
             f"vllm:num_requests_running{{{labels}}} {s['num_requests_running']}",
@@ -1843,6 +1845,12 @@ class EngineServer:
             f"tpu:num_kv_blocks{{{labels}}} {s['num_blocks']}",
             "# TYPE tpu:hbm_headroom_bytes gauge",
             f"tpu:hbm_headroom_bytes{{{labels}}} {headroom}",
+            # KV cache storage cost per token slot (int8 KV cache roughly
+            # halves this vs bf16); the dtype rides as a label so capacity
+            # dashboards can split fleets mid-migration.
+            "# TYPE tpu:kv_cache_bytes_per_token gauge",
+            f"tpu:kv_cache_bytes_per_token{{{kv_dtype_labels}}} "
+            f"{s.get('kv_cache_bytes_per_token', 0)}",
             "# TYPE tpu:engine_sleeping gauge",
             f"tpu:engine_sleeping{{{labels}}} {int(s['is_sleeping'])}",
             "# TYPE tpu:cached_prompt_tokens counter",
@@ -1972,6 +1980,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--quantization", default=None, choices=["int8"],
                    help="weight-only quantization: int8 weights + "
                         "per-channel scales (llama family)")
+    p.add_argument("--kv-cache-dtype", default="bf16",
+                   choices=["bf16", "int8"],
+                   help="KV cache storage dtype: int8 stores quantized "
+                        "K/V pages with per-token per-kv-head f32 scales, "
+                        "halving KV HBM traffic and roughly doubling KV "
+                        "capacity at equal HBM budget")
     p.add_argument("--api-key", default=None,
                    help="require 'Authorization: Bearer <key>' on the "
                         "serving surface (default: VLLM_API_KEY / "
@@ -2077,6 +2091,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         model=model,
         dtype=args.dtype,
         quantization=args.quantization,
+        kv_cache_dtype=args.kv_cache_dtype,
         prefill_chunk_size=args.prefill_chunk_size,
         prefill_batch=args.prefill_batch,
         enable_chunked_prefill=args.enable_chunked_prefill,

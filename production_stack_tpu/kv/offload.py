@@ -72,12 +72,28 @@ def _unpack_arrays(data: bytes, keys) -> dict:
     return out
 
 
-def pack_block(k: np.ndarray, v: np.ndarray) -> bytes:
-    """Serialize one block's pages ([L, bs, KVH, D] each) to bytes."""
+def pack_block(k, v) -> bytes:
+    """Serialize one block's pages ([L, bs, KVH, D] each) to bytes.
+
+    Int8 KV-cache blocks arrive as ``(data, scales)`` tuples (scales
+    [L, bs*KVH] f32); they ship under dedicated ``k_scale``/``v_scale``
+    keys — for a 128-dim head the payload is ~0.52x the bf16 block, which
+    is the point of quantized offload (every spilled byte moves over host
+    RAM or the cache-server socket)."""
+    if isinstance(k, (tuple, list)):
+        return _pack_arrays(k=k[0], k_scale=k[1], v=v[0], v_scale=v[1])
     return _pack_arrays(k=k, v=v)
 
 
-def unpack_block(data: bytes) -> Tuple[np.ndarray, np.ndarray]:
+def unpack_block(data: bytes):
+    """Inverse of :func:`pack_block`: returns (k, v) bare arrays for bf16
+    payloads, ((k, k_scale), (v, v_scale)) tuples for int8 ones (detected
+    from the key set — both directions of a mixed-fleet rollout parse)."""
+    with np.load(io.BytesIO(data)) as z:
+        quantized = "k_scale_shape" in z.files
+    if quantized:
+        out = _unpack_arrays(data, ("k", "k_scale", "v", "v_scale"))
+        return ((out["k"], out["k_scale"]), (out["v"], out["v_scale"]))
     out = _unpack_arrays(data, ("k", "v"))
     return out["k"], out["v"]
 
@@ -95,24 +111,33 @@ def _raw_view(arr: np.ndarray) -> memoryview:
 
 
 def pack_transfer_buffers(
-    hashes, num_tokens: int, k: np.ndarray, v: np.ndarray
+    hashes, num_tokens: int, k, v
 ) -> "list":
-    """Zero-copy packing: returns [header_bytes, k_view, v_view] suitable
-    for writing sequentially to a socket/stream."""
+    """Zero-copy packing: returns [header_bytes, *array_views] suitable
+    for writing sequentially to a socket/stream. Int8 KV payloads arrive
+    as ``(data, scales)`` tuples; their views follow the header in the
+    FIXED order k, k_scale, v, v_scale (the header's key order), so a
+    receiver can walk the body with frombuffer offsets either way."""
     import json as _json
     import struct
 
+    fields = {}
+    if isinstance(k, (tuple, list)):
+        fields["k"], fields["k_scale"] = k[0], k[1]
+        fields["v"], fields["v_scale"] = v[0], v[1]
+    else:
+        fields["k"], fields["v"] = k, v
     header = _json.dumps({
         "hashes": [int(h) for h in hashes],
         "num_tokens": int(num_tokens),
-        "k": {"dtype": _dtype_name(k), "shape": list(k.shape)},
-        "v": {"dtype": _dtype_name(v), "shape": list(v.shape)},
+        **{key: {"dtype": _dtype_name(arr), "shape": list(arr.shape)}
+           for key, arr in fields.items()},
     }).encode()
     head = _TRANSFER_MAGIC + struct.pack("<I", len(header)) + header
-    return [head, _raw_view(k), _raw_view(v)]
+    return [head] + [_raw_view(arr) for arr in fields.values()]
 
 
-def pack_transfer(hashes, num_tokens: int, k: np.ndarray, v: np.ndarray) -> bytes:
+def pack_transfer(hashes, num_tokens: int, k, v) -> bytes:
     """One-shot packing for callers that need a single bytes payload."""
     return b"".join(bytes(b) for b in pack_transfer_buffers(
         hashes, num_tokens, k, v))
@@ -121,7 +146,8 @@ def pack_transfer(hashes, num_tokens: int, k: np.ndarray, v: np.ndarray) -> byte
 def unpack_transfer(data: bytes) -> dict:
     """Inverse of pack_transfer. Array data is reinterpreted in place
     (frombuffer at offsets — no slicing copies). Legacy .npz payloads
-    (round-1 engines) still unpack."""
+    (round-1 engines) still unpack; int8 payloads come back out as
+    (data, scales) tuples under "k"/"v"."""
     if data[:4] == _TRANSFER_MAGIC:
         import json as _json
         import struct
@@ -129,8 +155,11 @@ def unpack_transfer(data: bytes) -> dict:
         (hlen,) = struct.unpack_from("<I", data, 4)
         header = _json.loads(data[8 : 8 + hlen].decode())
         offset = 8 + hlen
+        quantized = "k_scale" in header
+        keys = (("k", "k_scale", "v", "v_scale") if quantized
+                else ("k", "v"))
         out = {}
-        for key in ("k", "v"):
+        for key in keys:
             dtype = _resolve_dtype(header[key]["dtype"])
             shape = tuple(header[key]["shape"])
             count = int(np.prod(shape)) if shape else 1
@@ -138,6 +167,13 @@ def unpack_transfer(data: bytes) -> dict:
                 data, dtype=dtype, count=count, offset=offset
             ).reshape(shape)
             offset += count * dtype.itemsize
+        if quantized:
+            return {
+                "hashes": [int(h) for h in header["hashes"]],
+                "num_tokens": int(header["num_tokens"]),
+                "k": (out["k"], out["k_scale"]),
+                "v": (out["v"], out["v_scale"]),
+            }
         return {
             "hashes": [int(h) for h in header["hashes"]],
             "num_tokens": int(header["num_tokens"]),
@@ -272,7 +308,11 @@ class HostKVStore:
         # the per-process LRU states in lockstep.
         def nbytes(x):
             if isinstance(x, dict):
-                return sum(a.nbytes for a in x.values())
+                return sum(nbytes(a) for a in x.values())
+            if isinstance(x, (tuple, list)):
+                # int8 KV leaves: (data, scales) — possibly of shard
+                # dicts in multi-host staging.
+                return sum(nbytes(e) for e in x)
             return x.nbytes
 
         return nbytes(k) + nbytes(v)

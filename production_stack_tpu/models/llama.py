@@ -286,8 +286,9 @@ def apply(
     # them through the scalar layer index (flat scatter / page-level
     # gather). Loop carries alias in place under XLA, so only the touched
     # pages move — per-layer slices (or pages in the scan ys) would copy
-    # the entire pool every forward step.
-    L = k_all.shape[0]
+    # the entire pool every forward step. With an int8 cache each side is
+    # a (data, scales) tuple that rides the carry the same way.
+    L = (k_all[0] if isinstance(k_all, tuple) else k_all).shape[0]
 
     if lora_layers is not None:
         def scan_body(carry, per_layer):

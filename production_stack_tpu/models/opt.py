@@ -129,7 +129,7 @@ def apply(
 
     # Stacked KV pages ride the scan carry whole (in-place under XLA);
     # see llama.apply.
-    L = k_all.shape[0]
+    L = (k_all[0] if isinstance(k_all, tuple) else k_all).shape[0]
 
     def scan_body(carry, layer_params):
         x, k_all, v_all, l = carry

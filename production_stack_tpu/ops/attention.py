@@ -30,6 +30,30 @@ _CHUNKED_SCORE_BYTES = 1 << 30
 _CHUNKED_SCORE_SPAN = 1024
 
 
+def kv_page_data(pages):
+    """The array leaf of a KV page operand.
+
+    Pages are either a bare ``[L, NB, bs, KVH, D]`` array (bf16 cache) or
+    a ``(data, scales)`` 2-tuple (int8 cache): ``data`` is the int8 pages
+    array and ``scales`` is a float32 ``[L, NB, bs * KVH]`` per-slot,
+    per-kv-head symmetric scale (flat token-major: row-major it bitcasts
+    to ``(L * NB * bs, KVH)``, the same flat-slot view the scatter uses).
+    The last dim is kept flat so it rides the 128-lane tile instead of
+    padding a tiny KVH axis."""
+    return pages[0] if isinstance(pages, tuple) else pages
+
+
+def quantize_kv(x: jax.Array):
+    """Symmetric per-(token, kv-head) int8 quantization of [..., KVH, D]
+    values: scale = amax/127 over D (1.0 where the row is all-zero, so
+    empty slots stay exactly zero and nothing divides by zero)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)  # [..., KVH]
+    scale = jnp.where(amax > 0, amax, 1.0) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def _use_pallas() -> bool:
     if os.environ.get("TPU_STACK_FORCE_XLA_ATTENTION"):
         return False
@@ -69,16 +93,25 @@ def prefill_attention(
     return out.reshape(B, T, H, D)
 
 
-def _gather_ctx(pages: jax.Array, block_tables: jax.Array,
-                layer: jax.Array):
+def _gather_ctx(pages, block_tables: jax.Array, layer: jax.Array,
+                out_dtype=None):
     """Gather a batch's context from stacked pages [L, NB, bs, KVH, D]
     without materializing a whole layer: page-level indices into the
-    (L*NB)-page flat view."""
-    L, NB, bs, KVH, D = pages.shape
+    (L*NB)-page flat view. Quantized (data, scales) pages are gathered
+    page-wise too — int8 bytes over the wire — then dequantized into
+    ``out_dtype`` right before use."""
+    data = kv_page_data(pages)
+    L, NB, bs, KVH, D = data.shape
     B, MAXB = block_tables.shape
-    flat = pages.reshape(L * NB, bs, KVH, D)
+    flat = data.reshape(L * NB, bs, KVH, D)
     idx = layer * NB + block_tables  # [B, MAXB]
-    return flat[idx].reshape(B, MAXB * bs, KVH, D)
+    ctx = flat[idx].reshape(B, MAXB * bs, KVH, D)
+    if isinstance(pages, tuple):
+        flat_s = pages[1].reshape(L * NB, bs, KVH)
+        ctx_s = flat_s[idx].reshape(B, MAXB * bs, KVH)
+        ctx = (ctx.astype(jnp.float32) * ctx_s[..., None]).astype(
+            out_dtype or jnp.float32)
+    return ctx
 
 
 def context_prefill_attention(
@@ -99,12 +132,13 @@ def context_prefill_attention(
     (reference buys this from vLLM ``--enable-prefix-caching`` +
     LMCache offload; here it is native). Returns [B, T, H, D]."""
     B, T, H, D = q.shape
-    bs = k_pages.shape[2]
-    KVH = k_pages.shape[3]
+    k_data = kv_page_data(k_pages)
+    bs = k_data.shape[2]
+    KVH = k_data.shape[3]
     MAXB = block_tables.shape[1]
     group = H // KVH
-    k_ctx = _gather_ctx(k_pages, block_tables, layer)
-    v_ctx = _gather_ctx(v_pages, block_tables, layer)
+    k_ctx = _gather_ctx(k_pages, block_tables, layer, out_dtype=q.dtype)
+    v_ctx = _gather_ctx(v_pages, block_tables, layer, out_dtype=q.dtype)
     qg = q.reshape(B, T, KVH, group, D)
     S = MAXB * bs
     # The one-shot einsum materializes f32 scores [B, KVH, g, T, S] —
@@ -170,8 +204,8 @@ def context_prefill_attention(
 
 
 def write_kv_pages(
-    k_pages: jax.Array,  # [L, NB, bs, KVH, D] stacked pages
-    v_pages: jax.Array,  # [L, NB, bs, KVH, D]
+    k_pages,  # [L, NB, bs, KVH, D] stacked pages (or (data, scales))
+    v_pages,  # [L, NB, bs, KVH, D] (or (data, scales))
     k_new: jax.Array,  # [B, T, KVH, D]
     v_new: jax.Array,  # [B, T, KVH, D]
     slot_mapping: jax.Array,  # [B, T] flat slot ids (layer 0); negative = skip
@@ -182,21 +216,32 @@ def write_kv_pages(
     Operates on the FULL stacked array through a flat reshape (a bitcast):
     when the stacked pages are threaded as a loop carry, XLA performs this
     scatter in place — slicing out a per-layer view first would copy the
-    layer every step."""
-    L, NB, bs, KVH, D = k_pages.shape
-    flat_k = k_pages.reshape(L * NB * bs, KVH, D)
-    flat_v = v_pages.reshape(L * NB * bs, KVH, D)
+    layer every step. Quantized (data, scales) pages quantize here, on
+    the scatter: pages only ever hold int8 + scales, so every downstream
+    reader (reference, pallas, offload) sees one canonical encoding."""
+    L, NB, bs, KVH, D = kv_page_data(k_pages).shape
     slots = slot_mapping.reshape(-1)
     # Layer offset; out-of-range slots are dropped by scatter mode="drop".
     slots = jnp.where(slots < 0, L * NB * bs, slots + layer * NB * bs)
-    flat_k = flat_k.at[slots].set(
-        k_new.reshape(-1, KVH, D).astype(k_pages.dtype), mode="drop"
-    )
-    flat_v = flat_v.at[slots].set(
-        v_new.reshape(-1, KVH, D).astype(v_pages.dtype), mode="drop"
-    )
-    return (flat_k.reshape(L, NB, bs, KVH, D),
-            flat_v.reshape(L, NB, bs, KVH, D))
+
+    def scatter(pages, new):
+        if isinstance(pages, tuple):
+            data, scales = pages
+            q, s = quantize_kv(new)
+            flat = data.reshape(L * NB * bs, KVH, D)
+            flat = flat.at[slots].set(q.reshape(-1, KVH, D), mode="drop")
+            # The [L, NB, bs*KVH] scale array is row-major identical to
+            # (L*NB*bs, KVH): the same flat slot indexes both scatters.
+            flat_s = scales.reshape(L * NB * bs, KVH)
+            flat_s = flat_s.at[slots].set(s.reshape(-1, KVH), mode="drop")
+            return (flat.reshape(L, NB, bs, KVH, D),
+                    flat_s.reshape(L, NB, bs * KVH))
+        flat = pages.reshape(L * NB * bs, KVH, D)
+        flat = flat.at[slots].set(
+            new.reshape(-1, KVH, D).astype(pages.dtype), mode="drop")
+        return flat.reshape(L, NB, bs, KVH, D)
+
+    return scatter(k_pages, k_new), scatter(v_pages, v_new)
 
 
 def paged_attention_reference(
@@ -211,11 +256,12 @@ def paged_attention_reference(
 ) -> jax.Array:
     """XLA fallback: gather the padded context, mask, soft-max. [B, H, D]."""
     B, H, D = q.shape
-    bs, KVH = k_pages.shape[2], k_pages.shape[3]
+    k_data = kv_page_data(k_pages)
+    bs, KVH = k_data.shape[2], k_data.shape[3]
     MAXB = block_tables.shape[1]
     group = H // KVH
-    k_ctx = _gather_ctx(k_pages, block_tables, layer)
-    v_ctx = _gather_ctx(v_pages, block_tables, layer)
+    k_ctx = _gather_ctx(k_pages, block_tables, layer, out_dtype=q.dtype)
+    v_ctx = _gather_ctx(v_pages, block_tables, layer, out_dtype=q.dtype)
     qg = q.reshape(B, KVH, group, D)
     scores = jnp.einsum(
         "bkgd,bskd->bkgs", qg, k_ctx, preferred_element_type=jnp.float32
@@ -239,8 +285,9 @@ def paged_decode_attention(
     scale: float,
 ) -> jax.Array:
     """Dispatch to the pallas kernel on TPU, XLA reference elsewhere."""
-    block_size = k_pages.shape[2]
-    kvh, head_dim = k_pages.shape[3], k_pages.shape[4]
+    k_data = kv_page_data(k_pages)
+    block_size = k_data.shape[2]
+    kvh, head_dim = k_data.shape[3], k_data.shape[4]
     # The kernel's manual page DMAs slice [bs, KVH, D] out of HBM:
     # Mosaic requires the sliced dims tile-aligned (KVH to the 8-row
     # sublane, D to the 128 lanes; bs to 8). Misaligned models (e.g.
@@ -249,6 +296,10 @@ def paged_decode_attention(
     # AOT compile where no fallback is possible.
     tile_ok = (block_size % 8 == 0 and kvh % 8 == 0
                and head_dim % 128 == 0)
+    if isinstance(k_pages, tuple):
+        # The int8 kernel DMAs per-page scale rows [bs*KVH] out of the
+        # flat scale array: that last dim must fill whole 128-lane tiles.
+        tile_ok = tile_ok and (block_size * kvh) % 128 == 0
     if tile_ok and _use_pallas():
         from production_stack_tpu.ops.pallas_paged_attention import (
             pallas_paged_attention,

@@ -53,29 +53,39 @@ NEG_INF = -1e30
 RING = 6
 
 
-def _start_chunk_copy(k_hbm, v_hbm, k_buf, v_buf, sems, bt_ref, layer,
-                      b, chunk, slot, pages_per_block):
-    """Kick off async copies of one chunk's pages into ring slot `slot`."""
+def _chunk_copies(k_hbm, v_hbm, k_buf, v_buf, sems, bt_ref, layer,
+                  b, chunk, slot, pages_per_block,
+                  ks_hbm=None, vs_hbm=None, ks_buf=None, vs_buf=None):
+    """Async-copy descriptors for one chunk's pages into ring slot `slot`.
+
+    With a quantized cache two extra per-page copies move the f32 scale
+    rows ([bs*KVH] each — ~3% of the bf16 page bytes they replace) on
+    semaphore lanes 2/3."""
+    copies = []
     for p in range(pages_per_block):
         page = bt_ref[b, chunk * pages_per_block + p]
-        pltpu.make_async_copy(
-            k_hbm.at[layer, page], k_buf.at[slot, p], sems.at[slot, 0, p]
-        ).start()
-        pltpu.make_async_copy(
-            v_hbm.at[layer, page], v_buf.at[slot, p], sems.at[slot, 1, p]
-        ).start()
+        copies.append(pltpu.make_async_copy(
+            k_hbm.at[layer, page], k_buf.at[slot, p], sems.at[slot, 0, p]))
+        copies.append(pltpu.make_async_copy(
+            v_hbm.at[layer, page], v_buf.at[slot, p], sems.at[slot, 1, p]))
+        if ks_hbm is not None:
+            copies.append(pltpu.make_async_copy(
+                ks_hbm.at[layer, page], ks_buf.at[slot, p],
+                sems.at[slot, 2, p]))
+            copies.append(pltpu.make_async_copy(
+                vs_hbm.at[layer, page], vs_buf.at[slot, p],
+                sems.at[slot, 3, p]))
+    return copies
 
 
-def _wait_chunk_copy(k_hbm, v_hbm, k_buf, v_buf, sems, bt_ref, layer,
-                     b, chunk, slot, pages_per_block):
-    for p in range(pages_per_block):
-        page = bt_ref[b, chunk * pages_per_block + p]
-        pltpu.make_async_copy(
-            k_hbm.at[layer, page], k_buf.at[slot, p], sems.at[slot, 0, p]
-        ).wait()
-        pltpu.make_async_copy(
-            v_hbm.at[layer, page], v_buf.at[slot, p], sems.at[slot, 1, p]
-        ).wait()
+def _start_chunk_copy(*args, **kwargs):
+    for c in _chunk_copies(*args, **kwargs):
+        c.start()
+
+
+def _wait_chunk_copy(*args, **kwargs):
+    for c in _chunk_copies(*args, **kwargs):
+        c.wait()
 
 
 def _decode_kernel(
@@ -85,25 +95,30 @@ def _decode_kernel(
     layer_ref,  # [1]
     # inputs
     q_ref,  # [1, KVH * g_pad, D] (VMEM block for sequence b; pre-scaled)
-    k_hbm_ref,  # [L, NB, bs, KVH, D] in ANY/HBM
+    k_hbm_ref,  # [L, NB, bs, KVH, D] in ANY/HBM (int8 when quantized)
     v_hbm_ref,
-    # output
-    o_ref,  # [1, KVH * g_pad, D]
-    # scratch
-    k_buf,  # VMEM [RING, P, bs, KVH, D]
-    v_buf,
-    sems,  # DMA [RING, 2, P]
-    s_ref,  # [KVH * g_pad, span] f32 scores (all heads batched)
-    acc_ref,  # [KVH * g_pad, D] f32
-    m_ref,  # [KVH * g_pad, 128] f32
-    l_ref,  # [KVH * g_pad, 128] f32
-    *,
+    # quantized only: ks_hbm_ref / vs_hbm_ref [L, NB, bs*KVH] f32 in ANY,
+    # then output o_ref [1, KVH*g_pad, D], then scratch: k_buf/v_buf
+    # VMEM [RING, P, bs, KVH, D], (quantized: ks_buf/vs_buf VMEM
+    # [RING, P, bs*KVH] f32,) sems DMA [RING, 2|4, P], s_ref
+    # [KVH*g_pad, span] f32, acc_ref [KVH*g_pad, D] f32, m_ref/l_ref
+    # [KVH*g_pad, 128] f32.
+    *refs,
     block_size: int,
     kvh: int,
     g_pad: int,
     pages_per_block: int,
     ring: int,
+    quantized: bool,
 ):
+    if quantized:
+        (ks_hbm_ref, vs_hbm_ref, o_ref, k_buf, v_buf, ks_buf, vs_buf,
+         sems, s_ref, acc_ref, m_ref, l_ref) = refs
+        scale_kwargs = dict(ks_hbm=ks_hbm_ref, vs_hbm=vs_hbm_ref,
+                            ks_buf=ks_buf, vs_buf=vs_buf)
+    else:
+        (o_ref, k_buf, v_buf, sems, s_ref, acc_ref, m_ref, l_ref) = refs
+        scale_kwargs = {}
     b = pl.program_id(0)
     c = pl.program_id(1)
     nc = pl.num_programs(1)
@@ -129,7 +144,8 @@ def _decode_kernel(
             def _(gb=gb, gc=gc, k=k):
                 _start_chunk_copy(
                     k_hbm_ref, v_hbm_ref, k_buf, v_buf, sems,
-                    block_tables_ref, layer, gb, gc, k % ring, P)
+                    block_tables_ref, layer, gb, gc, k % ring, P,
+                    **scale_kwargs)
 
     @pl.when(c == 0)
     def _init():
@@ -149,23 +165,32 @@ def _decode_kernel(
     def _prefetch():
         _start_chunk_copy(k_hbm_ref, v_hbm_ref, k_buf, v_buf, sems,
                           block_tables_ref, layer, b_pre, c_pre,
-                          jax.lax.rem(g_pre, ring), P)
+                          jax.lax.rem(g_pre, ring), P, **scale_kwargs)
 
     @pl.when(chunk_start < ctx)
     def _compute():
         _wait_chunk_copy(k_hbm_ref, v_hbm_ref, k_buf, v_buf, sems,
-                         block_tables_ref, layer, b, c, slot, P)
+                         block_tables_ref, layer, b, c, slot, P,
+                         **scale_kwargs)
         # Per-head QK dots into ONE scores scratch, then every VPU stage
         # (mask, max, exp, l/acc updates) runs once over all heads' rows.
         # Operands are cast to f32 first — measured FASTER than feeding
         # bf16 straight to the MXU at these tiny tile shapes (ring sweep,
         # round 5: bf16 operands cost +66%; Mosaic's repacking of skinny
         # bf16 tiles outweighs the cast traffic).
+        if quantized:
+            # [P, bs*KVH] -> token-major [span, KVH]: row p*bs+t, col h.
+            k_sc = ks_buf[slot].reshape(span_tokens, kvh)
+            v_sc = vs_buf[slot].reshape(span_tokens, kvh)
         for h in range(kvh):  # static unroll over kv heads
             rows = slice(h * g_pad, (h + 1) * g_pad)
             q = q_ref[0, rows, :].astype(jnp.float32)  # [g_pad, D]
             k = (k_buf[slot, :, :, h, :]
                  .reshape(span_tokens, -1).astype(jnp.float32))
+            if quantized:
+                # Dequantize on-chip: the HBM stream stayed int8; the
+                # [span, 1] column broadcast is sublane-aligned.
+                k = k * k_sc[:, h:h + 1]
             s_ref[rows, :] = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -190,6 +215,8 @@ def _decode_kernel(
             rows = slice(h * g_pad, (h + 1) * g_pad)
             v = (v_buf[slot, :, :, h, :]
                  .reshape(span_tokens, -1).astype(jnp.float32))
+            if quantized:
+                v = v * v_sc[:, h:h + 1]
             acc_ref[rows, :] = acc_ref[rows, :] + jax.lax.dot(
                 p_[rows, :], v, preferred_element_type=jnp.float32)
 
@@ -203,8 +230,8 @@ def _decode_kernel(
     jax.jit, static_argnames=("scale", "pages_per_block", "ring", "interpret"))
 def pallas_paged_attention(
     q: jax.Array,  # [B, H, D]
-    k_pages: jax.Array,  # [L, NB, bs, KVH, D] stacked pages
-    v_pages: jax.Array,  # [L, NB, bs, KVH, D]
+    k_pages,  # [L, NB, bs, KVH, D] stacked pages (or (data, scales))
+    v_pages,  # [L, NB, bs, KVH, D] (or (data, scales))
     block_tables: jax.Array,  # [B, MAXB] int32
     context_lens: jax.Array,  # [B] int32
     layer,  # scalar layer index (traced)
@@ -214,6 +241,10 @@ def pallas_paged_attention(
     ring: int = 0,  # DMA ring depth; 0 -> RING default
     interpret: bool = False,
 ) -> jax.Array:
+    quantized = isinstance(k_pages, tuple)
+    if quantized:
+        k_pages, k_scales = k_pages
+        v_pages, v_scales = v_pages
     B, H, D = q.shape
     L, NB, bs, KVH, _ = k_pages.shape
     MAXB = block_tables.shape[1]
@@ -241,37 +272,50 @@ def pallas_paged_attention(
     R = ring or RING
     kernel = functools.partial(
         _decode_kernel, block_size=bs, kvh=KVH, g_pad=g_pad,
-        pages_per_block=P, ring=R,
+        pages_per_block=P, ring=R, quantized=quantized,
     )
     layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+    in_specs = [
+        pl.BlockSpec(
+            (1, KVH * g_pad, D), lambda b, c, bt, cl, lr: (b, 0, 0)
+        ),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((R, P, bs, KVH, D), k_pages.dtype),
+        pltpu.VMEM((R, P, bs, KVH, D), v_pages.dtype),
+    ]
+    operands = [qg, k_pages, v_pages]
+    if quantized:
+        # Scale arrays ride two extra DMA lanes; their ring scratch is
+        # [R, P, bs*KVH] f32 (a page's scale row is one 1D copy).
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                     pl.BlockSpec(memory_space=pl.ANY)]
+        scratch_shapes += [pltpu.VMEM((R, P, bs * KVH), jnp.float32),
+                           pltpu.VMEM((R, P, bs * KVH), jnp.float32)]
+        operands += [k_scales, v_scales]
+    scratch_shapes += [
+        pltpu.SemaphoreType.DMA((R, 4 if quantized else 2, P)),
+        pltpu.VMEM((KVH * g_pad, P * bs), jnp.float32),
+        pltpu.VMEM((KVH * g_pad, D), jnp.float32),
+        pltpu.VMEM((KVH * g_pad, 128), jnp.float32),
+        pltpu.VMEM((KVH * g_pad, 128), jnp.float32),
+    ]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(B, nc),
-            in_specs=[
-                pl.BlockSpec(
-                    (1, KVH * g_pad, D), lambda b, c, bt, cl, lr: (b, 0, 0)
-                ),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, KVH * g_pad, D), lambda b, c, bt, cl, lr: (b, 0, 0)
             ),
-            scratch_shapes=[
-                pltpu.VMEM((R, P, bs, KVH, D), k_pages.dtype),
-                pltpu.VMEM((R, P, bs, KVH, D), v_pages.dtype),
-                pltpu.SemaphoreType.DMA((R, 2, P)),
-                pltpu.VMEM((KVH * g_pad, P * bs), jnp.float32),
-                pltpu.VMEM((KVH * g_pad, D), jnp.float32),
-                pltpu.VMEM((KVH * g_pad, 128), jnp.float32),
-                pltpu.VMEM((KVH * g_pad, 128), jnp.float32),
-            ],
+            scratch_shapes=scratch_shapes,
         ),
         out_shape=jax.ShapeDtypeStruct((B, KVH * g_pad, D), q.dtype),
         interpret=interpret,
     )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
-      layer_arr, qg, k_pages, v_pages)
+      layer_arr, *operands)
     out = out.reshape(B, KVH, g_pad, D)[:, :, :group, :]
     return out.reshape(B, H, D)

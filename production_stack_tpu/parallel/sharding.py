@@ -187,6 +187,28 @@ def kv_block_sharding(cfg: ModelConfig, mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def kv_scale_sharding(cfg: ModelConfig, mesh: Mesh) -> NamedSharding:
+    """Int8 KV scale array [L, NB, bs*KVH]: layer axis on pp (alongside
+    its pages), replicated over tp. The flat token-major last dim
+    interleaves kv heads per token, so a tp head split is inexpressible —
+    and not worth expressing: scales are ~0.8% of the pool's bytes."""
+    pp = mesh.shape.get("pp", 1)
+    layer_axis = "pp" if pp > 1 and cfg.num_layers % pp == 0 else None
+    if layer_axis:
+        return NamedSharding(mesh, P(layer_axis, None, None))
+    return NamedSharding(mesh, P())
+
+
+def kv_scale_block_sharding(cfg: ModelConfig, mesh: Mesh) -> NamedSharding:
+    """ONE block's scales [L, bs*KVH] — :func:`kv_scale_sharding` minus
+    the NB axis (mirrors kv_block_sharding's relationship to the pool)."""
+    pp = mesh.shape.get("pp", 1)
+    layer_axis = "pp" if pp > 1 and cfg.num_layers % pp == 0 else None
+    if layer_axis:
+        return NamedSharding(mesh, P(layer_axis, None))
+    return NamedSharding(mesh, P())
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Replicated host-built batch metadata (tokens, tables, lens)."""
     return NamedSharding(mesh, P())

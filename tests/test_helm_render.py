@@ -459,3 +459,43 @@ def test_qos_tenants_render_configmap_and_router_flags():
              if d["metadata"]["name"].endswith("-router")]
     bcmd = bdeps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
     assert "--qos-tenants-file" not in bcmd
+
+
+def test_kv_cache_dtype_plumbs_into_engine_command():
+    """kvCacheDtype renders as --kv-cache-dtype (absent when unset —
+    bf16 is the engine default), the schema accepts bf16/int8, and
+    rejects anything else."""
+    import copy
+    import json
+
+    import jsonschema
+
+    values = copy.deepcopy(load_values(CHART, os.path.join(
+        CHART, "examples", "values-01-minimal.yaml")))
+    spec = values["servingEngineSpec"]["modelSpec"][0]
+    spec["kvCacheDtype"] = "int8"
+    with open(os.path.join(CHART, "values.schema.json")) as f:
+        schema = json.load(f)
+    jsonschema.validate(values, schema)
+
+    rendered = MiniHelm(CHART).render(values)
+    deps = [d for d in _docs(rendered, "Deployment")
+            if d["metadata"]["name"].endswith("-engine")]
+    assert deps, "engine deployment missing"
+    cmd = deps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--kv-cache-dtype" in cmd
+    assert cmd[cmd.index("--kv-cache-dtype") + 1] == "int8"
+
+    # Invalid dtype fails schema validation (fat-fingered "fp8" can't
+    # slip through to a CrashLoopBackOff at engine start).
+    bad = copy.deepcopy(values)
+    bad["servingEngineSpec"]["modelSpec"][0]["kvCacheDtype"] = "fp8"
+    with pytest.raises(jsonschema.ValidationError):
+        jsonschema.validate(bad, schema)
+
+    base = _render(os.path.join(CHART, "examples",
+                                "values-01-minimal.yaml"))
+    bdeps = [d for d in _docs(base, "Deployment")
+             if d["metadata"]["name"].endswith("-engine")]
+    bcmd = bdeps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--kv-cache-dtype" not in bcmd

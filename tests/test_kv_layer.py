@@ -241,3 +241,106 @@ def test_host_store_lru_and_remote_spill():
     assert s["evicted"] == 2
     assert store.get(4) is not None
     assert store.get(0) is None  # LRU-evicted, no remote tier
+
+
+def test_pack_block_roundtrip_int8_tuple_payload():
+    """Int8 KV cache offload payloads are (data, scales) tuples; the
+    npz wire format must round-trip them exactly (data bytes AND f32
+    scales), and non-quantized payloads must keep the legacy key set."""
+    import numpy as np
+
+    from production_stack_tpu.kv.offload import pack_block, unpack_block
+
+    rng = np.random.default_rng(7)
+    kd = rng.integers(-127, 128, (2, 8, 2, 64), np.int8)
+    vd = rng.integers(-127, 128, (2, 8, 2, 64), np.int8)
+    ks = rng.random((2, 16), np.float32)
+    vs = rng.random((2, 16), np.float32)
+
+    data = pack_block((kd, ks), (vd, vs))
+    k2, v2 = unpack_block(data)
+    assert isinstance(k2, tuple) and isinstance(v2, tuple)
+    np.testing.assert_array_equal(k2[0], kd)
+    np.testing.assert_array_equal(v2[0], vd)
+    np.testing.assert_array_equal(k2[1], ks)
+    np.testing.assert_array_equal(v2[1], vs)
+
+    # bf16 payloads keep the pre-int8 key set (mixed-fleet detection is
+    # by k_scale presence).
+    import io
+    import zipfile
+
+    k32 = rng.random((2, 8, 2, 64), np.float32)
+    plain = pack_block(k32, k32)
+    with zipfile.ZipFile(io.BytesIO(plain)) as z:
+        assert not any(n.startswith("k_scale") for n in z.namelist())
+    k3, v3 = unpack_block(plain)
+    assert not isinstance(k3, tuple)
+    np.testing.assert_array_equal(k3, k32)
+
+
+def test_host_store_roundtrip_int8_tuples():
+    """HostKVStore put/get with (data, scales) tuple payloads: exact
+    round-trip and byte accounting that counts both leaves."""
+    import numpy as np
+
+    from production_stack_tpu.kv.offload import HostKVStore
+
+    rng = np.random.default_rng(11)
+    kd = rng.integers(-127, 128, (2, 8, 2, 64), np.int8)
+    ks = rng.random((2, 16), np.float32)
+    store = HostKVStore(capacity_bytes=1 << 20)
+    store.put(5, (kd, ks), (kd.copy(), ks.copy()))
+    got = store.get(5)
+    assert got is not None
+    k2, v2 = got
+    np.testing.assert_array_equal(k2[0], kd)
+    np.testing.assert_array_equal(k2[1], ks)
+    np.testing.assert_array_equal(v2[0], kd)
+    # Accounting counts data + scales for both K and V.
+    assert store.stats()["bytes"] == 2 * (kd.nbytes + ks.nbytes)
+
+
+def test_cache_server_roundtrip_int8_tuples():
+    """Remote cache-server path with int8+scales payloads: pack_block ->
+    HTTP put/get -> unpack_block round-trips, and the quantized payload
+    is roughly half the bf16 wire size for the same block shape."""
+    import numpy as np
+
+    from production_stack_tpu.kv.cache_server import (
+        CacheServer,
+        run_cache_server,
+    )
+    from production_stack_tpu.kv.offload import (
+        RemoteKVClient,
+        pack_block,
+        unpack_block,
+    )
+
+    async def run():
+        server = CacheServer(capacity_bytes=1 << 20)
+        runner = await run_cache_server(server, "127.0.0.1", 0)
+        port = list(runner.sites)[0]._server.sockets[0].getsockname()[1]
+        url = f"http://127.0.0.1:{port}"
+
+        rng = np.random.default_rng(13)
+        kd = rng.integers(-127, 128, (2, 8, 2, 64), np.int8)
+        vd = rng.integers(-127, 128, (2, 8, 2, 64), np.int8)
+        ks = rng.random((2, 16), np.float32)
+        vs = rng.random((2, 16), np.float32)
+
+        def sync_part():
+            client = RemoteKVClient(url)
+            assert client.put(43, pack_block((kd, ks), (vd, vs)))
+            data = client.get(43)
+            assert data is not None
+            k2, v2 = unpack_block(data)
+            np.testing.assert_array_equal(k2[0], kd)
+            np.testing.assert_array_equal(k2[1], ks)
+            np.testing.assert_array_equal(v2[0], vd)
+            np.testing.assert_array_equal(v2[1], vs)
+
+        await asyncio.get_running_loop().run_in_executor(None, sync_part)
+        await runner.cleanup()
+
+    asyncio.run(run())

@@ -162,3 +162,70 @@ def test_register_decode_blocks_respects_kv_frontier():
     all_tokens.append(104)
     mgr.register_decode_blocks("s1", all_tokens)
     assert seq.num_registered == 8
+
+
+def _chain_hashes(tokens, block_size=4):
+    """Full-block chain hashes for a prompt (via a roomy scratch manager)."""
+    big = KVCacheManager(num_blocks=64, block_size=block_size)
+    bids, _, _ = big.allocate_prompt("scratch", tokens)
+    return [big.allocator.blocks[b].prefix_hash for b in bids
+            if big.allocator.blocks[b].prefix_hash is not None]
+
+
+def test_restore_then_oom_rolls_back_restore_blocks():
+    """external_lookup hits allocate+register restore blocks BEFORE their
+    pages are written; a fresh-block OOM later in the same allocate_prompt
+    must unregister them and return them to the free list — leaving one
+    registered would serve garbage pages as prefix cache to the next
+    prompt, and leaking one would shrink the pool forever."""
+    store = set(_chain_hashes(list(range(20))))
+
+    # 4-block pool: the walk restores 4 blocks (the whole pool), the
+    # 5th (fresh) block OOMs.
+    mgr = KVCacheManager(num_blocks=4, block_size=4)
+    mgr.external_lookup = lambda h: h in store
+    assert mgr.allocate_prompt("s2", list(range(20))) is None
+
+    alloc = mgr.allocator
+    assert alloc.num_free == 4  # every restore block back on the free list
+    assert not alloc.prefix_map  # no garbage-page cache entries
+    assert all(b.ref_count == 0 for b in alloc.blocks)
+    assert all(b.prefix_hash is None for b in alloc.blocks)
+    assert "s2" not in mgr.seqs
+
+    # The pool is whole again: a fitting prompt allocates fine.
+    mgr.external_lookup = None
+    assert mgr.allocate_prompt("s3", list(range(12))) is not None
+
+
+def test_restore_oom_rollback_keeps_device_cache_hits_cold():
+    """Mixed walk: device prefix-cache hits + external restores, then OOM.
+    The rollback must free ONLY the restore blocks (their hashes leave the
+    prefix map); genuinely cached blocks return to cold cache, still
+    servable to the next prompt."""
+    tokens = list(range(24))
+    hashes = _chain_hashes(tokens)
+    store = set(hashes)
+
+    # Pool of 5: seed device cache with the first two chain blocks, then
+    # the walk restores the remaining 3 free blocks and the fresh
+    # allocation OOMs.
+    mgr = KVCacheManager(num_blocks=5, block_size=4)
+    mgr.allocate_prompt("w", list(range(8)))
+    mgr.free("w")
+    assert len(mgr.allocator.prefix_map) == 2  # cold device cache
+
+    mgr.external_lookup = lambda h: h in store
+    assert mgr.allocate_prompt("s2", tokens) is None
+
+    alloc = mgr.allocator
+    assert alloc.num_free == 3
+    assert all(b.ref_count == 0 for b in alloc.blocks)
+    # Device-cache entries survive; the restored hashes are gone.
+    assert hashes[0] in alloc.prefix_map and hashes[1] in alloc.prefix_map
+    assert all(h not in alloc.prefix_map for h in hashes[2:])
+
+    # The cold cache still serves: an 8-token prompt reuses block h1.
+    mgr.external_lookup = None
+    out = mgr.allocate_prompt("s3", list(range(8)))
+    assert out is not None and out[1] == 4

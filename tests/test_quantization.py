@@ -141,3 +141,52 @@ def test_quantization_validation():
 
         EngineCore(EngineConfig(model="tiny-opt", num_blocks=32,
                                 quantization="int8"))
+
+
+def test_no_bf16_full_weight_leaf_live_after_int8_init():
+    """Residual-HBM regression (llama8b headroom): after a quantized
+    init, no full-weight bf16 staging buffer — host or device — may stay
+    reachable. Runs in a subprocess because jax.live_arrays() is
+    process-global (other tests' bf16 engines would false-positive)."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    script = textwrap.dedent("""
+        import jax
+        import jax.numpy as jnp
+
+        from production_stack_tpu.engine.config import EngineConfig
+        from production_stack_tpu.engine.core import EngineCore
+
+        core = EngineCore(EngineConfig(
+            model="tiny-llama", max_model_len=128, max_num_seqs=2,
+            block_size=8, num_blocks=64, max_loras=0,
+            quantization="int8", quantize_embeddings=True))
+        core.start()
+        try:
+            cfg = core.model_config
+            # Smallest full-weight leaf in bf16: the stacked wq stack.
+            threshold = (cfg.num_layers * cfg.hidden_size
+                         * cfg.num_heads * cfg.head_dim * 2)
+            leaves = jax.tree_util.tree_leaves(core.params)
+            big_bf16 = [l for l in leaves if l.dtype == jnp.bfloat16
+                        and l.nbytes >= threshold]
+            assert not big_bf16, [l.shape for l in big_bf16]
+            owned = {id(x) for x in leaves}
+            owned |= {id(x) for x in jax.tree_util.tree_leaves(core.kv)}
+            stray = [x for x in jax.live_arrays()
+                     if x.dtype == jnp.bfloat16 and x.nbytes >= threshold
+                     and id(x) not in owned]
+            assert not stray, [(x.shape, x.nbytes) for x in stray]
+        finally:
+            core.stop()
+        print("NO_BF16_WEIGHT_LEAF_OK")
+    """)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, env=env,
+                         timeout=540)
+    assert out.returncode == 0, out.stdout + "\n" + out.stderr
+    assert "NO_BF16_WEIGHT_LEAF_OK" in out.stdout
