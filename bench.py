@@ -183,6 +183,20 @@ QOS_FLOOD = _env_int("BENCH_QOS_FLOOD", 16)
 QOS_INTERACTIVE_REQS = _env_int("BENCH_QOS_INTERACTIVE_REQS", 6)
 QOS_TTFT = _env_float("BENCH_QOS_TTFT", 0.3)
 QOS_PREFILL_CHUNKS = _env_int("BENCH_QOS_PREFILL_CHUNKS", 8)
+# Chaos failover A/B: BENCH_CHAOS=1 runs the hermetic fault-tolerance
+# harness (production_stack_tpu/testing/chaos_ab.py — 3 fake replicas,
+# real router, no TPU, no jax import): mid-storm one replica is killed
+# and another hung before first byte, with router fault tolerance ON
+# then OFF. Writes BENCH_CHAOS_OUT (default BENCH_CHAOS.json) with
+# completion rate + p99 for both legs. Acceptance: ON completes >= 99%
+# with p99 bounded near the TTFT deadline; OFF is the failure baseline.
+CHAOS = _env_int("BENCH_CHAOS", 0)
+CHAOS_OUT = os.environ.get("BENCH_CHAOS_OUT", "BENCH_CHAOS.json")
+CHAOS_TOTAL = _env_int("BENCH_CHAOS_TOTAL", 120)
+CHAOS_CONCURRENCY = _env_int("BENCH_CHAOS_CONCURRENCY", 12)
+CHAOS_AFTER = _env_int("BENCH_CHAOS_AFTER", 30)
+CHAOS_CLIENT_TIMEOUT = _env_float("BENCH_CHAOS_CLIENT_TIMEOUT", 8.0)
+CHAOS_TTFT_DEADLINE = _env_float("BENCH_CHAOS_TTFT_DEADLINE", 2.0)
 
 
 def _load_baseline() -> float:
@@ -666,6 +680,22 @@ def _qos_main() -> None:
     print(json.dumps(result))
 
 
+def _chaos_main() -> None:
+    """BENCH_CHAOS=1: the failover A/B. Fully hermetic (fake engines),
+    so this branch never imports jax or touches a device."""
+    from production_stack_tpu.testing.chaos_ab import run_chaos_ab
+
+    result = asyncio.run(run_chaos_ab(
+        total=CHAOS_TOTAL, concurrency=CHAOS_CONCURRENCY,
+        chaos_after=CHAOS_AFTER, client_timeout_s=CHAOS_CLIENT_TIMEOUT,
+        ttft_deadline_s=CHAOS_TTFT_DEADLINE))
+    result["backend"] = "fake"
+    with open(os.path.join(REPO, CHAOS_OUT), "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result))
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--cpu", action="store_true",
@@ -673,6 +703,9 @@ def main() -> None:
     args = parser.parse_args()
     if QOS:
         _qos_main()
+        return
+    if CHAOS:
+        _chaos_main()
         return
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -702,103 +735,89 @@ def main() -> None:
             raise SystemExit(3)
     import jax
 
-    try:
-        if SPEC_AB:
-            # Spec-on vs spec-off A/B on the same workload (run
-            # BENCH_REPETITIVE=1 for the prompt-lookup best case). Both
-            # legs run in this process back to back; the JSON artifact
-            # carries both so the speedup is attributable.
-            partials = {}
-            off = _run_scenario(lambda: _main(0), "spec_off",
-                                SPEC_OUT, partials)
-            on = _run_scenario(lambda: _main(SPEC or 4), "spec_on",
-                               SPEC_OUT, partials)
-            for leg in (off, on):
-                leg["backend"] = jax.devices()[0].platform
-            result = {
-                "metric": f"spec_decode_ab({MODEL})",
-                "value": on["value"],
-                "unit": "tok/s",
-                "vs_baseline": (
-                    round(on["value"] / off["value"], 3)
-                    if off["value"] else None),
-                "config": CONFIG_KEY,
-                "spec_off_tok_s": off["value"],
-                "spec_on_tok_s": on["value"],
-                "spec_off_tokens_per_forward": off["tokens_per_forward"],
-                "spec_on_tokens_per_forward": on["tokens_per_forward"],
-                "acceptance_rate": on["engine_spec_acceptance_rate"],
-                "spec_disabled_requests": on["engine_spec_disabled"],
-                "repetitive": bool(REPETITIVE),
-                "spec_off": off,
-                "spec_on": on,
-            }
-            with open(os.path.join(REPO, SPEC_OUT), "w") as f:
-                json.dump(result, f, indent=2)
-                f.write("\n")
-            print(json.dumps(result))
-            return
-        if KV_QUANT:
-            # Int8 KV cache A/B: same workload, bf16 pages vs int8
-            # pages + per-token scales. Token-level greedy agreement is
-            # covered by tests/test_kv_quant.py; the A/B surfaces
-            # throughput, decode time, per-token KV bytes, and the
-            # capacity win (blocks at equal HBM budget when the pool is
-            # auto-sized).
-            partials = {}
-            bf16 = _run_scenario(lambda: _main(SPEC, "bf16"), "kv_bf16",
-                                 KV_QUANT_OUT, partials)
-            int8 = _run_scenario(lambda: _main(SPEC, "int8"), "kv_int8",
-                                 KV_QUANT_OUT, partials)
-            for leg in (bf16, int8):
-                leg["backend"] = jax.devices()[0].platform
-            result = {
-                "metric": f"kv_quant_ab({MODEL})",
-                "value": int8["value"],
-                "unit": "tok/s",
-                "vs_baseline": (
-                    round(int8["value"] / bf16["value"], 3)
-                    if bf16["value"] else None),
-                "config": CONFIG_KEY,
-                "bf16_tok_s": bf16["value"],
-                "int8_tok_s": int8["value"],
-                "bf16_kv_bytes_per_token":
-                    bf16["engine_kv_bytes_per_token"],
-                "int8_kv_bytes_per_token":
-                    int8["engine_kv_bytes_per_token"],
-                "bf16_num_blocks": bf16["engine_num_blocks"],
-                "int8_num_blocks": int8["engine_num_blocks"],
-                "bf16_decode_s": bf16["engine_decode_s"],
-                "int8_decode_s": int8["engine_decode_s"],
-                "bf16_p50_ttft_s": bf16["p50_ttft_s"],
-                "int8_p50_ttft_s": int8["p50_ttft_s"],
-                "kv_bf16": bf16,
-                "kv_int8": int8,
-            }
-            with open(os.path.join(REPO, KV_QUANT_OUT), "w") as f:
-                json.dump(result, f, indent=2)
-                f.write("\n")
-            print(json.dumps(result))
-            return
-        result = _run_scenario(lambda: _main(), "single")
-    except Exception as e:  # noqa: BLE001
-        # The tunneled dev runtime leaks residual HBM across processes:
-        # configs near the ceiling (llama8b: weights+pool ~13 GB of a
-        # ~13 GB usable chip) nondeterministically OOM at engine INIT —
-        # measured back-to-back identical runs flip between success and
-        # ResourceExhausted. A retry must come from a FRESH process (this
-        # one holds partial allocations), so re-exec up to 2 times.
-        retries = int(os.environ.get("BENCH_OOM_RETRY", "0"))
-        if "RESOURCE_EXHAUSTED" in str(e) and retries < 2:
-            import sys
-            import time as _time
-
-            print(f"init OOM (residual runtime state); re-exec retry "
-                  f"{retries + 1}/2", file=sys.stderr)
-            _time.sleep(30)
-            os.environ["BENCH_OOM_RETRY"] = str(retries + 1)
-            os.execv(sys.executable, [sys.executable] + sys.argv)
-        raise
+    if SPEC_AB:
+        # Spec-on vs spec-off A/B on the same workload (run
+        # BENCH_REPETITIVE=1 for the prompt-lookup best case). Both
+        # legs run in this process back to back; the JSON artifact
+        # carries both so the speedup is attributable.
+        partials = {}
+        off = _run_scenario(lambda: _main(0), "spec_off",
+                            SPEC_OUT, partials)
+        on = _run_scenario(lambda: _main(SPEC or 4), "spec_on",
+                           SPEC_OUT, partials)
+        for leg in (off, on):
+            leg["backend"] = jax.devices()[0].platform
+        result = {
+            "metric": f"spec_decode_ab({MODEL})",
+            "value": on["value"],
+            "unit": "tok/s",
+            "vs_baseline": (
+                round(on["value"] / off["value"], 3)
+                if off["value"] else None),
+            "config": CONFIG_KEY,
+            "spec_off_tok_s": off["value"],
+            "spec_on_tok_s": on["value"],
+            "spec_off_tokens_per_forward": off["tokens_per_forward"],
+            "spec_on_tokens_per_forward": on["tokens_per_forward"],
+            "acceptance_rate": on["engine_spec_acceptance_rate"],
+            "spec_disabled_requests": on["engine_spec_disabled"],
+            "repetitive": bool(REPETITIVE),
+            "spec_off": off,
+            "spec_on": on,
+        }
+        with open(os.path.join(REPO, SPEC_OUT), "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        print(json.dumps(result))
+        return
+    if KV_QUANT:
+        # Int8 KV cache A/B: same workload, bf16 pages vs int8
+        # pages + per-token scales. Token-level greedy agreement is
+        # covered by tests/test_kv_quant.py; the A/B surfaces
+        # throughput, decode time, per-token KV bytes, and the
+        # capacity win (blocks at equal HBM budget when the pool is
+        # auto-sized).
+        partials = {}
+        bf16 = _run_scenario(lambda: _main(SPEC, "bf16"), "kv_bf16",
+                             KV_QUANT_OUT, partials)
+        int8 = _run_scenario(lambda: _main(SPEC, "int8"), "kv_int8",
+                             KV_QUANT_OUT, partials)
+        for leg in (bf16, int8):
+            leg["backend"] = jax.devices()[0].platform
+        result = {
+            "metric": f"kv_quant_ab({MODEL})",
+            "value": int8["value"],
+            "unit": "tok/s",
+            "vs_baseline": (
+                round(int8["value"] / bf16["value"], 3)
+                if bf16["value"] else None),
+            "config": CONFIG_KEY,
+            "bf16_tok_s": bf16["value"],
+            "int8_tok_s": int8["value"],
+            "bf16_kv_bytes_per_token":
+                bf16["engine_kv_bytes_per_token"],
+            "int8_kv_bytes_per_token":
+                int8["engine_kv_bytes_per_token"],
+            "bf16_num_blocks": bf16["engine_num_blocks"],
+            "int8_num_blocks": int8["engine_num_blocks"],
+            "bf16_decode_s": bf16["engine_decode_s"],
+            "int8_decode_s": int8["engine_decode_s"],
+            "bf16_p50_ttft_s": bf16["p50_ttft_s"],
+            "int8_p50_ttft_s": int8["p50_ttft_s"],
+            "kv_bf16": bf16,
+            "kv_int8": int8,
+        }
+        with open(os.path.join(REPO, KV_QUANT_OUT), "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        print(json.dumps(result))
+        return
+    # Init OOM from residual runtime HBM (llama8b near the ceiling,
+    # ROADMAP item 3) is now absorbed IN-PROCESS by the engine's
+    # pool-shrink ladder (engine/core.py _alloc_kv_with_shrink) plus
+    # --hbm-headroom-reserve; the fresh-process re-exec workaround
+    # that used to live here is gone.
+    result = _run_scenario(lambda: _main(), "single")
     result["backend"] = jax.devices()[0].platform
     print(json.dumps(result))
 

@@ -76,12 +76,24 @@ def render_prompt(body_json: dict) -> str:
     """OpenAI request -> the text whose prefix keys the pick. Uses the
     ENGINE's chat-template renderer so trie chains agree across tiers by
     construction (a local copy would silently diverge if the template
-    changed)."""
+    changed).
+
+    Defensive against malformed bodies: the EPP sits in front of every
+    request, so garbage shapes (messages that aren't a list, entries that
+    aren't dicts, non-string content) must degrade to an empty prompt —
+    a round-robin pick — never an exception that kills the stream."""
+    if not isinstance(body_json, dict):
+        return ""
     if "messages" in body_json:
         from production_stack_tpu.engine.tokenizer import ByteTokenizer
 
-        return ByteTokenizer.apply_chat_template(
-            None, body_json.get("messages") or [])
+        messages = body_json.get("messages")
+        if not isinstance(messages, list):
+            return ""
+        messages = [m for m in messages
+                    if isinstance(m, dict)
+                    and isinstance(m.get("content"), str)]
+        return ByteTokenizer.apply_chat_template(None, messages)
     prompt = body_json.get("prompt", "")
     if isinstance(prompt, list):
         prompt = prompt[0] if prompt and isinstance(prompt[0], str) else ""
@@ -170,9 +182,15 @@ class ExtProcPicker:
 
                 try:
                     parsed = json.loads(body_buf.decode() or "{}")
-                except (ValueError, UnicodeDecodeError):
+                except (ValueError, UnicodeDecodeError, RecursionError):
+                    # Truncated/garbage frames and nesting bombs: treat
+                    # as an empty body (round-robin pick), keep serving.
                     parsed = {}
-                chosen = self._pick(render_prompt(parsed))
+                try:
+                    prompt = render_prompt(parsed)
+                except Exception:  # noqa: BLE001 - never kill the stream
+                    prompt = ""
+                chosen = self._pick(prompt)
                 self.picks_total += 1
                 yield self._respond_body(chosen)
                 body_buf = b""

@@ -160,6 +160,23 @@ livenessProbe:
   failureThreshold: {{ .root.Values.servingEngineSpec.livenessProbe.failureThreshold }}
 {{- end -}}
 
+{{/* preStop drain hook (dict: root, port): POST /drain so rolling
+     updates and scale-downs finish in-flight generations before the
+     pod dies (docs/fault_tolerance.md). Shared by the single-host
+     Deployment and the multi-host StatefulSet. python (always in the
+     engine image) instead of curl (not guaranteed). */}}
+{{- define "chart.engineLifecycle" -}}
+{{- if and .root.Values.servingEngineSpec.drain .root.Values.servingEngineSpec.drain.enabled }}
+lifecycle:
+  preStop:
+    exec:
+      command:
+        - python
+        - -c
+        - {{ printf "import urllib.request as u; u.urlopen(u.Request('http://127.0.0.1:%d/drain?timeout_s=%d', method='POST'), timeout=%d)" (int .port) (int .root.Values.servingEngineSpec.drain.timeoutSeconds) (add (int .root.Values.servingEngineSpec.drain.timeoutSeconds) 10) | quote }}
+{{- end }}
+{{- end -}}
+
 {{/* Whether a modelSpec mounts the cluster-wide shared model storage
      (sharedStorage.enabled and no per-model PVC overriding /models). */}}
 {{- define "chart.usesSharedStorage" -}}

@@ -386,7 +386,56 @@ def build_dashboard():
              "engine /metrics directly)"))
     y += 7
 
-    # ---- Row 9: Current Resource Usage (ref panels 14-19) --------------- #
+    # ---- Row 9: Fault Tolerance (retries, breaker, drain, OOM ladder) --- #
+    panels.append(row("Fault Tolerance", y)); y += 1
+    panels.append(panel(
+        "timeseries", "Retries per endpoint (rate)",
+        [target("rate(vllm_router:retries_total[5m])",
+                legend="{{server}}")],
+        grid(7, 8, 0, y), unit="reqps",
+        desc="Retry attempts dispatched by the router, labelled by the "
+             "endpoint the retry was sent TO (--fault-tolerance); a "
+             "sustained rate means some replica is failing first "
+             "attempts"))
+    panels.append(panel(
+        "timeseries", "Failovers per endpoint (rate)",
+        [target("rate(vllm_router:failovers_total[5m])",
+                legend="{{server}}")],
+        grid(7, 8, 8, y), unit="reqps",
+        desc="Requests rescued on a different replica than originally "
+             "routed, labelled by the endpoint that served the rescue"))
+    panels.append(panel(
+        "timeseries", "Circuit breaker state per endpoint",
+        [target("vllm_router:circuit_state", legend="{{server}}")],
+        grid(7, 8, 16, y),
+        desc="0 = closed (healthy), 1 = open (excluded from routing "
+             "until the reset window), 2 = half-open (one probe in "
+             "flight)"))
+    y += 7
+    panels.append(panel(
+        "timeseries", "Stale engine-stats scrapes (rate)",
+        [target("rate(vllm_router:engine_stats_stale_total[5m])",
+                legend="{{server}}")],
+        grid(7, 8, 0, y),
+        desc="Scrape cycles in which an endpoint's stats had failed "
+             "repeatedly and were withheld from routing decisions"))
+    panels.append(panel(
+        "timeseries", "Engines draining",
+        [target("tpu:engine_draining", legend="{{instance}}")],
+        grid(7, 8, 8, y),
+        desc="1 while the engine is draining (POST /drain stopped "
+             "admission and is finishing in-flight requests; the helm "
+             "preStop hook drives this on pod termination)"))
+    panels.append(panel(
+        "stat", "KV pool-shrink retries (init OOM ladder)",
+        [target("sum(tpu:pool_shrink_retries_total)", instant=True)],
+        grid(7, 8, 16, y),
+        desc="Allocation rungs taken by the init-time OOM shrink "
+             "ladder; nonzero means pool sizing / "
+             "--hbm-headroom-reserve should be revisited"))
+    y += 7
+
+    # ---- Row 10: Current Resource Usage (ref panels 14-19) -------------- #
     panels.append(row("Current Resource Usage", y)); y += 1
     panels.append(panel(
         "timeseries", "Router CPU usage",

@@ -120,6 +120,21 @@ class EngineConfig:
     # "bf16" (default) keeps the request path byte-identical to before
     # the flag existed.
     kv_cache_dtype: str = "bf16"
+    # HBM bytes to keep free PER DEVICE when auto-sizing the KV pool:
+    # residual allocations (checkpoint staging, compiler workspaces,
+    # fragmentation) that memory_stats misses repeatedly OOMed the 8B
+    # model at hbm_utilization budgets that looked safe on paper
+    # (ROADMAP item 3). Subtracted from free HBM before hbm_utilization
+    # applies. 0 keeps the historical sizing.
+    hbm_headroom_reserve: int = 0
+    # Pool-shrink retry ladder on ResourceExhausted during KV-pool
+    # allocation: shrink num_blocks by pool_shrink_step (fraction) and
+    # retry, up to pool_shrink_retries rungs, instead of dying and
+    # forcing a fresh-process relaunch (the bench.py re-exec this
+    # replaces). Single-host only — multihost replicas exchange
+    # num_blocks before allocation and must agree on shapes.
+    pool_shrink_retries: int = 4
+    pool_shrink_step: float = 0.15
 
     def __post_init__(self):
         if self.quantization not in (None, "int8"):
@@ -138,6 +153,12 @@ class EngineConfig:
                 "speculative_num_tokens must be 0 (off) or >= 2")
         if self.speculative_ngram_size < 1:
             raise ValueError("speculative_ngram_size must be >= 1")
+        if self.hbm_headroom_reserve < 0:
+            raise ValueError("hbm_headroom_reserve must be >= 0")
+        if self.pool_shrink_retries < 0:
+            raise ValueError("pool_shrink_retries must be >= 0")
+        if not 0.0 < self.pool_shrink_step < 1.0:
+            raise ValueError("pool_shrink_step must be in (0, 1)")
 
     @property
     def max_blocks_per_seq(self) -> int:

@@ -322,9 +322,12 @@ class EngineCore:
         # HBM headroom left on this device AFTER the pool: exported as
         # tpu:hbm_headroom_bytes so near-OOM deployments (llama8b-int8
         # on 16 GB) are visible before they flip to ResourceExhausted
-        # (VERDICT r4 weak #6).
+        # (VERDICT r4 weak #6). Computed after the allocation so a
+        # pool-shrink ladder rung is reflected in the exported figure.
         self.hbm_headroom_bytes: Optional[int] = None
+        self.pool_shrink_retries_total = 0
         free_before = self._free_hbm_bytes()
+        self.kv = self._alloc_kv_with_shrink()
         if free_before is not None:
             mc_ = self.model_config
             tp_ = self.mesh.shape.get("tp", 1)
@@ -336,7 +339,6 @@ class EngineCore:
                 self.num_blocks * self._kv_bytes_per_block()
                 // shard_factor)
             self.hbm_headroom_bytes = max(free_before - pool_per_device, 0)
-        self.kv = self._alloc_kv()
         # Replicated block gather (disagg extract): every process runs
         # the same gather; the replicated output is host-readable from
         # any of them. (A bare _repl per (k, v) component is a valid
@@ -635,6 +637,11 @@ class EngineCore:
             pp = self.mesh.shape.get("pp", 1)
             tp_factor = tp if tp > 1 and mc.num_kv_heads % tp == 0 else 1
             pp_factor = pp if pp > 1 and mc.num_layers % pp == 0 else 1
+            # Explicit per-device headroom reserve comes off the top:
+            # residual allocations that memory_stats misses (checkpoint
+            # staging remnants, XLA autotuning scratch) repeatedly OOMed
+            # llama8b at utilization budgets that looked safe on paper.
+            free = max(free - self.config.hbm_headroom_reserve, 0)
             budget = free * self.config.hbm_utilization * tp_factor * pp_factor
             num = int(budget // self._kv_bytes_per_block())
         else:
@@ -675,6 +682,53 @@ class EngineCore:
             return z, jnp.zeros(shape, mc.jnp_dtype)
 
         return zeros()
+
+    @staticmethod
+    def _is_resource_exhausted(exc: BaseException) -> bool:
+        """XLA surfaces device OOM as XlaRuntimeError with a
+        RESOURCE_EXHAUSTED status string (no stable exception subclass
+        across jaxlib versions — the same string-match bench.py used for
+        its re-exec workaround, now handled in-process)."""
+        return "RESOURCE_EXHAUSTED" in str(exc)
+
+    def _alloc_kv_with_shrink(self):
+        """KV-pool allocation with an OOM pool-shrink retry ladder.
+
+        Auto-sizing works from free-HBM estimates that can miss residual
+        allocations (checkpoint staging remnants, compiler workspaces),
+        so the first allocation may land on ResourceExhausted even at a
+        sane hbm_utilization. Instead of dying — and forcing the
+        fresh-process relaunch bench.py used to do — shrink num_blocks
+        by pool_shrink_step and retry, up to pool_shrink_retries rungs,
+        never below the 2-sequence floor. Multihost replicas exchange
+        num_blocks before allocation and must agree on array shapes, so
+        the ladder only engages single-host; a multihost OOM still
+        raises (the leader's figure is already committed to peers)."""
+        cfg = self.config
+        rungs = cfg.pool_shrink_retries if self._mh is None else 0
+        min_blocks = cfg.max_blocks_per_seq * 2
+        for rung in range(rungs + 1):
+            try:
+                return self._alloc_kv()
+            except Exception as e:  # noqa: BLE001 - XlaRuntimeError
+                if not self._is_resource_exhausted(e):
+                    raise
+                if rung >= rungs or self.num_blocks <= min_blocks:
+                    logger.error(
+                        "KV pool allocation RESOURCE_EXHAUSTED with no "
+                        "shrink rungs left (num_blocks=%d, floor=%d)",
+                        self.num_blocks, min_blocks)
+                    raise
+                shrunk = max(
+                    int(self.num_blocks * (1.0 - cfg.pool_shrink_step)),
+                    min_blocks)
+                logger.warning(
+                    "KV pool allocation RESOURCE_EXHAUSTED at %d blocks; "
+                    "shrinking to %d (rung %d/%d)",
+                    self.num_blocks, shrunk, rung + 1, rungs)
+                self.num_blocks = shrunk
+                self.pool_shrink_retries_total += 1
+                gc.collect()  # drop the failed allocation's host refs
 
     def _make_forward(self, mode: str):
         """Prefill program: forward + on-device sampling of the last real
@@ -2048,6 +2102,7 @@ class EngineCore:
             "num_preempted_total": self.scheduler.num_preempted_total,
             "num_blocks": self.num_blocks,
             "hbm_headroom_bytes": self.hbm_headroom_bytes,
+            "pool_shrink_retries_total": self.pool_shrink_retries_total,
             "kv_cache_dtype": self.config.kv_cache_dtype,
             "kv_cache_bytes_per_token": (
                 self._kv_bytes_per_block() // self.config.block_size),

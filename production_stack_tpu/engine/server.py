@@ -148,6 +148,14 @@ class EngineServer:
         # current stats() sample is missing, so dashboards and alerts
         # never see the series disappear.
         self._last_hbm_headroom = 0
+        # Graceful drain (POST /drain, wired as the helm preStop hook):
+        # once draining, new inference requests get 503 + Retry-After
+        # (the router's failover sends them elsewhere), /health flips to
+        # 503 so readiness probes and the router's health sweep pull
+        # this replica, and in-flight requests run to completion —
+        # tracked by the middleware counter below.
+        self.draining = False
+        self._inflight = 0
 
     async def start_kv_reporting(self, own_url: str) -> None:
         """Register with the router's KV controller (retried lazily on
@@ -352,7 +360,23 @@ class EngineServer:
         if self.api_keys and gated and not auth.check_bearer(
                 request.headers.get("Authorization"), self.api_keys):
             return auth.unauthorized_response()
-        return await handler(request)
+        if not auth.is_gated(request.path):
+            return await handler(request)
+        # Inference surface: refuse new admissions while draining
+        # (in-flight requests — already counted — run to completion; the
+        # router's pre-first-byte failover reroutes rejected ones), and
+        # count in-flight requests so /drain knows when the replica is
+        # quiescent. /kv/*, /health, /metrics stay open throughout.
+        if self.draining:
+            return web.json_response(
+                {"error": {"message": "engine is draining",
+                           "type": "ServiceUnavailable"}},
+                status=503, headers={"Retry-After": "1"})
+        self._inflight += 1
+        try:
+            return await handler(request)
+        finally:
+            self._inflight -= 1
 
     def make_app(self) -> web.Application:
         app = web.Application(middlewares=[self._auth_middleware])
@@ -370,6 +394,7 @@ class EngineServer:
         r.add_get("/metrics", self.handle_metrics)
         r.add_get("/health", self.handle_health)
         r.add_get("/version", self.handle_version)
+        r.add_post("/drain", self.handle_drain)
         r.add_post("/sleep", self.handle_sleep)
         r.add_post("/wake_up", self.handle_wake)
         r.add_get("/is_sleeping", self.handle_is_sleeping)
@@ -1324,6 +1349,13 @@ class EngineServer:
             return web.json_response(
                 {"status": "unhealthy", "error": self.core.fatal_error},
                 status=503)
+        if self.draining:
+            # Readiness flips on drain: k8s pulls the pod from Service
+            # endpoints and the router's health sweep stops routing
+            # here while in-flight requests finish.
+            return web.json_response(
+                {"status": "draining", "in_flight": self._inflight},
+                status=503, headers={"Retry-After": "1"})
         body = {"status": "ok"}
         mh = self.core._mh
         if mh is not None:
@@ -1338,6 +1370,31 @@ class EngineServer:
         from production_stack_tpu import __version__
 
         return web.json_response({"version": __version__})
+
+    async def handle_drain(self, request: web.Request) -> web.Response:
+        """Graceful drain (the helm preStop hook, and any rollout
+        orchestrator): stop admitting inference requests, flip /health
+        to 503 so readiness and the router pull this replica, then wait
+        until in-flight requests finish (bounded by ?timeout_s=, default
+        30). Idempotent — repeat calls just re-await quiescence."""
+        try:
+            timeout_s = float(request.query.get("timeout_s", "30"))
+        except ValueError:
+            return web.json_response(
+                {"error": {"message": "timeout_s must be a number",
+                           "type": "BadRequestError"}}, status=400)
+        if not self.draining:
+            logger.info("Drain requested: admission stopped, %d in flight",
+                        self._inflight)
+        self.draining = True
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while self._inflight > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        drained = self._inflight == 0
+        return web.json_response(
+            {"status": "drained" if drained else "draining",
+             "in_flight": self._inflight},
+            status=200 if drained else 202)
 
     async def handle_sleep(self, request: web.Request) -> web.Response:
         level = int(request.query.get("level", "1"))
@@ -1853,6 +1910,14 @@ class EngineServer:
             f"{s.get('kv_cache_bytes_per_token', 0)}",
             "# TYPE tpu:engine_sleeping gauge",
             f"tpu:engine_sleeping{{{labels}}} {int(s['is_sleeping'])}",
+            # Fault tolerance: OOM pool-shrink ladder rungs taken at KV
+            # allocation, and the graceful-drain flag (1 while POST
+            # /drain has admission stopped).
+            "# TYPE tpu:pool_shrink_retries counter",
+            f"tpu:pool_shrink_retries_total{{{labels}}} "
+            f"{s.get('pool_shrink_retries_total', 0)}",
+            "# TYPE tpu:engine_draining gauge",
+            f"tpu:engine_draining{{{labels}}} {int(self.draining)}",
             "# TYPE tpu:cached_prompt_tokens counter",
             f"tpu:cached_prompt_tokens_total{{{labels}}} {s['cached_tokens_total']}",
             # Disaggregated-prefill KV handoff (the NIXL-pipe equivalent).
@@ -1996,6 +2061,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--block-size", type=int, default=64)
     p.add_argument("--num-blocks", type=int, default=None)
     p.add_argument("--hbm-utilization", type=float, default=0.7)
+    p.add_argument("--hbm-headroom-reserve", type=float, default=0.0,
+                   help="GiB of per-device HBM kept free when auto-"
+                        "sizing the KV pool (residual allocations "
+                        "memory_stats misses); on ResourceExhausted the "
+                        "pool additionally shrinks itself in retry "
+                        "rungs instead of dying (single-host)")
     p.add_argument("--tensor-parallel-size", type=int, default=1)
     p.add_argument("--pipeline-parallel-size", type=int, default=1,
                    help="stage-shard the layer stack over a pp mesh axis")
@@ -2102,6 +2173,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         block_size=args.block_size,
         num_blocks=args.num_blocks,
         hbm_utilization=args.hbm_utilization,
+        hbm_headroom_reserve=int(args.hbm_headroom_reserve * (1 << 30)),
         tensor_parallel_size=args.tensor_parallel_size,
         pipeline_parallel_size=args.pipeline_parallel_size,
         pp_microbatches=args.pp_microbatches,

@@ -67,6 +67,9 @@ class RouterState:
     log_stats_thread: Optional[threading.Thread] = None
     trace_recorder: Any = None
     qos: Any = None  # QoSGate when --qos-tenants-file is set, else None
+    # FaultTolerance bundle (circuit breaker + retry/deadline config)
+    # when --fault-tolerance is set, else None (single-attempt path).
+    fault_tolerance: Any = None
     extra: dict = field(default_factory=dict)
 
 
@@ -149,6 +152,7 @@ async def metrics_handler(request: web.Request) -> web.Response:
         state.service_discovery.get_endpoint_info(),
         state.engine_stats_scraper.get_engine_stats(),
         state.request_stats_monitor.get_request_stats(),
+        fault_tolerance=state.fault_tolerance,
     )
     return web.Response(
         body=metrics_mod.render_metrics(),
@@ -604,6 +608,25 @@ def initialize_all(args) -> RouterState:
                     state.qos.queue.max_concurrency,
                     state.qos.queue.shed_queue_depth)
 
+    # Fault-tolerance layer (production_stack_tpu/router/fault_tolerance):
+    # circuit breaker + retry/failover + streaming deadlines. Off by
+    # default — the request path is then byte-identical to the
+    # single-attempt router.
+    from production_stack_tpu.router.fault_tolerance import (
+        initialize_fault_tolerance,
+    )
+
+    state.fault_tolerance = initialize_fault_tolerance(
+        args, service_discovery=state.service_discovery)
+    if state.fault_tolerance is not None:
+        cfg = state.fault_tolerance.config
+        logger.info(
+            "Fault tolerance enabled: max_retries=%d breaker_threshold=%d "
+            "breaker_reset=%.0fs ttft_deadline=%.0fs "
+            "inter_chunk_deadline=%.0fs", cfg.max_retries,
+            cfg.breaker_failure_threshold, cfg.breaker_reset_s,
+            cfg.ttft_deadline_s, cfg.inter_chunk_deadline_s)
+
     # Dynamic config watcher.
     if getattr(args, "dynamic_config_json", None):
         from production_stack_tpu.router.dynamic_config import (
@@ -631,7 +654,9 @@ def _start_log_stats_thread(state: RouterState, interval: float) -> threading.Th
                 endpoints = state.service_discovery.get_endpoint_info()
                 engine_stats = state.engine_stats_scraper.get_engine_stats()
                 request_stats = state.request_stats_monitor.get_request_stats()
-                metrics_mod.update_gauges(endpoints, engine_stats, request_stats)
+                metrics_mod.update_gauges(
+                    endpoints, engine_stats, request_stats,
+                    fault_tolerance=state.fault_tolerance)
                 lines = ["", "==== Router stats ===="]
                 for ep in endpoints:
                     rs = request_stats.get(ep.url)

@@ -74,6 +74,14 @@ class EngineStats:
 class EngineStatsScraper(metaclass=SingletonMeta):
     """Daemon thread scraping every engine's /metrics (reference :88-218)."""
 
+    # Consecutive scrape failures before an endpoint's stats are marked
+    # stale and withheld from routing decisions. Below the threshold the
+    # last-known stats carry forward (one dropped scrape should not make
+    # a kvaware/least-loaded router forget a replica); at or above it,
+    # stale numbers are worse than none — the routing logic falls back
+    # to its no-stats behavior for that replica.
+    STALE_AFTER = 3
+
     def __init__(self, scrape_interval: float = 10.0):
         if hasattr(self, "_initialized"):
             return
@@ -82,6 +90,8 @@ class EngineStatsScraper(metaclass=SingletonMeta):
         self._stats: Dict[str, EngineStats] = {}
         self._lock = threading.Lock()
         self._running = True
+        self._fail_counts: Dict[str, int] = {}
+        self._stale: set = set()
         self._thread = threading.Thread(
             target=self._scrape_worker, daemon=True, name="engine-stats-scraper"
         )
@@ -98,16 +108,40 @@ class EngineStatsScraper(metaclass=SingletonMeta):
             except RuntimeError:
                 endpoints = []
             fresh: Dict[str, EngineStats] = {}
+            stale: set = set()
+            with self._lock:
+                previous = dict(self._stats)
             for ep in endpoints:
                 stats = self._scrape_one(ep.url)
                 if stats is not None:
                     fresh[ep.url] = stats
+                    self._fail_counts[ep.url] = 0
+                    continue
+                failures = self._fail_counts.get(ep.url, 0) + 1
+                self._fail_counts[ep.url] = failures
+                if failures < self.STALE_AFTER and ep.url in previous:
+                    # Grace window: carry the last-known stats forward.
+                    fresh[ep.url] = previous[ep.url]
+                else:
+                    stale.add(ep.url)
+                    self._count_stale(ep.url)
+            # Forget counters for endpoints discovery no longer reports.
+            live = {ep.url for ep in endpoints}
+            for url in [u for u in self._fail_counts if u not in live]:
+                del self._fail_counts[url]
             with self._lock:
                 self._stats = fresh
+                self._stale = stale
             for _ in range(int(self.scrape_interval * 10)):
                 if not self._running:
                     return
                 time.sleep(0.1)
+
+    @staticmethod
+    def _count_stale(url: str) -> None:
+        from production_stack_tpu.router import metrics as router_metrics
+
+        router_metrics.engine_stats_stale.labels(server=url).inc()
 
     def _scrape_one(self, url: str) -> Optional[EngineStats]:
         try:
@@ -119,8 +153,16 @@ class EngineStatsScraper(metaclass=SingletonMeta):
             return None
 
     def get_engine_stats(self) -> Dict[str, EngineStats]:
+        """Routable stats only: endpoints whose scrapes have failed
+        STALE_AFTER consecutive cycles are excluded (their numbers are
+        stale — routing on them would pile load onto a replica whose
+        true state is unknown)."""
         with self._lock:
             return dict(self._stats)
+
+    def get_stale_endpoints(self) -> "set[str]":
+        with self._lock:
+            return set(self._stale)
 
     def get_health(self) -> bool:
         return self._thread.is_alive()

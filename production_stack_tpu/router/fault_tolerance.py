@@ -1,0 +1,239 @@
+"""Router-side fault tolerance: circuit breaker + retry/failover policy.
+
+The reference production-stack leans on Kubernetes (readiness probes,
+Service endpoints) to stop routing at broken pods; between probe
+intervals every request to a dead replica fails. This module closes
+that window inside the router:
+
+- :class:`CircuitBreaker` tracks consecutive failures per endpoint URL.
+  After ``failure_threshold`` consecutive failures the breaker OPENs and
+  the endpoint is excluded from routing. After ``reset_s`` seconds one
+  probe request is let through (HALF_OPEN); success CLOSEs the breaker,
+  failure re-OPENs it for another ``reset_s``.
+- :class:`FaultToleranceConfig` carries the retry/backoff/deadline knobs
+  parsed from ``--ft-*`` flags (router/parser.py).
+
+The retry loop itself lives in request_service.py (it is entangled with
+the streaming proxy); the idempotency rule is enforced there: a request
+is only ever retried/failed-over BEFORE the first streamed byte reached
+the client. See docs/fault_tolerance.md.
+
+Breaker state is exported as ``vllm_router:circuit_state`` (0 CLOSED,
+1 OPEN, 2 HALF_OPEN) and mirrored into the service-discovery unhealthy
+set so ``/health`` and routing filters see one consistent view.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+# Breaker states (the values are exported verbatim as the
+# vllm_router:circuit_state gauge).
+CLOSED = 0
+OPEN = 1
+HALF_OPEN = 2
+
+_STATE_NAMES = {CLOSED: "closed", OPEN: "open", HALF_OPEN: "half_open"}
+
+
+@dataclass
+class FaultToleranceConfig:
+    """Knobs for the router's retry / circuit-breaker / deadline layer."""
+
+    enabled: bool = False
+    # Bounded retry with exponential backoff + full jitter. max_retries
+    # counts ADDITIONAL attempts after the first (3 -> up to 4 tries).
+    max_retries: int = 3
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 2.0
+    # Circuit breaker: consecutive failures before the endpoint trips
+    # OPEN, and how long it stays open before a half-open probe.
+    breaker_failure_threshold: int = 5
+    breaker_reset_s: float = 30.0
+    # Streaming deadlines replacing the old flat total timeout: the
+    # first byte must arrive within ttft_deadline_s of dispatch, and
+    # each subsequent chunk within inter_chunk_deadline_s of the
+    # previous one. 0 disables the respective deadline.
+    ttft_deadline_s: float = 120.0
+    inter_chunk_deadline_s: float = 30.0
+    # Retry-After hint returned with 503 when every replica is broken.
+    retry_after_s: int = 5
+
+    def backoff_s(self, attempt: int, rand: float) -> float:
+        """Full-jitter exponential backoff for retry number ``attempt``
+        (0-based): uniform in [0, min(base * 2^attempt, max)]."""
+        ceiling = min(self.backoff_base_s * (2 ** attempt),
+                      self.backoff_max_s)
+        return ceiling * rand
+
+
+class CircuitBreaker:
+    """Per-endpoint-URL consecutive-failure circuit breaker.
+
+    Thread-safe: failures are recorded from request handlers on the
+    event loop while /metrics and /health read state from other tasks,
+    and the service-discovery health thread may consult it.
+
+    When a breaker opens, the URL is also pushed into the service
+    discovery module's unhealthy set (when the active discovery class
+    supports it) so every consumer of
+    ``get_unhealthy_endpoint_hashes()`` — /health, routing filters —
+    sees the same exclusion without double bookkeeping.
+    """
+
+    def __init__(self, failure_threshold: int = 5, reset_s: float = 30.0,
+                 service_discovery: Any = None):
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.reset_s = float(reset_s)
+        self._lock = threading.Lock()
+        # url -> [state, consecutive_failures, opened_at_monotonic]
+        self._state: Dict[str, List[float]] = {}
+        self._sd = service_discovery
+        # Cumulative trip count (exported for observability/tests).
+        self.trips_total = 0
+
+    # -- internal ---------------------------------------------------- #
+    def _entry(self, url: str) -> List[float]:
+        e = self._state.get(url)
+        if e is None:
+            e = [CLOSED, 0, 0.0]
+            self._state[url] = e
+        return e
+
+    def _mark_sd(self, url: str, unhealthy: bool) -> None:
+        """Mirror breaker state into the service-discovery unhealthy set
+        (best-effort: only StaticServiceDiscovery tracks one today)."""
+        sd = self._sd
+        if sd is None:
+            return
+        fn = getattr(sd, "mark_unhealthy" if unhealthy else "clear_unhealthy",
+                     None)
+        if fn is not None:
+            try:
+                fn(url)
+            except Exception:  # pragma: no cover - defensive
+                logger.debug("service discovery unhealthy-mirror failed",
+                             exc_info=True)
+
+    # -- queries ----------------------------------------------------- #
+    def allow(self, url: str) -> bool:
+        """May a request be sent to ``url`` right now? An OPEN breaker
+        past its reset window transitions to HALF_OPEN and admits ONE
+        probe request."""
+        now = time.monotonic()
+        with self._lock:
+            e = self._entry(url)
+            if e[0] == CLOSED:
+                return True
+            if e[0] == OPEN:
+                if now - e[2] >= self.reset_s:
+                    e[0] = HALF_OPEN
+                    return True
+                return False
+            # HALF_OPEN: one probe is already in flight; hold the rest
+            # back until it reports success/failure.
+            return False
+
+    def state_value(self, url: str) -> int:
+        with self._lock:
+            e = self._state.get(url)
+            return int(e[0]) if e is not None else CLOSED
+
+    def state_name(self, url: str) -> str:
+        return _STATE_NAMES[self.state_value(url)]
+
+    def blocked_urls(self) -> "set[str]":
+        """URLs that would currently be refused by :meth:`allow` —
+        WITHOUT consuming the half-open probe slot."""
+        now = time.monotonic()
+        blocked = set()
+        with self._lock:
+            for url, e in self._state.items():
+                if e[0] == OPEN and now - e[2] < self.reset_s:
+                    blocked.add(url)
+        return blocked
+
+    def snapshot(self) -> Dict[str, int]:
+        """url -> state value, for the circuit_state gauge."""
+        with self._lock:
+            return {url: int(e[0]) for url, e in self._state.items()}
+
+    # -- transitions -------------------------------------------------- #
+    def record_success(self, url: str) -> None:
+        clear = False
+        with self._lock:
+            e = self._entry(url)
+            if e[0] != CLOSED:
+                clear = True
+            e[0] = CLOSED
+            e[1] = 0
+        if clear:
+            logger.info("circuit breaker CLOSED for %s", url)
+            self._mark_sd(url, unhealthy=False)
+
+    def record_failure(self, url: str) -> None:
+        tripped = False
+        with self._lock:
+            e = self._entry(url)
+            if e[0] == HALF_OPEN:
+                # Probe failed: straight back to OPEN for another window.
+                e[0] = OPEN
+                e[2] = time.monotonic()
+                tripped = True
+            else:
+                e[1] += 1
+                if e[1] >= self.failure_threshold and e[0] == CLOSED:
+                    e[0] = OPEN
+                    e[2] = time.monotonic()
+                    tripped = True
+            if tripped:
+                self.trips_total += 1
+        if tripped:
+            logger.warning(
+                "circuit breaker OPEN for %s (%d consecutive failures; "
+                "half-open probe in %.0fs)", url,
+                self.failure_threshold, self.reset_s)
+            self._mark_sd(url, unhealthy=True)
+
+
+class FaultTolerance:
+    """The router's fault-tolerance state bundle (config + breaker),
+    hung off RouterState as ``state.fault_tolerance``."""
+
+    def __init__(self, config: FaultToleranceConfig,
+                 service_discovery: Any = None):
+        self.config = config
+        self.breaker = CircuitBreaker(
+            failure_threshold=config.breaker_failure_threshold,
+            reset_s=config.breaker_reset_s,
+            service_discovery=service_discovery,
+        )
+
+
+def initialize_fault_tolerance(args,
+                               service_discovery: Any = None,
+                               ) -> Optional[FaultTolerance]:
+    """Build the FaultTolerance bundle from parsed router args (None
+    when --fault-tolerance is off: request_service then runs the exact
+    pre-existing single-attempt code path)."""
+    if not getattr(args, "fault_tolerance", False):
+        return None
+    cfg = FaultToleranceConfig(
+        enabled=True,
+        max_retries=args.ft_max_retries,
+        backoff_base_s=args.ft_backoff_base,
+        backoff_max_s=args.ft_backoff_max,
+        breaker_failure_threshold=args.ft_breaker_threshold,
+        breaker_reset_s=args.ft_breaker_reset,
+        ttft_deadline_s=args.ft_ttft_deadline,
+        inter_chunk_deadline_s=args.ft_inter_chunk_deadline,
+        retry_after_s=args.ft_retry_after,
+    )
+    return FaultTolerance(cfg, service_discovery=service_discovery)

@@ -20,6 +20,11 @@ class AiohttpClientWrapper(metaclass=SingletonMeta):
 
     def session(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
+            # No total timeout at the session level: a flat total cap
+            # kills legitimate long generations. Per-request liveness is
+            # enforced by the fault-tolerance layer's TTFT and
+            # inter-chunk deadlines (request_service.process_request);
+            # sock_connect bounds only the TCP handshake.
             self._session = aiohttp.ClientSession(
                 connector=aiohttp.TCPConnector(limit=0),
                 timeout=aiohttp.ClientTimeout(total=None, sock_connect=30),

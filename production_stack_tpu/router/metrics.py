@@ -93,10 +93,33 @@ tenant_queue_wait = Histogram(
              5.0, 10.0, 30.0, 60.0),
     registry=REGISTRY)
 
+# --- Fault tolerance (production_stack_tpu/router/fault_tolerance.py) ----
+# Series appear only with --fault-tolerance on (the retry/failover layer
+# does not exist otherwise).
+retries_total = Counter(
+    "vllm_router:retries_total",
+    "Upstream attempts retried (connect error or 5xx before the first "
+    "streamed byte)",
+    _L, registry=REGISTRY)
+failovers_total = Counter(
+    "vllm_router:failovers_total",
+    "Requests that completed on a different replica than first routed",
+    _L, registry=REGISTRY)
+circuit_state = Gauge(
+    "vllm_router:circuit_state",
+    "Per-endpoint circuit breaker state (0 closed, 1 open, 2 half-open)",
+    _L, registry=REGISTRY)
+engine_stats_stale = Counter(
+    "vllm_router:engine_stats_stale_total",
+    "Scrape cycles in which an endpoint's engine stats were marked stale "
+    "and excluded from routing",
+    _L, registry=REGISTRY)
+
 _PROCESS = psutil.Process()
 
 
-def update_gauges(endpoints, engine_stats: Dict, request_stats: Dict) -> None:
+def update_gauges(endpoints, engine_stats: Dict, request_stats: Dict,
+                  fault_tolerance=None) -> None:
     """Refresh all gauges from the current stat snapshots.
 
     Called from both the /metrics handler and the periodic stats logger
@@ -118,6 +141,9 @@ def update_gauges(endpoints, engine_stats: Dict, request_stats: Dict) -> None:
         num_requests_waiting.labels(server=url).set(stats.num_queuing_requests)
         kv_cache_usage.labels(server=url).set(stats.gpu_cache_usage_perc)
         prefix_cache_hit_rate.labels(server=url).set(stats.gpu_prefix_cache_hit_rate)
+    if fault_tolerance is not None:
+        for url, value in fault_tolerance.breaker.snapshot().items():
+            circuit_state.labels(server=url).set(value)
     router_cpu_pct.set(_PROCESS.cpu_percent(interval=None))
     router_mem_bytes.set(_PROCESS.memory_info().rss)
     try:

@@ -82,6 +82,40 @@ def build_parser() -> argparse.ArgumentParser:
                              "tenants file's shed_queue_depth)")
     parser.add_argument("--qos-reload-interval", type=float, default=2.0,
                         help="seconds between tenants-file mtime checks")
+    # Fault tolerance (production_stack_tpu/router/fault_tolerance.py)
+    parser.add_argument("--fault-tolerance", action="store_true",
+                        help="enable the fault-tolerant data plane: "
+                             "per-endpoint circuit breaker, bounded "
+                             "retry with failover to another healthy "
+                             "replica (connect errors and 5xx before "
+                             "the first streamed byte only), and "
+                             "TTFT/inter-chunk streaming deadlines. "
+                             "Unset = today's single-attempt behavior, "
+                             "byte-identical")
+    parser.add_argument("--ft-max-retries", type=int, default=3,
+                        help="additional attempts after the first "
+                             "(failing over across healthy replicas)")
+    parser.add_argument("--ft-backoff-base", type=float, default=0.05,
+                        help="exponential backoff base seconds "
+                             "(full jitter)")
+    parser.add_argument("--ft-backoff-max", type=float, default=2.0,
+                        help="backoff ceiling seconds")
+    parser.add_argument("--ft-breaker-threshold", type=int, default=5,
+                        help="consecutive failures before an endpoint's "
+                             "circuit breaker opens")
+    parser.add_argument("--ft-breaker-reset", type=float, default=30.0,
+                        help="seconds an open breaker waits before a "
+                             "half-open probe request")
+    parser.add_argument("--ft-ttft-deadline", type=float, default=120.0,
+                        help="seconds allowed until the first upstream "
+                             "byte (0 disables)")
+    parser.add_argument("--ft-inter-chunk-deadline", type=float,
+                        default=30.0,
+                        help="seconds allowed between streamed chunks "
+                             "(0 disables)")
+    parser.add_argument("--ft-retry-after", type=int, default=5,
+                        help="Retry-After seconds returned with 503 "
+                             "when every replica is broken")
     # Dynamic config
     parser.add_argument("--kv-admit-ttl", type=float, default=600.0,
                         help="seconds a KV admission claim stays routable "
@@ -151,6 +185,19 @@ def validate_args(args: argparse.Namespace) -> None:
     if getattr(args, "qos_shed_queue_depth", None) is not None \
             and args.qos_shed_queue_depth < 0:
         raise ValueError("--qos-shed-queue-depth must be >= 0")
+    if getattr(args, "fault_tolerance", False):
+        if args.ft_max_retries < 0:
+            raise ValueError("--ft-max-retries must be >= 0")
+        if args.ft_backoff_base < 0 or args.ft_backoff_max < 0:
+            raise ValueError("--ft-backoff-base/--ft-backoff-max must "
+                             "be >= 0")
+        if args.ft_breaker_threshold < 1:
+            raise ValueError("--ft-breaker-threshold must be >= 1")
+        if args.ft_breaker_reset <= 0:
+            raise ValueError("--ft-breaker-reset must be > 0")
+        if args.ft_ttft_deadline < 0 or args.ft_inter_chunk_deadline < 0:
+            raise ValueError("--ft-ttft-deadline/--ft-inter-chunk-"
+                             "deadline must be >= 0 (0 disables)")
     if not 0.0 <= args.sentry_traces_sample_rate <= 1.0:
         raise ValueError("--sentry-traces-sample-rate must be in [0, 1]")
     if not 0.0 <= args.sentry_profile_session_sample_rate <= 1.0:

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import random
 import time
 import uuid
 from typing import AsyncGenerator, Optional, Tuple
@@ -53,19 +54,61 @@ async def process_request(
     body: bytes,
     headers: dict,
     method: str = "POST",
+    ttft_deadline: Optional[float] = None,
+    inter_chunk_deadline: Optional[float] = None,
 ) -> AsyncGenerator[Tuple[str, object], None]:
     """Stream a backend request; yields ("headers", (status, hdrs)) then
-    ("chunk", bytes)... — mirroring reference request.py:55-137."""
+    ("chunk", bytes)... — mirroring reference request.py:55-137.
+
+    With both deadlines None (fault tolerance off) this is the exact
+    historical single-attempt path. With --fault-tolerance on, the flat
+    upstream timeout is replaced by a TTFT deadline (dispatch -> first
+    body byte, connect and response headers included) and an inter-chunk
+    deadline (each subsequent read), so a hung engine raises
+    ``asyncio.TimeoutError`` instead of wedging the stream.
+    """
     monitor = state.request_stats_monitor
     monitor.on_new_request(backend_url, request_id, time.time())
     session = get_client_session()
     first = True
     try:
-        async with session.request(
+        if ttft_deadline is None and inter_chunk_deadline is None:
+            async with session.request(
+                method, f"{backend_url}{endpoint}", data=body, headers=headers
+            ) as resp:
+                yield "headers", (resp.status, dict(resp.headers))
+                async for chunk in resp.content.iter_any():
+                    now = time.time()
+                    if first:
+                        monitor.on_request_response(backend_url, request_id, now)
+                        first = False
+                    else:
+                        monitor.on_token(backend_url, request_id, now)
+                    yield "chunk", chunk
+            return
+        t0 = time.monotonic()
+        req = session.request(
             method, f"{backend_url}{endpoint}", data=body, headers=headers
-        ) as resp:
+        )
+        if ttft_deadline:
+            resp = await asyncio.wait_for(req, ttft_deadline)
+        else:
+            resp = await req
+        async with resp:
             yield "headers", (resp.status, dict(resp.headers))
-            async for chunk in resp.content.iter_any():
+            while True:
+                if first and ttft_deadline:
+                    budget = max(0.001,
+                                 ttft_deadline - (time.monotonic() - t0))
+                elif not first and inter_chunk_deadline:
+                    budget = inter_chunk_deadline
+                else:
+                    budget = None
+                read = resp.content.readany()
+                chunk = (await asyncio.wait_for(read, budget)
+                         if budget is not None else await read)
+                if not chunk:
+                    break
                 now = time.time()
                 if first:
                     monitor.on_request_response(backend_url, request_id, now)
@@ -75,6 +118,100 @@ async def process_request(
                 yield "chunk", chunk
     finally:
         monitor.on_request_complete(backend_url, request_id, time.time())
+
+
+async def _stream_with_failover(
+    state,
+    ft,
+    request_id: str,
+    server_url: str,
+    candidate_urls,
+    endpoint: str,
+    body: bytes,
+    headers: dict,
+) -> AsyncGenerator[Tuple[str, object], None]:
+    """Retry/failover wrapper around :func:`process_request`.
+
+    Yields the same ("headers", ...)/("chunk", ...) events, plus
+    ("attempt", url) before each upstream try and ("failed", message) if
+    every attempt is exhausted (caller turns that into 503 +
+    Retry-After).
+
+    The idempotency rule: headers are BUFFERED until the first body byte
+    arrives, so a connect error, a 5xx response, or a TTFT-deadline
+    expiry — all strictly before the first streamed byte — can fail over
+    to another replica. Once the first chunk is yielded downstream the
+    request is committed: any later fault records a breaker failure and
+    propagates; it is never retried (the client already saw bytes).
+    """
+    from production_stack_tpu.router import metrics as router_metrics
+
+    cfg = ft.config
+    breaker = ft.breaker
+    # The routed URL leads; remaining healthy replicas are failover
+    # targets, cycled if retries outnumber candidates.
+    ordered = [server_url] + [u for u in candidate_urls if u != server_url]
+    attempts = cfg.max_retries + 1
+    last_error = "no healthy replica"
+    committed = False
+    for attempt in range(attempts):
+        url = ordered[attempt % len(ordered)]
+        if not breaker.allow(url):
+            last_error = f"circuit open for {url}"
+            continue
+        if attempt > 0:
+            router_metrics.retries_total.labels(server=url).inc()
+            await asyncio.sleep(cfg.backoff_s(attempt - 1, random.random()))
+        yield "attempt", url
+        pending_headers = None
+        try:
+            stream = process_request(
+                state, request_id, url, endpoint, body, headers,
+                ttft_deadline=cfg.ttft_deadline_s or None,
+                inter_chunk_deadline=cfg.inter_chunk_deadline_s or None,
+            )
+            async for kind, payload in stream:
+                if kind == "headers":
+                    status, _hdrs = payload
+                    if status >= 500:
+                        # 5xx before any body byte: retryable per the
+                        # idempotency rule.
+                        last_error = f"{url} answered {status}"
+                        breaker.record_failure(url)
+                        await stream.aclose()
+                        pending_headers = None
+                        break
+                    pending_headers = payload
+                else:
+                    if pending_headers is not None:
+                        committed = True
+                        if url != server_url:
+                            router_metrics.failovers_total.labels(
+                                server=url).inc()
+                        yield "headers", pending_headers
+                        pending_headers = None
+                    yield kind, payload
+            else:
+                # Clean upstream EOF. Flush still-buffered headers
+                # (empty-body response, e.g. 204 or HEAD-ish).
+                if pending_headers is not None:
+                    if url != server_url:
+                        router_metrics.failovers_total.labels(
+                            server=url).inc()
+                    yield "headers", pending_headers
+                breaker.record_success(url)
+                return
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            breaker.record_failure(url)
+            if committed:
+                # Bytes already reached the client: NEVER retried.
+                raise
+            last_error = f"{url}: {type(e).__name__}: {e}"
+            logger.warning(
+                "Attempt %d/%d for %s on %s failed before first byte: %s",
+                attempt + 1, attempts, request_id, url, last_error)
+            continue
+    yield "failed", last_error
 
 
 async def route_general_request(
@@ -186,6 +323,29 @@ async def route_general_request(
             status=400,
         )
 
+    # Circuit breaker: endpoints with an OPEN breaker are excluded from
+    # routing. If that leaves nothing, every replica is broken — tell
+    # the client when to come back instead of burning a doomed attempt.
+    ft = getattr(state, "fault_tolerance", None)
+    if ft is not None:
+        blocked = ft.breaker.blocked_urls()
+        if blocked:
+            healthy = [ep for ep in endpoints if ep.url not in blocked]
+            if not healthy:
+                if trace is not None:
+                    root.finish(status=503, error="all_circuits_open")
+                    recorder.record(trace)
+                return web.json_response(
+                    {"error": {
+                        "message": "All replicas are failing "
+                                   "(circuit breakers open); retry later.",
+                        "type": "ServiceUnavailable"}},
+                    status=503,
+                    headers={"Retry-After": str(ft.config.retry_after_s),
+                             **qos_headers},
+                )
+            endpoints = healthy
+
     # Weighted-fair dispatch: wait for a slot before picking a backend so
     # the routing decision sees fresh stats.  The lease is held for the
     # whole upstream exchange (streaming included) and released in the
@@ -261,15 +421,39 @@ async def route_general_request(
             headers["traceparent"] = format_traceparent(
                 trace.trace_id, upstream.span_id)
 
-        stream = process_request(
-            state, request_id, server_url, endpoint, body, headers
-        )
+        if ft is not None:
+            stream = _stream_with_failover(
+                state, ft, request_id, server_url,
+                [ep.url for ep in endpoints], endpoint, body, headers,
+            )
+        else:
+            stream = process_request(
+                state, request_id, server_url, endpoint, body, headers
+            )
         response: Optional[web.StreamResponse] = None
         full_response = bytearray()
         got_first_chunk = False
         try:
             try:
                 async for kind, payload in stream:
+                    if kind == "attempt":
+                        server_url = payload
+                        continue
+                    if kind == "failed":
+                        logger.error(
+                            "All upstream attempts failed for %s: %s",
+                            request_id, payload)
+                        if upstream is not None:
+                            upstream.finish(error=str(payload))
+                        return web.json_response(
+                            {"error": {
+                                "message": f"All replicas failed: {payload}",
+                                "type": "ServiceUnavailable"}},
+                            status=503,
+                            headers={
+                                "Retry-After": str(ft.config.retry_after_s),
+                                **qos_headers},
+                        )
                     if kind == "headers":
                         status, hdrs = payload
                         response = web.StreamResponse(status=status)
@@ -292,7 +476,7 @@ async def route_general_request(
                         full_response.extend(payload)
                         assert response is not None
                         await response.write(payload)
-            except aiohttp.ClientError as e:
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
                 logger.error("Backend %s failed for %s: %s", server_url, request_id, e)
                 if upstream is not None:
                     upstream.finish(error=str(e))
@@ -418,13 +602,20 @@ async def route_disaggregated_prefill_request(
                 "router.kv_pull", source=prefill_url, target=decode_url)
             headers["traceparent"] = format_traceparent(
                 trace.trace_id, pull_span.span_id)
+        # The pull is a control+transfer exchange, not a token stream:
+        # a total deadline fits. With fault tolerance on, the TTFT
+        # deadline governs it instead of the historical flat 60s.
+        ft = getattr(state, "fault_tolerance", None)
+        pull_timeout = 60.0
+        if ft is not None and ft.config.ttft_deadline_s:
+            pull_timeout = ft.config.ttft_deadline_s
         try:
             async with session.post(
                 f"{decode_url}/kv/pull",
                 json={"source_url": prefill_url, "request": request_json},
                 headers={k: headers[k] for k in ("X-Request-Id", "traceparent")
                          if k in headers},
-                timeout=aiohttp.ClientTimeout(total=60),
+                timeout=aiohttp.ClientTimeout(total=pull_timeout),
             ) as pull_resp:
                 pull = await pull_resp.json()
                 logger.info(

@@ -152,6 +152,14 @@ class StaticServiceDiscovery(ServiceDiscovery):
                 )
             )
         self._unhealthy: set = set()
+        # URLs the router's circuit breaker tripped OPEN for
+        # (fault_tolerance.py). Kept separate from the probe-based set so
+        # the periodic health sweep's wholesale replacement of
+        # self._unhealthy cannot erase breaker state; surfaced together
+        # in get_unhealthy_endpoint_hashes(). Breaker-marked URLs stay in
+        # get_endpoint_info() — the half-open probe must remain routable;
+        # request-level filtering uses breaker.blocked_urls().
+        self._breaker_unhealthy: set = set()
         self._running = True
         self._hc_thread: Optional[threading.Thread] = None
         if static_backend_health_checks:
@@ -183,7 +191,16 @@ class StaticServiceDiscovery(ServiceDiscovery):
 
     def get_unhealthy_endpoint_hashes(self) -> List[str]:
         with self._lock:
-            return sorted(self._unhealthy)
+            return sorted(self._unhealthy | self._breaker_unhealthy)
+
+    def mark_unhealthy(self, url: str) -> None:
+        """Circuit-breaker mirror: report ``url`` unhealthy."""
+        with self._lock:
+            self._breaker_unhealthy.add(url)
+
+    def clear_unhealthy(self, url: str) -> None:
+        with self._lock:
+            self._breaker_unhealthy.discard(url)
 
     def get_endpoint_info(self) -> List[EndpointInfo]:
         with self._lock:

@@ -1,0 +1,204 @@
+"""Hermetic chaos A/B: router fault tolerance ON vs OFF under replica loss.
+
+The physics, with no TPU and no model: three :class:`FakeEngine`
+replicas serve a storm of short streamed requests through the real
+router. Mid-storm one replica is KILLED (its server drops every
+connection and refuses new ones) and a second is HUNG (it accepts
+requests but never sends response headers — the slow-TTFT failure that
+a flat connect timeout never catches).
+
+- **ft_on** leg: the router runs with ``--fault-tolerance``. Connect
+  refusals and TTFT-deadline expiries happen *before the first streamed
+  byte*, so the retry loop fails the request over to the surviving
+  replica; after ``ft_breaker_threshold`` consecutive failures each
+  broken replica's circuit opens and is excluded up front. The storm
+  completes (target: >= 99%) with p99 bounded by roughly one TTFT
+  deadline + backoff.
+- **ft_off** leg: same traffic, no fault tolerance. Round-robin keeps
+  assigning ~2/3 of requests to the dead and hung replicas: dead ones
+  fail fast, hung ones burn the client's whole timeout. This is the
+  failure baseline the ON leg is judged against.
+
+Used by ``bench.py`` (BENCH_CHAOS=1) and ``tests/test_fault_tolerance.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import List, Optional
+
+from production_stack_tpu.testing.qos_ab import (
+    _p99,
+    _reset_router_singletons,
+)
+
+MODEL = "chaos-model"
+
+
+async def _start(app, shutdown_timeout: float = 0.5):
+    """Start an app on an ephemeral port. A short shutdown timeout
+    matters here: the hung replica still holds 300 s sleeping handlers
+    at leg teardown, and the default 60 s grace would stall the bench."""
+    from aiohttp import web
+
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0,
+                       shutdown_timeout=shutdown_timeout)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+async def _one_request(session, router_url: str,
+                       client_timeout_s: float) -> Optional[float]:
+    """One streamed chat completion; returns wall latency on a complete
+    stream (``[DONE]`` seen), None on any failure."""
+    import aiohttp
+
+    t0 = time.perf_counter()
+    try:
+        async with session.post(
+            router_url + "/v1/chat/completions",
+            json={"model": MODEL, "max_tokens": 4, "stream": True,
+                  "messages": [{"role": "user", "content": "ping"}]},
+            timeout=aiohttp.ClientTimeout(total=client_timeout_s),
+        ) as resp:
+            if resp.status != 200:
+                return None
+            done = False
+            async for line in resp.content:
+                if line.strip() == b"data: [DONE]":
+                    done = True
+            return time.perf_counter() - t0 if done else None
+    except (aiohttp.ClientError, asyncio.TimeoutError):
+        return None
+
+
+async def _run_leg(*, ft_on: bool, total: int, concurrency: int,
+                   chaos_after: int, client_timeout_s: float,
+                   ttft_deadline_s: float, engine_ttft: float) -> dict:
+    import aiohttp
+
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.parser import build_parser
+    from production_stack_tpu.testing.fake_engine import FakeEngine
+
+    _reset_router_singletons()
+    engines = [FakeEngine(model=MODEL, ttft=engine_ttft,
+                          max_tokens_default=4) for _ in range(3)]
+    started = [await _start(e.make_app()) for e in engines]
+    runners = [r for r, _ in started]
+    urls = [u for _, u in started]
+
+    args = build_parser().parse_args([])
+    args.static_backends = ",".join(urls)
+    args.static_models = ",".join([MODEL] * 3)
+    args.routing_logic = "roundrobin"
+    args.engine_stats_interval = 60
+    if ft_on:
+        args.fault_tolerance = True
+        args.ft_max_retries = 3
+        args.ft_backoff_base = 0.02
+        args.ft_backoff_max = 0.25
+        args.ft_breaker_threshold = 3
+        args.ft_breaker_reset = 60.0
+        args.ft_ttft_deadline = ttft_deadline_s
+        args.ft_inter_chunk_deadline = ttft_deadline_s
+    router_app = build_app(args)
+    router_runner, router_url = await _start(router_app)
+
+    chaos_fired = asyncio.Event()
+    finished = [0]
+
+    async def fire_chaos(session):
+        # KILL replica 1: drop every connection, refuse new ones.
+        await runners[1].cleanup()
+        # HANG replica 2: accepts requests, never sends headers (the
+        # slow-TTFT fault), via its own control endpoint.
+        async with session.post(
+            urls[2] + "/fault",
+            json={"mode": "hang_before_stream", "times": -1},
+        ) as resp:
+            assert resp.status == 200
+        chaos_fired.set()
+
+    latencies: List[float] = []
+    failed = 0
+    sem = asyncio.Semaphore(concurrency)
+
+    async def one(session, i):
+        nonlocal failed
+        async with sem:
+            result = await _one_request(session, router_url,
+                                        client_timeout_s)
+            if result is None:
+                failed += 1
+            else:
+                latencies.append(result)
+            finished[0] += 1
+            if finished[0] == chaos_after:
+                await fire_chaos(session)
+
+    t_leg = time.perf_counter()
+    try:
+        async with aiohttp.ClientSession() as session:
+            await asyncio.gather(
+                *[one(session, i) for i in range(total)])
+    finally:
+        await router_runner.cleanup()
+        for i, runner in enumerate(runners):
+            if i != 1:  # replica 1 was killed mid-storm
+                await runner.cleanup()
+        _reset_router_singletons()
+
+    return {
+        "ft_on": ft_on,
+        "total": total,
+        "completed": len(latencies),
+        "failed": failed,
+        "completion_rate": round(len(latencies) / total, 4) if total else None,
+        "p50_latency_s": round(sorted(latencies)[len(latencies) // 2], 4)
+        if latencies else None,
+        "p99_latency_s": round(_p99(latencies), 4) if latencies else None,
+        "leg_wall_s": round(time.perf_counter() - t_leg, 2),
+        "chaos_fired": chaos_fired.is_set(),
+        "engine_requests": [len(e.requests_seen) for e in engines],
+        "hung_faults_injected": engines[2].faults_injected,
+    }
+
+
+async def run_chaos_ab(*, total: int = 120, concurrency: int = 12,
+                       chaos_after: int = 30,
+                       client_timeout_s: float = 8.0,
+                       ttft_deadline_s: float = 2.0,
+                       engine_ttft: float = 0.01,
+                       skip_off: bool = False) -> dict:
+    """Run the ON leg then the OFF baseline; returns the A/B dict.
+
+    ``skip_off`` runs only the ON leg (the tier-1 test uses it — the OFF
+    leg deliberately burns client timeouts and would slow the suite)."""
+    on = await _run_leg(
+        ft_on=True, total=total, concurrency=concurrency,
+        chaos_after=chaos_after, client_timeout_s=client_timeout_s,
+        ttft_deadline_s=ttft_deadline_s, engine_ttft=engine_ttft)
+    off = None
+    if not skip_off:
+        off = await _run_leg(
+            ft_on=False, total=total, concurrency=concurrency,
+            chaos_after=chaos_after, client_timeout_s=client_timeout_s,
+            ttft_deadline_s=ttft_deadline_s, engine_ttft=engine_ttft)
+    return {
+        "metric": "chaos_failover_ab",
+        "unit": "completion_rate",
+        "value": on["completion_rate"],
+        "ft_off_completion_rate": off["completion_rate"] if off else None,
+        "total": total,
+        "concurrency": concurrency,
+        "chaos_after": chaos_after,
+        "client_timeout_s": client_timeout_s,
+        "ttft_deadline_s": ttft_deadline_s,
+        "ft_on": on,
+        "ft_off": off,
+    }

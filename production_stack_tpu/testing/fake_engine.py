@@ -6,7 +6,18 @@ scraping, sleep mode) be exercised hermetically with no TPU or cluster.
 
 Serves: /v1/models, /v1/chat/completions, /v1/completions, /v1/embeddings,
 /tokenize, /detokenize, /metrics (vllm:* exposition), /sleep, /wake_up,
-/is_sleeping, /health, /v1/audio/transcriptions.
+/is_sleeping, /health, /v1/audio/transcriptions, /fault (fault injection),
+/drain (graceful drain, mirroring the real engine server).
+
+Fault injection (for the router fault-tolerance tests and BENCH_CHAOS):
+POST /fault {"mode": ..., "after_chunks": N, "times": K} arms one of
+``error_before_stream`` (500 before any body byte), ``hang_before_stream``
+(accepts the request, never sends headers — the router's TTFT deadline
+must fire), ``hang_mid_stream`` (streams ``after_chunks`` chunks then
+stalls — the inter-chunk deadline must fire), ``crash_after_n_chunks``
+(streams ``after_chunks`` chunks then drops the TCP connection).
+``times`` bounds how many requests fault (-1 = until cleared); mode null
+disarms. Connect-refuse is exercised by stopping the runner itself.
 """
 
 from __future__ import annotations
@@ -69,6 +80,16 @@ class FakeEngine:
         self.priority_requests: Dict[str, int] = {
             "interactive": 0, "batch": 0}
         self.sleeping = False
+        # Fault injection state (see module docstring). ``fault_times``
+        # counts down per faulted request; -1 means until disarmed.
+        self.fault_mode: Optional[str] = None
+        self.fault_after_chunks = 0
+        self.fault_times = -1
+        self.faults_injected = 0
+        # Drain state mirroring the real engine server: /drain stops
+        # admission (inference 503s), /health flips to 503, in-flight
+        # requests finish.
+        self.draining = False
         self.num_running = 0
         self.num_waiting = 0
         self.requests_seen: List[dict] = []
@@ -79,6 +100,18 @@ class FakeEngine:
         self.trace_recorder = TraceRecorder("fake-engine")
 
     # -- helpers -----------------------------------------------------------
+    def _take_fault(self) -> Optional[str]:
+        """Claim the armed fault for this request (decrementing ``times``);
+        returns the mode or None."""
+        if self.fault_mode is None:
+            return None
+        if self.fault_times == 0:
+            return None
+        if self.fault_times > 0:
+            self.fault_times -= 1
+        self.faults_injected += 1
+        return self.fault_mode
+
     def _token_delay(self) -> float:
         return 1.0 / self.tokens_per_sec if self.tokens_per_sec > 0 else 0.0
 
@@ -149,6 +182,8 @@ class FakeEngine:
         app.router.add_post("/wake_up", self.handle_wake)
         app.router.add_get("/is_sleeping", self.handle_is_sleeping)
         app.router.add_get("/health", self.handle_health)
+        app.router.add_post("/fault", self.handle_fault)
+        app.router.add_post("/drain", self.handle_drain)
         app.router.add_post("/v1/audio/transcriptions", self.handle_transcription)
         from production_stack_tpu.obs.debug import add_debug_routes
 
@@ -188,6 +223,12 @@ class FakeEngine:
         })
 
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
+        if self.draining:
+            return web.json_response(
+                {"error": {"message": "engine is draining",
+                           "type": "ServiceUnavailable"}},
+                status=503, headers={"Retry-After": "1"})
+        fault = self._take_fault()
         body = await request.json()
         self.requests_seen.append(body)
         n_tokens = int(
@@ -204,6 +245,20 @@ class FakeEngine:
         priority = self._count_request(request)
         self.num_running += 1
         try:
+            if fault == "error_before_stream":
+                return web.json_response(
+                    {"error": {"message": "injected upstream failure",
+                               "type": "InternalServerError"}},
+                    status=500)
+            if fault == "hang_before_stream":
+                # Accept but never answer: the router's TTFT deadline is
+                # the only way out. Bounded so an un-deadlined client
+                # (FT off) eventually errors instead of wedging the test.
+                await asyncio.sleep(300)
+                return web.json_response(
+                    {"error": {"message": "injected hang elapsed",
+                               "type": "InternalServerError"}},
+                    status=500)
             await self._prefill_sleep(priority)
             t_prefill_end = time.time()
             if not stream:
@@ -226,6 +281,17 @@ class FakeEngine:
             resp.content_type = "text/event-stream"
             await resp.prepare(request)
             for i in range(n_tokens):
+                if fault and i == self.fault_after_chunks:
+                    if fault == "hang_mid_stream":
+                        # Stall after N chunks: the router's inter-chunk
+                        # deadline must fire. Bounded for FT-off tests.
+                        await asyncio.sleep(300)
+                    if fault == "crash_after_n_chunks":
+                        # Drop the TCP connection mid-stream, as a
+                        # crashing replica would.
+                        if request.transport is not None:
+                            request.transport.close()
+                        return resp
                 chunk = {
                     "id": rid, "object": "chat.completion.chunk",
                     "created": int(time.time()), "model": model,
@@ -253,6 +319,11 @@ class FakeEngine:
             self.num_running -= 1
 
     async def handle_completion(self, request: web.Request) -> web.StreamResponse:
+        if self.draining:
+            return web.json_response(
+                {"error": {"message": "engine is draining",
+                           "type": "ServiceUnavailable"}},
+                status=503, headers={"Retry-After": "1"})
         body = await request.json()
         self.requests_seen.append(body)
         n_tokens = int(body.get("max_tokens") or self.max_tokens_default)
@@ -353,7 +424,47 @@ class FakeEngine:
         return web.json_response({"is_sleeping": self.sleeping})
 
     async def handle_health(self, request: web.Request) -> web.Response:
+        if self.draining:
+            return web.json_response(
+                {"status": "draining", "in_flight": self.num_running},
+                status=503, headers={"Retry-After": "1"})
         return web.json_response({"status": "ok"})
+
+    async def handle_fault(self, request: web.Request) -> web.Response:
+        """Arm/disarm fault injection (see module docstring)."""
+        body = await request.json()
+        mode = body.get("mode")
+        valid = (None, "error_before_stream", "hang_before_stream",
+                 "hang_mid_stream", "crash_after_n_chunks")
+        if mode not in valid:
+            return web.json_response(
+                {"error": f"unknown fault mode {mode!r}"}, status=400)
+        self.fault_mode = mode
+        self.fault_after_chunks = int(body.get("after_chunks", 0))
+        self.fault_times = int(body.get("times", -1))
+        return web.json_response({
+            "mode": self.fault_mode,
+            "after_chunks": self.fault_after_chunks,
+            "times": self.fault_times,
+            "faults_injected": self.faults_injected,
+        })
+
+    async def handle_drain(self, request: web.Request) -> web.Response:
+        """Mirror of the real engine server's /drain: stop admission,
+        wait for in-flight requests, report drained/draining."""
+        try:
+            timeout_s = float(request.query.get("timeout_s", "30"))
+        except ValueError:
+            return web.json_response({"error": "bad timeout_s"}, status=400)
+        self.draining = True
+        deadline = time.monotonic() + timeout_s
+        while self.num_running > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        drained = self.num_running == 0
+        return web.json_response(
+            {"status": "drained" if drained else "draining",
+             "in_flight": self.num_running},
+            status=200 if drained else 202)
 
     async def handle_transcription(self, request: web.Request) -> web.Response:
         await request.post()

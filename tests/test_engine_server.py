@@ -330,3 +330,38 @@ def test_request_trace_and_stage_metrics(server_url):
         assert m and int(m.group(1)) >= 1, fam
     assert "tpu:slow_requests_total" in metrics
     assert re.search(r"tpu:hbm_headroom_bytes{[^}]*} \d+", metrics)
+
+
+def test_drain_endpoint_must_stay_last(server_url):
+    """Graceful drain (ISSUE 6): /drain stops admission, readiness
+    flips to 503, inference answers 503 + Retry-After, the draining
+    gauge rises — while ungated paths (/metrics) stay open.
+
+    MUST remain the last test in this module: it permanently drains the
+    module-scoped server.
+    """
+    async def run():
+        async with aiohttp.ClientSession() as s:
+            async with s.post(server_url + "/drain?timeout_s=10") as r:
+                assert r.status == 200
+                body = await r.json()
+                assert body["status"] == "drained"
+                assert body["in_flight"] == 0
+            async with s.get(server_url + "/health") as r:
+                assert r.status == 503
+                assert (await r.json())["status"] == "draining"
+                assert r.headers.get("Retry-After") == "1"
+            async with s.post(server_url + "/v1/completions", json={
+                "model": "tiny-llama", "prompt": "x", "max_tokens": 1,
+            }) as r:
+                assert r.status == 503
+                assert r.headers.get("Retry-After") == "1"
+            async with s.get(server_url + "/metrics") as r:
+                assert r.status == 200
+                text = await r.text()
+        import re as _re
+
+        assert _re.search(r"tpu:engine_draining{[^}]*} 1", text)
+        assert "tpu:pool_shrink_retries_total" in text
+
+    asyncio.run(run())

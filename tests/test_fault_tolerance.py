@@ -1,0 +1,576 @@
+"""Fault-tolerant data plane (ISSUE 6): circuit-breaking failover in the
+router, streaming deadlines, graceful drain, and the in-engine OOM
+pool-shrink ladder. All hermetic — fake engines (with injectable fault
+modes) + the real router in-process, and a CPU EngineCore for the
+ladder; no TPU, no network beyond loopback."""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from production_stack_tpu.router.fault_tolerance import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    FaultToleranceConfig,
+)
+
+MODEL = "ft-model"
+
+
+# --------------------------------------------------------------------- #
+# Circuit breaker + backoff units
+# --------------------------------------------------------------------- #
+
+class _FakeSD:
+    def __init__(self):
+        self.unhealthy = set()
+
+    def mark_unhealthy(self, url):
+        self.unhealthy.add(url)
+
+    def clear_unhealthy(self, url):
+        self.unhealthy.discard(url)
+
+
+def test_breaker_trips_after_consecutive_failures():
+    sd = _FakeSD()
+    br = CircuitBreaker(failure_threshold=3, reset_s=30.0,
+                        service_discovery=sd)
+    url = "http://e1"
+    for _ in range(2):
+        br.record_failure(url)
+    assert br.state_value(url) == CLOSED and br.allow(url)
+    br.record_failure(url)
+    assert br.state_value(url) == OPEN
+    assert not br.allow(url)
+    assert url in br.blocked_urls()
+    assert url in sd.unhealthy
+    # A success anywhere on the way does reset the consecutive count.
+    br2 = CircuitBreaker(failure_threshold=3, reset_s=30.0)
+    br2.record_failure(url)
+    br2.record_failure(url)
+    br2.record_success(url)
+    br2.record_failure(url)
+    br2.record_failure(url)
+    assert br2.state_value(url) == CLOSED
+
+
+def test_breaker_half_open_probe_then_close_or_reopen():
+    sd = _FakeSD()
+    br = CircuitBreaker(failure_threshold=1, reset_s=0.05,
+                        service_discovery=sd)
+    url = "http://e1"
+    br.record_failure(url)
+    assert br.state_value(url) == OPEN and not br.allow(url)
+    time.sleep(0.06)
+    # Past the reset window the URL is no longer request-filtered...
+    assert url not in br.blocked_urls()
+    # ...and exactly ONE probe is admitted.
+    assert br.allow(url)
+    assert br.state_value(url) == HALF_OPEN
+    assert not br.allow(url)
+    # Probe failure -> straight back to OPEN for another window.
+    br.record_failure(url)
+    assert br.state_value(url) == OPEN
+    time.sleep(0.06)
+    assert br.allow(url)
+    br.record_success(url)
+    assert br.state_value(url) == CLOSED and br.allow(url)
+    assert url not in sd.unhealthy
+    assert br.trips_total == 2
+
+
+def test_backoff_full_jitter_bounds():
+    cfg = FaultToleranceConfig(backoff_base_s=0.1, backoff_max_s=0.4)
+    assert cfg.backoff_s(0, 1.0) == pytest.approx(0.1)
+    assert cfg.backoff_s(1, 1.0) == pytest.approx(0.2)
+    assert cfg.backoff_s(5, 1.0) == pytest.approx(0.4)  # capped
+    assert cfg.backoff_s(3, 0.0) == 0.0                 # full jitter floor
+
+
+# --------------------------------------------------------------------- #
+# Hermetic router + fake-engine harness
+# --------------------------------------------------------------------- #
+
+async def _start(app, shutdown_timeout: float = 0.5):
+    from aiohttp import web
+
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0,
+                       shutdown_timeout=shutdown_timeout)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+def _router_args(engine_urls, *, ft_on, **ft_over):
+    from production_stack_tpu.router.parser import build_parser
+
+    args = build_parser().parse_args([])
+    args.static_backends = ",".join(engine_urls)
+    args.static_models = ",".join([MODEL] * len(engine_urls))
+    args.routing_logic = "roundrobin"
+    args.engine_stats_interval = 60
+    if ft_on:
+        args.fault_tolerance = True
+        args.ft_max_retries = ft_over.get("max_retries", 3)
+        args.ft_backoff_base = 0.02
+        args.ft_backoff_max = 0.2
+        args.ft_breaker_threshold = ft_over.get("breaker_threshold", 5)
+        args.ft_breaker_reset = ft_over.get("breaker_reset", 60.0)
+        args.ft_ttft_deadline = ft_over.get("ttft_deadline", 5.0)
+        args.ft_inter_chunk_deadline = ft_over.get("inter_chunk_deadline", 5.0)
+    return args
+
+
+class _Stack:
+    """N fake engines behind one real router, torn down cleanly."""
+
+    def __init__(self, n_engines, *, ft_on, engine_kwargs=None, **ft_over):
+        self.n = n_engines
+        self.ft_on = ft_on
+        self.ft_over = ft_over
+        self.engine_kwargs = engine_kwargs or {}
+        self.engines = []
+        self.runners = []
+        self.urls = []
+
+    async def __aenter__(self):
+        from production_stack_tpu.router.app import build_app
+        from production_stack_tpu.testing.fake_engine import FakeEngine
+        from production_stack_tpu.testing.qos_ab import (
+            _reset_router_singletons,
+        )
+
+        _reset_router_singletons()
+        for _ in range(self.n):
+            eng = FakeEngine(model=MODEL, max_tokens_default=4,
+                             **self.engine_kwargs)
+            runner, url = await _start(eng.make_app())
+            self.engines.append(eng)
+            self.runners.append(runner)
+            self.urls.append(url)
+        args = _router_args(self.urls, ft_on=self.ft_on, **self.ft_over)
+        self.router_runner, self.router_url = await _start(build_app(args))
+        return self
+
+    async def __aexit__(self, *exc):
+        from production_stack_tpu.testing.qos_ab import (
+            _reset_router_singletons,
+        )
+
+        await self.router_runner.cleanup()
+        for runner in self.runners:
+            await runner.cleanup()
+        _reset_router_singletons()
+
+
+async def _stream_chat(session, base_url, *, max_tokens=4, timeout_s=15.0):
+    """Returns (status, raw_body_bytes, done_seen)."""
+    import aiohttp
+
+    try:
+        async with session.post(
+            base_url + "/v1/chat/completions",
+            json={"model": MODEL, "max_tokens": max_tokens, "stream": True,
+                  "messages": [{"role": "user", "content": "hello"}]},
+            timeout=aiohttp.ClientTimeout(total=timeout_s),
+        ) as resp:
+            body = b""
+            try:
+                async for chunk in resp.content.iter_any():
+                    body += chunk
+            except aiohttp.ClientError:
+                pass  # truncated mid-stream; judged via done_seen
+            return resp.status, body, b"data: [DONE]\n\n" in body
+    except asyncio.TimeoutError:
+        return None, b"", False
+
+
+def test_streaming_parity_no_fault():
+    """With no fault firing, the FT-on proxy path must hand the client
+    the exact bytes the FT-off path does — a fixed-payload upstream makes
+    the comparison literal (ids/timestamps can't drift)."""
+    from aiohttp import web
+
+    payload = (b'data: {"id":"fixed","choices":[{"index":0,'
+               b'"delta":{"content":"Hello "}}]}\n\n'
+               b'data: {"id":"fixed","choices":[{"index":0,"delta":{},'
+               b'"finish_reason":"length"}]}\n\n'
+               b"data: [DONE]\n\n")
+
+    def fixed_app():
+        async def models(request):
+            return web.json_response({"object": "list", "data": [
+                {"id": MODEL, "object": "model", "created": 0,
+                 "owned_by": "t"}]})
+
+        async def chat(request):
+            resp = web.StreamResponse()
+            resp.content_type = "text/event-stream"
+            await resp.prepare(request)
+            # Two writes so the proxy sees multiple reads.
+            await resp.write(payload[:40])
+            await resp.write(payload[40:])
+            await resp.write_eof()
+            return resp
+
+        app = web.Application()
+        app.router.add_get("/v1/models", models)
+        app.router.add_post("/v1/chat/completions", chat)
+        return app
+
+    async def run_leg(ft_on):
+        import aiohttp
+
+        from production_stack_tpu.router.app import build_app
+        from production_stack_tpu.testing.qos_ab import (
+            _reset_router_singletons,
+        )
+
+        _reset_router_singletons()
+        upstream_runner, upstream_url = await _start(fixed_app())
+        args = _router_args([upstream_url], ft_on=ft_on)
+        router_runner, router_url = await _start(build_app(args))
+        try:
+            async with aiohttp.ClientSession() as session:
+                status, body, done = await _stream_chat(session, router_url)
+            assert status == 200 and done
+            return body
+        finally:
+            await router_runner.cleanup()
+            await upstream_runner.cleanup()
+            _reset_router_singletons()
+
+    body_off = asyncio.run(run_leg(False))
+    body_on = asyncio.run(run_leg(True))
+    assert body_off == payload
+    assert body_on == payload
+    assert body_on == body_off
+
+
+def test_failover_before_first_byte():
+    """A replica that 500s before streaming is retried on the other
+    replica; the client never notices."""
+    async def run():
+        import aiohttp
+
+        async with _Stack(2, ft_on=True) as stack:
+            # Arm BOTH engines once: whichever roundrobin picks first
+            # 500s exactly once, then the failover lands on a healthy
+            # replica (or the same one, recovered).
+            async with aiohttp.ClientSession() as session:
+                for url in stack.urls:
+                    async with session.post(
+                        url + "/fault",
+                        json={"mode": "error_before_stream", "times": 1},
+                    ) as resp:
+                        assert resp.status == 200
+                status, _, done = await _stream_chat(session,
+                                                     stack.router_url)
+                assert status == 200 and done
+                assert sum(e.faults_injected for e in stack.engines) >= 1
+
+    asyncio.run(run())
+
+
+def test_no_retry_after_first_byte():
+    """The idempotency rule: once a byte has streamed to the client, a
+    replica crash mid-stream fails the request — it is NEVER replayed on
+    another replica."""
+    async def run():
+        import aiohttp
+
+        async with _Stack(2, ft_on=True) as stack:
+            for url in stack.urls:
+                async with aiohttp.ClientSession() as session:
+                    async with session.post(
+                        url + "/fault",
+                        json={"mode": "crash_after_n_chunks",
+                              "after_chunks": 2, "times": -1},
+                    ) as resp:
+                        assert resp.status == 200
+            async with aiohttp.ClientSession() as session:
+                status, body, done = await _stream_chat(session,
+                                                        stack.router_url)
+            # Headers + first chunks arrived, then truncation — no [DONE].
+            assert status == 200 and not done
+            assert b"Hello" in body
+            # Exactly one engine ever saw the request: no replay.
+            assert sum(len(e.requests_seen) for e in stack.engines) == 1
+
+    asyncio.run(run())
+
+
+def test_ttft_deadline_then_breaker_opens():
+    """A hung replica (accepts, never sends headers) is cut off by the
+    TTFT deadline; with every replica broken the router answers 503 +
+    Retry-After, and once the breaker trips it answers instantly."""
+    async def run():
+        import aiohttp
+
+        async with _Stack(1, ft_on=True, max_retries=1,
+                          breaker_threshold=2,
+                          ttft_deadline=0.4) as stack:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    stack.urls[0] + "/fault",
+                    json={"mode": "hang_before_stream", "times": -1},
+                ) as resp:
+                    assert resp.status == 200
+                t0 = time.perf_counter()
+                async with session.post(
+                    stack.router_url + "/v1/chat/completions",
+                    json={"model": MODEL, "max_tokens": 2, "stream": True,
+                          "messages": [{"role": "user", "content": "x"}]},
+                    timeout=aiohttp.ClientTimeout(total=10),
+                ) as resp:
+                    wall = time.perf_counter() - t0
+                    assert resp.status == 503
+                    assert resp.headers.get("Retry-After")
+                # Two TTFT expiries (attempt + retry) tripped the
+                # threshold-2 breaker: the next request is rejected
+                # up front, no deadline burned.
+                t0 = time.perf_counter()
+                async with session.post(
+                    stack.router_url + "/v1/chat/completions",
+                    json={"model": MODEL, "max_tokens": 2,
+                          "messages": [{"role": "user", "content": "x"}]},
+                    timeout=aiohttp.ClientTimeout(total=10),
+                ) as resp:
+                    fast_wall = time.perf_counter() - t0
+                    assert resp.status == 503
+                    assert resp.headers.get("Retry-After")
+                assert wall < 5.0
+                assert fast_wall < 0.3
+
+    asyncio.run(run())
+
+
+def test_inter_chunk_deadline_bounds_midstream_hang():
+    """A replica that stalls mid-stream is cut off by the inter-chunk
+    deadline (bounded wall time), and — first byte already delivered —
+    the request is not replayed."""
+    async def run():
+        import aiohttp
+
+        async with _Stack(2, ft_on=True,
+                          inter_chunk_deadline=0.4) as stack:
+            for url in stack.urls:
+                async with aiohttp.ClientSession() as session:
+                    async with session.post(
+                        url + "/fault",
+                        json={"mode": "hang_mid_stream",
+                              "after_chunks": 1, "times": -1},
+                    ) as resp:
+                        assert resp.status == 200
+            t0 = time.perf_counter()
+            async with aiohttp.ClientSession() as session:
+                status, body, done = await _stream_chat(session,
+                                                        stack.router_url)
+            wall = time.perf_counter() - t0
+            assert status == 200 and not done
+            assert wall < 5.0
+            assert sum(len(e.requests_seen) for e in stack.engines) == 1
+
+    asyncio.run(run())
+
+
+def test_drain_honored_by_router_failover():
+    """Draining a replica flips it to 503-before-stream; with fault
+    tolerance on, traffic fails over to the remaining replica and every
+    request completes."""
+    async def run():
+        import aiohttp
+
+        async with _Stack(2, ft_on=True) as stack:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    stack.urls[0] + "/drain?timeout_s=2") as resp:
+                    assert resp.status == 200
+                    assert (await resp.json())["status"] == "drained"
+                # Drained replica: readiness flipped.
+                async with session.get(stack.urls[0] + "/health") as resp:
+                    assert resp.status == 503
+                for _ in range(6):
+                    status, _, done = await _stream_chat(session,
+                                                         stack.router_url)
+                    assert status == 200 and done
+                # The drained engine admitted none of them.
+                assert len(stack.engines[0].requests_seen) == 0
+                assert len(stack.engines[1].requests_seen) == 6
+
+    asyncio.run(run())
+
+
+def test_chaos_scenario_replica_killed_and_hung():
+    """The registered tier-1-safe chaos scenario: replica killed +
+    replica hung mid-storm, fault tolerance ON — the storm completes
+    (>= 99%) with bounded latency. (bench.py BENCH_CHAOS=1 runs the
+    same harness at full size plus the FT-off baseline leg.)"""
+    from production_stack_tpu.testing.chaos_ab import run_chaos_ab
+
+    result = asyncio.run(run_chaos_ab(
+        total=24, concurrency=6, chaos_after=6, client_timeout_s=8.0,
+        ttft_deadline_s=0.8, skip_off=True))
+    on = result["ft_on"]
+    assert on["chaos_fired"]
+    assert on["completion_rate"] >= 0.99, on
+    assert on["p99_latency_s"] < 8.0, on
+
+
+# --------------------------------------------------------------------- #
+# Engine stats staleness (router/engine_stats.py satellite)
+# --------------------------------------------------------------------- #
+
+def test_engine_stats_staleness(monkeypatch):
+    from production_stack_tpu.router import engine_stats as es_mod
+    from production_stack_tpu.router import service_discovery as sd_mod
+    from production_stack_tpu.utils.misc import SingletonMeta
+
+    class _EP:
+        def __init__(self, url):
+            self.url = url
+
+    class _Discovery:
+        def get_endpoint_info(self):
+            return [_EP("http://a"), _EP("http://b")]
+
+    monkeypatch.setattr(sd_mod, "get_service_discovery",
+                        lambda: _Discovery())
+
+    behavior = {"http://a": True, "http://b": True}
+
+    def fake_scrape(self, url):
+        return es_mod.EngineStats(num_running_requests=1) \
+            if behavior[url] else None
+
+    SingletonMeta._reset_instance(es_mod.EngineStatsScraper)
+    monkeypatch.setattr(es_mod.EngineStatsScraper, "_scrape_one",
+                        fake_scrape)
+    scraper = es_mod.EngineStatsScraper(scrape_interval=0.1)
+    try:
+        def wait_for(cond, timeout=10.0):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if cond():
+                    return True
+                time.sleep(0.01)
+            return False
+
+        # Both scraping fine.
+        assert wait_for(
+            lambda: set(scraper.get_engine_stats()) == {"http://a",
+                                                        "http://b"})
+        # b starts failing: after exactly one failed cycle the grace
+        # window still carries its last-known stats forward (it must not
+        # vanish from routing on one dropped scrape)...
+        behavior["http://b"] = False
+        assert wait_for(
+            lambda: 1 <= scraper._fail_counts.get("http://b", 0)
+            < scraper.STALE_AFTER)
+        assert "http://b" in scraper.get_engine_stats()
+        # ...but after STALE_AFTER consecutive failures it is excluded
+        # and reported stale.
+        assert wait_for(
+            lambda: set(scraper.get_engine_stats()) == {"http://a"})
+        assert scraper.get_stale_endpoints() == {"http://b"}
+        # Recovery clears staleness immediately.
+        behavior["http://b"] = True
+        assert wait_for(
+            lambda: "http://b" in scraper.get_engine_stats()
+            and not scraper.get_stale_endpoints())
+    finally:
+        scraper.close()
+        SingletonMeta._reset_instance(es_mod.EngineStatsScraper)
+
+
+# --------------------------------------------------------------------- #
+# In-engine OOM pool-shrink ladder (regression for the bench.py re-exec)
+# --------------------------------------------------------------------- #
+
+def test_pool_shrink_ladder_absorbs_init_oom(monkeypatch):
+    """Simulated ResourceExhausted on the first two KV-pool allocations:
+    engine init must succeed IN THIS PROCESS via the shrink ladder (the
+    fresh-process re-exec this replaces is gone from bench.py), with the
+    shrunk pool still serving tokens."""
+    import jax
+
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.core import EngineCore
+    from production_stack_tpu.engine.sampling import SamplingParams
+
+    orig = EngineCore._alloc_kv
+    calls = {"n": 0}
+
+    def flaky_alloc(self):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: Error allocating device buffer: "
+                "attempting to allocate 12.34G")
+        return orig(self)
+
+    monkeypatch.setattr(EngineCore, "_alloc_kv", flaky_alloc)
+    cfg = EngineConfig(
+        model="tiny-llama", max_model_len=128, max_num_seqs=4,
+        block_size=4, num_blocks=96, min_prefill_bucket=16, max_loras=4,
+        pool_shrink_retries=4, pool_shrink_step=0.15)
+    eng = EngineCore(cfg, devices=jax.devices()[:1])
+    try:
+        # 96 -> 81 -> 68, both rungs above the floor of
+        # max_blocks_per_seq * 2 = 64.
+        assert calls["n"] == 3
+        assert eng.num_blocks == 68
+        assert eng.pool_shrink_retries_total == 2
+        assert eng.stats()["pool_shrink_retries_total"] == 2
+        eng.start()
+
+        import queue
+
+        q = queue.Queue()
+        eng.add_request("r-shrunk", "hello world",
+                        SamplingParams(temperature=0.0, max_tokens=3),
+                        lambda token, finish: q.put((token, finish)))
+        tokens = []
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            token, finish = q.get(timeout=120)
+            tokens.append(token)
+            if finish:
+                break
+        assert len(tokens) >= 1
+    finally:
+        eng.stop()
+
+
+def test_pool_shrink_ladder_exhausted_reraises(monkeypatch):
+    """Non-OOM allocation errors and floor/rung exhaustion must re-raise
+    instead of looping."""
+    import jax
+
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.core import EngineCore
+
+    def always_oom(self):
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of HBM")
+
+    monkeypatch.setattr(EngineCore, "_alloc_kv", always_oom)
+    cfg = EngineConfig(
+        model="tiny-llama", max_model_len=128, max_num_seqs=4,
+        block_size=4, num_blocks=96, min_prefill_bucket=16, max_loras=4,
+        pool_shrink_retries=2, pool_shrink_step=0.15)
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        EngineCore(cfg, devices=jax.devices()[:1])
+
+    def other_error(self):
+        raise ValueError("not an OOM")
+
+    monkeypatch.setattr(EngineCore, "_alloc_kv", other_error)
+    with pytest.raises(ValueError, match="not an OOM"):
+        EngineCore(cfg, devices=jax.devices()[:1])

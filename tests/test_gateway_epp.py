@@ -138,6 +138,51 @@ def test_epp_completion_prompt_and_file_watch(tmp_path):
         server.stop(0)
 
 
+def test_epp_malformed_body_clean_reject(epp):
+    """Truncated and garbage request bodies must never crash the EPP:
+    every exchange completes both phases cleanly (no stream error), and
+    a well-formed request afterwards still gets a real pick. First leg
+    of the malformed-input suite (ISSUE 6 satellite)."""
+    pb2, stub, _, _ = epp
+
+    def raw_exchange(raw: bytes):
+        def requests():
+            h = pb2.ProcessingRequest()
+            h.request_headers.headers.headers.add(
+                key=":path", raw_value=b"/v1/chat/completions")
+            h.request_headers.end_of_stream = False
+            yield h
+            b = pb2.ProcessingRequest()
+            b.request_body.body = raw
+            b.request_body.end_of_stream = True
+            yield b
+
+        return list(stub(requests()))
+
+    hostile = (
+        b"",                                      # empty body
+        b"\x80\xff\x00 not even utf-8 \xfe",      # undecodable bytes
+        b'{"model": "m", "messages": [{"role"',   # truncated JSON
+        b"5",                                     # JSON, not an object
+        b'"just a string"',
+        b'{"messages": "not-a-list"}',
+        b'{"messages": [42, null, {"role": "user", "content": null}]}',
+        b'{"prompt": {"nested": "object"}}',
+        b"[" * 2000 + b"]" * 2000,                # nesting bomb
+    )
+    for raw in hostile:
+        responses = raw_exchange(raw)
+        assert len(responses) == 2, raw[:40]
+        # The body phase still answers CONTINUE (pick or no pick).
+        assert responses[1].WhichOneof("response") == "request_body"
+
+    # The server survived all of it and still picks normally.
+    good = _openai_exchange(pb2, stub, {
+        "model": "m", "messages": [
+            {"role": "user", "content": "still serving?"}]})
+    assert _dest(good[1]) in ("10.0.0.4:8000", "10.0.0.5:8000")
+
+
 # ---- round 5: the NATIVE EPP data plane (tpu-stack-epp) ----------------
 # Same protocol assertions as above, but against the C++ server with its
 # own HTTP/2 stack — driven here by the real grpcio client (dynamic-table

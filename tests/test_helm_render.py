@@ -499,3 +499,78 @@ def test_kv_cache_dtype_plumbs_into_engine_command():
              if d["metadata"]["name"].endswith("-engine")]
     bcmd = bdeps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
     assert "--kv-cache-dtype" not in bcmd
+
+
+def test_drain_prestop_and_router_fault_tolerance_flags():
+    """servingEngineSpec.drain.enabled wires a POST /drain preStop hook
+    (plus a matching terminationGracePeriodSeconds) into BOTH the
+    single-host Deployment and the multi-host StatefulSet, and
+    routerSpec.faultTolerance.enabled passes --fault-tolerance and the
+    --ft-* knobs to the router; both default off with nothing rendered
+    (docs/fault_tolerance.md)."""
+    import copy
+    import json
+
+    import jsonschema
+
+    values = copy.deepcopy(load_values(CHART, os.path.join(
+        CHART, "examples", "values-07-multihost-llama70b.yaml")))
+    # A single-host model alongside the multi-host one: the preStop hook
+    # must land on both pod templates from the shared helper.
+    values["servingEngineSpec"]["modelSpec"].append({
+        "name": "small", "modelURL": "tiny-llama", "replicaCount": 1,
+    })
+    values["servingEngineSpec"]["drain"] = {
+        "enabled": True, "timeoutSeconds": 90,
+    }
+    values["routerSpec"]["faultTolerance"] = {
+        "enabled": True, "maxRetries": 5, "breakerThreshold": 3,
+        "breakerReset": 20, "ttftDeadline": 60, "interChunkDeadline": 15,
+    }
+    with open(os.path.join(CHART, "values.schema.json")) as f:
+        schema = json.load(f)
+    jsonschema.validate(values, schema)
+
+    rendered = MiniHelm(CHART).render(values)
+    pods = []
+    for d in _docs(rendered, "Deployment"):
+        if d["metadata"]["name"].endswith("-engine"):
+            pods.append(d["spec"]["template"]["spec"])
+    for d in _docs(rendered, "StatefulSet"):
+        pods.append(d["spec"]["template"]["spec"])
+    assert len(pods) == 2, "expected one Deployment + one StatefulSet"
+    for pod in pods:
+        assert pod["terminationGracePeriodSeconds"] == 120  # 90 + 30
+        hook = pod["containers"][0]["lifecycle"]["preStop"]["exec"]
+        assert hook["command"][0] == "python"
+        assert "/drain?timeout_s=90" in hook["command"][-1]
+        assert "method='POST'" in hook["command"][-1]
+
+    router = [d for d in _docs(rendered, "Deployment")
+              if d["metadata"]["name"].endswith("-router")][0]
+    cmd = router["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--fault-tolerance" in cmd
+    assert cmd[cmd.index("--ft-max-retries") + 1] == "5"
+    assert cmd[cmd.index("--ft-breaker-threshold") + 1] == "3"
+    assert cmd[cmd.index("--ft-breaker-reset") + 1] == "20"
+    assert cmd[cmd.index("--ft-ttft-deadline") + 1] == "60"
+    assert cmd[cmd.index("--ft-inter-chunk-deadline") + 1] == "15"
+
+    # Bad knob types fail schema validation.
+    bad = copy.deepcopy(values)
+    bad["routerSpec"]["faultTolerance"]["breakerThreshold"] = "three"
+    with pytest.raises(jsonschema.ValidationError):
+        jsonschema.validate(bad, schema)
+
+    # Default chart: no preStop, no grace override, no --ft flags.
+    base = _render(os.path.join(CHART, "examples",
+                                "values-01-minimal.yaml"))
+    bspecs = [d["spec"]["template"]["spec"]
+              for d in _docs(base, "Deployment")]
+    for spec in bspecs:
+        assert "terminationGracePeriodSeconds" not in spec
+        assert "lifecycle" not in spec["containers"][0]
+    bcmd = [d for d in _docs(base, "Deployment")
+            if d["metadata"]["name"].endswith("-router")
+            ][0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--fault-tolerance" not in bcmd
