@@ -197,6 +197,17 @@ CHAOS_CONCURRENCY = _env_int("BENCH_CHAOS_CONCURRENCY", 12)
 CHAOS_AFTER = _env_int("BENCH_CHAOS_AFTER", 30)
 CHAOS_CLIENT_TIMEOUT = _env_float("BENCH_CHAOS_CLIENT_TIMEOUT", 8.0)
 CHAOS_TTFT_DEADLINE = _env_float("BENCH_CHAOS_TTFT_DEADLINE", 2.0)
+# Fleet prefix-cache A/B: BENCH_FLEET=1 runs the hermetic cross-replica
+# pull A/B (testing/fleet_ab.py) — repeat-prompt traffic round-robined
+# across 3 fake replicas, global prefix cache ON then OFF. Writes
+# BENCH_FLEET_OUT (default BENCH_FLEET_r09.json) with the reuse-TTFT
+# speedup and the cross-replica pull hit-rate.
+FLEET = _env_int("BENCH_FLEET", 0)
+FLEET_OUT = os.environ.get("BENCH_FLEET_OUT", "BENCH_FLEET_r09.json")
+FLEET_USERS = _env_int("BENCH_FLEET_USERS", 10)
+FLEET_ROUNDS = _env_int("BENCH_FLEET_ROUNDS", 3)
+FLEET_CONCURRENCY = _env_int("BENCH_FLEET_CONCURRENCY", 4)
+FLEET_TTFT = _env_float("BENCH_FLEET_TTFT", 0.2)
 
 
 def _load_baseline() -> float:
@@ -696,6 +707,21 @@ def _chaos_main() -> None:
     print(json.dumps(result))
 
 
+def _fleet_main() -> None:
+    """BENCH_FLEET=1: the cross-replica prefix-cache A/B. Fully hermetic
+    (fake engines), so this branch never imports jax or touches a device."""
+    from production_stack_tpu.testing.fleet_ab import run_fleet_ab
+
+    result = asyncio.run(run_fleet_ab(
+        users=FLEET_USERS, rounds=FLEET_ROUNDS,
+        concurrency=FLEET_CONCURRENCY, engine_ttft=FLEET_TTFT))
+    result["backend"] = "fake"
+    with open(os.path.join(REPO, FLEET_OUT), "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result))
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--cpu", action="store_true",
@@ -706,6 +732,9 @@ def main() -> None:
         return
     if CHAOS:
         _chaos_main()
+        return
+    if FLEET:
+        _fleet_main()
         return
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"

@@ -435,7 +435,77 @@ def build_dashboard():
              "--hbm-headroom-reserve should be revisited"))
     y += 7
 
-    # ---- Row 10: Current Resource Usage (ref panels 14-19) -------------- #
+    # ---- Row 10: Fleet Cache & Autoscaling (docs/fleet.md) -------------- #
+    panels.append(row("Fleet Cache & Autoscaling", y)); y += 1
+    panels.append(panel(
+        "timeseries", "Cross-replica KV pulls (rate)",
+        [target("rate(vllm_router:kv_pull_attempts_total[5m])",
+                legend="attempted"),
+         target("rate(vllm_router:kv_pull_success_total[5m])",
+                legend="succeeded"),
+         target("rate(vllm_router:kv_pull_failures_total[5m])",
+                legend="failed")],
+        grid(7, 8, 0, y), unit="reqps",
+        desc="Router-orchestrated /kv/pull transfers of a matched "
+             "prefix from the holder replica to the routed one "
+             "(--fleet-cache); failures fall back to plain recompute, "
+             "so they cost TTFT, not correctness"))
+    panels.append(panel(
+        "timeseries", "KV pull latency (p50/p99)",
+        [target("histogram_quantile(0.5, sum(rate("
+                "vllm_router:kv_pull_latency_seconds_bucket[5m])) "
+                "by (le))", legend="p50"),
+         target("histogram_quantile(0.99, sum(rate("
+                "vllm_router:kv_pull_latency_seconds_bucket[5m])) "
+                "by (le))", legend="p99")],
+        grid(7, 8, 8, y), unit="s",
+        desc="Wall time of the blocking /kv/pull before the request is "
+             "forwarded; must stay well under a cold prefill of the "
+             "same prefix for the fleet cache to pay off"))
+    panels.append(panel(
+        "timeseries", "L3 (cache server) traffic",
+        [target("rate(vllm_router:fleet_l3_pulls_total[5m])",
+                legend="router pulls answered from L3"),
+         target("rate(tpu:l3_spill_blocks_total[5m])",
+                legend="{{instance}} spill blocks"),
+         target("rate(tpu:l3_hit_blocks_total[5m])",
+                legend="{{instance}} hit blocks")],
+        grid(7, 8, 16, y),
+        desc="Shared-L3 tier: evicted pages spilled to the cache "
+             "server stay pullable fleet-wide after the holder replica "
+             "evicts (or scales in)"))
+    y += 7
+    panels.append(panel(
+        "timeseries", "Autoscale: recommended vs current replicas",
+        [target("vllm_router:autoscale_recommended_replicas",
+                legend="recommended"),
+         target("vllm_router:autoscale_current_replicas",
+                legend="current")],
+        grid(7, 8, 0, y),
+        desc="Load-predictive recommender (--autoscale) from queue "
+             "depth, HBM headroom, and QoS backlog; a persistent gap "
+             "means the actuator (HPA/KEDA) is not keeping up"))
+    panels.append(panel(
+        "timeseries", "HBM headroom per engine",
+        [target("tpu:hbm_headroom_bytes", legend="{{instance}}")],
+        grid(7, 8, 8, y), unit="bytes",
+        desc="Free HBM after weights + KV pool; sustained low headroom "
+             "feeds the recommender's scale-out signal before queues "
+             "actually build"))
+    panels.append(panel(
+        "timeseries", "L3 spill/hit bytes (rate)",
+        [target("sum(rate(tpu:l3_spill_bytes_total[5m]))",
+                legend="spilled"),
+         target("sum(rate(tpu:l3_hit_bytes_total[5m]))",
+                legend="hits")],
+        grid(7, 8, 16, y), unit="Bps",
+        desc="Byte throughput to/from the shared cache server; hits "
+             "persistently near zero while spills grow means the L3 is "
+             "a write-only graveyard — lower kvOffloadGb or raise L3 "
+             "capacity"))
+    y += 7
+
+    # ---- Row 11: Current Resource Usage (ref panels 14-19) -------------- #
     panels.append(row("Current Resource Usage", y)); y += 1
     panels.append(panel(
         "timeseries", "Router CPU usage",

@@ -133,6 +133,11 @@ class EngineServer:
         self.kv_transfer_device_pulls = 0
         self.kv_transfer_device_bytes = 0
         self.kv_transfer_device_seconds = 0.0
+        # Fleet pulls answered from the shared L3 tier: the peer missed
+        # but the prefix is resident in the remote cache server, so
+        # prefill restores it instead of recomputing.
+        self.l3_pull_hits = 0
+        self.l3_pull_blocks = 0
         self._device_pipe = None
         self._device_pipe_failed = False
         # Per-request stage tracing (queue/prefill/decode spans recorded
@@ -270,6 +275,13 @@ class EngineServer:
         if not paths or self._loop is None or self.kv_controller_url is None:
             return
 
+        # With a remote tier configured, the allocator's eviction hook
+        # spills the blocks to the shared L3 before this report fires:
+        # tell the controller so the claims transfer to the L3
+        # pseudo-instance instead of vanishing (fleet pull: peer → L3).
+        spilled = (self.core.offload is not None
+                   and self.core.offload.remote is not None)
+
         async def _send():
             import aiohttp
 
@@ -278,7 +290,7 @@ class EngineServer:
                     await s.post(
                         f"{self.kv_controller_url}/kv/evict",
                         json={"instance_id": self.instance_id,
-                              "paths": paths},
+                              "paths": paths, "spilled": spilled},
                         timeout=aiohttp.ClientTimeout(total=5),
                     )
             except aiohttp.ClientError as e:
@@ -1383,10 +1395,28 @@ class EngineServer:
             return web.json_response(
                 {"error": {"message": "timeout_s must be a number",
                            "type": "BadRequestError"}}, status=400)
-        if not self.draining:
+        first_drain = not self.draining
+        if first_drain:
             logger.info("Drain requested: admission stopped, %d in flight",
                         self._inflight)
         self.draining = True
+        if first_drain and self.kv_controller_url is not None:
+            # Announce departure to the KV controller immediately: the
+            # router must stop treating this replica as a prefix holder
+            # (kvaware picks, fleet pull sources) while it quiesces.
+            import aiohttp
+
+            try:
+                async with aiohttp.ClientSession(
+                        headers=self._auth_headers()) as s:
+                    await s.post(
+                        f"{self.kv_controller_url}/kv/deregister",
+                        json={"instance_id": self.instance_id},
+                        timeout=aiohttp.ClientTimeout(total=5),
+                    )
+                self._kv_registered = False
+            except aiohttp.ClientError as e:
+                logger.debug("KV deregister report failed: %s", e)
         deadline = time.monotonic() + max(0.0, timeout_s)
         while self._inflight > 0 and time.monotonic() < deadline:
             await asyncio.sleep(0.05)
@@ -1737,6 +1767,48 @@ class EngineServer:
             self.trace_recorder.record(trace)
         return resp
 
+    def _l3_probe(self, token_ids: List[int], adapter: str) -> int:
+        """How many leading blocks of ``token_ids`` are resident in the
+        offload tier (host RAM or the remote L3 cache server). 0 when no
+        tier is configured. Runs on an executor: remote probes are HEAD
+        requests against the cache server."""
+        core = self.core
+        if core.offload is None:
+            return 0
+        from production_stack_tpu.engine.kvcache import BlockAllocator
+
+        bs = core.config.block_size
+        parent = core.kv_mgr.chain_root(adapter)
+        blocks = 0
+        i = 0
+        while i + bs <= len(token_ids):
+            h = BlockAllocator.chain_hash(parent, tuple(token_ids[i:i + bs]))
+            if not core.offload.contains(h):
+                break
+            parent = h
+            blocks += 1
+            i += bs
+        return blocks
+
+    def _l3_fallback(self, token_ids: List[int],
+                     req_body: dict) -> Optional[web.Response]:
+        """Peer pull missed: if the prefix is L3-resident, answer
+        ``status: l3`` — prefill will restore the blocks through the
+        offload tier (kv_mgr.external_lookup), no transfer needed here.
+        Returns None when the L3 misses too (caller reports miss)."""
+        if self.core.offload is None:
+            return None
+        adapter = self._resolve_adapter(req_body.get("model", "")) or ""
+        blocks = self._l3_probe(token_ids, adapter)
+        if blocks <= 0:
+            return None
+        self.l3_pull_hits += 1
+        self.l3_pull_blocks += blocks
+        return web.json_response({
+            "status": "l3", "injected_blocks": 0, "l3_blocks": blocks,
+            "num_tokens": blocks * self.core.config.block_size,
+        })
+
     async def _kv_pull_impl(self, request: web.Request) -> web.Response:
         """Pull the KV for a prompt from another engine and install it —
         the decode-side step of disaggregated prefill. Data moves engine to
@@ -1810,16 +1882,32 @@ class EngineServer:
                     timeout=aiohttp.ClientTimeout(total=60),
                 ) as resp:
                     if resp.status != 200:
+                        # Peer miss → try the shared L3 tier before
+                        # conceding a recompute.
+                        l3 = await asyncio.get_running_loop(
+                        ).run_in_executor(
+                            None,
+                            lambda: self._l3_fallback(token_ids, req_body))
+                        if l3 is not None:
+                            return l3
                         return web.json_response(
                             {"status": "miss", "injected_blocks": 0})
                     data = await resp.read()
         except aiohttp.ClientError as e:
+            l3 = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self._l3_fallback(token_ids, req_body))
+            if l3 is not None:
+                return l3
             return web.json_response(
                 {"error": f"source unreachable: {e}"}, status=502)
         fetch_seconds = time.monotonic() - t0
         try:
             payload = unpack_transfer(data)
         except Exception:  # noqa: BLE001 - truncated/version-skewed payload
+            l3 = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self._l3_fallback(token_ids, req_body))
+            if l3 is not None:
+                return l3
             return web.json_response({"status": "miss", "injected_blocks": 0})
         injected = await asyncio.get_running_loop().run_in_executor(
             None, lambda: self.core.inject_kv(
@@ -2005,6 +2093,25 @@ class EngineServer:
                 "# TYPE tpu:kv_offload_misses counter",
                 f"tpu:kv_offload_misses_total{{{labels}}} {off['misses']}",
             ]
+            if off.get("remote"):
+                # L3 (shared cache server) tier traffic + cross-replica
+                # pulls answered out of L3 instead of a peer transfer.
+                lines += [
+                    "# TYPE tpu:l3_spill_blocks counter",
+                    f"tpu:l3_spill_blocks_total{{{labels}}} "
+                    f"{off.get('remote_put_blocks', 0)}",
+                    "# TYPE tpu:l3_spill_bytes counter",
+                    f"tpu:l3_spill_bytes_total{{{labels}}} "
+                    f"{off.get('remote_put_bytes', 0)}",
+                    "# TYPE tpu:l3_hit_blocks counter",
+                    f"tpu:l3_hit_blocks_total{{{labels}}} "
+                    f"{off.get('remote_get_blocks', 0)}",
+                    "# TYPE tpu:l3_hit_bytes counter",
+                    f"tpu:l3_hit_bytes_total{{{labels}}} "
+                    f"{off.get('remote_get_bytes', 0)}",
+                    "# TYPE tpu:l3_pull_hits counter",
+                    f"tpu:l3_pull_hits_total{{{labels}}} {self.l3_pull_hits}",
+                ]
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 

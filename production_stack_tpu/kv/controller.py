@@ -34,6 +34,13 @@ _global_kv_controller: Optional["KVController"] = None
 
 CHUNK_SIZE = 128  # characters per hash chunk; matches router.hashtrie default
 
+# Reserved instance id for the shared L3 cache server: engines that spill
+# evicted prefixes to the remote tier report the eviction with
+# ``spilled=true`` and the controller re-attributes the claim to this
+# pseudo-instance instead of dropping it, so the fleet pull path can try
+# peer → L3 → recompute.
+L3_INSTANCE = "__l3__"
+
 
 def chunk_hashes(text: str, chunk_size: int = CHUNK_SIZE) -> List[int]:
     return [
@@ -69,7 +76,19 @@ class KVController:
         self.admit_ttl = admit_ttl
         self._root = _Node()
         self._instances: Dict[str, dict] = {}  # id -> {url, last_seen}
+        self._l3_url: Optional[str] = None
         self._lock = asyncio.Lock()
+
+    def attach_l3(self, url: Optional[str]) -> None:
+        """Attach (or detach) the shared L3 cache server. While set,
+        spilled evictions keep their trie claims under ``L3_INSTANCE``.
+        Sync on purpose: called at router init, before serving starts."""
+        self._l3_url = url
+        if url:
+            self._instances[L3_INSTANCE] = {
+                "url": url, "last_seen": time.time()}
+        else:
+            self._instances.pop(L3_INSTANCE, None)
 
     def _fresh(self, ts: float, now: float) -> bool:
         return self.admit_ttl <= 0 or (now - ts) <= self.admit_ttl
@@ -87,6 +106,18 @@ class KVController:
                 node = stack.pop()
                 node.instances.pop(instance_id, None)
                 stack.extend(node.children.values())
+
+    async def deregister_url(self, url: str) -> List[str]:
+        """Deregister every instance advertising ``url`` (breaker-open
+        mirror: the router only knows the failing endpoint's URL)."""
+        async with self._lock:
+            gone = [i for i, info in self._instances.items()
+                    if info["url"] == url and i != L3_INSTANCE]
+        for instance_id in gone:
+            await self.deregister_instance(instance_id)
+        if gone:
+            logger.info("KV controller: deregistered %s for %s", gone, url)
+        return gone
 
     async def instance_url(self, instance_id: str) -> Optional[str]:
         async with self._lock:
@@ -115,9 +146,12 @@ class KVController:
     async def admit_text(self, instance_id: str, text: str) -> None:
         await self.admit(instance_id, chunk_hashes(text, self.chunk_size))
 
-    async def evict(self, instance_id: str, hashes: List[int]) -> None:
+    async def evict(self, instance_id: str, hashes: List[int],
+                    spilled: bool = False) -> None:
         """Evict a prefix: the instance no longer holds `hashes` nor anything
-        below it."""
+        below it. With ``spilled=True`` (engine pushed the evicted blocks to
+        the remote tier) and an attached L3, the vacated claims transfer to
+        ``L3_INSTANCE`` so the prefix stays routable via the shared cache."""
         async with self._lock:
             node = self._root
             path = []
@@ -127,21 +161,32 @@ class KVController:
                     return
                 path.append(nxt)
                 node = nxt
+            now = time.time()
+            mark_l3 = spilled and self._l3_url is not None
             stack = [node]
             while stack:
                 n = stack.pop()
-                n.instances.pop(instance_id, None)
+                if n.instances.pop(instance_id, None) is not None and mark_l3:
+                    n.instances[L3_INSTANCE] = now
                 stack.extend(n.children.values())
+            if mark_l3 and L3_INSTANCE in self._instances:
+                self._instances[L3_INSTANCE]["last_seen"] = now
 
     # -- lookup (reference LookupMsg) --------------------------------------
     async def lookup(self, text: str) -> Optional[Tuple[int, str]]:
-        """Longest stored prefix of ``text`` → (matched_chars, instance_id)."""
+        """Longest stored prefix of ``text`` → (matched_chars, instance_id).
+
+        Live engine holders win over the L3 pseudo-instance at equal match
+        depth; a strictly deeper L3 match wins so the fleet pull path can
+        restore the longer prefix from the shared cache."""
         hashes = chunk_hashes(text, self.chunk_size)
         now = time.time()
         async with self._lock:
             node = self._root
             matched = 0
-            best: Optional[Set[str]] = None
+            best_engines: Optional[Set[str]] = None
+            engine_matched = 0
+            l3_matched = 0
             for h in hashes:
                 nxt = node.children.get(h)
                 if nxt is None or not nxt.instances:
@@ -153,16 +198,27 @@ class KVController:
                 if not live:
                     break
                 matched += 1
-                best = live
+                engines = live - {L3_INSTANCE}
+                if engines:
+                    best_engines = engines
+                    engine_matched = matched
+                if L3_INSTANCE in live:
+                    l3_matched = matched
                 node = nxt
-            if not best:
-                return None
-            matched_chars = min(matched * self.chunk_size, len(text))
-            # Deterministic tiebreak: most-recently-seen instance.
-            inst = max(
-                best, key=lambda i: self._instances.get(i, {}).get("last_seen", 0)
-            )
-            return matched_chars, inst
+            if best_engines and engine_matched >= l3_matched:
+                matched_chars = min(engine_matched * self.chunk_size,
+                                    len(text))
+                # Deterministic tiebreak: most-recently-seen instance.
+                inst = max(
+                    best_engines,
+                    key=lambda i: self._instances.get(i, {}).get(
+                        "last_seen", 0),
+                )
+                return matched_chars, inst
+            if l3_matched:
+                return min(l3_matched * self.chunk_size, len(text)), \
+                    L3_INSTANCE
+            return None
 
 
 def initialize_kv_controller(chunk_size: int = CHUNK_SIZE,

@@ -1,0 +1,350 @@
+"""Fleet-scale serving: global prefix cache + load-predictive autoscaling.
+
+Turns the per-replica pieces that already exist in-tree — the KV
+controller's chunk-hash trie (:mod:`production_stack_tpu.kv.controller`),
+the disaggregated-prefill ``/kv/pull`` path
+(:mod:`production_stack_tpu.engine.server`), and the remote
+:mod:`production_stack_tpu.kv.cache_server` — into one cluster-wide cache
+hierarchy:
+
+- **L1** (HBM prefix cache, per replica) and **L2** (host offload tier)
+  are unchanged.
+- **Cross-replica pulls**: when the controller says the longest stored
+  prefix of a prompt lives on a *different* replica than the routing
+  pick, :class:`FleetCache` asks the picked replica to ``/kv/pull`` the
+  prefix from the holder before the request is proxied. A pull that
+  misses, times out, or targets a breaker-open holder degrades to plain
+  recompute — never to request failure.
+- **L3**: engines with ``--kv-remote-url`` spill evicted blocks to the
+  shared cache server; the controller re-attributes those claims to the
+  ``__l3__`` pseudo-instance (``spilled=true`` eviction reports), so a
+  prefix that left every replica is still pullable fleet-wide.
+
+:class:`AutoscaleRecommender` closes the loop: it folds the signals the
+stack already exports — per-replica queue depth, HBM KV pressure, and
+the QoS batch backlog — into a recommended replica count (served at
+``GET /autoscale/recommendation`` and as
+``vllm_router:autoscale_*_replicas`` gauges for KEDA/HPA), plus a
+scale-in orchestration that drains the chosen replica via the engine's
+``/drain`` hook and evicts it from the controller so no request is ever
+routed to — or told to pull from — a disappearing holder.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from production_stack_tpu.kv.controller import L3_INSTANCE, KVController
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class FleetCacheConfig:
+    pull_timeout_s: float = 15.0
+    # Minimum controller match (characters) worth a pull round-trip; a
+    # shorter prefix recomputes faster than it transfers.
+    min_match_chars: int = 256
+    l3_url: Optional[str] = None
+    api_key: Optional[str] = None
+
+
+class FleetCache:
+    """Router-side orchestrator of cross-replica KV pulls.
+
+    One instance per router process, created only when ``--fleet-cache``
+    is set — with the flag off the request path never reaches this
+    module (parity convention, see tests/test_fleet.py).
+    """
+
+    def __init__(self, config: FleetCacheConfig,
+                 kv_controller: KVController,
+                 fault_tolerance=None):
+        self.config = config
+        self.kv_controller = kv_controller
+        self.fault_tolerance = fault_tolerance
+        self.pulls_attempted = 0
+        self.pulls_succeeded = 0
+        self.pulls_failed = 0
+        self.l3_pulls = 0
+
+    def _headers(self, request_id: str) -> Dict[str, str]:
+        headers = {"X-Request-Id": request_id}
+        if self.config.api_key:
+            headers["Authorization"] = f"Bearer {self.config.api_key}"
+        return headers
+
+    async def maybe_pull(self, server_url: str, prompt: str,
+                         request_json: dict, request_id: str) -> Optional[dict]:
+        """If a different replica (or the L3) holds a long-enough prefix
+        of ``prompt``, ask ``server_url`` to pull it before prefill.
+
+        Returns a summary dict (for tracing/tests) or None when no pull
+        applied. Never raises: every failure mode means "recompute",
+        which the engine does anyway.
+        """
+        if not prompt or len(prompt) < self.config.min_match_chars:
+            return None
+        try:
+            match = await self.kv_controller.lookup(prompt)
+        except Exception as e:  # noqa: BLE001 - lookup is best-effort
+            logger.warning("fleet lookup failed: %s", e)
+            return None
+        if match is None:
+            return None
+        matched_chars, holder = match
+        if matched_chars < self.config.min_match_chars:
+            return None
+        holder_url = await self.kv_controller.instance_url(holder)
+        if not holder_url:
+            return None
+        if holder_url.rstrip("/") == server_url.rstrip("/"):
+            return None  # the pick already holds it — plain L1 hit
+        ft = self.fault_tolerance
+        if ft is not None and holder_url in ft.breaker.blocked_urls():
+            # Breaker-open holder: don't burn the pull timeout against a
+            # replica that is already failing — recompute instead.
+            logger.info("fleet: skipping pull from breaker-open holder %s",
+                        holder_url)
+            return None
+
+        from production_stack_tpu.router import metrics as router_metrics
+
+        self.pulls_attempted += 1
+        router_metrics.kv_pull_attempts.labels(server=server_url).inc()
+        if holder == L3_INSTANCE:
+            self.l3_pulls += 1
+            router_metrics.fleet_l3_pulls.inc()
+        t0 = time.monotonic()
+        outcome = "ok"
+        injected = 0
+        try:
+            import aiohttp
+
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    f"{server_url.rstrip('/')}/kv/pull",
+                    json={"source_url": holder_url,
+                          "request": request_json},
+                    headers=self._headers(request_id),
+                    timeout=aiohttp.ClientTimeout(
+                        total=self.config.pull_timeout_s),
+                ) as resp:
+                    if resp.status != 200:
+                        outcome = f"http_{resp.status}"
+                    else:
+                        body = await resp.json()
+                        status = body.get("status")
+                        injected = int(body.get("injected_blocks", 0) or 0)
+                        if status == "ok" and injected > 0:
+                            outcome = "ok"
+                        elif status == "l3":
+                            # The target found the prefix in its remote
+                            # tier; prefill restores it without transfer.
+                            outcome = "ok"
+                            injected = int(body.get("l3_blocks", 0) or 0)
+                        else:
+                            outcome = "miss"
+        except asyncio.TimeoutError:
+            outcome = "timeout"
+        except Exception as e:  # noqa: BLE001 - any transport failure
+            logger.warning("fleet pull %s <- %s failed: %s",
+                           server_url, holder_url, e)
+            outcome = "unreachable"
+        elapsed = time.monotonic() - t0
+        router_metrics.kv_pull_latency.labels(server=server_url).observe(
+            elapsed)
+        if outcome == "ok":
+            self.pulls_succeeded += 1
+            router_metrics.kv_pull_success.labels(server=server_url).inc()
+        else:
+            self.pulls_failed += 1
+            router_metrics.kv_pull_failures.labels(
+                server=server_url, reason=outcome).inc()
+        logger.info(
+            "fleet pull %s <- %s (%s): %s, %d blocks, %.1f ms",
+            server_url, holder_url,
+            "l3" if holder == L3_INSTANCE else holder,
+            outcome, injected, elapsed * 1e3)
+        return {"holder": holder, "holder_url": holder_url,
+                "matched_chars": matched_chars, "outcome": outcome,
+                "injected_blocks": injected, "seconds": elapsed}
+
+    def health(self) -> dict:
+        return {
+            "pulls_attempted": self.pulls_attempted,
+            "pulls_succeeded": self.pulls_succeeded,
+            "pulls_failed": self.pulls_failed,
+            "l3_pulls": self.l3_pulls,
+            "min_match_chars": self.config.min_match_chars,
+            "l3_url": self.config.l3_url,
+        }
+
+
+@dataclass
+class AutoscaleConfig:
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # Desired replicas ≈ total backlog / target backlog per replica.
+    queue_depth_target: float = 4.0
+    # Scale out one extra replica when mean HBM KV occupancy crosses this.
+    hbm_usage_high: float = 0.9
+    drain_timeout_s: float = 120.0
+
+
+class AutoscaleRecommender:
+    """Load-predictive replica-count recommendation.
+
+    Passive: every call to :meth:`recommend` folds the freshest signal
+    snapshot; the KEDA/HPA manifests under deploy/autoscaling/ (or the
+    helm-rendered equivalents) act on the exported gauges, and
+    :meth:`scale_in` implements the graceful half of the loop.
+    """
+
+    def __init__(self, config: AutoscaleConfig,
+                 kv_controller: Optional[KVController] = None,
+                 api_key: Optional[str] = None):
+        self.config = config
+        self.kv_controller = kv_controller
+        self.api_key = api_key
+        self.last: dict = {}
+
+    def recommend(self, endpoints, engine_stats: Dict,
+                  qos=None) -> dict:
+        from production_stack_tpu.router import metrics as router_metrics
+
+        current = len(endpoints)
+        waiting = running = 0
+        usages: List[float] = []
+        for stats in (engine_stats or {}).values():
+            waiting += stats.num_queuing_requests
+            running += stats.num_running_requests
+            usages.append(stats.gpu_cache_usage_perc)
+        headrooms = [
+            stats.hbm_headroom_bytes
+            for stats in (engine_stats or {}).values()
+            if getattr(stats, "hbm_headroom_bytes", -1.0) >= 0
+        ]
+        qos_backlog = 0
+        if qos is not None:
+            try:
+                qos_backlog = int(qos.queue.queued())
+            except Exception:  # noqa: BLE001 - QoS health is advisory
+                qos_backlog = 0
+        backlog = waiting + qos_backlog
+        desired = math.ceil(backlog / max(self.config.queue_depth_target,
+                                          1e-9))
+        desired = max(desired, 1 if (running or backlog) else 0)
+        mean_usage = sum(usages) / len(usages) if usages else 0.0
+        if usages and mean_usage >= self.config.hbm_usage_high:
+            # KV pressure scales out even when queues look shallow: an
+            # HBM-full fleet preempts before it queues.
+            desired = max(desired, current + 1)
+        desired = min(max(desired, self.config.min_replicas),
+                      self.config.max_replicas)
+        self.last = {
+            "recommended_replicas": desired,
+            "current_replicas": current,
+            "signals": {
+                "queue_depth": waiting,
+                "running": running,
+                "qos_backlog": qos_backlog,
+                "mean_hbm_kv_usage": round(mean_usage, 4),
+                "min_hbm_headroom_bytes": (
+                    min(headrooms) if headrooms else None),
+            },
+        }
+        router_metrics.autoscale_recommended_replicas.set(desired)
+        router_metrics.autoscale_current_replicas.set(current)
+        return self.last
+
+    def pick_scale_in_victim(self, endpoints, engine_stats: Dict,
+                             request_stats: Dict) -> Optional[str]:
+        """Least-loaded replica: fewest queued+running requests."""
+        if not endpoints:
+            return None
+
+        def load(url: str) -> float:
+            stats = (engine_stats or {}).get(url)
+            if stats is None:
+                return 0.0
+            return stats.num_queuing_requests + stats.num_running_requests
+
+        return min((ep.url for ep in endpoints), key=load)
+
+    async def scale_in(self, url: str) -> dict:
+        """Gracefully retire ``url``: evict it from the KV controller
+        (so no routing decision or pull targets it mid-drain), then
+        drive the engine's ``/drain`` hook and report the outcome. The
+        actual pod deletion is the orchestrator's job (HPA/KEDA +
+        preStop); this is the data-plane half."""
+        evicted: List[str] = []
+        if self.kv_controller is not None:
+            evicted = await self.kv_controller.deregister_url(url)
+        drain_status: Optional[int] = None
+        drain_body: dict = {}
+        try:
+            import aiohttp
+
+            headers = {}
+            if self.api_key:
+                headers["Authorization"] = f"Bearer {self.api_key}"
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    f"{url.rstrip('/')}/drain",
+                    params={"timeout_s": str(self.config.drain_timeout_s)},
+                    headers=headers,
+                    timeout=aiohttp.ClientTimeout(
+                        total=self.config.drain_timeout_s + 10.0),
+                ) as resp:
+                    drain_status = resp.status
+                    try:
+                        drain_body = await resp.json()
+                    except Exception:  # noqa: BLE001 - non-JSON drain reply
+                        drain_body = {}
+        except Exception as e:  # noqa: BLE001 - engine may already be gone
+            logger.warning("scale-in drain of %s failed: %s", url, e)
+            drain_body = {"error": str(e)}
+        return {"url": url, "deregistered_instances": evicted,
+                "drain_status": drain_status, "drain": drain_body}
+
+
+def initialize_fleet(args, kv_controller, fault_tolerance=None):
+    """Build (FleetCache | None, AutoscaleRecommender | None) from parsed
+    router args — both None unless their flags are set, preserving the
+    flag-off request path byte for byte."""
+    from production_stack_tpu.utils import auth
+
+    keys = auth.resolve_api_keys(getattr(args, "api_key", None))
+    key = keys[0] if keys else None
+    fleet = None
+    if getattr(args, "fleet_cache", False):
+        fleet = FleetCache(
+            FleetCacheConfig(
+                pull_timeout_s=args.fleet_pull_timeout,
+                min_match_chars=args.fleet_min_match_chars,
+                l3_url=args.fleet_l3_url,
+                api_key=key,
+            ),
+            kv_controller,
+            fault_tolerance=fault_tolerance,
+        )
+    autoscaler = None
+    if getattr(args, "autoscale", False):
+        autoscaler = AutoscaleRecommender(
+            AutoscaleConfig(
+                min_replicas=args.autoscale_min_replicas,
+                max_replicas=args.autoscale_max_replicas,
+                queue_depth_target=args.autoscale_queue_depth_target,
+                hbm_usage_high=args.autoscale_hbm_usage_high,
+                drain_timeout_s=args.autoscale_drain_timeout,
+            ),
+            kv_controller=kv_controller,
+            api_key=key,
+        )
+    return fleet, autoscaler

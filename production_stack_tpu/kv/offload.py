@@ -250,6 +250,12 @@ class HostKVStore:
         self.misses = 0
         self.stored = 0
         self.evicted = 0
+        # Remote (L3) tier traffic, for tpu:l3_* metrics: blocks/bytes
+        # spilled up to the cache server and fetched back from it.
+        self.remote_put_blocks = 0
+        self.remote_put_bytes = 0
+        self.remote_get_blocks = 0
+        self.remote_get_bytes = 0
         # Remote uploads happen on a background writer so a slow/unreachable
         # cache server never stalls the engine thread (put is called from
         # the allocator's eviction hook, under engine locks). Bounded queue:
@@ -281,7 +287,10 @@ class HostKVStore:
                 prefix_hash, data = self._remote_queue.pop(0)
                 self._remote_inflight += 1
             try:
-                self.remote.put(prefix_hash, data)
+                if self.remote.put(prefix_hash, data):
+                    with self._lock:
+                        self.remote_put_blocks += 1
+                        self.remote_put_bytes += len(data)
             finally:
                 with self._remote_cv:
                     self._remote_inflight -= 1
@@ -360,6 +369,8 @@ class HostKVStore:
                 else:
                     with self._lock:
                         self.hits += 1
+                        self.remote_get_blocks += 1
+                        self.remote_get_bytes += len(data)
                     return k, v
         with self._lock:
             self.misses += 1
@@ -381,4 +392,9 @@ class HostKVStore:
                 "misses": self.misses,
                 "stored": self.stored,
                 "evicted": self.evicted,
+                "remote": self.remote is not None,
+                "remote_put_blocks": self.remote_put_blocks,
+                "remote_put_bytes": self.remote_put_bytes,
+                "remote_get_blocks": self.remote_get_blocks,
+                "remote_get_bytes": self.remote_get_bytes,
             }

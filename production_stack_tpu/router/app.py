@@ -67,6 +67,8 @@ class RouterState:
     log_stats_thread: Optional[threading.Thread] = None
     trace_recorder: Any = None
     qos: Any = None  # QoSGate when --qos-tenants-file is set, else None
+    fleet: Any = None  # FleetCache when --fleet-cache is set, else None
+    autoscaler: Any = None  # AutoscaleRecommender when --autoscale is set
     # FaultTolerance bundle (circuit breaker + retry/deadline config)
     # when --fault-tolerance is set, else None (single-attempt path).
     fault_tolerance: Any = None
@@ -293,12 +295,34 @@ async def kv_evict(request: web.Request) -> web.Response:
     state = request.app["state"]
     body = await request.json()
     # "hashes": one root-anchored chunk path; "paths": several (an engine
-    # evicting a block shared by multiple admitted prompts).
+    # evicting a block shared by multiple admitted prompts). "spilled":
+    # the engine pushed the evicted blocks to its remote tier, so with an
+    # attached L3 the claims transfer to the L3 pseudo-instance instead
+    # of vanishing (fleet pull path: peer → L3 → recompute).
     paths = body.get("paths")
     if paths is None:
         paths = [body.get("hashes", [])]
+    spilled = bool(body.get("spilled", False))
     for path in paths:
-        await state.kv_controller.evict(body["instance_id"], path)
+        await state.kv_controller.evict(body["instance_id"], path,
+                                        spilled=spilled)
+    return web.json_response({"status": "ok"})
+
+
+async def kv_deregister(request: web.Request) -> web.Response:
+    """An engine announcing departure (drain/shutdown): drop its instance
+    registration and sweep every trie claim so no routing decision or
+    cross-replica pull targets it again."""
+    state = request.app["state"]
+    body = await request.json()
+    instance_id = body.get("instance_id")
+    if instance_id:
+        await state.kv_controller.deregister_instance(instance_id)
+    elif body.get("url"):
+        await state.kv_controller.deregister_url(body["url"])
+    else:
+        return web.json_response(
+            {"error": "instance_id or url required"}, status=400)
     return web.json_response({"status": "ok"})
 
 
@@ -309,6 +333,48 @@ async def kv_lookup(request: web.Request) -> web.Response:
     if match is None:
         return web.json_response({"matched": 0, "instance_id": None})
     return web.json_response({"matched": match[0], "instance_id": match[1]})
+
+
+# -- autoscale recommender (production_stack_tpu/kv/fleet.py) ---------------
+
+
+async def autoscale_recommendation(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    if state.autoscaler is None:
+        return web.json_response(
+            {"error": "autoscale recommender not enabled "
+                      "(--autoscale)"}, status=404)
+    endpoints = state.service_discovery.get_endpoint_info()
+    rec = state.autoscaler.recommend(
+        endpoints, state.engine_stats_scraper.get_engine_stats(),
+        qos=state.qos)
+    return web.json_response(rec)
+
+
+async def autoscale_scale_in(request: web.Request) -> web.Response:
+    """Data-plane half of scale-in: pick (or accept) a victim replica,
+    evict it from the KV controller, then drive its /drain hook. The
+    orchestrator (HPA/KEDA + preStop) deletes the pod afterwards."""
+    state = request.app["state"]
+    if state.autoscaler is None:
+        return web.json_response(
+            {"error": "autoscale recommender not enabled "
+                      "(--autoscale)"}, status=404)
+    try:
+        body = await request.json()
+    except Exception:  # noqa: BLE001 - empty body = auto-pick victim
+        body = {}
+    url = body.get("url")
+    if not url:
+        url = state.autoscaler.pick_scale_in_victim(
+            state.service_discovery.get_endpoint_info(),
+            state.engine_stats_scraper.get_engine_stats(),
+            state.request_stats_monitor.get_request_stats())
+    if not url:
+        return web.json_response(
+            {"error": "no replica available to scale in"}, status=409)
+    result = await state.autoscaler.scale_in(url)
+    return web.json_response(result)
 
 
 # ---------------------------------------------------------------------------
@@ -392,6 +458,10 @@ def build_app(args) -> web.Application:
     app.router.add_post("/kv/admit", kv_admit)
     app.router.add_post("/kv/evict", kv_evict)
     app.router.add_post("/kv/lookup", kv_lookup)
+    app.router.add_post("/kv/deregister", kv_deregister)
+    # Autoscale recommender (404 unless --autoscale)
+    app.router.add_get("/autoscale/recommendation", autoscale_recommendation)
+    app.router.add_post("/autoscale/scale_in", autoscale_scale_in)
     # Flight recorder (router-side spans of every proxied request).
     if state.trace_recorder is not None:
         from production_stack_tpu.obs.debug import add_debug_routes
@@ -626,6 +696,42 @@ def initialize_all(args) -> RouterState:
             "inter_chunk_deadline=%.0fs", cfg.max_retries,
             cfg.breaker_failure_threshold, cfg.breaker_reset_s,
             cfg.ttft_deadline_s, cfg.inter_chunk_deadline_s)
+        # Breaker-open mirror into the KV controller: a tripped endpoint
+        # must stop being a pull source / kvaware routing target right
+        # away — re-registration on recovery repopulates it.
+        kv_controller = state.kv_controller
+
+        def _on_breaker_open(url: str) -> None:
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:  # tripped off-loop (tests, threads)
+                return
+            loop.create_task(kv_controller.deregister_url(url))
+
+        state.fault_tolerance.breaker.on_open = _on_breaker_open
+
+    # Fleet cache + autoscale recommender (production_stack_tpu/kv/fleet):
+    # both None unless their flags are set — the request path is then
+    # byte-identical to the per-replica router.
+    from production_stack_tpu.kv.fleet import initialize_fleet
+
+    state.fleet, state.autoscaler = initialize_fleet(
+        args, state.kv_controller, fault_tolerance=state.fault_tolerance)
+    if state.fleet is not None:
+        if state.fleet.config.l3_url:
+            state.kv_controller.attach_l3(state.fleet.config.l3_url)
+        logger.info(
+            "Fleet cache enabled: min_match_chars=%d pull_timeout=%.1fs "
+            "l3=%s", state.fleet.config.min_match_chars,
+            state.fleet.config.pull_timeout_s,
+            state.fleet.config.l3_url or "none")
+    if state.autoscaler is not None:
+        logger.info(
+            "Autoscale recommender enabled: replicas=[%d, %d] "
+            "queue_depth_target=%.1f",
+            state.autoscaler.config.min_replicas,
+            state.autoscaler.config.max_replicas,
+            state.autoscaler.config.queue_depth_target)
 
     # Dynamic config watcher.
     if getattr(args, "dynamic_config_json", None):

@@ -35,6 +35,7 @@ class EngineStats:
     gpu_prefix_cache_queries: int = 0
     gpu_cache_usage_perc: float = 0.0  # on TPU: HBM KV pool usage fraction
     gpu_prefix_cache_hit_rate: float = 0.0
+    hbm_headroom_bytes: float = -1.0  # free HBM beyond pool+weights; -1 unknown
 
     @staticmethod
     def from_vllm_scrape(metrics_text: str) -> "EngineStats":
@@ -54,6 +55,9 @@ class EngineStats:
                     "tpu:hbm_kv_usage_perc",
                 ):
                     stats.gpu_cache_usage_perc = float(value)
+                elif name == "tpu:hbm_headroom_bytes":
+                    # Autoscale signal (kv/fleet.py recommender).
+                    stats.hbm_headroom_bytes = float(value)
                 elif name in (
                     "vllm:gpu_prefix_cache_hits_total",
                     "tpu:prefix_cache_hits_total",

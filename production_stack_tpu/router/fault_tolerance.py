@@ -98,6 +98,11 @@ class CircuitBreaker:
         self._sd = service_discovery
         # Cumulative trip count (exported for observability/tests).
         self.trips_total = 0
+        # Called with the URL each time a breaker trips OPEN (after the
+        # service-discovery mirror). The KV-aware layer hooks this to
+        # deregister the failing instance from the KV controller so the
+        # router never routes to — or pulls from — a dead holder.
+        self.on_open: Optional[Any] = None
 
     # -- internal ---------------------------------------------------- #
     def _entry(self, url: str) -> List[float]:
@@ -201,6 +206,12 @@ class CircuitBreaker:
                 "half-open probe in %.0fs)", url,
                 self.failure_threshold, self.reset_s)
             self._mark_sd(url, unhealthy=True)
+            if self.on_open is not None:
+                try:
+                    self.on_open(url)
+                except Exception:  # pragma: no cover - defensive
+                    logger.debug("breaker on_open hook failed",
+                                 exc_info=True)
 
 
 class FaultTolerance:

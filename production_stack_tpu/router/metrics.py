@@ -115,6 +115,40 @@ engine_stats_stale = Counter(
     "and excluded from routing",
     _L, registry=REGISTRY)
 
+# --- Fleet cache & autoscaling (production_stack_tpu/kv/fleet.py) --------
+# Series appear only with --fleet-cache / the autoscale recommender on.
+kv_pull_attempts = Counter(
+    "vllm_router:kv_pull_attempts_total",
+    "Cross-replica KV pulls orchestrated (target asked to pull the "
+    "matched prefix from the holder)",
+    _L, registry=REGISTRY)
+kv_pull_success = Counter(
+    "vllm_router:kv_pull_success_total",
+    "Cross-replica KV pulls that injected blocks on the target",
+    _L, registry=REGISTRY)
+kv_pull_failures = Counter(
+    "vllm_router:kv_pull_failures_total",
+    "Cross-replica KV pulls that missed or failed (target recomputes)",
+    ["server", "reason"], registry=REGISTRY)
+kv_pull_latency = Histogram(
+    "vllm_router:kv_pull_latency_seconds",
+    "Latency of the /kv/pull control round-trip (s)", _L,
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+             5.0, 10.0, 30.0),
+    registry=REGISTRY)
+fleet_l3_pulls = Counter(
+    "vllm_router:fleet_l3_pulls_total",
+    "Pulls whose holder was the shared L3 cache server",
+    registry=REGISTRY)
+autoscale_recommended_replicas = Gauge(
+    "vllm_router:autoscale_recommended_replicas",
+    "Replica count the load-predictive recommender asks for",
+    registry=REGISTRY)
+autoscale_current_replicas = Gauge(
+    "vllm_router:autoscale_current_replicas",
+    "Replica count the recommender currently observes",
+    registry=REGISTRY)
+
 _PROCESS = psutil.Process()
 
 

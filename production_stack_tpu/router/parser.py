@@ -116,6 +116,45 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--ft-retry-after", type=int, default=5,
                         help="Retry-After seconds returned with 503 "
                              "when every replica is broken")
+    # Fleet cache & autoscaling (production_stack_tpu/kv/fleet.py)
+    parser.add_argument("--fleet-cache", action="store_true",
+                        help="enable the global prefix cache: when the KV "
+                             "controller says another replica (or the L3 "
+                             "cache server) holds a long prefix of the "
+                             "prompt, the routed replica /kv/pull-s it "
+                             "before prefill instead of recomputing. "
+                             "Unset = today's per-replica behavior, "
+                             "byte-identical")
+    parser.add_argument("--fleet-pull-timeout", type=float, default=15.0,
+                        help="seconds allowed for the /kv/pull control "
+                             "round-trip before falling back to recompute")
+    parser.add_argument("--fleet-min-match-chars", type=int, default=256,
+                        help="minimum controller prefix match (characters) "
+                             "worth a cross-replica pull")
+    parser.add_argument("--fleet-l3-url", type=str, default=None,
+                        help="shared L3 cache server URL (kv.cache_server); "
+                             "spilled evictions stay routable through it")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="enable the load-predictive autoscale "
+                             "recommender: /autoscale/recommendation and "
+                             "vllm_router:autoscale_*_replicas gauges fed "
+                             "from queue depth, HBM KV pressure, and the "
+                             "QoS backlog; /autoscale/scale_in drains and "
+                             "deregisters a replica")
+    parser.add_argument("--autoscale-min-replicas", type=int, default=1)
+    parser.add_argument("--autoscale-max-replicas", type=int, default=8)
+    parser.add_argument("--autoscale-queue-depth-target", type=float,
+                        default=4.0,
+                        help="backlog (waiting + QoS queue) each replica "
+                             "is expected to absorb")
+    parser.add_argument("--autoscale-hbm-usage-high", type=float,
+                        default=0.9,
+                        help="mean HBM KV usage fraction above which one "
+                             "extra replica is recommended")
+    parser.add_argument("--autoscale-drain-timeout", type=float,
+                        default=120.0,
+                        help="seconds /autoscale/scale_in waits for the "
+                             "victim's /drain to quiesce")
     # Dynamic config
     parser.add_argument("--kv-admit-ttl", type=float, default=600.0,
                         help="seconds a KV admission claim stays routable "
@@ -198,6 +237,21 @@ def validate_args(args: argparse.Namespace) -> None:
         if args.ft_ttft_deadline < 0 or args.ft_inter_chunk_deadline < 0:
             raise ValueError("--ft-ttft-deadline/--ft-inter-chunk-"
                              "deadline must be >= 0 (0 disables)")
+    if getattr(args, "fleet_cache", False):
+        if args.fleet_pull_timeout <= 0:
+            raise ValueError("--fleet-pull-timeout must be > 0")
+        if args.fleet_min_match_chars < 1:
+            raise ValueError("--fleet-min-match-chars must be >= 1")
+    if getattr(args, "autoscale", False):
+        if args.autoscale_min_replicas < 0:
+            raise ValueError("--autoscale-min-replicas must be >= 0")
+        if args.autoscale_max_replicas < max(args.autoscale_min_replicas, 1):
+            raise ValueError("--autoscale-max-replicas must be >= "
+                             "max(--autoscale-min-replicas, 1)")
+        if args.autoscale_queue_depth_target <= 0:
+            raise ValueError("--autoscale-queue-depth-target must be > 0")
+        if not 0.0 < args.autoscale_hbm_usage_high <= 1.0:
+            raise ValueError("--autoscale-hbm-usage-high must be in (0, 1]")
     if not 0.0 <= args.sentry_traces_sample_rate <= 1.0:
         raise ValueError("--sentry-traces-sample-rate must be in [0, 1]")
     if not 0.0 <= args.sentry_profile_session_sample_rate <= 1.0:

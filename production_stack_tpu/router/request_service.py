@@ -40,9 +40,18 @@ HOP_BY_HOP = {
 }
 
 
+# Identity headers are asserted by the router (QoS admission writes the
+# authenticated tenant and effective priority), never trusted from the
+# client: forwarding a client-supplied X-Tenant / X-Priority would let
+# anyone spoof tenant accounting and preemption class engine-side.
+_ROUTER_ASSERTED = {"x-tenant", "x-priority"}
+
+
 def _forward_headers(request: web.Request) -> dict:
     return {
-        k: v for k, v in request.headers.items() if k.lower() not in HOP_BY_HOP
+        k: v for k, v in request.headers.items()
+        if k.lower() not in HOP_BY_HOP
+        and k.lower() not in _ROUTER_ASSERTED
     }
 
 
@@ -405,6 +414,31 @@ async def route_general_request(
             request_id, requested_model, server_url,
             in_router_time, (time.time() - in_router_time) * 1e3,
         )
+
+        # Global prefix cache (--fleet-cache): if another replica or the
+        # L3 holds a long prefix of this prompt, have the picked replica
+        # pull it before prefill. Strictly best-effort — any failure
+        # means the engine recomputes, exactly as without the flag.
+        fleet = getattr(state, "fleet", None)
+        if fleet is not None and request_json is not None:
+            from production_stack_tpu.router.routing_logic import (
+                _extract_prompt,
+            )
+
+            pull_span = (
+                trace.start_span("router.kv_pull") if trace else None)
+            pull = await fleet.maybe_pull(
+                server_url, _extract_prompt(request_json) or "",
+                request_json, request_id)
+            if pull_span is not None:
+                if pull is None:
+                    pull_span.finish(outcome="skip")
+                else:
+                    pull_span.finish(
+                        holder=pull["holder_url"],
+                        outcome=pull["outcome"],
+                        injected_blocks=pull["injected_blocks"],
+                        matched_chars=pull["matched_chars"])
 
         headers = _forward_headers(request)
         headers["X-Request-Id"] = request_id

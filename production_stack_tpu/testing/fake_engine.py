@@ -17,7 +17,17 @@ must fire), ``hang_mid_stream`` (streams ``after_chunks`` chunks then
 stalls — the inter-chunk deadline must fire), ``crash_after_n_chunks``
 (streams ``after_chunks`` chunks then drops the TCP connection).
 ``times`` bounds how many requests fault (-1 = until cleared); mode null
-disarms. Connect-refuse is exercised by stopping the runner itself.
+disarms. ``pull_error`` faults /kv/pull (500) instead of inference.
+Connect-refuse is exercised by stopping the runner itself.
+
+Fleet surface (hermetic mirror of the real engine's global-prefix-cache
+integration): a simulated prefix cache keyed on the KV controller's chunk
+hashes. A request whose leading chunks are cached skips that fraction of
+its TTFT; completions admit their prompt's chunks and (after
+``configure_kv``) report them to the router's /kv/admit. /kv/pull copies
+matching chunks from an in-process peer (``run_fake_engine`` registry),
+mirroring the real cross-replica transfer; /drain mirrors the real
+server's controller deregistration.
 """
 
 from __future__ import annotations
@@ -35,6 +45,10 @@ from production_stack_tpu.obs.trace import TraceRecorder
 
 
 class FakeEngine:
+    # url -> engine, for in-process /kv/pull peer copies (the fake analog
+    # of the real server's _local_peers port registry).
+    _peers: Dict[str, "FakeEngine"] = {}
+
     def __init__(
         self,
         model: str = "fake-model",
@@ -94,6 +108,20 @@ class FakeEngine:
         self.num_waiting = 0
         self.requests_seen: List[dict] = []
         self.kv_usage = 0.42
+        # Fleet surface (see module docstring). ``self_url`` is stamped by
+        # run_fake_engine once the real port is known; ``configure_kv``
+        # registers with the router's KV controller.
+        self.prefix_cache: "set[int]" = set()
+        self.kv_controller_url: Optional[str] = None
+        self.self_url: Optional[str] = None
+        self.api_key: Optional[str] = None
+        self.instance_id = f"fake-{uuid.uuid4().hex[:8]}"
+        self.kv_pulls_received = 0
+        self.kv_pulls_served = 0
+        self.pull_requests: List[dict] = []
+        self.prefix_cache_hits = 0
+        self.prefix_cache_queries = 0
+        self.hbm_headroom_bytes: float = -1.0  # >=0: scraped by autoscaler
         # Same trace surface as the real engine server: synthetic
         # queue/prefill/decode spans linked under the router's forwarded
         # traceparent, retrievable at /debug/traces/{request_id}.
@@ -128,7 +156,73 @@ class FakeEngine:
                 self.tenant_requests.get(tenant, 0) + 1
         return priority
 
-    async def _prefill_sleep(self, priority: str = "interactive") -> int:
+    # -- fleet surface -----------------------------------------------------
+    def _kv_headers(self) -> Dict[str, str]:
+        if self.api_key:
+            return {"Authorization": f"Bearer {self.api_key}"}
+        return {}
+
+    async def _kv_post(self, path: str, payload: dict) -> None:
+        """Best-effort POST to the router's KV controller endpoints."""
+        if self.kv_controller_url is None:
+            return
+        import aiohttp
+
+        try:
+            async with aiohttp.ClientSession() as sess:
+                await sess.post(
+                    f"{self.kv_controller_url}{path}", json=payload,
+                    headers=self._kv_headers(),
+                    timeout=aiohttp.ClientTimeout(total=5))
+        except Exception:  # noqa: BLE001 - controller may be gone in tests
+            pass
+
+    async def configure_kv(self, controller_url: str,
+                           api_key: Optional[str] = None) -> None:
+        """Register with the router's KV controller (call after
+        run_fake_engine so ``self_url`` is stamped)."""
+        self.kv_controller_url = controller_url.rstrip("/")
+        self.api_key = api_key
+        await self._kv_post("/kv/register", {
+            "instance_id": self.instance_id, "url": self.self_url})
+
+    def _prefix_hashes(self, body: dict) -> "List[int]":
+        # The simulated prefix cache only exists once the engine is
+        # wired to a KV controller (configure_kv) — otherwise repeat
+        # prompts would skip their TTFT and break every timing-based
+        # fake-engine test that reuses a prompt.
+        if not self.kv_controller_url:
+            return []
+        from production_stack_tpu.kv.controller import chunk_hashes
+        from production_stack_tpu.router.routing_logic import _extract_prompt
+
+        prompt = _extract_prompt(body)
+        return chunk_hashes(prompt) if prompt else []
+
+    def _cached_fraction(self, hashes: "List[int]") -> float:
+        """Leading fraction of the prompt's chunks already held — that
+        fraction of the TTFT is skipped, like real prefix-cache reuse."""
+        if not hashes:
+            return 0.0
+        cached = 0
+        for h in hashes:
+            if h not in self.prefix_cache:
+                break
+            cached += 1
+        self.prefix_cache_hits += cached
+        self.prefix_cache_queries += len(hashes)
+        return cached / len(hashes)
+
+    async def _admit_prefix(self, hashes: "List[int]") -> None:
+        if not hashes:
+            return
+        self.prefix_cache.update(hashes)
+        if self.kv_controller_url:
+            await self._kv_post("/kv/admit", {
+                "instance_id": self.instance_id, "hashes": hashes})
+
+    async def _prefill_sleep(self, priority: str = "interactive",
+                             cached_frac: float = 0.0) -> int:
         """TTFT wait; under the contention model it holds the engine lock
         in 1 (unchunked) or ``prefill_chunks`` (chunked) slices. Returns
         the chunk count.
@@ -137,9 +231,10 @@ class FakeEngine:
         prefill is in flight — the fake-device analog of the real
         scheduler's priority admission + preemption, so the noisy-neighbor
         A/B observes the same TTFT protection hermetically."""
+        effective_ttft = self.ttft * (1.0 - cached_frac)
         if not self.simulate_contention:
-            if self.ttft > 0:
-                await asyncio.sleep(self.ttft)
+            if effective_ttft > 0:
+                await asyncio.sleep(effective_ttft)
             return 1
         chunks = self.prefill_chunks if self.enable_chunked_prefill else 1
         interactive = priority != "batch"
@@ -151,8 +246,8 @@ class FakeEngine:
                 if not interactive:
                     await self._no_interactive.wait()
                 async with self._engine_lock:
-                    if self.ttft > 0:
-                        await asyncio.sleep(self.ttft / chunks)
+                    if effective_ttft > 0:
+                        await asyncio.sleep(effective_ttft / chunks)
         finally:
             if interactive:
                 self._interactive_prefills -= 1
@@ -184,6 +279,7 @@ class FakeEngine:
         app.router.add_get("/health", self.handle_health)
         app.router.add_post("/fault", self.handle_fault)
         app.router.add_post("/drain", self.handle_drain)
+        app.router.add_post("/kv/pull", self.handle_kv_pull)
         app.router.add_post("/v1/audio/transcriptions", self.handle_transcription)
         from production_stack_tpu.obs.debug import add_debug_routes
 
@@ -228,9 +324,12 @@ class FakeEngine:
                 {"error": {"message": "engine is draining",
                            "type": "ServiceUnavailable"}},
                 status=503, headers={"Retry-After": "1"})
-        fault = self._take_fault()
+        # pull_error targets /kv/pull only — don't let inference claim it.
+        fault = None if self.fault_mode == "pull_error" else self._take_fault()
         body = await request.json()
         self.requests_seen.append(body)
+        prefix = self._prefix_hashes(body)
+        cached_frac = self._cached_fraction(prefix)
         n_tokens = int(
             body.get("max_tokens")
             or body.get("max_completion_tokens")
@@ -259,7 +358,7 @@ class FakeEngine:
                     {"error": {"message": "injected hang elapsed",
                                "type": "InternalServerError"}},
                     status=500)
-            await self._prefill_sleep(priority)
+            await self._prefill_sleep(priority, cached_frac)
             t_prefill_end = time.time()
             if not stream:
                 for _ in range(n_tokens):
@@ -317,6 +416,7 @@ class FakeEngine:
             self._record_trace(request, rid, model, t_arrival,
                                t_prefill_end, n_tokens)
             self.num_running -= 1
+            await self._admit_prefix(prefix)
 
     async def handle_completion(self, request: web.Request) -> web.StreamResponse:
         if self.draining:
@@ -333,7 +433,9 @@ class FakeEngine:
         model = body.get("model", self.models[0])
         t_arrival = time.time()
         priority = self._count_request(request)
-        await self._prefill_sleep(priority)
+        prefix = self._prefix_hashes(body)
+        await self._prefill_sleep(priority, self._cached_fraction(prefix))
+        await self._admit_prefix(prefix)
         t_prefill_end = time.time()
         if not stream:
             self._record_trace(request, rid, model, t_arrival,
@@ -410,6 +512,11 @@ class FakeEngine:
             "# TYPE tpu:spec_disabled_requests counter\n"
             f"tpu:spec_disabled_requests_total {self.spec_disabled_requests_total}\n"
         )
+        if self.hbm_headroom_bytes >= 0:
+            text += (
+                "# TYPE tpu:hbm_headroom_bytes gauge\n"
+                f"tpu:hbm_headroom_bytes {self.hbm_headroom_bytes}\n"
+            )
         return web.Response(text=text, content_type="text/plain")
 
     async def handle_sleep(self, request: web.Request) -> web.Response:
@@ -435,7 +542,7 @@ class FakeEngine:
         body = await request.json()
         mode = body.get("mode")
         valid = (None, "error_before_stream", "hang_before_stream",
-                 "hang_mid_stream", "crash_after_n_chunks")
+                 "hang_mid_stream", "crash_after_n_chunks", "pull_error")
         if mode not in valid:
             return web.json_response(
                 {"error": f"unknown fault mode {mode!r}"}, status=400)
@@ -456,7 +563,13 @@ class FakeEngine:
             timeout_s = float(request.query.get("timeout_s", "30"))
         except ValueError:
             return web.json_response({"error": "bad timeout_s"}, status=400)
+        first_drain = not self.draining
         self.draining = True
+        if first_drain and self.kv_controller_url:
+            # Mirror the real server: a draining replica's cache is about
+            # to disappear — stop advertising it to the controller.
+            await self._kv_post("/kv/deregister",
+                                {"instance_id": self.instance_id})
         deadline = time.monotonic() + timeout_s
         while self.num_running > 0 and time.monotonic() < deadline:
             await asyncio.sleep(0.05)
@@ -466,16 +579,63 @@ class FakeEngine:
              "in_flight": self.num_running},
             status=200 if drained else 202)
 
+    async def handle_kv_pull(self, request: web.Request) -> web.Response:
+        """Cross-replica KV pull, same contract as the real engine server:
+        body {"source_url", "request"}; copies the source peer's matching
+        leading chunks into this engine's cache so the imminent inference
+        request sees them as cached (the TTFT win the router measures)."""
+        body = await request.json()
+        self.pull_requests.append(body)
+        if self.fault_mode == "pull_error" and self.fault_times != 0:
+            if self.fault_times > 0:
+                self.fault_times -= 1
+            self.faults_injected += 1
+            return web.json_response(
+                {"error": "injected pull failure"}, status=500)
+        source_url = str(body.get("source_url") or "").rstrip("/")
+        hashes = self._prefix_hashes(body.get("request") or {})
+        peer = FakeEngine._peers.get(source_url)
+        if peer is None or not hashes:
+            return web.json_response({"status": "miss", "injected_blocks": 0})
+        injected = 0
+        for h in hashes:
+            if h not in peer.prefix_cache:
+                break
+            self.prefix_cache.add(h)
+            injected += 1
+        if injected == 0:
+            return web.json_response({"status": "miss", "injected_blocks": 0})
+        peer.kv_pulls_served += 1
+        self.kv_pulls_received += 1
+        return web.json_response({
+            "status": "ok", "injected_blocks": injected,
+            "num_tokens": injected})
+
     async def handle_transcription(self, request: web.Request) -> web.Response:
         await request.post()
         return web.json_response({"text": "fake transcription"})
 
 
 async def run_fake_engine(engine: FakeEngine, host: str, port: int) -> web.AppRunner:
-    runner = web.AppRunner(engine.make_app())
+    app = engine.make_app()
+    bound: "List[str]" = []
+
+    async def _unregister(app):
+        # Drop the peer registration so a recycled port can't resolve to a
+        # stopped engine's cache (same guard as the real server).
+        if bound and FakeEngine._peers.get(bound[0]) is engine:
+            del FakeEngine._peers[bound[0]]
+
+    app.on_cleanup.append(_unregister)
+    runner = web.AppRunner(app)
     await runner.setup()
     site = web.TCPSite(runner, host, port)
     await site.start()
+    real_port = site._server.sockets[0].getsockname()[1]
+    url = f"http://{host}:{real_port}"
+    bound.append(url)
+    FakeEngine._peers[url] = engine
+    engine.self_url = url
     return runner
 
 

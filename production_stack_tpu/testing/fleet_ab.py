@@ -1,0 +1,208 @@
+"""Hermetic fleet A/B: global prefix cache ON (cross-replica pulls) vs OFF.
+
+The physics, with no TPU and no model: three :class:`FakeEngine`
+replicas serve repeat-prompt traffic through the real router with
+**round-robin** routing — so a user's second request lands on a
+*different* replica than the one that prefilled their prefix. Each user
+has a unique ~1.2 kB prompt prefix (well past the fleet's
+``min_match_chars``), and each fake engine skips the cached fraction of
+its TTFT, like real prefix-cache reuse.
+
+- **pulls_on** leg: the router runs with ``--fleet-cache``. After the
+  prime round, the KV controller knows which replica holds each prefix;
+  on a repeat request routed elsewhere, the router orchestrates a
+  ``/kv/pull`` from the holder before forwarding, so the repeat prefill
+  is (mostly) cached and TTFT collapses.
+- **pulls_off** leg: same traffic, no fleet cache. A repeat request that
+  round-robins onto a different replica recomputes the whole prefix —
+  full TTFT. Only the ~1/N that happen to re-land on the holder reuse.
+
+Used by ``bench.py`` (BENCH_FLEET=1) and ``tests/test_fleet.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import List, Optional
+
+from production_stack_tpu.testing.qos_ab import (
+    _p99,
+    _reset_router_singletons,
+)
+
+MODEL = "fleet-model"
+
+
+async def _start(app):
+    from aiohttp import web
+
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+def _user_prompt(i: int, chars: int = 1200) -> str:
+    """Unique-per-user prompt prefix, distinct from char 0 so no two
+    users share leading controller chunks."""
+    return (f"user-{i:03d} corpus line about topic {i}. " * 64)[:chars]
+
+
+async def _ttft_request(session, router_url: str, prompt: str,
+                        timeout_s: float = 30.0) -> Optional[float]:
+    """One streamed chat completion; returns TTFT (first content chunk)
+    on a complete stream, None on any failure."""
+    import aiohttp
+
+    t0 = time.perf_counter()
+    try:
+        async with session.post(
+            router_url + "/v1/chat/completions",
+            json={"model": MODEL, "max_tokens": 2, "stream": True,
+                  "messages": [{"role": "user", "content": prompt}]},
+            timeout=aiohttp.ClientTimeout(total=timeout_s),
+        ) as resp:
+            if resp.status != 200:
+                return None
+            ttft = None
+            done = False
+            async for line in resp.content:
+                stripped = line.strip()
+                if stripped == b"data: [DONE]":
+                    done = True
+                elif ttft is None and stripped.startswith(b"data:"):
+                    ttft = time.perf_counter() - t0
+            return ttft if done else None
+    except (aiohttp.ClientError, asyncio.TimeoutError):
+        return None
+
+
+async def _run_leg(*, fleet_on: bool, users: int, rounds: int,
+                   concurrency: int, engine_ttft: float,
+                   min_match_chars: int) -> dict:
+    import aiohttp
+
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.parser import build_parser
+    from production_stack_tpu.testing.fake_engine import (
+        FakeEngine,
+        run_fake_engine,
+    )
+
+    _reset_router_singletons()
+    engines = [FakeEngine(model=MODEL, ttft=engine_ttft,
+                          max_tokens_default=2) for _ in range(3)]
+    runners = [await run_fake_engine(e, "127.0.0.1", 0) for e in engines]
+    urls = [e.self_url for e in engines]
+
+    args = build_parser().parse_args([])
+    args.static_backends = ",".join(urls)
+    args.static_models = ",".join([MODEL] * 3)
+    # Round-robin on purpose: it maximizes repeat requests landing off
+    # the holder replica, which is exactly the case fleet pulls fix.
+    args.routing_logic = "roundrobin"
+    args.engine_stats_interval = 60
+    if fleet_on:
+        args.fleet_cache = True
+        args.fleet_min_match_chars = min_match_chars
+    router_app = build_app(args)
+    router_runner, router_url = await _start(router_app)
+    for e in engines:
+        await e.configure_kv(router_url)
+
+    prompts = [_user_prompt(i) for i in range(users)]
+    cold: List[float] = []
+    reuse: List[float] = []
+    failed = 0
+    sem = asyncio.Semaphore(concurrency)
+
+    async def one(session, i: int, bucket: List[float]):
+        nonlocal failed
+        async with sem:
+            ttft = await _ttft_request(session, router_url, prompts[i])
+            if ttft is None:
+                failed += 1
+            else:
+                bucket.append(ttft)
+
+    try:
+        async with aiohttp.ClientSession() as session:
+            # Prime round: every user's prefix lands on some replica and
+            # is admitted to the controller. Later rounds are the reuse
+            # traffic the A/B measures; the barrier between rounds makes
+            # sure admissions precede lookups.
+            await asyncio.gather(
+                *[one(session, i, cold) for i in range(users)])
+            for _ in range(rounds - 1):
+                await asyncio.gather(
+                    *[one(session, i, reuse) for i in range(users)])
+    finally:
+        await router_runner.cleanup()
+        for runner in runners:
+            await runner.cleanup()
+        _reset_router_singletons()
+
+    reuse_total = users * (rounds - 1)
+    pulls = sum(e.kv_pulls_received for e in engines)
+    sorted_reuse = sorted(reuse)
+    return {
+        "fleet_on": fleet_on,
+        "users": users,
+        "rounds": rounds,
+        "engine_ttft_s": engine_ttft,
+        "completed": len(cold) + len(reuse),
+        "failed": failed,
+        "cold_ttft_p50_s": round(sorted(cold)[len(cold) // 2], 4)
+        if cold else None,
+        "reuse_ttft_p50_s": round(sorted_reuse[len(sorted_reuse) // 2], 4)
+        if reuse else None,
+        "reuse_ttft_mean_s": round(sum(reuse) / len(reuse), 4)
+        if reuse else None,
+        "reuse_ttft_p99_s": round(_p99(reuse), 4) if reuse else None,
+        "cross_replica_pulls": pulls,
+        "cross_replica_hit_rate": round(pulls / reuse_total, 4)
+        if reuse_total else None,
+        "pulls_served": sum(e.kv_pulls_served for e in engines),
+        "engine_requests": [len(e.requests_seen) for e in engines],
+        "engine_prefix_hit_chunks": sum(
+            e.prefix_cache_hits for e in engines),
+    }
+
+
+async def run_fleet_ab(*, users: int = 10, rounds: int = 3,
+                       concurrency: int = 4, engine_ttft: float = 0.2,
+                       min_match_chars: int = 256,
+                       skip_off: bool = False) -> dict:
+    """Run the pulls-on leg then the pulls-off baseline; A/B dict.
+
+    ``skip_off`` runs only the ON leg (tier-1 test uses it — the OFF leg
+    exists to quantify the TTFT win, not to gate correctness)."""
+    on = await _run_leg(
+        fleet_on=True, users=users, rounds=rounds, concurrency=concurrency,
+        engine_ttft=engine_ttft, min_match_chars=min_match_chars)
+    off = None
+    if not skip_off:
+        off = await _run_leg(
+            fleet_on=False, users=users, rounds=rounds,
+            concurrency=concurrency, engine_ttft=engine_ttft,
+            min_match_chars=min_match_chars)
+    speedup = None
+    if off and on["reuse_ttft_mean_s"] and off["reuse_ttft_mean_s"]:
+        if on["reuse_ttft_mean_s"] > 0:
+            speedup = round(
+                off["reuse_ttft_mean_s"] / on["reuse_ttft_mean_s"], 2)
+    return {
+        "metric": "fleet_prefix_cache_ab",
+        "unit": "reuse_ttft_speedup",
+        "value": speedup,
+        "cross_replica_hit_rate": on["cross_replica_hit_rate"],
+        "users": users,
+        "rounds": rounds,
+        "concurrency": concurrency,
+        "engine_ttft_s": engine_ttft,
+        "pulls_on": on,
+        "pulls_off": off,
+    }

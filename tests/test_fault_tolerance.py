@@ -574,3 +574,97 @@ def test_pool_shrink_ladder_exhausted_reraises(monkeypatch):
     monkeypatch.setattr(EngineCore, "_alloc_kv", other_error)
     with pytest.raises(ValueError, match="not an OOM"):
         EngineCore(cfg, devices=jax.devices()[:1])
+
+
+# --------------------------------------------------------------------- #
+# Breaker / drain eviction from the KV controller (fleet satellite)
+# --------------------------------------------------------------------- #
+
+def test_breaker_open_deregisters_kv_instances():
+    """When a replica's circuit opens, the router must stop advertising
+    its prefix cache: the KV controller drops every instance at that URL,
+    so no routing decision or fleet pull targets a failing holder."""
+    async def run():
+        from production_stack_tpu.kv.controller import chunk_hashes
+        from production_stack_tpu.router.app import build_app
+        from production_stack_tpu.testing.qos_ab import (
+            _reset_router_singletons,
+        )
+
+        _reset_router_singletons()
+        args = _router_args(
+            ["http://127.0.0.1:1", "http://127.0.0.1:2"],
+            ft_on=True, breaker_threshold=2)
+        app = build_app(args)
+        router_runner, _ = await _start(app)
+        try:
+            state = app["state"]
+            ctl = state.kv_controller
+            text = "b" * 512
+            await ctl.register_instance("bad", "http://127.0.0.1:1")
+            await ctl.admit("bad", chunk_hashes(text, ctl.chunk_size))
+            assert (await ctl.lookup(text))[1] == "bad"
+            # Trip the breaker from inside the running loop, as the
+            # retry path does.
+            for _ in range(2):
+                state.fault_tolerance.breaker.record_failure(
+                    "http://127.0.0.1:1")
+            assert ("http://127.0.0.1:1"
+                    in state.fault_tolerance.breaker.blocked_urls())
+            await asyncio.sleep(0.05)  # the on_open hook is a task
+            assert await ctl.lookup(text) is None
+            assert "bad" not in ctl._instances
+        finally:
+            await router_runner.cleanup()
+            _reset_router_singletons()
+
+    asyncio.run(run())
+
+
+def test_drain_deregisters_from_kv_controller():
+    """A drained replica's cache is about to disappear: /drain reports
+    /kv/deregister to the router, after which controller lookups stop
+    returning the instance."""
+    async def run():
+        import aiohttp
+
+        from production_stack_tpu.router.app import build_app
+        from production_stack_tpu.testing.fake_engine import (
+            FakeEngine,
+            run_fake_engine,
+        )
+        from production_stack_tpu.testing.qos_ab import (
+            _reset_router_singletons,
+        )
+
+        _reset_router_singletons()
+        eng = FakeEngine(model=MODEL, max_tokens_default=2)
+        eng_runner = await run_fake_engine(eng, "127.0.0.1", 0)
+        args = _router_args([eng.self_url], ft_on=False)
+        app = build_app(args)
+        router_runner, router_url = await _start(app)
+        try:
+            await eng.configure_kv(router_url)
+            ctl = app["state"].kv_controller
+            prompt = "d" * 512
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    f"{router_url}/v1/chat/completions",
+                    json={"model": MODEL, "max_tokens": 2,
+                          "messages": [{"role": "user",
+                                        "content": prompt}]}) as resp:
+                    assert resp.status == 200
+                # The completed request admitted its prefix.
+                match = await ctl.lookup(prompt)
+                assert match is not None and match[1] == eng.instance_id
+                async with session.post(
+                    eng.self_url + "/drain?timeout_s=2") as resp:
+                    assert resp.status == 200
+            assert await ctl.lookup(prompt) is None
+            assert eng.instance_id not in ctl._instances
+        finally:
+            await router_runner.cleanup()
+            await eng_runner.cleanup()
+            _reset_router_singletons()
+
+    asyncio.run(run())

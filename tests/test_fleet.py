@@ -1,0 +1,396 @@
+"""Fleet subsystem: global prefix cache (cross-replica KV pulls),
+load-predictive autoscaling, and the flag-off parity guarantee.
+
+Controller/recommender units run in-process; scenarios run 3 real
+FakeEngine replicas behind the real router (hermetic, no TPU). The
+flag-off test pins the PR convention: with ``--fleet-cache`` and
+``--autoscale`` unset, ``state.fleet``/``state.autoscaler`` are None and
+the request path is byte-identical to a router built before this
+subsystem existed.
+"""
+
+import asyncio
+from types import SimpleNamespace
+
+import pytest
+
+from production_stack_tpu.kv.controller import (
+    L3_INSTANCE,
+    KVController,
+    chunk_hashes,
+)
+from production_stack_tpu.kv.fleet import (
+    AutoscaleConfig,
+    AutoscaleRecommender,
+)
+from production_stack_tpu.router.engine_stats import EngineStats
+
+MODEL = "fleet-model"
+
+
+# --------------------------------------------------------------------- #
+# Controller: L3 residency + lookup preference
+# --------------------------------------------------------------------- #
+
+def test_l3_residency_spilled_eviction_and_lookup_preference():
+    async def run():
+        ctl = KVController(chunk_size=128)
+        text = "f" * 384  # 3 chunks
+        hashes = chunk_hashes(text, 128)
+        await ctl.register_instance("A", "http://a")
+        await ctl.admit("A", hashes)
+        assert await ctl.lookup(text) == (384, "A")
+
+        # Spilled eviction (root-anchored path: the whole subtree) with
+        # no L3 attached: claims simply vanish.
+        await ctl.evict("A", hashes[:1], spilled=True)
+        assert await ctl.lookup(text) is None
+
+        # With the L3 attached, spilled claims transfer to __l3__.
+        await ctl.admit("A", hashes)
+        ctl.attach_l3("http://l3:8100")
+        await ctl.evict("A", hashes[:1], spilled=True)
+        assert await ctl.lookup(text) == (384, L3_INSTANCE)
+        assert await ctl.instance_url(L3_INSTANCE) == "http://l3:8100"
+
+        # A live engine holding a SHORTER prefix loses to a deeper L3
+        # match (the pull restores more), but WINS at equal depth (no
+        # reason to touch the shared tier when a replica has it all).
+        await ctl.register_instance("B", "http://b")
+        await ctl.admit("B", hashes[:1])
+        assert await ctl.lookup(text) == (384, L3_INSTANCE)
+        await ctl.admit("B", hashes)
+        assert await ctl.lookup(text) == (384, "B")
+
+        # Non-spilled eviction never creates L3 claims, even when
+        # attached: only blocks that actually reached the remote tier
+        # may be advertised there.
+        await ctl.evict("B", hashes[:1], spilled=False)
+        assert await ctl.lookup(text) == (384, L3_INSTANCE)
+
+    asyncio.run(run())
+
+
+def test_deregister_url_drops_all_instances_at_url():
+    async def run():
+        ctl = KVController(chunk_size=128)
+        text = "g" * 256
+        await ctl.register_instance("old", "http://replica:9")
+        await ctl.register_instance("new", "http://replica:9")
+        await ctl.admit("old", chunk_hashes(text, 128))
+        gone = await ctl.deregister_url("http://replica:9")
+        assert sorted(gone) == ["new", "old"]
+        assert await ctl.lookup(text) is None
+        # The L3 pseudo-instance survives URL-based deregistration.
+        ctl.attach_l3("http://replica:9")
+        assert await ctl.deregister_url("http://replica:9") == []
+        assert await ctl.instance_url(L3_INSTANCE) == "http://replica:9"
+
+    asyncio.run(run())
+
+
+# --------------------------------------------------------------------- #
+# Autoscale recommender units
+# --------------------------------------------------------------------- #
+
+def _eps(*urls):
+    return [SimpleNamespace(url=u) for u in urls]
+
+
+def test_recommender_scales_on_queue_depth():
+    rec = AutoscaleRecommender(AutoscaleConfig(queue_depth_target=4.0))
+    stats = {
+        "http://a": EngineStats(num_queuing_requests=5,
+                                num_running_requests=2),
+        "http://b": EngineStats(num_queuing_requests=4,
+                                num_running_requests=1),
+    }
+    out = rec.recommend(_eps("http://a", "http://b"), stats)
+    # backlog 9 / target 4 -> ceil = 3
+    assert out["recommended_replicas"] == 3
+    assert out["current_replicas"] == 2
+    assert out["signals"]["queue_depth"] == 9
+
+
+def test_recommender_idle_floor_and_max_clamp():
+    rec = AutoscaleRecommender(AutoscaleConfig(
+        min_replicas=1, max_replicas=4, queue_depth_target=1.0))
+    idle = rec.recommend(_eps("http://a"), {
+        "http://a": EngineStats()})
+    assert idle["recommended_replicas"] == 1  # min floor, not 0
+    flood = rec.recommend(_eps("http://a"), {
+        "http://a": EngineStats(num_queuing_requests=100)})
+    assert flood["recommended_replicas"] == 4  # max clamp
+
+
+def test_recommender_hbm_pressure_scales_out():
+    rec = AutoscaleRecommender(AutoscaleConfig(hbm_usage_high=0.9))
+    stats = {
+        "http://a": EngineStats(gpu_cache_usage_perc=0.95,
+                                num_running_requests=1),
+        "http://b": EngineStats(gpu_cache_usage_perc=0.92,
+                                num_running_requests=1),
+    }
+    out = rec.recommend(_eps("http://a", "http://b"), stats)
+    # Queues are empty, but an HBM-full fleet grows before it queues.
+    assert out["recommended_replicas"] == 3
+    assert out["signals"]["mean_hbm_kv_usage"] == pytest.approx(0.935)
+
+
+def test_pick_scale_in_victim_is_least_loaded():
+    rec = AutoscaleRecommender(AutoscaleConfig())
+    stats = {
+        "http://a": EngineStats(num_queuing_requests=3,
+                                num_running_requests=2),
+        "http://b": EngineStats(num_queuing_requests=0,
+                                num_running_requests=1),
+    }
+    assert rec.pick_scale_in_victim(
+        _eps("http://a", "http://b"), stats, {}) == "http://b"
+    assert rec.pick_scale_in_victim([], {}, {}) is None
+
+
+# --------------------------------------------------------------------- #
+# Hermetic router + fake-replica scenarios
+# --------------------------------------------------------------------- #
+
+async def _start(app):
+    from aiohttp import web
+
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+class _FleetStack:
+    """3 fake replicas (with the fleet surface registered to the
+    controller) behind one real router."""
+
+    def __init__(self, *, fleet_on=True, autoscale=False, ft_on=False,
+                 n=3, engine_ttft=0.05, **argover):
+        self.fleet_on = fleet_on
+        self.autoscale = autoscale
+        self.ft_on = ft_on
+        self.n = n
+        self.engine_ttft = engine_ttft
+        self.argover = argover
+        self.engines = []
+        self.runners = []
+        self.urls = []
+
+    async def __aenter__(self):
+        from production_stack_tpu.router.app import build_app
+        from production_stack_tpu.router.parser import build_parser
+        from production_stack_tpu.testing.fake_engine import (
+            FakeEngine,
+            run_fake_engine,
+        )
+        from production_stack_tpu.testing.qos_ab import (
+            _reset_router_singletons,
+        )
+
+        _reset_router_singletons()
+        for _ in range(self.n):
+            eng = FakeEngine(model=MODEL, ttft=self.engine_ttft,
+                             max_tokens_default=2)
+            self.runners.append(await run_fake_engine(eng, "127.0.0.1", 0))
+            self.engines.append(eng)
+            self.urls.append(eng.self_url)
+        args = build_parser().parse_args([])
+        args.static_backends = ",".join(self.urls)
+        args.static_models = ",".join([MODEL] * self.n)
+        args.routing_logic = "roundrobin"
+        args.engine_stats_interval = 60
+        if self.fleet_on:
+            args.fleet_cache = True
+            args.fleet_min_match_chars = 256
+        if self.autoscale:
+            args.autoscale = True
+            args.autoscale_drain_timeout = 5.0
+        if self.ft_on:
+            args.fault_tolerance = True
+            args.ft_max_retries = 3
+            args.ft_backoff_base = 0.02
+            args.ft_backoff_max = 0.2
+            args.ft_breaker_threshold = 5
+            args.ft_ttft_deadline = 5.0
+            args.ft_inter_chunk_deadline = 5.0
+        for k, v in self.argover.items():
+            setattr(args, k, v)
+        self.app = build_app(args)
+        self.router_runner, self.router_url = await _start(self.app)
+        for eng in self.engines:
+            await eng.configure_kv(self.router_url)
+        return self
+
+    async def __aexit__(self, *exc):
+        from production_stack_tpu.testing.qos_ab import (
+            _reset_router_singletons,
+        )
+
+        await self.router_runner.cleanup()
+        for runner in self.runners:
+            await runner.cleanup()
+        _reset_router_singletons()
+
+
+def _prompt(i):
+    return (f"user-{i:03d} corpus line about topic {i}. " * 64)[:1200]
+
+
+async def _chat(session, router_url, i, timeout_s=20.0):
+    """Non-streamed chat; returns HTTP status (None on transport error)."""
+    import aiohttp
+
+    try:
+        async with session.post(
+            f"{router_url}/v1/chat/completions",
+            json={"model": MODEL, "max_tokens": 2,
+                  "messages": [{"role": "user", "content": _prompt(i)}]},
+            timeout=aiohttp.ClientTimeout(total=timeout_s),
+        ) as resp:
+            await resp.read()
+            return resp.status
+    except (aiohttp.ClientError, asyncio.TimeoutError):
+        return None
+
+
+def test_cross_replica_pull_scenario():
+    """The registered tier-1-safe fleet scenario: repeat prompts
+    round-robined across 3 replicas complete with a nonzero
+    cross-replica hit-rate and a reuse-TTFT win. (bench.py BENCH_FLEET=1
+    runs the same harness at full size plus the pulls-off baseline.)"""
+    from production_stack_tpu.testing.fleet_ab import run_fleet_ab
+
+    result = asyncio.run(run_fleet_ab(
+        users=4, rounds=2, concurrency=2, engine_ttft=0.1, skip_off=True))
+    on = result["pulls_on"]
+    assert on["failed"] == 0
+    assert on["cross_replica_pulls"] > 0
+    assert on["cross_replica_hit_rate"] > 0
+    assert on["reuse_ttft_p50_s"] < on["cold_ttft_p50_s"], on
+
+
+def test_pull_failure_falls_back_to_recompute():
+    """A pull that 500s degrades to plain recompute: the request still
+    completes, and the failure is counted — never surfaced."""
+    async def run():
+        import aiohttp
+
+        async with _FleetStack(fleet_on=True) as stack:
+            async with aiohttp.ClientSession() as s:
+                # Prime round: 2 prompts on 3 round-robin replicas, so
+                # the reuse round is guaranteed to land each prompt on a
+                # replica that does NOT hold it (requests 2,3 go to
+                # replicas 2,0 while the prefixes live on 0,1).
+                for i in range(2):
+                    assert await _chat(s, stack.router_url, i) == 200
+                # Every replica's /kv/pull now fails.
+                for url in stack.urls:
+                    async with s.post(url + "/fault",
+                                      json={"mode": "pull_error",
+                                            "times": -1}) as resp:
+                        assert resp.status == 200
+                # Reuse round: pulls are attempted, 500, recomputed.
+                for i in range(2):
+                    assert await _chat(s, stack.router_url, i) == 200
+            fleet = stack.app["state"].fleet
+            assert fleet is not None
+            assert fleet.pulls_attempted >= 1
+            assert fleet.pulls_failed >= 1
+            assert fleet.pulls_succeeded == 0
+            assert sum(e.kv_pulls_received for e in stack.engines) == 0
+            assert sum(e.faults_injected for e in stack.engines) >= 1
+
+    asyncio.run(run())
+
+
+def test_scale_in_mid_storm_zero_failed_requests():
+    """Scale-out/scale-in scenario: 3 replicas under a request storm,
+    one retired mid-storm via POST /autoscale/scale_in. The victim is
+    deregistered from the KV controller before it drains, fault
+    tolerance fails its 503s over, and not one request fails."""
+    async def run():
+        import aiohttp
+
+        async with _FleetStack(fleet_on=True, autoscale=True,
+                               ft_on=True) as stack:
+            total, fired_after = 24, 8
+            statuses = []
+            scale_in_result = {}
+            done = [0]
+            sem = asyncio.Semaphore(6)
+
+            async def one(s, i):
+                async with sem:
+                    statuses.append(await _chat(s, stack.router_url, i % 6))
+                    done[0] += 1
+                    if done[0] == fired_after:
+                        async with s.post(
+                            f"{stack.router_url}/autoscale/scale_in",
+                            json={}) as resp:
+                            assert resp.status == 200
+                            scale_in_result.update(await resp.json())
+
+            async with aiohttp.ClientSession() as s:
+                await asyncio.gather(*[one(s, i) for i in range(total)])
+
+            assert statuses.count(200) == total, statuses
+            victim_url = scale_in_result["url"]
+            assert victim_url in stack.urls
+            victim = stack.engines[stack.urls.index(victim_url)]
+            assert victim.draining
+            assert scale_in_result["drain_status"] in (200, 202)
+            # The victim's cache is gone from the controller: nothing
+            # routes a pull at (or admits claims for) the dead replica.
+            ctl = stack.app["state"].kv_controller
+            assert victim.instance_id not in ctl._instances
+
+    asyncio.run(run())
+
+
+def test_autoscale_recommendation_endpoint():
+    async def run():
+        import aiohttp
+
+        async with _FleetStack(fleet_on=False, autoscale=True) as stack:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"{stack.router_url}/autoscale/recommendation") as resp:
+                    assert resp.status == 200
+                    body = await resp.json()
+        assert body["recommended_replicas"] >= 1
+        assert body["current_replicas"] == 3
+        assert "queue_depth" in body["signals"]
+
+    asyncio.run(run())
+
+
+def test_fleet_flags_off_request_path_untouched():
+    """Flag-off parity (PR convention): without --fleet-cache /
+    --autoscale, the fleet objects are never built, no replica ever
+    receives a /kv/pull, the autoscale endpoints 404, and repeat
+    requests behave exactly as before this subsystem existed."""
+    async def run():
+        import aiohttp
+
+        async with _FleetStack(fleet_on=False, autoscale=False) as stack:
+            state = stack.app["state"]
+            assert state.fleet is None
+            assert state.autoscaler is None
+            async with aiohttp.ClientSession() as s:
+                for _ in range(2):  # repeat prompt: the fleet trigger
+                    assert await _chat(s, stack.router_url, 0) == 200
+                async with s.get(
+                    f"{stack.router_url}/autoscale/recommendation") as r:
+                    assert r.status == 404
+                async with s.post(
+                    f"{stack.router_url}/autoscale/scale_in", json={}) as r:
+                    assert r.status == 404
+            assert all(e.pull_requests == [] for e in stack.engines)
+            assert sum(e.kv_pulls_received for e in stack.engines) == 0
+
+    asyncio.run(run())

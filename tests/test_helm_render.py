@@ -574,3 +574,112 @@ def test_drain_prestop_and_router_fault_tolerance_flags():
             if d["metadata"]["name"].endswith("-router")
             ][0]["spec"]["template"]["spec"]["containers"][0]["command"]
     assert "--fault-tolerance" not in bcmd
+
+
+def test_fleet_cache_and_autoscaling_render():
+    """routerSpec.fleetCache/autoscale render --fleet-*/--autoscale-*
+    router flags (l3Url defaulting to the chart's cache-server Service
+    when one is enabled), and servingEngineSpec.autoscaling renders a
+    per-modelSpec engine HPA (mode hpa) or KEDA ScaledObject (mode
+    keda); everything defaults off with nothing rendered
+    (docs/fleet.md)."""
+    import copy
+    import json
+
+    import jsonschema
+
+    values = copy.deepcopy(load_values(CHART, os.path.join(
+        CHART, "examples", "values-01-minimal.yaml")))
+    values["cacheserverSpec"]["enableServer"] = True
+    values["routerSpec"]["fleetCache"] = {
+        "enabled": True, "pullTimeoutSeconds": 10,
+        "minMatchChars": 512, "l3Url": "",
+    }
+    values["routerSpec"]["autoscale"] = {
+        "enabled": True, "minReplicas": 1, "maxReplicas": 6,
+        "queueDepthTarget": 4, "hbmUsageHigh": 0.9,
+        "drainTimeoutSeconds": 60,
+    }
+    values["servingEngineSpec"]["autoscaling"] = {
+        "enabled": True, "mode": "hpa", "minReplicas": 1,
+        "maxReplicas": 6, "queueDepthTarget": 4, "cooldownSeconds": 300,
+    }
+    with open(os.path.join(CHART, "values.schema.json")) as f:
+        schema = json.load(f)
+    jsonschema.validate(values, schema)
+
+    rendered = MiniHelm(CHART).render(values)
+    router = [d for d in _docs(rendered, "Deployment")
+              if d["metadata"]["name"].endswith("-router")][0]
+    cmd = router["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--fleet-cache" in cmd
+    assert cmd[cmd.index("--fleet-pull-timeout") + 1] == "10"
+    assert cmd[cmd.index("--fleet-min-match-chars") + 1] == "512"
+    # l3Url unset + cache server enabled -> defaults to its Service.
+    l3 = cmd[cmd.index("--fleet-l3-url") + 1]
+    assert "-cache-server-service:8200" in l3, l3
+    assert "--autoscale" in cmd
+    assert cmd[cmd.index("--autoscale-max-replicas") + 1] == "6"
+    assert cmd[cmd.index("--autoscale-queue-depth-target") + 1] == "4"
+    assert cmd[cmd.index("--autoscale-hbm-usage-high") + 1] == "0.9"
+    assert cmd[cmd.index("--autoscale-drain-timeout") + 1] == "60"
+
+    hpas = [d for d in _docs(rendered, "HorizontalPodAutoscaler")
+            if d["metadata"]["name"].endswith("-engine-hpa")]
+    assert len(hpas) == 1
+    hpa = hpas[0]
+    assert hpa["spec"]["scaleTargetRef"]["name"].endswith("-opt125m-engine")
+    assert hpa["spec"]["minReplicas"] == 1
+    assert hpa["spec"]["maxReplicas"] == 6
+    metric = hpa["spec"]["metrics"][0]["object"]
+    assert metric["metric"]["name"] == "vllm_router_num_requests_waiting"
+    assert metric["target"]["value"] == 4
+    assert not list(_docs(rendered, "ScaledObject"))
+
+    # An explicit l3Url wins over the chart's cache server default.
+    pinned = copy.deepcopy(values)
+    pinned["routerSpec"]["fleetCache"]["l3Url"] = "http://l3.example:9"
+    pcmd = [d for d in _docs(MiniHelm(CHART).render(pinned), "Deployment")
+            if d["metadata"]["name"].endswith("-router")
+            ][0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert pcmd[pcmd.index("--fleet-l3-url") + 1] == "http://l3.example:9"
+
+    # keda mode renders a ScaledObject instead of the HPA.
+    keda = copy.deepcopy(values)
+    keda["servingEngineSpec"]["autoscaling"]["mode"] = "keda"
+    keda["servingEngineSpec"]["autoscaling"]["prometheusAddress"] = (
+        "http://prom.monitoring.svc:9090")
+    jsonschema.validate(keda, schema)
+    krendered = MiniHelm(CHART).render(keda)
+    sos = list(_docs(krendered, "ScaledObject"))
+    assert len(sos) == 1
+    so = sos[0]
+    assert so["spec"]["scaleTargetRef"]["name"].endswith("-opt125m-engine")
+    assert so["spec"]["cooldownPeriod"] == 300
+    trig = so["spec"]["triggers"][0]
+    assert trig["type"] == "prometheus"
+    assert trig["metadata"]["serverAddress"] == (
+        "http://prom.monitoring.svc:9090")
+    assert trig["metadata"]["query"] == (
+        "sum(vllm_router:num_requests_waiting)")
+    assert trig["metadata"]["threshold"] == "4"
+    assert not [d for d in _docs(krendered, "HorizontalPodAutoscaler")
+                if d["metadata"]["name"].endswith("-engine-hpa")]
+
+    # Bad mode fails schema validation.
+    bad = copy.deepcopy(values)
+    bad["servingEngineSpec"]["autoscaling"]["mode"] = "vpa"
+    with pytest.raises(jsonschema.ValidationError):
+        jsonschema.validate(bad, schema)
+
+    # Default chart: no fleet flags, no engine scalers (flag-off parity).
+    base = _render(os.path.join(CHART, "examples",
+                                "values-01-minimal.yaml"))
+    bcmd = [d for d in _docs(base, "Deployment")
+            if d["metadata"]["name"].endswith("-router")
+            ][0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--fleet-cache" not in bcmd
+    assert "--autoscale" not in bcmd
+    assert not [d for d in _docs(base, "HorizontalPodAutoscaler")
+                if d["metadata"]["name"].endswith("-engine-hpa")]
+    assert not list(_docs(base, "ScaledObject"))

@@ -615,6 +615,48 @@ async def test_no_tenants_file_leaves_request_path_untouched(tmp_path):
         await _cleanup(runners)
 
 
+async def test_spoofed_qos_headers_stripped_when_qos_off(tmp_path):
+    """Security regression: client-supplied X-Tenant / X-Priority are
+    router-asserted headers — with QoS off they must be stripped at the
+    proxy boundary, not forwarded to the engine."""
+    engine, app, url, runners = await _qos_router(tmp_path, tenants=None)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{url}/v1/chat/completions", json=_chat(),
+                headers={"X-Tenant": "victim-tenant",
+                         "X-Priority": "batch"}) as resp:
+                assert resp.status == 200
+        # Neither spoofed header reached the engine: no tenant recorded,
+        # and priority defaulted from the ABSENCE of the header.
+        assert engine.tenant_requests == {}
+        assert engine.priority_requests == {"interactive": 1, "batch": 0}
+    finally:
+        await _cleanup(runners)
+
+
+async def test_spoofed_tenant_header_overwritten_when_qos_on(tmp_path):
+    """With QoS on, the forwarded X-Tenant is the AUTHENTICATED tenant —
+    a client claiming someone else's identity in the header can't bill
+    or prioritize as them."""
+    tenants = {"tenants": [
+        {"name": "acme", "api_keys": ["sk-acme"], "weight": 1,
+         "priority": "interactive"}]}
+    engine, app, url, runners = await _qos_router(tmp_path, tenants)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{url}/v1/chat/completions", json=_chat(),
+                headers={"Authorization": "Bearer sk-acme",
+                         "X-Tenant": "victim-tenant"}) as resp:
+                assert resp.status == 200
+                assert resp.headers["x-tenant"] == "acme"
+        assert engine.tenant_requests == {"acme": 1}
+        assert "victim-tenant" not in engine.tenant_requests
+    finally:
+        await _cleanup(runners)
+
+
 async def test_health_reports_qos_state(tmp_path):
     tenants = {"tenants": [{"name": "acme", "api_keys": ["sk-acme"]}],
                "max_concurrency": 7}
