@@ -187,7 +187,7 @@ class EngineServer:
                     timeout=aiohttp.ClientTimeout(total=5),
                 ) as resp:
                     self._kv_registered = resp.status == 200
-        except aiohttp.ClientError as e:
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
             logger.debug("KV controller register failed: %s", e)
             self._kv_registered = False
         return self._kv_registered
@@ -275,12 +275,15 @@ class EngineServer:
         if not paths or self._loop is None or self.kv_controller_url is None:
             return
 
-        # With a remote tier configured, the allocator's eviction hook
-        # spills the blocks to the shared L3 before this report fires:
-        # tell the controller so the claims transfer to the L3
-        # pseudo-instance instead of vanishing (fleet pull: peer → L3).
-        spilled = (self.core.offload is not None
-                   and self.core.offload.remote is not None)
+        # This listener only fires when NO offload tier is configured
+        # (core._dispatch_evict short-circuits into the spill path and
+        # deliberately keeps the controller claims otherwise — the
+        # prefix is still servable here via contains()/restore), so the
+        # evicted chunks are simply gone from this replica: never report
+        # them as spilled. The /kv/evict protocol's ``spilled=true`` is
+        # reserved for callers that have CONFIRMED the blocks reached
+        # the L3 — an optimistic report would send fleet pulls on
+        # round-trips that can only end in a miss.
 
         async def _send():
             import aiohttp
@@ -290,10 +293,10 @@ class EngineServer:
                     await s.post(
                         f"{self.kv_controller_url}/kv/evict",
                         json={"instance_id": self.instance_id,
-                              "paths": paths, "spilled": spilled},
+                              "paths": paths},
                         timeout=aiohttp.ClientTimeout(total=5),
                     )
-            except aiohttp.ClientError as e:
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
                 logger.debug("KV evict report failed: %s", e)
 
         try:
@@ -340,7 +343,7 @@ class EngineServer:
                               "text": prompt_text},
                         timeout=aiohttp.ClientTimeout(total=5),
                     )
-            except aiohttp.ClientError as e:
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
                 logger.debug("KV admit report failed: %s", e)
 
         asyncio.get_running_loop().create_task(_send())
@@ -1415,7 +1418,10 @@ class EngineServer:
                         timeout=aiohttp.ClientTimeout(total=5),
                     )
                 self._kv_registered = False
-            except aiohttp.ClientError as e:
+            # aiohttp's total timeout raises asyncio.TimeoutError, which
+            # is NOT a ClientError: a hung controller must degrade to the
+            # admit TTL, never abort the drain before the quiescence wait.
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
                 logger.debug("KV deregister report failed: %s", e)
         deadline = time.monotonic() + max(0.0, timeout_s)
         while self._inflight > 0 and time.monotonic() < deadline:

@@ -265,15 +265,24 @@ class AutoscaleRecommender:
 
     def pick_scale_in_victim(self, endpoints, engine_stats: Dict,
                              request_stats: Dict) -> Optional[str]:
-        """Least-loaded replica: fewest queued+running requests."""
+        """Least-loaded replica: fewest queued+running requests.
+
+        A replica with no scraped engine stats is UNKNOWN, not idle — a
+        just-started replica must not beat an established idle one. The
+        router's own request accounting stands in when the scrape is
+        missing; a replica unknown to both sides sorts last and is only
+        picked when every replica is unknown."""
         if not endpoints:
             return None
 
         def load(url: str) -> float:
             stats = (engine_stats or {}).get(url)
-            if stats is None:
-                return 0.0
-            return stats.num_queuing_requests + stats.num_running_requests
+            if stats is not None:
+                return stats.num_queuing_requests + stats.num_running_requests
+            rstats = (request_stats or {}).get(url)
+            if rstats is not None:
+                return rstats.in_prefill_requests + rstats.in_decoding_requests
+            return float("inf")
 
         return min((ep.url for ep in endpoints), key=load)
 

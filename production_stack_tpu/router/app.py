@@ -296,9 +296,10 @@ async def kv_evict(request: web.Request) -> web.Response:
     body = await request.json()
     # "hashes": one root-anchored chunk path; "paths": several (an engine
     # evicting a block shared by multiple admitted prompts). "spilled":
-    # the engine pushed the evicted blocks to its remote tier, so with an
-    # attached L3 the claims transfer to the L3 pseudo-instance instead
-    # of vanishing (fleet pull path: peer → L3 → recompute).
+    # the caller CONFIRMED the evicted blocks reached the shared L3, so
+    # the claims transfer to the L3 pseudo-instance instead of vanishing
+    # (fleet pull path: peer → L3 → recompute). Engines whose offload
+    # tier still serves the blocks keep their claims and don't report.
     paths = body.get("paths")
     if paths is None:
         paths = [body.get("hashes", [])]
@@ -397,7 +398,14 @@ def build_app(args) -> web.Application:
 
     @web.middleware
     async def auth_middleware(request: web.Request, handler):
-        if api_keys and auth.is_gated(request.path) and \
+        # Privileged control-plane paths (/autoscale/*, /kv/deregister)
+        # are gated alongside the inference surface: they can drain or
+        # deregister replicas, and engines attach the shared deployment
+        # key to the /kv/deregister they send at drain time (an
+        # edge-only-key topology loses that report and falls back to
+        # the admit TTL + the breaker-open mirror).
+        if api_keys and (auth.is_gated(request.path)
+                         or auth.is_privileged(request.path)) and \
                 not auth.check_bearer(
                     request.headers.get("Authorization"), api_keys):
             return auth.unauthorized_response()

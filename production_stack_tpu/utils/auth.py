@@ -4,10 +4,14 @@
 Semantics follow vLLM: the key gates the INFERENCE surface (`/v1/*`
 plus the non-versioned aliases of the same endpoints), not the
 intra-stack control plane — probes (`/health`), scrapes (`/metrics`),
-the KV controller channel (`/kv/*`), and sleep administration carry no
-client credentials and stay open. Router-originated calls to engines
-(model probes, batch replays) attach the deployment key registered at
-app build time.
+the KV controller reporting channel (`/kv/register|admit|evict|lookup`),
+and sleep administration carry no client credentials and stay open.
+Control-plane endpoints that can take replicas out of service
+(`/autoscale/*`, `/kv/deregister`) are the exception: they are
+PRIVILEGED (see :func:`is_privileged`) and require the deployment key
+whenever one is configured. Router-originated calls to engines (model
+probes, batch replays) attach the deployment key registered at app
+build time.
 
 Comparisons are constant-time (`hmac.compare_digest`)."""
 
@@ -24,6 +28,22 @@ _GATED_EXACT = frozenset({"/score", "/rerank", "/tokenize", "/detokenize"})
 def is_gated(path: str) -> bool:
     """True when the path belongs to the API-key-protected surface."""
     return path.startswith("/v1/") or path in _GATED_EXACT
+
+
+# Destructive/privileged control-plane endpoints registered on the
+# client-facing router port: scale-in auto-picks a victim and drives its
+# /drain with the router's own deployment key, and /kv/deregister sweeps
+# a replica's routing claims. Unauthenticated access to either is a
+# one-request denial of service, so — unlike the rest of the /kv
+# reporting channel — they require the deployment key when one is set.
+_PRIVILEGED_EXACT = frozenset({"/kv/deregister"})
+_PRIVILEGED_PREFIX = "/autoscale/"
+
+
+def is_privileged(path: str) -> bool:
+    """True for control-plane paths that can take replicas out of
+    service; gated like the inference surface (never open)."""
+    return path in _PRIVILEGED_EXACT or path.startswith(_PRIVILEGED_PREFIX)
 
 
 def _split_keys(value: str) -> Tuple[str, ...]:

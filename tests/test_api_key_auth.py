@@ -110,9 +110,37 @@ def test_router_edge_auth_and_shared_key_passthrough():
                     assert resp.status == 401
                 async with s.get(f"{base}/health") as resp:
                     assert resp.status == 200
+                # Privileged control-plane endpoints are gated: an
+                # unauthenticated scale_in auto-picks a victim and
+                # drains it (one-request outage), and /kv/deregister
+                # sweeps a replica's routing claims.
+                async with s.post(f"{base}/autoscale/scale_in",
+                                  json={}) as resp:
+                    assert resp.status == 401
+                async with s.get(
+                        f"{base}/autoscale/recommendation") as resp:
+                    assert resp.status == 401
+                async with s.post(f"{base}/kv/deregister",
+                                  json={"instance_id": "x"}) as resp:
+                    assert resp.status == 401
+                # With the deployment key they pass the gate: the
+                # autoscaler is not enabled here (404, not 401), the
+                # deregister succeeds, and the non-destructive /kv
+                # reporting channel stays open to keyless engines.
+                auth_hdr = {"Authorization": f"Bearer {KEY}"}
+                async with s.post(f"{base}/autoscale/scale_in",
+                                  json={}, headers=auth_hdr) as resp:
+                    assert resp.status == 404
+                async with s.post(f"{base}/kv/deregister",
+                                  json={"instance_id": "x"},
+                                  headers=auth_hdr) as resp:
+                    assert resp.status == 200
+                async with s.post(f"{base}/kv/lookup",
+                                  json={"text": "ab"}) as resp:
+                    assert resp.status == 200
                 async with s.post(
                         f"{base}/v1/chat/completions", json=body,
-                        headers={"Authorization": f"Bearer {KEY}"}) as resp:
+                        headers=auth_hdr) as resp:
                     assert resp.status == 200, await resp.text()
                     out = await resp.json()
                     assert out["choices"][0]["message"]["role"] == "assistant"

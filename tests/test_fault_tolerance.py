@@ -621,6 +621,40 @@ def test_breaker_open_deregisters_kv_instances():
     asyncio.run(run())
 
 
+def test_drain_survives_hung_kv_controller(monkeypatch):
+    """aiohttp's total-timeout raises asyncio.TimeoutError, which is NOT
+    a ClientError subclass: a hung/slow KV controller must degrade to
+    the admit TTL, never 500 the drain before the quiescence wait —
+    scale-in and preStop callers rely on /drain returning only once the
+    replica is quiescent."""
+    from types import SimpleNamespace
+
+    import aiohttp
+
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.server import EngineServer
+
+    server = EngineServer(
+        EngineConfig(model="tiny-llama", max_model_len=128,
+                     max_num_seqs=2, block_size=8, num_blocks=64,
+                     max_loras=0))
+    server.kv_controller_url = "http://127.0.0.1:9"
+
+    def hung_post(*args, **kwargs):
+        raise asyncio.TimeoutError()
+
+    monkeypatch.setattr(aiohttp.ClientSession, "post", hung_post)
+
+    async def run():
+        resp = await server.handle_drain(
+            SimpleNamespace(query={"timeout_s": "1"}))
+        assert resp.status == 200
+        assert server.draining
+
+    asyncio.run(run())
+    server.core.stop()
+
+
 def test_drain_deregisters_from_kv_controller():
     """A drained replica's cache is about to disappear: /drain reports
     /kv/deregister to the router, after which controller lookups stop

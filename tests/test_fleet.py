@@ -150,6 +150,35 @@ def test_pick_scale_in_victim_is_least_loaded():
     assert rec.pick_scale_in_victim([], {}, {}) is None
 
 
+def test_pick_scale_in_victim_unknown_stats_not_treated_as_idle():
+    """A replica with no scraped engine stats is UNKNOWN, not load-0: a
+    just-started replica must not be retired ahead of an established
+    idle one. Router-side request stats stand in for a missing scrape,
+    and an all-unknown fleet still yields a victim."""
+    from production_stack_tpu.router.request_stats import RequestStats
+
+    rec = AutoscaleRecommender(AutoscaleConfig())
+    stats = {
+        "http://a": EngineStats(num_queuing_requests=2,
+                                num_running_requests=1),
+    }
+    # http://b was never scraped: loaded-but-known http://a still wins.
+    assert rec.pick_scale_in_victim(
+        _eps("http://a", "http://b"), stats, {}) == "http://a"
+    # The router's own request accounting fills the gap when present.
+    rstats = {"http://b": RequestStats(in_prefill_requests=0,
+                                       in_decoding_requests=0)}
+    assert rec.pick_scale_in_victim(
+        _eps("http://a", "http://b"), stats, rstats) == "http://b"
+    rstats = {"http://b": RequestStats(in_prefill_requests=4,
+                                       in_decoding_requests=4)}
+    assert rec.pick_scale_in_victim(
+        _eps("http://a", "http://b"), stats, rstats) == "http://a"
+    # Every replica unknown: scale-in still proceeds with some victim.
+    assert rec.pick_scale_in_victim(
+        _eps("http://a", "http://b"), {}, {}) in ("http://a", "http://b")
+
+
 # --------------------------------------------------------------------- #
 # Hermetic router + fake-replica scenarios
 # --------------------------------------------------------------------- #
