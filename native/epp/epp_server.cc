@@ -9,586 +9,59 @@
 // src/gateway_inference_extension/prefix_aware_picker.go:52-130). The
 // Python file remains as the launcher/fallback.
 //
-// Per stream (one ext-proc session per gateway request):
-//   request_headers  -> CONTINUE
-//   request_body     -> JSON -> prompt text (chat template identical to
-//                       engine/tokenizer.py ByteTokenizer) -> pick ->
-//                       header mutation x-gateway-destination-endpoint
-//
-// Endpoints come from --endpoints or a watched --endpoints-file (a
-// ConfigMap mount), exactly like the Python EPP.
+// The protocol machinery (JSON, ext-proc protobuf, per-connection h2
+// loop, hardening caps and protocol-error counters) lives in
+// epp_core.h, shared with the adversarial fuzz harness (h2fuzz.cc) so
+// the fuzzer drives the exact production code path.
 //
 // Thread model: one thread per connection; picks go through the picker
 // library's C ABI under a process-wide mutex (pick cost is ~us; the
 // mutex is invisible next to socket IO).
 
-#include <atomic>
-#include <chrono>
 #include <cstdio>
 #include <cstring>
-#include <fstream>
-#include <mutex>
 #include <sstream>
 #include <string>
 #include <thread>
 #include <vector>
 
-#include "h2grpc.h"
-
-// ---- picker C ABI (libtpu_stack_pickers) ------------------------------
-extern "C" {
-void* tpu_picker_create();
-void tpu_picker_set_endpoints(void* p, const char* endpoints);
-int tpu_picker_pick_roundrobin_buf(void* p, char* out, size_t cap);
-int tpu_picker_pick_prefix_buf(void* p, const char* text, size_t len,
-                               char* out, size_t cap);
-int tpu_picker_pick_kv_buf(void* p, const char* text, size_t len,
-                           size_t* matched, char* out, size_t cap);
-}
+#include "epp_core.h"
 
 namespace {
 
-constexpr const char* kDestHeader = "x-gateway-destination-endpoint";
-
-// ---- minimal JSON parser (OpenAI request bodies) ----------------------
-struct Json {
-  enum Type { Null, Bool, Num, Str, Arr, Obj } type = Null;
-  bool b = false;
-  double num = 0;
-  std::string str;
-  std::vector<Json> arr;
-  std::vector<std::pair<std::string, Json>> obj;
-
-  const Json* get(const std::string& key) const {
-    for (const auto& kv : obj)
-      if (kv.first == key) return &kv.second;
-    return nullptr;
-  }
-};
-
-struct JsonParser {
-  const char* p;
-  const char* end;
-  bool ok = true;
-  int depth = 0;
-  // Nesting bound: a body of 100k open brackets would otherwise recurse
-  // the parser off the thread stack (one SIGSEGV = the whole data
-  // plane). OpenAI bodies nest ~4 deep.
-  static constexpr int kMaxDepth = 64;
-
-  JsonParser(const char* data, size_t n) : p(data), end(data + n) {}
-
-  void ws() {
-    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
-      ++p;
-  }
-
-  bool lit(const char* s) {
-    size_t n = strlen(s);
-    if (p + n > end || memcmp(p, s, n) != 0) return false;
-    p += n;
-    return true;
-  }
-
-  Json parse() {
-    ws();
-    Json j;
-    if (p >= end || depth > kMaxDepth) { ok = false; return j; }
-    char c = *p;
-    if (c == '{') return parse_obj();
-    if (c == '[') return parse_arr();
-    if (c == '"') { j.type = Json::Str; j.str = parse_str(); return j; }
-    if (c == 't') { ok &= lit("true"); j.type = Json::Bool; j.b = true; return j; }
-    if (c == 'f') { ok &= lit("false"); j.type = Json::Bool; return j; }
-    if (c == 'n') { ok &= lit("null"); return j; }
-    // number
-    j.type = Json::Num;
-    char* numend = nullptr;
-    j.num = strtod(p, &numend);
-    if (numend == p) ok = false;
-    p = numend;
-    return j;
-  }
-
-  std::string parse_str() {
-    std::string out;
-    if (p >= end || *p != '"') { ok = false; return out; }
-    ++p;
-    while (p < end && *p != '"') {
-      char c = *p++;
-      if (c == '\\' && p < end) {
-        char e = *p++;
-        switch (e) {
-          case 'n': out.push_back('\n'); break;
-          case 't': out.push_back('\t'); break;
-          case 'r': out.push_back('\r'); break;
-          case 'b': out.push_back('\b'); break;
-          case 'f': out.push_back('\f'); break;
-          case '/': out.push_back('/'); break;
-          case '\\': out.push_back('\\'); break;
-          case '"': out.push_back('"'); break;
-          case 'u': {
-            if (p + 4 > end) { ok = false; return out; }
-            unsigned cp = 0;
-            for (int i = 0; i < 4; i++) {
-              char h = *p++;
-              cp <<= 4;
-              if (h >= '0' && h <= '9') cp |= h - '0';
-              else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
-              else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
-              else { ok = false; return out; }
-            }
-            // UTF-8 encode (surrogate pairs folded to two 3-byte seqs;
-            // prompt hashing only needs deterministic bytes).
-            if (cp < 0x80) {
-              out.push_back(static_cast<char>(cp));
-            } else if (cp < 0x800) {
-              out.push_back(static_cast<char>(0xc0 | (cp >> 6)));
-              out.push_back(static_cast<char>(0x80 | (cp & 0x3f)));
-            } else {
-              out.push_back(static_cast<char>(0xe0 | (cp >> 12)));
-              out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3f)));
-              out.push_back(static_cast<char>(0x80 | (cp & 0x3f)));
-            }
-            break;
-          }
-          default: out.push_back(e);
-        }
-      } else {
-        out.push_back(c);
-      }
-    }
-    if (p < end) ++p;  // closing quote
-    else ok = false;
-    return out;
-  }
-
-  Json parse_obj() {
-    Json j;
-    j.type = Json::Obj;
-    ++depth;
-    struct Dec { int* d; ~Dec() { --*d; } } dec{&depth};
-    ++p;  // {
-    ws();
-    if (p < end && *p == '}') { ++p; return j; }
-    while (p < end) {
-      ws();
-      std::string key = parse_str();
-      ws();
-      if (p >= end || *p != ':') { ok = false; return j; }
-      ++p;
-      j.obj.emplace_back(std::move(key), parse());
-      ws();
-      if (p < end && *p == ',') { ++p; continue; }
-      break;
-    }
-    if (p < end && *p == '}') ++p;
-    else ok = false;
-    return j;
-  }
-
-  Json parse_arr() {
-    Json j;
-    j.type = Json::Arr;
-    ++depth;
-    struct Dec { int* d; ~Dec() { --*d; } } dec{&depth};
-    ++p;  // [
-    ws();
-    if (p < end && *p == ']') { ++p; return j; }
-    while (p < end) {
-      j.arr.push_back(parse());
-      ws();
-      if (p < end && *p == ',') { ++p; continue; }
-      break;
-    }
-    if (p < end && *p == ']') ++p;
-    else ok = false;
-    return j;
-  }
-};
-
-// OpenAI request body -> prompt text whose prefix keys the pick.
-// IDENTICAL rendering to engine/tokenizer.py ByteTokenizer
-// .apply_chat_template — trie chains must agree across tiers.
-std::string render_prompt(const std::string& body) {
-  JsonParser jp(body.data(), body.size());
-  Json j = jp.parse();
-  if (j.type != Json::Obj) return "";
-  const Json* messages = j.get("messages");
-  if (messages != nullptr && messages->type == Json::Arr) {
-    std::string out;
-    for (const Json& m : messages->arr) {
-      if (m.type != Json::Obj) continue;
-      const Json* role = m.get("role");
-      const Json* content = m.get("content");
-      std::string text;
-      if (content != nullptr) {
-        if (content->type == Json::Str) {
-          text = content->str;
-        } else if (content->type == Json::Arr) {
-          bool first = true;
-          for (const Json& seg : content->arr) {
-            if (seg.type != Json::Obj) continue;
-            const Json* t = seg.get("text");
-            if (!first) text += " ";
-            text += (t != nullptr && t->type == Json::Str) ? t->str : "";
-            first = false;
-          }
-        }
-      }
-      out += "<|";
-      out += (role != nullptr && role->type == Json::Str) ? role->str
-                                                          : "user";
-      out += "|>\n";
-      out += text;
-      out += "\n";
-    }
-    out += "<|assistant|>\n";
-    return out;
-  }
-  const Json* prompt = j.get("prompt");
-  if (prompt != nullptr) {
-    if (prompt->type == Json::Str) return prompt->str;
-    if (prompt->type == Json::Arr && !prompt->arr.empty() &&
-        prompt->arr[0].type == Json::Str)
-      return prompt->arr[0].str;
-  }
-  return "";
-}
-
-// ---- ext-proc protobuf ------------------------------------------------
-// ProcessingRequest: request_headers=2 (HttpHeaders: end_of_stream=3),
-// request_body=4 (HttpBody: body=1, end_of_stream=2).
-struct Parsed {
-  enum Kind { Other, ReqHeaders, ReqBody } kind = Other;
-  bool end_of_stream = false;
-  std::string body;
-};
-
-Parsed parse_processing_request(const std::string& msg) {
-  Parsed out;
-  h2::PbReader r(msg);
-  uint32_t wire;
-  for (uint32_t field = r.tag(&wire); field; field = r.tag(&wire)) {
-    if (field == 2 && wire == 2) {
-      out.kind = Parsed::ReqHeaders;
-      std::string sub;
-      if (!r.bytes(&sub)) break;
-      h2::PbReader hr(sub);
-      uint32_t hw;
-      for (uint32_t hf = hr.tag(&hw); hf; hf = hr.tag(&hw)) {
-        if (hf == 3 && hw == 0) {
-          uint64_t v;
-          hr.varint(&v);
-          out.end_of_stream = v != 0;
-        } else if (!hr.skip(hw)) {
-          break;
-        }
-      }
-    } else if (field == 4 && wire == 2) {
-      out.kind = Parsed::ReqBody;
-      std::string sub;
-      if (!r.bytes(&sub)) break;
-      h2::PbReader br(sub);
-      uint32_t bw;
-      for (uint32_t bf = br.tag(&bw); bf; bf = br.tag(&bw)) {
-        if (bf == 1 && bw == 2) {
-          br.bytes(&out.body);
-        } else if (bf == 2 && bw == 0) {
-          uint64_t v;
-          br.varint(&v);
-          out.end_of_stream = v != 0;
-        } else if (!br.skip(bw)) {
-          break;
-        }
-      }
-    } else if (!r.skip(wire)) {
-      break;
-    }
-  }
-  return out;
-}
-
-// ProcessingResponse{<field>: {response: CommonResponse{
-//   header_mutation{set_headers{header{key, raw_value}}},
-//   clear_route_cache}}}
-std::string build_response(bool for_body, const std::string& endpoint) {
-  std::string common;
-  if (!endpoint.empty()) {
-    std::string hv;
-    h2::pb_bytes(&hv, 1, kDestHeader);     // HeaderValue.key
-    h2::pb_bytes(&hv, 3, endpoint);        // HeaderValue.raw_value
-    std::string opt;
-    h2::pb_bytes(&opt, 1, hv);             // HeaderValueOption.header
-    std::string mut;
-    h2::pb_bytes(&mut, 1, opt);            // HeaderMutation.set_headers
-    h2::pb_bytes(&common, 2, mut);         // CommonResponse.header_mutation
-    h2::pb_bool(&common, 5, true);         // clear_route_cache
-  }
-  std::string inner;
-  h2::pb_bytes(&inner, 1, common);         // {Headers,Body}Response.response
-  std::string resp;
-  h2::pb_bytes(&resp, for_body ? 3 : 1, inner);
-  return resp;
-}
-
-// ---- endpoint state ---------------------------------------------------
-struct EndpointState {
-  std::mutex mu;
-  std::string joined;  // '\n'-separated
-  std::string file;
-
-  void set(const std::vector<std::string>& eps) {
-    std::string j;
-    for (const auto& e : eps) {
-      if (!j.empty()) j += "\n";
-      j += e;
-    }
-    std::lock_guard<std::mutex> lock(mu);
-    joined = j;
-  }
-
-  std::string get() {
-    std::lock_guard<std::mutex> lock(mu);
-    return joined;
-  }
-
-  void watch_loop() {
-    std::string last;
-    while (true) {
-      std::ifstream f(file);
-      if (f) {
-        std::vector<std::string> eps;
-        std::string line;
-        while (std::getline(f, line)) {
-          auto hash = line.find('#');
-          if (hash != std::string::npos) line.erase(hash);
-          while (!line.empty() && (line.back() == ' ' || line.back() == '\r'))
-            line.pop_back();
-          size_t start = line.find_first_not_of(' ');
-          if (start != std::string::npos && start > 0) line.erase(0, start);
-          if (!line.empty()) eps.push_back(line);
-        }
-        set(eps);
-      }
-      std::this_thread::sleep_for(std::chrono::seconds(5));
-    }
-  }
-};
-
-void* g_picker = nullptr;
-std::mutex g_pick_mu;
-EndpointState g_state;
-std::string g_algorithm = "prefix";
-std::atomic<uint64_t> g_picks{0};
-
-std::string do_pick(const std::string& prompt) {
-  // Re-push the endpoint set only when it changed (the watcher updates
-  // it every few seconds at most; set_endpoints takes the picker's
-  // unique lock and rebuilds its sorted list). Picks themselves go
-  // through the thread-safe *_buf ABI — the Picker's internal
-  // shared_mutex is the only serialization (reads shared, the
-  // insert-after-pick write brief).
-  {
-    std::lock_guard<std::mutex> lock(g_pick_mu);
-    static std::string last_endpoints;
-    std::string eps = g_state.get();
-    if (eps != last_endpoints) {
-      tpu_picker_set_endpoints(g_picker, eps.c_str());
-      last_endpoints = eps;
-    }
-  }
-  char out[512];
-  int n;
-  if (g_algorithm == "roundrobin" || prompt.empty()) {
-    n = tpu_picker_pick_roundrobin_buf(g_picker, out, sizeof(out));
-  } else if (g_algorithm == "kv") {
-    size_t matched = 0;
-    n = tpu_picker_pick_kv_buf(g_picker, prompt.data(), prompt.size(),
-                               &matched, out, sizeof(out));
-    if (n <= 0)
-      n = tpu_picker_pick_roundrobin_buf(g_picker, out, sizeof(out));
-  } else {
-    n = tpu_picker_pick_prefix_buf(g_picker, prompt.data(),
-                                   prompt.size(), out, sizeof(out));
-  }
-  g_picks.fetch_add(1, std::memory_order_relaxed);
-  return n > 0 ? std::string(out, n) : std::string();
-}
-
-// ---- per-connection h2 server loop ------------------------------------
-struct StreamState {
-  bool sent_headers = false;
-  bool closed = false;
-  h2::GrpcBuf grpc;
-  std::string body_buf;
-};
-
-void send_response_headers(int fd, uint32_t sid) {
-  std::string block;
-  h2::hpack_status200(&block);
-  h2::hpack_literal(&block, "content-type", "application/grpc");
-  h2::write_frame(fd, h2::HEADERS, h2::END_HEADERS, sid, block);
-}
-
-void send_trailers(int fd, uint32_t sid) {
-  std::string block;
-  h2::hpack_literal(&block, "grpc-status", "0");
-  h2::write_frame(fd, h2::HEADERS,
-                  h2::END_HEADERS | h2::END_STREAM, sid, block);
-}
-
-// End a stream the gRPC way: response headers (if not yet sent) then
-// grpc-status trailers, and drop its state.
-void close_stream(int fd, uint32_t sid,
-                  std::map<uint32_t, StreamState>& streams) {
-  StreamState& st = streams[sid];
-  if (!st.sent_headers) {
-    send_response_headers(fd, sid);
-    st.sent_headers = true;
-  }
-  send_trailers(fd, sid);
-  streams.erase(sid);
-}
-
-void serve_connection(int fd) {
-  char preface[h2::kPrefaceLen];
-  if (!h2::read_exact(fd, preface, h2::kPrefaceLen) ||
-      memcmp(preface, h2::kPreface, h2::kPrefaceLen) != 0) {
+// Plaintext Prometheus exposition on --metrics-port: protocol-error
+// counters plus total picks.  One short-lived connection per scrape;
+// the request bytes are irrelevant (everything is GET /metrics).
+void metrics_loop(int srv) {
+  while (true) {
+    int fd = ::accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    char discard[1024];
+    (void)::read(fd, discard, sizeof(discard));
+    std::string body = epp::render_protocol_error_metrics();
+    char line[96];
+    snprintf(line, sizeof(line),
+             "# TYPE epp_picks_total counter\nepp_picks_total %llu\n",
+             static_cast<unsigned long long>(
+                 epp::g_picks.load(std::memory_order_relaxed)));
+    body += line;
+    std::ostringstream resp;
+    resp << "HTTP/1.1 200 OK\r\n"
+         << "Content-Type: text/plain; version=0.0.4\r\n"
+         << "Content-Length: " << body.size() << "\r\n"
+         << "Connection: close\r\n\r\n"
+         << body;
+    std::string out = resp.str();
+    h2::write_all(fd, out.data(), out.size());
     ::close(fd);
-    return;
   }
-  int one = 1;
-  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  // Our SETTINGS: defaults are fine; empty frame.
-  h2::write_frame(fd, h2::SETTINGS, 0, 0, "");
-
-  h2::SendWindows wins;
-  std::map<uint32_t, StreamState> streams;
-  // Bytes consumed since the last connection-level WINDOW_UPDATE.
-  int64_t recv_since_update = 0;
-
-  h2::Frame f;
-  while (h2::read_frame(fd, &f)) {
-    switch (f.type) {
-      case h2::SETTINGS: {
-        if (f.flags & h2::ACK) break;
-        h2::apply_settings(f.payload, &wins);
-        h2::write_frame(fd, h2::SETTINGS, h2::ACK, 0, "");
-        // A raised INITIAL_WINDOW_SIZE can unblock queued DATA (a client
-        // may legally open with window 0 and enable flow later).
-        if (!wins.flush(fd)) { ::close(fd); return; }
-        break;
-      }
-      case h2::PING:
-        if (!(f.flags & h2::ACK))
-          h2::write_frame(fd, h2::PING, h2::ACK, 0, f.payload);
-        break;
-      case h2::WINDOW_UPDATE: {
-        if (f.payload.size() == 4) {
-          uint32_t inc = (uint8_t(f.payload[0]) << 24) |
-                         (uint8_t(f.payload[1]) << 16) |
-                         (uint8_t(f.payload[2]) << 8) |
-                         uint8_t(f.payload[3]);
-          wins.on_window_update(f.stream, inc & 0x7fffffffu);
-          if (!wins.flush(fd)) { ::close(fd); return; }
-        }
-        break;
-      }
-      case h2::HEADERS:
-      case h2::CONTINUATION: {
-        // Header blocks are skipped wholesale (see h2grpc.h): every
-        // client stream is a Process call. Only the flags matter.
-        if (f.flags & h2::END_STREAM)
-          close_stream(fd, f.stream, streams);
-        else
-          streams[f.stream];  // ensure stream state exists
-        break;
-      }
-      case h2::DATA: {
-        StreamState& st = streams[f.stream];
-        std::string payload = f.payload;
-        if (f.flags & h2::PADDED) {
-          if (payload.empty()) break;
-          uint8_t pad = static_cast<uint8_t>(payload[0]);
-          payload = payload.substr(
-              1, payload.size() > size_t(pad) + 1
-                     ? payload.size() - 1 - pad : 0);
-        }
-        // Replenish receive windows promptly (clients block on them).
-        recv_since_update += static_cast<int64_t>(f.payload.size());
-        if (!f.payload.empty()) {
-          h2::write_frame(fd, h2::WINDOW_UPDATE, 0, f.stream,
-                          h2::window_update_payload(
-                              static_cast<uint32_t>(f.payload.size())));
-          if (recv_since_update >= (1 << 14)) {
-            h2::write_frame(fd, h2::WINDOW_UPDATE, 0, 0,
-                            h2::window_update_payload(
-                                static_cast<uint32_t>(recv_since_update)));
-            recv_since_update = 0;
-          }
-        }
-        st.grpc.feed(payload);
-        if (st.grpc.bad) {  // absurd claimed message length: protocol
-          ::close(fd);      // error, drop the connection
-          return;
-        }
-        std::string msg;
-        while (st.grpc.next(&msg)) {
-          Parsed req = parse_processing_request(msg);
-          std::string resp;
-          if (req.kind == Parsed::ReqHeaders) {
-            if (req.end_of_stream) {
-              resp = build_response(false, do_pick(""));
-            } else {
-              resp = build_response(false, "");  // CONTINUE
-            }
-          } else if (req.kind == Parsed::ReqBody) {
-            st.body_buf += req.body;
-            // Bound the body accumulator: a client streaming chunks
-            // forever (no end_of_stream) would otherwise grow it
-            // without limit while we keep replenishing its windows.
-            // Past the cap, pick on what we have (prefix hashing only
-            // needs the front of the prompt anyway).
-            if (!req.end_of_stream &&
-                st.body_buf.size() < (8u << 20)) {
-              continue;  // more chunks coming
-            }
-            resp = build_response(true, do_pick(render_prompt(st.body_buf)));
-            st.body_buf.clear();
-          } else {
-            continue;  // response_headers/body: nothing to do
-          }
-          if (!st.sent_headers) {
-            send_response_headers(fd, f.stream);
-            st.sent_headers = true;
-          }
-          if (!wins.send_data(fd, f.stream, h2::grpc_frame(resp), false)) {
-            ::close(fd);
-            return;
-          }
-        }
-        if (f.flags & h2::END_STREAM)
-          close_stream(fd, f.stream, streams);
-        break;
-      }
-      case h2::RST_STREAM:
-        streams.erase(f.stream);
-        break;
-      case h2::GOAWAY:
-        ::close(fd);
-        return;
-      default:
-        break;  // PRIORITY / PUSH_PROMISE etc: ignore
-    }
-  }
-  ::close(fd);
 }
 
 }  // namespace
 
 int main(int argc, char** argv) {
   int port = 9002;
+  int metrics_port = 0;
   std::string endpoints;
   for (int i = 1; i < argc; i++) {
     std::string arg = argv[i];
@@ -601,26 +74,44 @@ int main(int argc, char** argv) {
     };
     if (arg == "--port") port = atoi(next("--port").c_str());
     else if (arg == "--endpoints") endpoints = next("--endpoints");
-    else if (arg == "--endpoints-file") g_state.file = next("--endpoints-file");
-    else if (arg == "--algorithm") g_algorithm = next("--algorithm");
+    else if (arg == "--endpoints-file")
+      epp::g_state.file = next("--endpoints-file");
+    else if (arg == "--algorithm") epp::g_algorithm = next("--algorithm");
+    else if (arg == "--metrics-port")
+      metrics_port = atoi(next("--metrics-port").c_str());
+    else if (arg == "--read-timeout-ms")
+      epp::g_conn_cfg.recv_timeout_ms =
+          atoi(next("--read-timeout-ms").c_str());
+    else if (arg == "--max-streams")
+      epp::g_conn_cfg.max_streams =
+          static_cast<size_t>(atoi(next("--max-streams").c_str()));
     else {
       fprintf(stderr,
               "usage: tpu-stack-epp [--port N] [--endpoints a,b] "
-              "[--endpoints-file F] [--algorithm prefix|kv|roundrobin]\n");
+              "[--endpoints-file F] [--algorithm prefix|kv|roundrobin] "
+              "[--metrics-port N] [--read-timeout-ms N] [--max-streams N]\n");
       return 2;
     }
   }
-  g_picker = tpu_picker_create();
+  epp::g_picker = tpu_picker_create();
   {
     std::vector<std::string> eps;
     std::stringstream ss(endpoints);
     std::string e;
     while (std::getline(ss, e, ','))
       if (!e.empty()) eps.push_back(e);
-    g_state.set(eps);
+    epp::g_state.set(eps);
   }
-  if (!g_state.file.empty()) {
-    std::thread(&EndpointState::watch_loop, &g_state).detach();
+  if (!epp::g_state.file.empty()) {
+    std::thread(&epp::EndpointState::watch_loop, &epp::g_state).detach();
+  }
+  if (metrics_port > 0) {
+    int msrv = h2::listen_on(metrics_port);
+    if (msrv < 0) {
+      perror("metrics listen");
+      return 1;
+    }
+    std::thread(metrics_loop, msrv).detach();
   }
   int srv = h2::listen_on(port);
   if (srv < 0) {
@@ -628,11 +119,11 @@ int main(int argc, char** argv) {
     return 1;
   }
   fprintf(stderr, "tpu-stack-epp (ext-proc) on :%d algorithm=%s\n", port,
-          g_algorithm.c_str());
+          epp::g_algorithm.c_str());
   fflush(stderr);
   while (true) {
     int fd = ::accept(srv, nullptr, nullptr);
     if (fd < 0) continue;
-    std::thread(serve_connection, fd).detach();
+    std::thread(epp::serve_connection, fd).detach();
   }
 }

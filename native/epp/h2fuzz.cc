@@ -1,0 +1,592 @@
+// tpu-stack-h2fuzz — deterministic, structure-aware adversarial harness
+// for the native EPP data plane (epp_core.h).  No libFuzzer dependency:
+// a seeded xorshift mutation engine drives serve_connection() over an
+// in-process socketpair, so the exact production code path faces the
+// hostile bytes.  Built with -fsanitize=address,undefined in the CI
+// `native-hardening` leg.
+//
+// Three phases:
+//  1. Protocol-error classes: one canonical malicious input per RFC
+//     violation class; asserts the server answers GOAWAY (connection
+//     errors) or RST_STREAM (stream errors) AND bumps the matching
+//     epp_protocol_errors_total counter.
+//  2. Corpus replay: every native/epp/corpus/json/* body is wrapped in
+//     a well-formed ext-proc session; the server must answer a pick
+//     (never crash, hang, or GOAWAY on garbage *content*).
+//  3. Seeded mutation: N iterations (default 10000) of structural
+//     mutations over the seeds — bit flips, truncation, length-field
+//     corruption, frame splices, duplication — asserting only the hard
+//     invariants: no crash (sanitizers abort the process), no hang past
+//     the deadline, output stays bounded.
+//
+// Usage: tpu-stack-h2fuzz [--iterations N] [--seed S] [--corpus DIR]
+//                         [--timeout-ms N]
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <signal.h>
+#include <sys/socket.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <functional>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "epp_core.h"
+
+namespace {
+
+// ---- deterministic RNG (no std::random_device anywhere) ---------------
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed ? seed : 0x9e3779b97f4a7c15ull) {}
+  uint64_t next() {
+    s ^= s << 13;
+    s ^= s >> 7;
+    s ^= s << 17;
+    return s;
+  }
+  uint32_t below(uint32_t n) { return n ? uint32_t(next() % n) : 0; }
+};
+
+// ---- byte-level builders ---------------------------------------------
+std::string frame(uint8_t type, uint8_t flags, uint32_t sid,
+                  const std::string& payload) {
+  std::string out;
+  uint32_t len = uint32_t(payload.size());
+  out.push_back(char((len >> 16) & 0xff));
+  out.push_back(char((len >> 8) & 0xff));
+  out.push_back(char(len & 0xff));
+  out.push_back(char(type));
+  out.push_back(char(flags));
+  out.push_back(char((sid >> 24) & 0x7f));
+  out.push_back(char((sid >> 16) & 0xff));
+  out.push_back(char((sid >> 8) & 0xff));
+  out.push_back(char(sid & 0xff));
+  out += payload;
+  return out;
+}
+
+// A frame header that CLAIMS `len` bytes without carrying them.
+std::string frame_header_only(uint32_t len, uint8_t type, uint8_t flags,
+                              uint32_t sid) {
+  std::string out;
+  out.push_back(char((len >> 16) & 0xff));
+  out.push_back(char((len >> 8) & 0xff));
+  out.push_back(char(len & 0xff));
+  out.push_back(char(type));
+  out.push_back(char(flags));
+  out.push_back(char((sid >> 24) & 0x7f));
+  out.push_back(char((sid >> 16) & 0xff));
+  out.push_back(char((sid >> 8) & 0xff));
+  out.push_back(char(sid & 0xff));
+  return out;
+}
+
+std::string preface() { return std::string(h2::kPreface, h2::kPrefaceLen); }
+
+std::string opening() {
+  return preface() + frame(h2::SETTINGS, 0, 0, "");
+}
+
+std::string headers_frame(uint32_t sid, uint8_t extra_flags = 0) {
+  // Block content is skipped wholesale by the server; one indexed byte.
+  return frame(h2::HEADERS, uint8_t(h2::END_HEADERS | extra_flags), sid,
+               "\x88");
+}
+
+// ext-proc ProcessingRequest{request_body{body, end_of_stream}} wrapped
+// in a gRPC length-prefixed frame.
+std::string ext_proc_body(const std::string& json, bool eos = true) {
+  std::string hb;
+  h2::pb_bytes(&hb, 1, json);
+  if (eos) h2::pb_bool(&hb, 2, true);
+  std::string req;
+  h2::pb_bytes(&req, 4, hb);
+  return h2::grpc_frame(req);
+}
+
+std::string settings_entry(uint16_t id, uint32_t val) {
+  std::string p;
+  p.push_back(char((id >> 8) & 0xff));
+  p.push_back(char(id & 0xff));
+  p.push_back(char((val >> 24) & 0xff));
+  p.push_back(char((val >> 16) & 0xff));
+  p.push_back(char((val >> 8) & 0xff));
+  p.push_back(char(val & 0xff));
+  return p;
+}
+
+std::string valid_session(const std::string& json) {
+  std::string in = opening() + headers_frame(1);
+  // Chunk DATA at the server's SETTINGS_MAX_FRAME_SIZE — a compliant
+  // client never exceeds it (and the server now rejects those who do).
+  std::string body = ext_proc_body(json);
+  size_t off = 0;
+  do {
+    size_t n = std::min<size_t>(body.size() - off, h2::kDefaultMaxFrameLen);
+    bool last = off + n >= body.size();
+    in += frame(h2::DATA, last ? h2::END_STREAM : 0, 1,
+                body.substr(off, n));
+    off += n;
+  } while (off < body.size());
+  return in;
+}
+
+// ---- case runner ------------------------------------------------------
+struct Outcome {
+  std::string out;     // everything the server wrote (bounded)
+  bool hang = false;   // server thread alive past the deadline
+  bool overflow = false;  // server wrote more than the output bound
+};
+
+constexpr size_t kMaxOutput = 16u << 20;
+
+Outcome run_case(const std::string& input, int timeout_ms) {
+  Outcome oc;
+  int sv[2];
+  if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) {
+    perror("socketpair");
+    exit(1);
+  }
+  std::atomic<bool> done{false};
+  std::thread server([&, fd = sv[1]] {
+    epp::serve_connection(fd);  // closes fd itself
+    done.store(true, std::memory_order_release);
+  });
+  int cfd = sv[0];
+  fcntl(cfd, F_SETFL, O_NONBLOCK);
+  size_t written = 0;
+  bool wr_closed = false;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  char buf[65536];
+  while (std::chrono::steady_clock::now() < deadline) {
+    pollfd p{};
+    p.fd = cfd;
+    p.events = POLLIN;
+    if (!wr_closed && written < input.size()) p.events |= POLLOUT;
+    int pr = ::poll(&p, 1, 20);
+    if (pr < 0) break;
+    bool io = false;
+    if (p.revents & (POLLIN | POLLHUP | POLLERR)) {
+      ssize_t r = ::read(cfd, buf, sizeof(buf));
+      if (r > 0) {
+        io = true;
+        if (oc.out.size() + size_t(r) <= kMaxOutput)
+          oc.out.append(buf, size_t(r));
+        else
+          oc.overflow = true;
+      } else if (r == 0) {
+        break;  // server closed its side
+      } else if (errno != EAGAIN && errno != EWOULDBLOCK) {
+        break;
+      }
+    }
+    if (!wr_closed && (p.revents & POLLOUT) && written < input.size()) {
+      ssize_t w = ::write(cfd, input.data() + written,
+                          std::min<size_t>(input.size() - written, 65536));
+      if (w > 0) {
+        io = true;
+        written += size_t(w);
+      } else if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
+        wr_closed = true;  // server stopped reading (closed on us)
+        ::shutdown(cfd, SHUT_WR);
+      }
+    }
+    if (!wr_closed && written >= input.size()) {
+      wr_closed = true;
+      ::shutdown(cfd, SHUT_WR);  // signal EOF; keep draining
+    }
+    (void)io;
+  }
+  ::close(cfd);
+  // The server must exit promptly once its peer is gone.
+  auto hard = std::chrono::steady_clock::now() +
+              std::chrono::milliseconds(timeout_ms);
+  while (!done.load(std::memory_order_acquire) &&
+         std::chrono::steady_clock::now() < hard)
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  if (!done.load(std::memory_order_acquire)) {
+    oc.hang = true;
+    server.detach();
+  } else {
+    server.join();
+  }
+  return oc;
+}
+
+// ---- server-output frame scan ----------------------------------------
+struct Scan {
+  bool goaway = false;
+  bool rst = false;
+  uint32_t goaway_code = 0;
+  uint32_t rst_code = 0;
+  int frames = 0;
+  bool headers = false;
+  bool data = false;
+};
+
+Scan scan_frames(const std::string& out) {
+  Scan s;
+  size_t i = 0;
+  while (i + 9 <= out.size()) {
+    uint32_t len = (uint32_t(uint8_t(out[i])) << 16) |
+                   (uint32_t(uint8_t(out[i + 1])) << 8) |
+                   uint32_t(uint8_t(out[i + 2]));
+    uint8_t type = uint8_t(out[i + 3]);
+    size_t pay = i + 9;
+    if (pay + len > out.size()) break;
+    s.frames++;
+    if (type == h2::GOAWAY && len >= 8) {
+      s.goaway = true;
+      s.goaway_code = (uint32_t(uint8_t(out[pay + 4])) << 24) |
+                      (uint32_t(uint8_t(out[pay + 5])) << 16) |
+                      (uint32_t(uint8_t(out[pay + 6])) << 8) |
+                      uint32_t(uint8_t(out[pay + 7]));
+    } else if (type == h2::RST_STREAM && len >= 4) {
+      s.rst = true;
+      s.rst_code = (uint32_t(uint8_t(out[pay])) << 24) |
+                   (uint32_t(uint8_t(out[pay + 1])) << 16) |
+                   (uint32_t(uint8_t(out[pay + 2])) << 8) |
+                   uint32_t(uint8_t(out[pay + 3]));
+    } else if (type == h2::HEADERS) {
+      s.headers = true;
+    } else if (type == h2::DATA) {
+      s.data = true;
+    }
+    i = pay + len;
+  }
+  return s;
+}
+
+// ---- protocol-error class table --------------------------------------
+enum Expect { kExpectGoaway, kExpectRst, kExpectEither, kExpectCloseOnly };
+
+struct ErrClass {
+  const char* name;
+  epp::ErrKind kind;
+  Expect expect;
+  std::function<std::string()> build;
+};
+
+std::vector<ErrClass> make_classes() {
+  using namespace h2;
+  std::vector<ErrClass> v;
+  v.push_back({"bad_preface", epp::kErrBadPreface, kExpectCloseOnly, [] {
+    return std::string("GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n");
+  }});
+  v.push_back({"frame_oversize", epp::kErrFrameOversize, kExpectGoaway, [] {
+    // Header claims 1 MiB — above SETTINGS_MAX_FRAME_SIZE; the server
+    // must reject before allocating, so no payload follows.
+    return opening() + frame_header_only(1u << 20, DATA, 0, 1);
+  }});
+  v.push_back({"settings_bad_length", epp::kErrBadSettings, kExpectGoaway,
+               [] { return opening() + frame(SETTINGS, 0, 0, "12345"); }});
+  v.push_back({"settings_ack_payload", epp::kErrBadSettings, kExpectGoaway,
+               [] {
+                 return opening() +
+                        frame(SETTINGS, ACK, 0, settings_entry(4, 1));
+               }});
+  v.push_back({"settings_on_stream", epp::kErrBadSettings, kExpectGoaway,
+               [] { return opening() + frame(SETTINGS, 0, 1, ""); }});
+  v.push_back({"settings_window_too_big", epp::kErrBadSettings,
+               kExpectGoaway, [] {
+                 return opening() +
+                        frame(SETTINGS, 0, 0,
+                              settings_entry(4, 0x80000000u));
+               }});
+  v.push_back({"settings_flood", epp::kErrFlood, kExpectGoaway, [] {
+    std::string in = opening();
+    for (int i = 0; i < 100; i++) in += frame(SETTINGS, 0, 0, "");
+    return in;
+  }});
+  v.push_back({"ping_bad_length", epp::kErrBadPing, kExpectGoaway, [] {
+    return opening() + frame(PING, 0, 0, "abc");
+  }});
+  v.push_back({"ping_flood", epp::kErrFlood, kExpectGoaway, [] {
+    std::string in = opening();
+    for (int i = 0; i < 200; i++)
+      in += frame(PING, 0, 0, std::string(8, 'p'));
+    return in;
+  }});
+  v.push_back({"window_update_bad_length", epp::kErrBadWindowUpdate,
+               kExpectGoaway, [] {
+                 return opening() + frame(WINDOW_UPDATE, 0, 0, "ab");
+               }});
+  v.push_back({"zero_window_increment_conn", epp::kErrZeroWindowInc,
+               kExpectGoaway, [] {
+                 return opening() +
+                        frame(WINDOW_UPDATE, 0, 0, window_update_payload(0));
+               }});
+  v.push_back({"zero_window_increment_stream", epp::kErrZeroWindowInc,
+               kExpectRst, [] {
+                 return opening() + headers_frame(1) +
+                        frame(WINDOW_UPDATE, 0, 1, window_update_payload(0));
+               }});
+  v.push_back({"window_overflow", epp::kErrWindowOverflow, kExpectGoaway,
+               [] {
+                 return opening() +
+                        frame(WINDOW_UPDATE, 0, 0,
+                              window_update_payload(0x7fffffffu)) +
+                        frame(WINDOW_UPDATE, 0, 0,
+                              window_update_payload(0x7fffffffu));
+               }});
+  v.push_back({"data_on_stream_zero", epp::kErrBadStreamId, kExpectGoaway,
+               [] { return opening() + frame(DATA, 0, 0, "x"); }});
+  v.push_back({"even_stream_id", epp::kErrBadStreamId, kExpectGoaway, [] {
+    return opening() + headers_frame(2);
+  }});
+  v.push_back({"padding_overflow", epp::kErrBadPadding, kExpectGoaway, [] {
+    // pad length 255 with a 5-byte payload: padding >= payload.
+    return opening() + headers_frame(1) +
+           frame(DATA, PADDED, 1, std::string("\xff") + "xxxx");
+  }});
+  v.push_back({"grpc_length_lie", epp::kErrGrpcFraming, kExpectEither, [] {
+    // gRPC length prefix claims 2 GiB.
+    std::string g("\x00\x7f\xff\xff\xff", 5);
+    g += "garbage";
+    return opening() + headers_frame(1) + frame(DATA, 0, 1, g);
+  }});
+  v.push_back({"rst_bad_length", epp::kErrBadRstStream, kExpectGoaway, [] {
+    return opening() + headers_frame(1) + frame(RST_STREAM, 0, 1, "ab");
+  }});
+  v.push_back({"push_promise_from_client", epp::kErrUnexpectedFrame,
+               kExpectGoaway, [] {
+                 return opening() + frame(PUSH_PROMISE, 0, 1,
+                                          std::string(8, '\0'));
+               }});
+  v.push_back({"stream_flood", epp::kErrFlood, kExpectRst, [] {
+    // More concurrent streams than the cap (fuzz config: 16).
+    std::string in = opening();
+    for (uint32_t sid = 1; sid < 80; sid += 2) in += headers_frame(sid);
+    return in;
+  }});
+  return v;
+}
+
+// ---- corpus -----------------------------------------------------------
+std::vector<std::string> load_dir(const std::string& dir) {
+  std::vector<std::string> names;
+  DIR* d = opendir(dir.c_str());
+  if (!d) return names;
+  while (dirent* e = readdir(d)) {
+    if (e->d_name[0] == '.') continue;
+    names.push_back(dir + "/" + e->d_name);
+  }
+  closedir(d);
+  std::sort(names.begin(), names.end());  // deterministic order
+  return names;
+}
+
+std::string slurp(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+// ---- mutation engine --------------------------------------------------
+std::string mutate(const std::string& seed, Rng& rng) {
+  std::string s = seed;
+  int n_mut = 1 + int(rng.below(6));
+  for (int m = 0; m < n_mut && !s.empty(); m++) {
+    switch (rng.below(8)) {
+      case 0: {  // bit flip
+        size_t i = rng.below(uint32_t(s.size()));
+        s[i] = char(uint8_t(s[i]) ^ (1u << rng.below(8)));
+        break;
+      }
+      case 1: {  // byte set
+        s[rng.below(uint32_t(s.size()))] = char(rng.below(256));
+        break;
+      }
+      case 2: {  // truncate tail
+        s.resize(rng.below(uint32_t(s.size())) + 1);
+        break;
+      }
+      case 3: {  // delete range
+        size_t i = rng.below(uint32_t(s.size()));
+        size_t n = rng.below(uint32_t(s.size() - i)) + 1;
+        s.erase(i, n);
+        break;
+      }
+      case 4: {  // duplicate range
+        size_t i = rng.below(uint32_t(s.size()));
+        size_t n = std::min<size_t>(rng.below(64) + 1, s.size() - i);
+        s.insert(i, s.substr(i, n));
+        break;
+      }
+      case 5: {  // insert random bytes
+        size_t i = rng.below(uint32_t(s.size() + 1));
+        std::string junk;
+        for (uint32_t k = rng.below(16) + 1; k > 0; k--)
+          junk.push_back(char(rng.below(256)));
+        s.insert(i, junk);
+        break;
+      }
+      case 6: {  // corrupt a (possible) frame-length field after preface
+        if (s.size() > h2::kPrefaceLen + 3) {
+          size_t i = h2::kPrefaceLen +
+                     rng.below(uint32_t(s.size() - h2::kPrefaceLen - 3));
+          s[i] = char(rng.below(256));
+          s[i + 1] = char(rng.below(256));
+          s[i + 2] = char(rng.below(256));
+        }
+        break;
+      }
+      case 7: {  // splice a random well-formed frame
+        uint8_t type = uint8_t(rng.below(11));
+        uint32_t sid = rng.below(8);
+        std::string payload;
+        for (uint32_t k = rng.below(24); k > 0; k--)
+          payload.push_back(char(rng.below(256)));
+        s += frame(type, uint8_t(rng.below(256)), sid, payload);
+        break;
+      }
+    }
+  }
+  return s;
+}
+
+void hexdump_head(const std::string& s) {
+  size_t n = std::min<size_t>(s.size(), 160);
+  for (size_t i = 0; i < n; i++) fprintf(stderr, "%02x", uint8_t(s[i]));
+  fprintf(stderr, "%s (%zu bytes)\n", s.size() > n ? "..." : "", s.size());
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  long iterations = 10000;
+  uint64_t seed = 1;
+  int timeout_ms = 5000;
+  std::string corpus_dir = "native/epp/corpus";
+  for (int i = 1; i < argc; i++) {
+    std::string arg = argv[i];
+    auto next = [&]() -> std::string {
+      if (i + 1 >= argc) { fprintf(stderr, "%s needs a value\n", arg.c_str()); exit(2); }
+      return argv[++i];
+    };
+    if (arg == "--iterations") iterations = atol(next().c_str());
+    else if (arg == "--seed") seed = strtoull(next().c_str(), nullptr, 10);
+    else if (arg == "--timeout-ms") timeout_ms = atoi(next().c_str());
+    else if (arg == "--corpus") corpus_dir = next();
+    else {
+      fprintf(stderr, "usage: tpu-stack-h2fuzz [--iterations N] [--seed S] "
+                      "[--corpus DIR] [--timeout-ms N]\n");
+      return 2;
+    }
+  }
+  // The server writing into a closed socketpair must not kill us.
+  signal(SIGPIPE, SIG_IGN);
+
+  epp::g_picker = tpu_picker_create();
+  epp::g_state.set({"10.0.0.1:8000", "10.0.0.2:8000", "10.0.0.3:8000"});
+  // Tight caps so flood classes trip quickly and hangs surface fast.
+  epp::g_conn_cfg.max_streams = 16;
+  epp::g_conn_cfg.max_ping_frames = 64;
+  epp::g_conn_cfg.max_settings_frames = 64;
+  epp::g_conn_cfg.max_buffered = 8u << 20;
+  epp::g_conn_cfg.recv_timeout_ms = timeout_ms;
+
+  int failures = 0;
+
+  // -- phase 1: protocol-error classes ----------------------------------
+  fprintf(stderr, "[h2fuzz] phase 1: protocol-error classes\n");
+  for (const ErrClass& c : make_classes()) {
+    uint64_t before = epp::err_counter(c.kind).load();
+    Outcome oc = run_case(c.build(), timeout_ms);
+    Scan s = scan_frames(oc.out);
+    uint64_t after = epp::err_counter(c.kind).load();
+    bool counted = after > before;
+    bool answered =
+        (c.expect == kExpectGoaway && s.goaway) ||
+        (c.expect == kExpectRst && s.rst) ||
+        (c.expect == kExpectEither && (s.goaway || s.rst)) ||
+        c.expect == kExpectCloseOnly;
+    if (oc.hang || !counted || !answered) {
+      failures++;
+      fprintf(stderr,
+              "[h2fuzz] FAIL class=%s hang=%d counted=%d goaway=%d(0x%x) "
+              "rst=%d(0x%x)\n",
+              c.name, int(oc.hang), int(counted), int(s.goaway),
+              s.goaway_code, int(s.rst), s.rst_code);
+    } else {
+      fprintf(stderr, "[h2fuzz] ok  class=%-28s goaway=%d rst=%d\n",
+              c.name, int(s.goaway), int(s.rst));
+    }
+  }
+
+  // -- phase 2: hostile-content corpus over a valid session -------------
+  std::vector<std::string> json_corpus;
+  for (const auto& path : load_dir(corpus_dir + "/json"))
+    json_corpus.push_back(slurp(path));
+  fprintf(stderr, "[h2fuzz] phase 2: %zu corpus bodies\n",
+          json_corpus.size());
+  for (size_t i = 0; i < json_corpus.size(); i++) {
+    Outcome oc = run_case(valid_session(json_corpus[i]), timeout_ms);
+    Scan s = scan_frames(oc.out);
+    // Garbage *content* in a well-formed session must still be answered
+    // with a pick response — robustness means degrade, not disconnect.
+    if (oc.hang || oc.overflow || !s.headers || !s.data || s.goaway) {
+      failures++;
+      fprintf(stderr,
+              "[h2fuzz] FAIL corpus[%zu] hang=%d overflow=%d headers=%d "
+              "data=%d goaway=%d\n",
+              i, int(oc.hang), int(oc.overflow), int(s.headers),
+              int(s.data), int(s.goaway));
+    }
+  }
+
+  // -- phase 3: seeded structural mutation ------------------------------
+  std::vector<std::string> seeds;
+  seeds.push_back(valid_session("{\"prompt\": \"hello world\"}"));
+  seeds.push_back(valid_session(
+      "{\"messages\":[{\"role\":\"user\",\"content\":\"hi there\"}],"
+      "\"model\":\"m\"}"));
+  for (const ErrClass& c : make_classes()) seeds.push_back(c.build());
+  for (const auto& body : json_corpus) seeds.push_back(valid_session(body));
+  for (const auto& path : load_dir(corpus_dir + "/h2"))
+    seeds.push_back(slurp(path));
+
+  Rng rng(seed);
+  fprintf(stderr, "[h2fuzz] phase 3: %ld mutation iterations over %zu "
+                  "seeds (seed=%llu)\n",
+          iterations, seeds.size(), (unsigned long long)seed);
+  for (long it = 0; it < iterations; it++) {
+    const std::string& base = seeds[rng.below(uint32_t(seeds.size()))];
+    std::string input = mutate(base, rng);
+    Outcome oc = run_case(input, timeout_ms);
+    if (oc.hang || oc.overflow) {
+      failures++;
+      fprintf(stderr, "[h2fuzz] FAIL iter=%ld hang=%d overflow=%d input=",
+              it, int(oc.hang), int(oc.overflow));
+      hexdump_head(input);
+      if (oc.hang) {
+        // A wedged server thread poisons every later case; stop here.
+        fprintf(stderr, "[h2fuzz] aborting after hang\n");
+        return 1;
+      }
+    }
+    if ((it + 1) % 1000 == 0)
+      fprintf(stderr, "[h2fuzz] ... %ld/%ld iterations\n", it + 1,
+              iterations);
+  }
+
+  // Final tally, Prometheus-style, so CI logs show the error mix.
+  fprintf(stderr, "%s", epp::render_protocol_error_metrics().c_str());
+  if (failures) {
+    fprintf(stderr, "[h2fuzz] FAILED: %d invariant violations\n", failures);
+    return 1;
+  }
+  fprintf(stderr, "[h2fuzz] PASS: all invariants held\n");
+  return 0;
+}

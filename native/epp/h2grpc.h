@@ -65,6 +65,24 @@ enum Flags : uint8_t {
   PRIORITY_FLAG = 0x20,
 };
 
+// RFC 7540 §7 error codes (the subset this server emits).
+enum ErrorCode : uint32_t {
+  NO_ERROR = 0x0,
+  PROTOCOL_ERROR = 0x1,
+  FLOW_CONTROL_ERROR = 0x3,
+  FRAME_SIZE_ERROR = 0x6,
+  REFUSED_STREAM = 0x7,
+  ENHANCE_YOUR_CALM = 0xb,
+};
+
+// SETTINGS_MAX_FRAME_SIZE default (RFC 7540 §6.5.2): we never raise it,
+// so any peer frame with a larger payload is a FRAME_SIZE_ERROR — and
+// must be rejected BEFORE the payload is allocated (a 24-bit length
+// field otherwise lets one frame header demand a 16 MiB resize).
+constexpr uint32_t kDefaultMaxFrameLen = 16384;
+// 2^31-1: the flow-control window ceiling (RFC 7540 §6.9.1).
+constexpr int64_t kMaxWindow = 0x7fffffff;
+
 struct Frame {
   uint8_t type = 0;
   uint8_t flags = 0;
@@ -94,9 +112,15 @@ inline bool write_all(int fd, const void* buf, size_t n) {
   return true;
 }
 
-inline bool read_frame(int fd, Frame* f) {
+enum class ReadResult { kOk, kEof, kOversize };
+
+// Frame read with the advertised max-frame-size enforced BEFORE the
+// payload allocation: on kOversize the header fields are filled in (so
+// the caller can name the offender in a GOAWAY) but not a byte of the
+// payload has been read or allocated.
+inline ReadResult read_frame_limited(int fd, Frame* f, uint32_t max_len) {
   uint8_t hdr[9];
-  if (!read_exact(fd, hdr, 9)) return false;
+  if (!read_exact(fd, hdr, 9)) return ReadResult::kEof;
   uint32_t len = (uint32_t(hdr[0]) << 16) | (uint32_t(hdr[1]) << 8) |
                  uint32_t(hdr[2]);
   f->type = hdr[3];
@@ -104,9 +128,17 @@ inline bool read_frame(int fd, Frame* f) {
   f->stream = ((uint32_t(hdr[5]) << 24) | (uint32_t(hdr[6]) << 16) |
                (uint32_t(hdr[7]) << 8) | uint32_t(hdr[8])) &
               0x7fffffffu;
+  if (len > max_len) return ReadResult::kOversize;
   f->payload.resize(len);
-  if (len > 0 && !read_exact(fd, f->payload.data(), len)) return false;
-  return true;
+  if (len > 0 && !read_exact(fd, f->payload.data(), len))
+    return ReadResult::kEof;
+  return ReadResult::kOk;
+}
+
+// Legacy unlimited read for trusted peers (the bench reads frames from
+// our own server); caps at the 24-bit wire maximum.
+inline bool read_frame(int fd, Frame* f) {
+  return read_frame_limited(fd, f, (1u << 24) - 1) == ReadResult::kOk;
 }
 
 inline bool write_frame(int fd, uint8_t type, uint8_t flags,
@@ -285,10 +317,35 @@ inline std::string window_update_payload(uint32_t inc) {
   return u;
 }
 
+inline std::string rst_stream_payload(uint32_t error_code) {
+  std::string p(4, '\0');
+  p[0] = static_cast<char>((error_code >> 24) & 0xff);
+  p[1] = static_cast<char>((error_code >> 16) & 0xff);
+  p[2] = static_cast<char>((error_code >> 8) & 0xff);
+  p[3] = static_cast<char>(error_code & 0xff);
+  return p;
+}
+
+inline std::string goaway_payload(uint32_t last_stream_id,
+                                  uint32_t error_code) {
+  std::string p(8, '\0');
+  p[0] = static_cast<char>((last_stream_id >> 24) & 0x7f);
+  p[1] = static_cast<char>((last_stream_id >> 16) & 0xff);
+  p[2] = static_cast<char>((last_stream_id >> 8) & 0xff);
+  p[3] = static_cast<char>(last_stream_id & 0xff);
+  p[4] = static_cast<char>((error_code >> 24) & 0xff);
+  p[5] = static_cast<char>((error_code >> 16) & 0xff);
+  p[6] = static_cast<char>((error_code >> 8) & 0xff);
+  p[7] = static_cast<char>(error_code & 0xff);
+  return p;
+}
+
 // Apply a SETTINGS payload to the send windows (only
-// INITIAL_WINDOW_SIZE, id 4, affects them) and return true so callers
-// can chain the ACK + flush.
-inline void apply_settings(const std::string& payload,
+// INITIAL_WINDOW_SIZE, id 4, affects them).  Returns false when the
+// payload is semantically invalid (INITIAL_WINDOW_SIZE above 2^31-1,
+// RFC 7540 §6.5.2 — a FLOW_CONTROL_ERROR on the connection).  Length
+// validation (multiple of 6) is the caller's frame-level concern.
+inline bool apply_settings(const std::string& payload,
                            struct SendWindows* wins);
 
 // ---- flow-controlled sender ------------------------------------------
@@ -319,12 +376,21 @@ struct SendWindows {
     return flush(fd);
   }
 
+  size_t queued_bytes() const {
+    size_t n = 0;
+    for (const Pending& p : queue) n += p.data.size();
+    return n;
+  }
+
   bool flush(int fd) {
     while (!queue.empty()) {
       Pending& front = queue.front();
       int64_t& sw = win(front.sid);
+      // Never exceed the peer's default SETTINGS_MAX_FRAME_SIZE per
+      // DATA frame, whatever the windows allow.
       int64_t allow = std::min<int64_t>(
-          {conn, sw, static_cast<int64_t>(front.data.size())});
+          {conn, sw, static_cast<int64_t>(front.data.size()),
+           int64_t(kDefaultMaxFrameLen)});
       if (allow < static_cast<int64_t>(front.data.size()) &&
           (conn <= 0 || sw <= 0))
         return true;  // window exhausted; wait for WINDOW_UPDATE
@@ -338,17 +404,21 @@ struct SendWindows {
         queue.pop_front();
       } else {
         front.data.erase(0, allow);
-        return true;  // partially sent; wait for more window
+        // Split by the frame-size cap with window still open: keep
+        // sending.  Window exhausted: wait for the next WINDOW_UPDATE.
+        if (conn <= 0 || sw <= 0) return true;
       }
     }
     return true;
   }
 
-  void on_window_update(uint32_t sid, uint32_t inc) {
-    if (sid == 0)
-      conn += inc;
-    else
-      win(sid) += inc;
+  // Returns false when the increment would push a window past 2^31-1
+  // (FLOW_CONTROL_ERROR on the connection, RFC 7540 §6.9.1).
+  bool on_window_update(uint32_t sid, uint32_t inc) {
+    int64_t& w = (sid == 0) ? conn : win(sid);
+    if (w + int64_t(inc) > kMaxWindow) return false;
+    w += inc;
+    return true;
   }
 
   void on_initial_window(int32_t v) {
@@ -358,7 +428,7 @@ struct SendWindows {
   }
 };
 
-inline void apply_settings(const std::string& payload,
+inline bool apply_settings(const std::string& payload,
                            SendWindows* wins) {
   for (size_t i = 0; i + 6 <= payload.size(); i += 6) {
     uint16_t id = (uint8_t(payload[i]) << 8) | uint8_t(payload[i + 1]);
@@ -366,8 +436,12 @@ inline void apply_settings(const std::string& payload,
                    (uint8_t(payload[i + 3]) << 16) |
                    (uint8_t(payload[i + 4]) << 8) |
                    uint8_t(payload[i + 5]);
-    if (id == 4) wins->on_initial_window(static_cast<int32_t>(val));
+    if (id == 4) {
+      if (val > uint32_t(kMaxWindow)) return false;
+      wins->on_initial_window(static_cast<int32_t>(val));
+    }
   }
+  return true;
 }
 
 inline int listen_on(int port) {

@@ -57,6 +57,47 @@ from production_stack_tpu.utils.log import init_logger
 
 logger = init_logger(__name__)
 
+# Hostile-input bound for request bodies: large enough for any real
+# OpenAI-API payload (long prompts, logit_bias maps, KV pull manifests),
+# small enough that a malicious body cannot balloon worker memory.
+MAX_BODY_BYTES = 32 << 20
+
+
+def _bad_request(message: str) -> web.HTTPBadRequest:
+    return web.HTTPBadRequest(
+        text=json.dumps({"error": {"message": message,
+                                   "type": "BadRequestError"}}),
+        content_type="application/json")
+
+
+async def _json_body(request: web.Request) -> dict:
+    """Read and parse a JSON request body defensively.
+
+    Hostile input — truncated/garbage JSON, non-UTF8 bytes, nesting
+    bombs deep enough to overflow the parser's recursion, or a
+    non-object top level — maps to a clean 4xx.  A bare
+    ``await request.json()`` turns those into aiohttp 500s
+    (RecursionError/UnicodeDecodeError escape the handler) and, for
+    pathological inputs, a wedged worker.  An empty body parses as {}
+    so body-less control POSTs (/sleep, /drain) keep working.
+    """
+    raw = await request.read()
+    if len(raw) > MAX_BODY_BYTES:
+        # Backstop for transports that bypass client_max_size (chunked
+        # bodies with no Content-Length on some aiohttp versions).
+        raise web.HTTPRequestEntityTooLarge(
+            max_size=MAX_BODY_BYTES, actual_size=len(raw),
+            text=json.dumps({"error": {"message": "request body too large",
+                                       "type": "BadRequestError"}}),
+            content_type="application/json")
+    try:
+        body = json.loads(raw) if raw else {}
+    except (ValueError, RecursionError):
+        raise _bad_request("request body is not parsable JSON") from None
+    if not isinstance(body, dict):
+        raise _bad_request("request body must be a JSON object")
+    return body
+
 
 class _TokenStream:
     """Bridges engine-thread token callbacks into an asyncio queue."""
@@ -394,7 +435,8 @@ class EngineServer:
             self._inflight -= 1
 
     def make_app(self) -> web.Application:
-        app = web.Application(middlewares=[self._auth_middleware])
+        app = web.Application(middlewares=[self._auth_middleware],
+                              client_max_size=MAX_BODY_BYTES)
         r = app.router
         r.add_get("/v1/models", self.handle_models)
         r.add_post("/v1/chat/completions", self.handle_chat)
@@ -506,7 +548,7 @@ class EngineServer:
         return web.json_response({"object": "list", "data": data})
 
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
-        body = await request.json()
+        body = await _json_body(request)
         model = body.get("model", self.config.model)
         if not self._check_model(model):
             return web.json_response(
@@ -543,7 +585,7 @@ class EngineServer:
         )
 
     async def handle_completion(self, request: web.Request) -> web.StreamResponse:
-        body = await request.json()
+        body = await _json_body(request)
         model = body.get("model", self.config.model)
         if not self._check_model(model):
             return web.json_response(
@@ -1168,7 +1210,7 @@ class EngineServer:
             return web.json_response(
                 {"error": {"message": "engine is sleeping",
                            "type": "ServiceUnavailable"}}, status=503)
-        body = await request.json()
+        body = await _json_body(request)
         inputs = body.get("input", [])
         # str | [str, ...] | [int, ...] (one token array) | [[int, ...], ...]
         if isinstance(inputs, str):
@@ -1241,7 +1283,7 @@ class EngineServer:
             return web.json_response(
                 {"error": {"message": "engine is sleeping",
                            "type": "ServiceUnavailable"}}, status=503)
-        body = await request.json()
+        body = await _json_body(request)
         list_1 = self._as_text_list(body.get("text_1"))
         list_2 = self._as_text_list(body.get("text_2"))
         if list_1 is None or list_2 is None:
@@ -1281,7 +1323,7 @@ class EngineServer:
             return web.json_response(
                 {"error": {"message": "engine is sleeping",
                            "type": "ServiceUnavailable"}}, status=503)
-        body = await request.json()
+        body = await _json_body(request)
         query = body.get("query")
         documents = body.get("documents")
         if not query or not isinstance(documents, list) or not documents:
@@ -1318,7 +1360,7 @@ class EngineServer:
         })
 
     async def handle_tokenize(self, request: web.Request) -> web.Response:
-        body = await request.json()
+        body = await _json_body(request)
         text = body.get("prompt")
         if text is None and "messages" in body:
             text = self.core.tokenizer.apply_chat_template(body["messages"])
@@ -1329,7 +1371,7 @@ class EngineServer:
         })
 
     async def handle_detokenize(self, request: web.Request) -> web.Response:
-        body = await request.json()
+        body = await _json_body(request)
         return web.json_response(
             {"prompt": self.core.tokenizer.decode(body.get("tokens", []))})
 
@@ -1451,7 +1493,7 @@ class EngineServer:
         return web.json_response({"is_sleeping": self.core.is_sleeping})
 
     async def handle_load_lora(self, request: web.Request) -> web.Response:
-        body = await request.json()
+        body = await _json_body(request)
         name = body.get("lora_name")
         if not name:
             return web.json_response(
@@ -1467,7 +1509,7 @@ class EngineServer:
         return web.json_response({"status": "ok", "lora_name": name})
 
     async def handle_unload_lora(self, request: web.Request) -> web.Response:
-        body = await request.json()
+        body = await _json_body(request)
         name = body.get("lora_name")
         ok = self.core.unload_lora_adapter(name or "")
         if not ok:
@@ -1505,7 +1547,7 @@ class EngineServer:
         concatenation copy — this path moves multi-GB KV at 8B/70B scale)."""
         from production_stack_tpu.kv.offload import pack_transfer_buffers
 
-        body = await request.json()
+        body = await _json_body(request)
         token_ids = self._tokens_from_body(body)
         adapter = self._resolve_adapter(body.get("model", "")) or ""
         payload = await asyncio.get_running_loop().run_in_executor(
@@ -1605,7 +1647,7 @@ class EngineServer:
             return web.json_response(
                 {"error": "device pipe unavailable on this backend"},
                 status=501)
-        body = await request.json()
+        body = await _json_body(request)
         token_ids = self._tokens_from_body(body)
         adapter = self._resolve_adapter(body.get("model", "")) or ""
         payload = await asyncio.get_running_loop().run_in_executor(
@@ -1642,7 +1684,7 @@ class EngineServer:
     async def handle_kv_release(self, request: web.Request) -> web.Response:
         """Free a parked prepare_pull offer once the peer's pull is done
         (fallback: the pipe's TTL pruning)."""
-        body = await request.json()
+        body = await _json_body(request)
         if self._device_pipe is not None and "uuid" in body:
             self._device_pipe.release(int(body["uuid"]))
         return web.json_response({"status": "ok"})
@@ -1826,7 +1868,7 @@ class EngineServer:
 
         from production_stack_tpu.kv.offload import unpack_transfer
 
-        body = await request.json()
+        body = await _json_body(request)
         source = body.get("source_url")
         if not source:
             return web.json_response(

@@ -21,6 +21,7 @@ from .fair_queue import (  # noqa: F401
     ShedError,
     priority_class,
 )
-from .gate import AdmitResult, QoSGate, estimate_tokens  # noqa: F401
+from .gate import (AdmitResult, QoSGate, estimate_token_parts,  # noqa: F401
+                   estimate_tokens)
 from .tenants import TenantRegistry, TenantSpec  # noqa: F401
 from .token_bucket import TokenBucket  # noqa: F401

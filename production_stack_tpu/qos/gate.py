@@ -10,7 +10,6 @@ lazily from the admission path as a fallback).
 
 from __future__ import annotations
 
-import json
 import logging
 import os
 import time
@@ -20,6 +19,7 @@ from .fair_queue import (PRIORITY_CLASS_NUM, FairDispatchQueue, QueueLease,
                          priority_class)
 from .tenants import TenantRegistry, TenantSpec
 from .token_bucket import TokenBucket
+from .usage import actual_tokens
 
 logger = logging.getLogger("uvicorn")
 
@@ -29,12 +29,15 @@ _DEFAULT_COMPLETION_TOKENS = 64
 _CHARS_PER_TOKEN = 4
 
 
-def estimate_tokens(request_json: dict) -> int:
-    """Cheap prompt+completion token estimate for tokens/s accounting.
+def estimate_token_parts(request_json: dict) -> Tuple[int, int]:
+    """(prompt_estimate, completion_estimate) for tokens/s accounting.
 
     ~4 chars/token on the prompt side (no tokenizer on the router), plus
     the requested max_tokens.  Deliberately rough: buckets only need the
     estimate to scale with request size, not to match the engine's count.
+    Split in two so post-completion reconciliation can compare a
+    completion-only measurement (SSE chunk count) against the same
+    prompt-side estimate admission charged.
     """
     chars = 0
     msgs = request_json.get("messages")
@@ -57,7 +60,13 @@ def estimate_tokens(request_json: dict) -> int:
                                   request_json.get("max_completion_tokens"))
     if not isinstance(max_tokens, (int, float)) or max_tokens <= 0:
         max_tokens = _DEFAULT_COMPLETION_TOKENS
-    return int(prompt_tokens + max_tokens)
+    return int(prompt_tokens), int(max_tokens)
+
+
+def estimate_tokens(request_json: dict) -> int:
+    """Cheap prompt+completion token estimate (see estimate_token_parts)."""
+    prompt_tokens, completion_tokens = estimate_token_parts(request_json)
+    return prompt_tokens + completion_tokens
 
 
 class AdmitResult:
@@ -146,7 +155,13 @@ class QoSGate:
         try:
             self._load()
             return True
-        except (ValueError, OSError, json.JSONDecodeError) as e:
+        except Exception as e:  # noqa: BLE001 -- any parse/validation error
+            # Broad on purpose: a torn or hostile tenants file can raise
+            # far more than json.JSONDecodeError (yaml.YAMLError,
+            # TypeError on odd shapes, RecursionError on nesting bombs).
+            # Whatever the failure, the admission path must keep serving
+            # with the last-good registry — never fail open to a
+            # zero-tenant default.
             logger.error("QoS tenants reload failed (%s); keeping previous "
                          "config: %s", self.tenants_file, e)
             self._mtime = mtime  # don't re-log every poll
@@ -212,6 +227,40 @@ class QoSGate:
         headers["x-ratelimit-remaining-tokens"] = _fmt_remaining(
             st.tok_bucket.remaining())
         return AdmitResult(True, "", 0.0, headers)
+
+    def reconcile(self, spec: TenantSpec, request_json: dict,
+                  response_body: bytes) -> float:
+        """Debit the tenant bucket with actual streamed usage.
+
+        Admission charged an estimate the *client* controls (prompt
+        chars + claimed max_tokens); a tenant understating max_tokens
+        while streaming long completions would otherwise get the
+        overage for free, every request.  After the response finishes
+        (or the client disconnects mid-stream — partial output was
+        still generated), measure what actually streamed and debit the
+        positive overage.  Returns the extra tokens debited (0.0 when
+        usage was at or under the estimate, or unmeasurable).
+
+        Only overage is charged — honest over-estimates are not
+        refunded, so padding max_tokens cannot be used to bank tokens.
+        """
+        measured = actual_tokens(response_body)
+        if measured is None:
+            return 0.0
+        tokens, scope = measured
+        prompt_est, completion_est = estimate_token_parts(request_json)
+        if scope == "completion":
+            # Chunk-count fallback covers the completion side only; add
+            # the same prompt estimate admission charged.
+            tokens += prompt_est
+        extra = float(tokens - (prompt_est + completion_est))
+        if extra <= 0:
+            return 0.0
+        st = self._state(spec)
+        if st.tok_bucket.unlimited:
+            return 0.0
+        st.tok_bucket.debit(extra)
+        return extra
 
     async def lease(self, spec: TenantSpec, priority: str,
                     request_json: dict) -> QueueLease:

@@ -119,12 +119,23 @@ class TenantRegistry:
     @classmethod
     def from_file(cls, path: str) -> "TenantRegistry":
         with open(path, encoding="utf-8") as f:
-            if path.endswith((".yaml", ".yml")):
-                import yaml
+            text = f.read()
+        # A zero-byte or whitespace-only file is almost always a torn
+        # read: a writer truncating before rewriting, caught mid-swap by
+        # the hot-reload poll.  yaml.safe_load would turn it into `None`
+        # and the registry would silently fail OPEN — every key mapping
+        # to default_tenant with no limits.  Refuse instead; the caller
+        # (QoSGate.maybe_reload) keeps the last-good registry.
+        if not text.strip():
+            raise ValueError(
+                f"tenants file {path}: empty (torn read?); refusing to "
+                "load a zero-tenant registry")
+        if path.endswith((".yaml", ".yml")):
+            import yaml
 
-                raw = yaml.safe_load(f) or {}
-            else:
-                raw = json.load(f)
+            raw = yaml.safe_load(text)
+        else:
+            raw = json.loads(text)
         if not isinstance(raw, dict):
             raise ValueError(f"tenants file {path}: expected a mapping")
         return cls.from_dict(raw)

@@ -45,6 +45,21 @@ class TokenBucket:
         deficit = min(amount, self.burst) - self._tokens
         return False, max(deficit / self.rate, 0.0)
 
+    def debit(self, amount: float, now: Optional[float] = None) -> None:
+        """Post-hoc charge for usage discovered after admission.
+
+        Admission quotes against an *estimate*; when the completed
+        request turns out to have consumed more (a tenant understating
+        max_tokens while streaming long completions), the overage is
+        debited here.  The balance may go negative — floored at -burst
+        so one huge response costs at most one extra full window — which
+        makes the next try_acquire fail until refill covers the debt.
+        """
+        if self.unlimited or amount <= 0:
+            return
+        self._refill(time.monotonic() if now is None else now)
+        self._tokens = max(self._tokens - amount, -self.burst)
+
     def remaining(self, now: Optional[float] = None) -> float:
         if self.unlimited:
             return float("inf")

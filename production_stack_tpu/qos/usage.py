@@ -1,0 +1,85 @@
+"""Measure *actual* token usage from a completed upstream response.
+
+QoS admission charges the tenant bucket with an estimate derived from
+the request (prompt chars / 4 + max_tokens).  That estimate is
+client-controlled: a tenant that understates `max_tokens` and then
+streams a long completion pays for 1 token and consumes 500.  After the
+response has fully streamed, the router calls `actual_tokens()` on the
+buffered body and debits the difference (QoSGate.reconcile) so gaming
+the estimator only works once per bucket window.
+
+Measurement sources, best first:
+
+1. A `usage` object in the response — non-streaming JSON bodies, or the
+   final SSE chunk when the engine emits stream usage.  Authoritative
+   (prompt + completion as counted by the engine).
+2. SSE chunk count — one `data:` event per streamed token in this
+   stack.  Covers completion tokens only; the caller adds back its own
+   prompt-side estimate so the comparison stays apples-to-apples.
+
+Returns None when the body is unusable (error JSON, empty, non-UTF8);
+the caller then skips reconciliation — never guesses.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Tuple
+
+# How the measured number relates to the admission estimate:
+#   "total"      — prompt + completion, engine-counted.
+#   "completion" — completion side only (SSE chunk count fallback).
+Measured = Tuple[int, str]
+
+
+def _usage_total(obj: object) -> Optional[int]:
+    if not isinstance(obj, dict):
+        return None
+    usage = obj.get("usage")
+    if not isinstance(usage, dict):
+        return None
+    total = usage.get("total_tokens")
+    if isinstance(total, (int, float)) and not isinstance(total, bool):
+        return max(int(total), 0)
+    prompt = usage.get("prompt_tokens", 0)
+    completion = usage.get("completion_tokens", 0)
+    if (isinstance(prompt, (int, float)) and not isinstance(prompt, bool)
+            and isinstance(completion, (int, float))
+            and not isinstance(completion, bool)):
+        return max(int(prompt) + int(completion), 0)
+    return None
+
+
+def actual_tokens(body: bytes) -> Optional[Measured]:
+    """Extract measured usage from a buffered response body."""
+    if not body:
+        return None
+    stripped = body.lstrip()
+    if not stripped.startswith(b"data:"):
+        try:
+            obj = json.loads(body)
+        except (ValueError, UnicodeDecodeError):
+            return None
+        total = _usage_total(obj)
+        return (total, "total") if total is not None else None
+    # SSE stream: one `data: {...}` event per line (blank-line separated).
+    events = []
+    for line in stripped.split(b"\n"):
+        line = line.strip()
+        if not line.startswith(b"data:"):
+            continue
+        payload = line[len(b"data:"):].strip()
+        if payload and payload != b"[DONE]":
+            events.append(payload)
+    if not events:
+        return None
+    # Engines that emit stream usage put it on one of the last chunks.
+    for payload in reversed(events[-4:]):
+        try:
+            obj = json.loads(payload)
+        except (ValueError, UnicodeDecodeError):
+            continue
+        total = _usage_total(obj)
+        if total is not None:
+            return (total, "total")
+    return (len(events), "completion")

@@ -92,6 +92,11 @@ tenant_queue_wait = Histogram(
     buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
              5.0, 10.0, 30.0, 60.0),
     registry=REGISTRY)
+qos_usage_reconciled = Counter(
+    "vllm_router:qos_usage_reconciled_tokens_total",
+    "Extra tokens debited post-completion when actual streamed usage "
+    "exceeded the admission estimate (tenants understating max_tokens)",
+    ["tenant"], registry=REGISTRY)
 
 # --- Fault tolerance (production_stack_tpu/router/fault_tolerance.py) ----
 # Series appear only with --fault-tolerance on (the retry/failover layer

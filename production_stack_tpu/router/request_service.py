@@ -40,6 +40,13 @@ HOP_BY_HOP = {
 }
 
 
+# Hostile-input bound for JSON request bodies (mirrors the engine-side
+# bound in production_stack_tpu/engine/server.py): big enough for any
+# real OpenAI payload, small enough that one request cannot balloon the
+# router's memory via full-body buffering.
+MAX_BODY_BYTES = 32 << 20
+
+
 # Identity headers are asserted by the router (QoS admission writes the
 # authenticated tenant and effective priority), never trusted from the
 # client: forwarding a client-supplied X-Tenant / X-Priority would let
@@ -232,12 +239,23 @@ async def route_general_request(
     body = await request.read()
     request_id = request.headers.get("X-Request-Id") or str(uuid.uuid4())
 
+    if len(body) > MAX_BODY_BYTES:
+        return web.json_response(
+            {"error": "Request body too large."}, status=413)
     try:
         request_json = json.loads(body) if body else {}
-    except json.JSONDecodeError:
+    except (ValueError, RecursionError):
+        # ValueError covers JSONDecodeError and UnicodeDecodeError;
+        # RecursionError is a nesting bomb blowing the C scanner's
+        # stack.  Either way: hostile input, clean 400, never a 500.
         return web.json_response(
             {"error": "Request body is not JSON parsable."}, status=400
         )
+    if not isinstance(request_json, dict):
+        # A non-object top level (e.g. `[]` or a bare string) would
+        # 500 later at request_json.get(...); reject it up front.
+        return web.json_response(
+            {"error": "Request body must be a JSON object."}, status=400)
 
     # Multi-tenant QoS admission (production_stack_tpu/qos/): resolve the
     # caller's tenant from its bearer key and run the token buckets.  With
@@ -389,6 +407,7 @@ async def route_general_request(
                 "router.qos_queue", queue_t0, queue_t0 + lease.wait_s,
                 parent=root, tenant=tenant.name, priority=priority)
 
+    full_response = bytearray()
     try:
         engine_stats = state.engine_stats_scraper.get_engine_stats()
         request_stats = state.request_stats_monitor.get_request_stats()
@@ -465,7 +484,6 @@ async def route_general_request(
                 state, request_id, server_url, endpoint, body, headers
             )
         response: Optional[web.StreamResponse] = None
-        full_response = bytearray()
         got_first_chunk = False
         try:
             try:
@@ -540,6 +558,23 @@ async def route_general_request(
     finally:
         if lease is not None:
             lease.release()
+        if qos is not None and tenant is not None:
+            # Usage reconciliation: the admission estimate trusted the
+            # client's max_tokens; debit the bucket with what actually
+            # streamed (runs on client aborts too — partial output was
+            # still generated) so understating max_tokens cannot buy
+            # sustained free throughput.
+            from production_stack_tpu.router import metrics as router_metrics
+            try:
+                extra = qos.reconcile(
+                    tenant, request_json, bytes(full_response))
+            except Exception:
+                logger.exception(
+                    "QoS usage reconciliation failed for %s", request_id)
+                extra = 0.0
+            if extra > 0:
+                router_metrics.qos_usage_reconciled.labels(
+                    tenant=tenant.name).inc(extra)
 
 
 async def send_request_to_prefiller(

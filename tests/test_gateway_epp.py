@@ -138,6 +138,23 @@ def test_epp_completion_prompt_and_file_watch(tmp_path):
         server.stop(0)
 
 
+def _raw_exchange(pb2, stub, raw: bytes):
+    """Headers + a raw (possibly hostile) body through the ext-proc
+    stream; returns both responses."""
+    def requests():
+        h = pb2.ProcessingRequest()
+        h.request_headers.headers.headers.add(
+            key=":path", raw_value=b"/v1/chat/completions")
+        h.request_headers.end_of_stream = False
+        yield h
+        b = pb2.ProcessingRequest()
+        b.request_body.body = raw
+        b.request_body.end_of_stream = True
+        yield b
+
+    return list(stub(requests()))
+
+
 def test_epp_malformed_body_clean_reject(epp):
     """Truncated and garbage request bodies must never crash the EPP:
     every exchange completes both phases cleanly (no stream error), and
@@ -146,18 +163,7 @@ def test_epp_malformed_body_clean_reject(epp):
     pb2, stub, _, _ = epp
 
     def raw_exchange(raw: bytes):
-        def requests():
-            h = pb2.ProcessingRequest()
-            h.request_headers.headers.headers.add(
-                key=":path", raw_value=b"/v1/chat/completions")
-            h.request_headers.end_of_stream = False
-            yield h
-            b = pb2.ProcessingRequest()
-            b.request_body.body = raw
-            b.request_body.end_of_stream = True
-            yield b
-
-        return list(stub(requests()))
+        return _raw_exchange(pb2, stub, raw)
 
     hostile = (
         b"",                                      # empty body
@@ -180,6 +186,35 @@ def test_epp_malformed_body_clean_reject(epp):
     good = _openai_exchange(pb2, stub, {
         "model": "m", "messages": [
             {"role": "user", "content": "still serving?"}]})
+    assert _dest(good[1]) in ("10.0.0.4:8000", "10.0.0.5:8000")
+
+
+# Table-driven replay of the shared fuzz corpus (native/epp/corpus/json)
+# over the PYTHON EPP path: the same hostile bodies the native fuzz
+# harness throws at the C++ server (minimized crashers + structural edge
+# cases) must also leave the Python data plane standing. One test per
+# corpus file so a regression names the exact input.
+
+_CORPUS_JSON_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native", "epp", "corpus", "json")
+_CORPUS_JSON = (sorted(os.listdir(_CORPUS_JSON_DIR))
+                if os.path.isdir(_CORPUS_JSON_DIR) else [])
+
+
+@pytest.mark.parametrize("name", _CORPUS_JSON)
+def test_epp_fuzz_corpus_replay_python(epp, name):
+    pb2, stub, _, _ = epp
+    with open(os.path.join(_CORPUS_JSON_DIR, name), "rb") as f:
+        raw = f.read()
+    responses = _raw_exchange(pb2, stub, raw)
+    # Both phases answer (no stream error, no crash, no hang) ...
+    assert len(responses) == 2, name
+    assert responses[1].WhichOneof("response") == "request_body"
+    # ... and the server still serves a well-formed request after.
+    good = _openai_exchange(pb2, stub, {
+        "model": "m", "messages": [
+            {"role": "user", "content": f"after {name}"}]})
     assert _dest(good[1]) in ("10.0.0.4:8000", "10.0.0.5:8000")
 
 
@@ -477,3 +512,30 @@ def test_native_epp_endpoints_file_watch(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+# ---- native fuzz harness smoke run -------------------------------------
+# The full 10k-iteration adversarial run (ASan/UBSan) lives in the CI
+# native-hardening job; this is a bounded deterministic smoke so local
+# runs with a built native/ tree catch protocol-error regressions too.
+
+_FUZZ_BIN = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native", "build", "tpu-stack-h2fuzz")
+_CORPUS_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native", "epp", "corpus")
+
+
+@pytest.mark.skipif(not os.path.exists(_FUZZ_BIN),
+                    reason="native fuzz harness not built")
+def test_native_h2fuzz_smoke():
+    import subprocess
+
+    proc = subprocess.run(
+        [_FUZZ_BIN, "--iterations", "250", "--seed", "7",
+         "--timeout-ms", "3000", "--corpus", _CORPUS_DIR],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, (
+        f"fuzz smoke failed:\n{proc.stdout[-4000:]}\n{proc.stderr[-4000:]}")
+    assert "PASS" in proc.stdout + proc.stderr

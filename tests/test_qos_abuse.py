@@ -1,0 +1,495 @@
+"""QoS abuse suite: the ways a tenant can game or break the admission
+layer, and the defenses that close them (ISSUE 8).
+
+- Token-bucket estimation gaming: admission charges an estimate the
+  CLIENT controls (prompt chars + claimed max_tokens).  A tenant that
+  understates max_tokens (e.g. sends it as a JSON string the estimator
+  ignores while the engine happily honors it) used to stream the
+  overage for free on every request.  Fixed by post-completion
+  reconciliation: the router measures what actually streamed and debits
+  the tenant bucket, driving it negative so the NEXT request throttles.
+- Hot-reload races: a torn/empty/unparseable tenants file mid-rewrite
+  must keep the last-good registry — never fail open to a zero-tenant
+  default where every key maps to the unlimited default tenant.
+- Fair-queue/gate accounting under adversarial interleavings: random
+  admit/cancel/shed storms must never leak a concurrency slot or
+  double-decrement the queued counters.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import random
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from production_stack_tpu.qos import QoSGate, ShedError
+from production_stack_tpu.qos.fair_queue import FairDispatchQueue
+from production_stack_tpu.qos.gate import estimate_token_parts, estimate_tokens
+from production_stack_tpu.qos.tenants import TenantRegistry
+from production_stack_tpu.qos.token_bucket import TokenBucket
+from production_stack_tpu.qos.usage import actual_tokens
+from production_stack_tpu.router import routing_logic as rl
+from production_stack_tpu.router.app import build_app
+from production_stack_tpu.router.engine_stats import EngineStatsScraper
+from production_stack_tpu.router.request_stats import RequestStatsMonitor
+from production_stack_tpu.testing.fake_engine import FakeEngine
+from production_stack_tpu.utils.misc import SingletonABCMeta, SingletonMeta
+
+# ---------------------------------------------------------------------------
+# TokenBucket.debit: the reconciliation primitive
+# ---------------------------------------------------------------------------
+
+
+def test_debit_drives_balance_negative_with_floor():
+    b = TokenBucket(rate=10, burst=20)
+    t0 = b._last
+    b.debit(25, now=t0)
+    # Negative balance, floored at -burst: one huge response costs at
+    # most one extra full window.
+    assert b.remaining(now=t0) == pytest.approx(-5)
+    b.debit(1000, now=t0)
+    assert b.remaining(now=t0) == pytest.approx(-20)
+    # In debt, nothing clears...
+    ok, retry = b.try_acquire(1, now=t0)
+    assert not ok and retry > 0
+    # ...until refill covers the debt plus the request.
+    ok, _ = b.try_acquire(1, now=t0 + 2.2)
+    assert ok
+
+
+def test_debit_noop_on_unlimited_and_nonpositive():
+    b = TokenBucket(rate=0, burst=0)
+    b.debit(10**9)
+    assert b.try_acquire(10**9) == (True, 0.0)
+    limited = TokenBucket(rate=5, burst=5)
+    t0 = limited._last
+    limited.debit(0, now=t0)
+    limited.debit(-50, now=t0)
+    assert limited.remaining(now=t0) == pytest.approx(5)
+
+
+# ---------------------------------------------------------------------------
+# usage.actual_tokens: measuring what really streamed
+# ---------------------------------------------------------------------------
+
+
+def test_actual_tokens_from_nonstream_usage():
+    body = json.dumps({"choices": [], "usage": {
+        "prompt_tokens": 7, "completion_tokens": 93,
+        "total_tokens": 100}}).encode()
+    assert actual_tokens(body) == (100, "total")
+    # total_tokens absent: prompt + completion still works.
+    body = json.dumps({"usage": {"prompt_tokens": 3,
+                                 "completion_tokens": 4}}).encode()
+    assert actual_tokens(body) == (7, "total")
+
+
+def test_actual_tokens_from_sse_usage_chunk():
+    chunks = [{"choices": [{"delta": {"content": "x"}}]}] * 3
+    chunks.append({"choices": [], "usage": {"total_tokens": 42}})
+    body = b"".join(
+        b"data: " + json.dumps(c).encode() + b"\n\n" for c in chunks
+    ) + b"data: [DONE]\n\n"
+    assert actual_tokens(body) == (42, "total")
+
+
+def test_actual_tokens_sse_fallback_counts_chunks():
+    body = b"".join(
+        b"data: " + json.dumps(
+            {"choices": [{"delta": {"content": "x"}}]}).encode() + b"\n\n"
+        for _ in range(17)
+    ) + b"data: [DONE]\n\n"
+    assert actual_tokens(body) == (17, "completion")
+
+
+def test_actual_tokens_unusable_bodies():
+    assert actual_tokens(b"") is None
+    assert actual_tokens(b"\xff\xfe not json") is None
+    assert actual_tokens(b'{"error": "boom"}') is None  # no usage
+    assert actual_tokens(b"[1, 2, 3]") is None
+    # Undecodable SSE events still COUNT (fallback path): a hostile
+    # stream can't zero out its own bill by garbling chunks.
+    assert actual_tokens(
+        b"data: \xff\xfe\n\ndata: [DONE]\n\n") == (1, "completion")
+
+
+# ---------------------------------------------------------------------------
+# Gate-level reconciliation
+# ---------------------------------------------------------------------------
+
+_LIMITS = {"tenants": [
+    {"name": "gamer", "api_keys": ["sk-g"], "weight": 1,
+     "priority": "interactive", "tokens_per_second": 100,
+     "burst_seconds": 2.0},
+]}
+
+
+def _gate(tmp_path, tenants=_LIMITS):
+    path = tmp_path / "tenants.json"
+    path.write_text(json.dumps(tenants))
+    return QoSGate(str(path), reload_interval_s=0.0)
+
+
+def test_estimator_gaming_vector_string_max_tokens():
+    """The concrete abuse: a string max_tokens is invisible to the
+    estimator (falls back to the 64-token default) but engines coerce
+    it and stream the full amount."""
+    honest = estimate_tokens({"prompt": "hi", "max_tokens": 400})
+    gamed = estimate_tokens({"prompt": "hi", "max_tokens": "400"})
+    assert honest > 400
+    assert gamed < 70  # the default estimate, not 400
+
+
+def test_reconcile_debits_overage_only(tmp_path):
+    gate = _gate(tmp_path)
+    spec = gate.resolve("Bearer sk-g")
+    req = {"prompt": "hi", "max_tokens": "400"}  # gamed: estimate ~65
+    assert gate.admit(spec, req).admitted
+    prompt_est, completion_est = estimate_token_parts(req)
+    est = prompt_est + completion_est
+    # The engine streamed 400 chunks anyway.
+    body = b"".join(
+        b"data: " + json.dumps(
+            {"choices": [{"delta": {"content": "Hello "}}]}).encode()
+        + b"\n\n" for _ in range(400)) + b"data: [DONE]\n\n"
+    extra = gate.reconcile(spec, req, body)
+    assert extra == pytest.approx(400 + prompt_est - est)
+    st = gate._state(spec)
+    assert st.tok_bucket.remaining() < 0
+    # At-or-under estimate: nothing debited (honest over-estimates are
+    # not refunded either, so padding max_tokens can't bank tokens).
+    before = st.tok_bucket.remaining()
+    assert gate.reconcile(spec, {"prompt": "hi", "max_tokens": 500},
+                          b'{"usage": {"total_tokens": 10}}') == 0.0
+    assert st.tok_bucket.remaining() == pytest.approx(before, abs=1.0)
+    # Unmeasurable body: no debit, never a guess.
+    assert gate.reconcile(spec, req, b"") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Hot-reload fail-closed (satellite 1)
+# ---------------------------------------------------------------------------
+
+_YAML_OK = """
+tenants:
+  - name: acme
+    api_keys: ["sk-acme"]
+    requests_per_second: 5
+"""
+
+
+def test_from_file_refuses_empty_file(tmp_path):
+    path = tmp_path / "tenants.yaml"
+    path.write_text("")
+    with pytest.raises(ValueError, match="torn read"):
+        TenantRegistry.from_file(str(path))
+    path.write_text("   \n\n  ")
+    with pytest.raises(ValueError):
+        TenantRegistry.from_file(str(path))
+
+
+def test_hot_reload_keeps_last_good_on_torn_or_hostile_file(tmp_path):
+    path = tmp_path / "tenants.yaml"
+    path.write_text(_YAML_OK)
+    gate = QoSGate(str(path), reload_interval_s=0.0)
+    assert gate.resolve("Bearer sk-acme").name == "acme"
+
+    # Torn read: writer truncated the file before rewriting.  The old
+    # code fed yaml.safe_load(None-ish) into a ZERO-tenant registry —
+    # every key silently became the unlimited default tenant.
+    path.write_text("")
+    os.utime(path, (1, 1))
+    assert not gate.maybe_reload(force=True)
+    assert gate.resolve("Bearer sk-acme").name == "acme"
+
+    # Unparseable YAML raises yaml.YAMLError, which the old except
+    # clause did not catch — it escaped into the admission path.
+    path.write_text("tenants: [{name: ][")
+    os.utime(path, (2, 2))
+    assert not gate.maybe_reload(force=True)
+    assert gate.resolve("Bearer sk-acme").name == "acme"
+
+    # Valid-YAML-wrong-shape (a list, not a mapping) and bad specs also
+    # keep the last-good registry.
+    path.write_text("- just\n- a\n- list\n")
+    os.utime(path, (3, 3))
+    assert not gate.maybe_reload(force=True)
+    assert gate.resolve("Bearer sk-acme").name == "acme"
+    path.write_text("tenants:\n  - name: x\n    weight: 0\n")
+    os.utime(path, (4, 4))
+    assert not gate.maybe_reload(force=True)
+    assert gate.resolve("Bearer sk-acme").name == "acme"
+
+    # The writer finishes its rewrite: the new registry is picked up.
+    path.write_text(_YAML_OK.replace("acme", "acme2"))
+    os.utime(path, (5, 5))
+    assert gate.maybe_reload(force=True)
+    assert gate.resolve("Bearer sk-acme2").name == "acme2"
+    assert gate.resolve("Bearer sk-acme").name == "default"
+
+
+# ---------------------------------------------------------------------------
+# Property test: random admit/cancel/shed interleavings (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+async def test_fair_queue_random_interleavings_never_leak_slots():
+    """Drive the queue with randomized storms of acquires, cancellations
+    at every await boundary, sheds, and releases.  Invariants: inflight
+    and every _queued counter return to exactly zero (a leak or a
+    double-decrement is permanent — release() floors at 0 but _pump
+    would stall forever on a leaked slot), and the queue still
+    dispatches afterwards."""
+    rng = random.Random(20260805)
+    for _ in range(25):
+        q = FairDispatchQueue(max_concurrency=rng.randint(1, 4),
+                              shed_queue_depth=rng.choice([0, 1, 3]))
+        held, tasks = [], []
+
+        async def worker(i, q=q, held=held, rng=rng):
+            try:
+                lease = await q.acquire(
+                    f"t{i % 3}", weight=rng.choice([1.0, 4.0]),
+                    priority=rng.choice(["interactive", "batch"]),
+                    cost=rng.choice([1.0, 64.0, 512.0]))
+            except ShedError:
+                return
+            held.append(lease)
+
+        for i in range(rng.randint(6, 18)):
+            tasks.append(asyncio.ensure_future(worker(i)))
+            r = rng.random()
+            if r < 0.5:
+                await asyncio.sleep(0)
+            if r < 0.25 and tasks:
+                rng.choice(tasks).cancel()
+            if rng.random() < 0.4 and held:
+                held.pop(rng.randrange(len(held))).release()
+
+        # Settle: keep releasing whatever dispatched until every worker
+        # has finished (dispatched, shed, or cancelled).
+        for _ in range(500):
+            await asyncio.sleep(0)
+            while held:
+                held.pop().release()
+            if all(t.done() for t in tasks):
+                break
+        else:
+            pytest.fail("queue wedged: workers never settled "
+                        "(leaked dispatch slot)")
+        await asyncio.gather(*tasks, return_exceptions=True)
+        while held:
+            held.pop().release()
+
+        assert q.inflight == 0
+        assert q._inflight_interactive == 0
+        assert q._queued == {"interactive": 0, "batch": 0}
+        # Still functional after the storm.
+        lease = await asyncio.wait_for(
+            q.acquire("after", priority="batch"), 1)
+        lease.release()
+        assert q.inflight == 0
+
+
+def test_admit_refund_never_overfills_request_bucket(tmp_path):
+    """admit() refunds the request-bucket token when the token bucket
+    rejects; a buggy refund would overfill past burst and mint free
+    requests/s.  Hammer the rejection path and check the cap."""
+    gate = _gate(tmp_path, {"tenants": [
+        {"name": "t", "api_keys": ["sk-t"], "requests_per_second": 5,
+         "tokens_per_second": 50, "burst_seconds": 1.0}]})
+    spec = gate.resolve("Bearer sk-t")
+    st = gate._state(spec)
+    rng = random.Random(7)
+    for _ in range(200):
+        gate.admit(spec, {"prompt": "x" * rng.randrange(0, 2000),
+                          "max_tokens": rng.choice([1, 40, 400])})
+        assert st.req_bucket._tokens <= st.req_bucket.burst + 1e-9
+        assert st.tok_bucket._tokens <= st.tok_bucket.burst + 1e-9
+
+
+# ---------------------------------------------------------------------------
+# Router end-to-end: the gaming tenant is throttled within one window
+# ---------------------------------------------------------------------------
+
+
+def _args(**overrides) -> argparse.Namespace:
+    from production_stack_tpu.router.parser import build_parser
+
+    args = build_parser().parse_args([])
+    for k, v in overrides.items():
+        setattr(args, k, v)
+    return args
+
+
+async def _start(app: web.Application):
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    def _reset():
+        for cls in (
+            rl.RoundRobinRouter, rl.SessionRouter, rl.PrefixAwareRouter,
+            rl.KvawareRouter, rl.DisaggregatedPrefillRouter,
+        ):
+            SingletonABCMeta._reset_instance(cls)
+        SingletonMeta._reset_instance(RequestStatsMonitor)
+        SingletonMeta._reset_instance(EngineStatsScraper)
+
+    _reset()
+    yield
+    _reset()
+
+
+async def _qos_router(tmp_path, tenants):
+    tenants_file = str(tmp_path / "tenants.json")
+    with open(tenants_file, "w") as f:
+        json.dump(tenants, f)
+    engine = FakeEngine(model="test-model")
+    eng_runner, eng_url = await _start(engine.make_app())
+    args = _args(
+        static_backends=eng_url,
+        static_models="test-model",
+        engine_stats_interval=60,
+        qos_tenants_file=tenants_file,
+    )
+    app = build_app(args)
+    router_runner, router_url = await _start(app)
+    return engine, app, router_url, [eng_runner, router_runner]
+
+
+async def _cleanup(runners):
+    for r in reversed(runners):
+        await r.cleanup()
+
+
+async def test_gaming_tenant_throttled_within_one_window(tmp_path):
+    """Acceptance case: tenant 'gamer' understates max_tokens (string →
+    estimator charges the 64-token default) and streams a 400-token
+    completion.  Reconciliation debits the real usage, so its very next
+    request 429s — throttled to the configured tokens/s within one
+    bucket window — while tenant 'honest' with the same limits keeps
+    being served."""
+    tenants = {"tenants": [
+        {"name": "gamer", "api_keys": ["sk-gamer"], "weight": 1,
+         "tokens_per_second": 100, "burst_seconds": 2.0},
+        {"name": "honest", "api_keys": ["sk-honest"], "weight": 1,
+         "tokens_per_second": 100, "burst_seconds": 2.0},
+    ]}
+    engine, app, url, runners = await _qos_router(tmp_path, tenants)
+    try:
+        gamed = {"model": "test-model", "stream": True,
+                 "max_tokens": "400",  # string: invisible to the estimator
+                 "messages": [{"role": "user", "content": "hi"}]}
+        small = {"model": "test-model", "max_tokens": 2,
+                 "messages": [{"role": "user", "content": "hi"}]}
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{url}/v1/chat/completions", json=gamed,
+                              headers={"Authorization": "Bearer sk-gamer"}
+                              ) as resp:
+                assert resp.status == 200
+                body = await resp.read()
+            # ~400 streamed chunks made it through on a ~65-token charge.
+            assert body.count(b"data:") > 390
+            await asyncio.sleep(0.05)  # let the handler's finally run
+
+            # Reconciliation drove the bucket negative (400-token debit
+            # against a 200-token burst, floored at -burst)...
+            qos = app["state"].qos
+            st = qos._state(qos.resolve("Bearer sk-gamer"))
+            assert st.tok_bucket.remaining() < -50
+
+            # ...so even a tiny follow-up request is throttled.
+            async with s.post(f"{url}/v1/chat/completions", json=small,
+                              headers={"Authorization": "Bearer sk-gamer"}
+                              ) as resp:
+                assert resp.status == 429
+                err = await resp.json()
+                assert "tokens" in err["error"]["message"]
+                assert int(resp.headers["Retry-After"]) >= 1
+
+            # Same limits, honest usage: still served.
+            async with s.post(f"{url}/v1/chat/completions", json=small,
+                              headers={"Authorization": "Bearer sk-honest"}
+                              ) as resp:
+                assert resp.status == 200
+
+            async with s.get(f"{url}/metrics") as resp:
+                text = await resp.text()
+        # The overage is visible on the reconciliation counter.
+        line = [ln for ln in text.splitlines()
+                if ln.startswith("vllm_router:qos_usage_reconciled_tokens_"
+                                 "total") and 'tenant="gamer"' in ln]
+        assert line and float(line[0].split()[-1]) > 300
+    finally:
+        await _cleanup(runners)
+
+
+async def test_nonstream_usage_reconciled_from_engine_usage(tmp_path):
+    """Non-streaming responses reconcile from the engine-reported usage
+    object (authoritative), same gaming vector."""
+    tenants = {"tenants": [
+        {"name": "gamer", "api_keys": ["sk-gamer"], "weight": 1,
+         "tokens_per_second": 100, "burst_seconds": 2.0}]}
+    engine, app, url, runners = await _qos_router(tmp_path, tenants)
+    try:
+        gamed = {"model": "test-model", "max_tokens": "300",
+                 "messages": [{"role": "user", "content": "hi"}]}
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{url}/v1/chat/completions", json=gamed,
+                              headers={"Authorization": "Bearer sk-gamer"}
+                              ) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+        assert body["usage"]["total_tokens"] == 305
+        await asyncio.sleep(0.05)
+        qos = app["state"].qos
+        st = qos._state(qos.resolve("Bearer sk-gamer"))
+        # 305 actual vs ~65 estimated: bucket deep in debt.
+        assert st.tok_bucket.remaining() < -50
+    finally:
+        await _cleanup(runners)
+
+
+# ---------------------------------------------------------------------------
+# Hostile request bodies at the router: 4xx, never a 500
+# ---------------------------------------------------------------------------
+
+
+async def test_router_hostile_bodies_get_4xx_never_5xx(tmp_path):
+    engine, app, url, runners = await _qos_router(
+        tmp_path, {"tenants": [{"name": "t", "api_keys": ["sk-t"]}]})
+    try:
+        hostile = [
+            b"{truncated",
+            b"\xff\xfe not utf8",
+            b"[" * 3000 + b"]" * 3000,   # nesting bomb -> RecursionError
+            b'"just a string"',          # non-object top level
+            b"[1,2,3]",
+        ]
+        async with aiohttp.ClientSession() as s:
+            for raw in hostile:
+                async with s.post(
+                        f"{url}/v1/chat/completions", data=raw,
+                        headers={"Content-Type": "application/json",
+                                 "Authorization": "Bearer sk-t"}) as resp:
+                    assert 400 <= resp.status < 500, raw[:30]
+            # The worker is not wedged: a good request still completes.
+            async with s.post(
+                    f"{url}/v1/chat/completions",
+                    json={"model": "test-model", "max_tokens": 2,
+                          "messages": [{"role": "user", "content": "ok"}]},
+                    headers={"Authorization": "Bearer sk-t"}) as resp:
+                assert resp.status == 200
+    finally:
+        await _cleanup(runners)
