@@ -187,11 +187,16 @@ QOS_PREFILL_CHUNKS = _env_int("BENCH_QOS_PREFILL_CHUNKS", 8)
 # harness (production_stack_tpu/testing/chaos_ab.py — 3 fake replicas,
 # real router, no TPU, no jax import): mid-storm one replica is killed
 # and another hung before first byte, with router fault tolerance ON
-# then OFF. Writes BENCH_CHAOS_OUT (default BENCH_CHAOS.json) with
+# then OFF. Writes BENCH_CHAOS_OUT (default BENCH_CHAOS_r09.json) with
 # completion rate + p99 for both legs. Acceptance: ON completes >= 99%
 # with p99 bounded near the TTFT deadline; OFF is the failure baseline.
+# A third leg (BENCH_CHAOS_KILL9, default on) kill -9's a claim-holding
+# replica with the fleet cache on and the breaker disabled: the KV claim
+# lease alone must sweep the corpse and stop stale-holder /kv/pulls
+# within one lease window.
 CHAOS = _env_int("BENCH_CHAOS", 0)
-CHAOS_OUT = os.environ.get("BENCH_CHAOS_OUT", "BENCH_CHAOS.json")
+CHAOS_OUT = os.environ.get("BENCH_CHAOS_OUT", "BENCH_CHAOS_r09.json")
+CHAOS_KILL9 = _env_int("BENCH_CHAOS_KILL9", 1)
 CHAOS_TOTAL = _env_int("BENCH_CHAOS_TOTAL", 120)
 CHAOS_CONCURRENCY = _env_int("BENCH_CHAOS_CONCURRENCY", 12)
 CHAOS_AFTER = _env_int("BENCH_CHAOS_AFTER", 30)
@@ -208,6 +213,12 @@ FLEET_USERS = _env_int("BENCH_FLEET_USERS", 10)
 FLEET_ROUNDS = _env_int("BENCH_FLEET_ROUNDS", 3)
 FLEET_CONCURRENCY = _env_int("BENCH_FLEET_CONCURRENCY", 4)
 FLEET_TTFT = _env_float("BENCH_FLEET_TTFT", 0.2)
+# --cold-repeat N: N fully cold serves, each in its own subprocess (no
+# warm jit caches, no reused pools — the cold-start number operators
+# actually see on a fresh replica). The artifact is rewritten and
+# fsynced after EVERY iteration, so a crash mid-run keeps the
+# completed ones.
+COLD_OUT = os.environ.get("BENCH_COLD_OUT", "BENCH_COLD_r09.json")
 
 
 def _load_baseline() -> float:
@@ -699,7 +710,8 @@ def _chaos_main() -> None:
     result = asyncio.run(run_chaos_ab(
         total=CHAOS_TOTAL, concurrency=CHAOS_CONCURRENCY,
         chaos_after=CHAOS_AFTER, client_timeout_s=CHAOS_CLIENT_TIMEOUT,
-        ttft_deadline_s=CHAOS_TTFT_DEADLINE))
+        ttft_deadline_s=CHAOS_TTFT_DEADLINE,
+        include_kill9=bool(CHAOS_KILL9)))
     result["backend"] = "fake"
     with open(os.path.join(REPO, CHAOS_OUT), "w") as f:
         json.dump(result, f, indent=2)
@@ -722,11 +734,76 @@ def _fleet_main() -> None:
     print(json.dumps(result))
 
 
+def _cold_repeat_main(n: int, cpu: bool) -> None:
+    """--cold-repeat N: run the configured scenario N times, each in an
+    isolated subprocess so every serve is fully cold (fresh interpreter,
+    fresh jit, fresh KV pool). Per-iteration results are flushed to
+    COLD_OUT as they land."""
+    import subprocess
+
+    out_path = os.path.join(REPO, COLD_OUT)
+    iters: list = []
+    summary: dict = {}
+    for i in range(n):
+        cmd = [sys.executable, os.path.abspath(__file__)]
+        if cpu:
+            cmd.append("--cpu")
+        t0 = time.time()
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        wall = round(time.time() - t0, 2)
+        parsed = None
+        # The child prints ONE JSON line last; partial-progress lines
+        # may precede it, so scan from the end.
+        for line in reversed((proc.stdout or "").strip().splitlines()):
+            try:
+                parsed = json.loads(line)
+                break
+            except ValueError:
+                continue
+        iters.append({
+            "iteration": i,
+            "wall_s": wall,
+            "returncode": proc.returncode,
+            "result": parsed,
+            "stderr_tail": ((proc.stderr or "")[-2000:]
+                            if proc.returncode else None),
+        })
+        values = [it["result"]["value"] for it in iters
+                  if it["result"] and it["result"].get("value") is not None]
+        summary = {
+            "metric": "cold_serve_repeat",
+            "unit": (iters[0]["result"] or {}).get("unit"),
+            "value": (statistics.median(values) if values else None),
+            "iterations_done": len(iters),
+            "iterations_total": n,
+            "values": values,
+            "wall_s_per_iteration": [it["wall_s"] for it in iters],
+            "iterations": iters,
+        }
+        with open(out_path, "w") as f:
+            json.dump(summary, f, indent=2)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        print(json.dumps({"cold_iteration": i, "wall_s": wall,
+                          "value": (parsed or {}).get("value"),
+                          "returncode": proc.returncode}), flush=True)
+    print(json.dumps(summary))
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--cpu", action="store_true",
                         help="force CPU backend (for smoke testing)")
+    parser.add_argument("--cold-repeat", type=int, default=0, metavar="N",
+                        help="run the scenario N times, each in an "
+                             "isolated subprocess (fully cold serve); "
+                             "per-iteration results flushed to "
+                             "BENCH_COLD_OUT")
     args = parser.parse_args()
+    if args.cold_repeat > 0:
+        _cold_repeat_main(args.cold_repeat, args.cpu)
+        return
     if QOS:
         _qos_main()
         return

@@ -100,22 +100,70 @@ def render_prompt(body_json: dict) -> str:
     return prompt if isinstance(prompt, str) else ""
 
 
+def _norm_endpoint(url: str) -> str:
+    """Router-side url (``http://ip:port/``) -> EPP endpoint (``ip:port``)."""
+    u = url.strip().rstrip("/")
+    for scheme in ("http://", "https://"):
+        if u.startswith(scheme):
+            u = u[len(scheme):]
+    return u
+
+
 class EndpointState:
     """Server-side endpoint set: static list or a watched file (one
-    endpoint per line — a ConfigMap mount the pool controller updates)."""
+    endpoint per line — a ConfigMap mount the pool controller updates).
 
-    def __init__(self, endpoints, watch_file=None, interval=5.0):
+    The pick set is additionally filtered by an exclusion view: with
+    ``--router-url`` set, the router's lease health (GET /kv/instances,
+    ``expired_urls``) is polled so a kill -9'd replica whose KV heartbeat
+    lease lapsed stops receiving gateway picks too — same health view as
+    the router's own service discovery, not a second opinion."""
+
+    def __init__(self, endpoints, watch_file=None, interval=5.0,
+                 router_url=None, health_interval=5.0):
         self._endpoints = list(endpoints)
         self._file = watch_file
         self._interval = interval
+        self._router_url = router_url.rstrip("/") if router_url else None
+        self._health_interval = health_interval
+        self._excluded: set = set()
         self._lock = threading.Lock()
         if watch_file:
             t = threading.Thread(target=self._watch, daemon=True)
             t.start()
+        if self._router_url:
+            t = threading.Thread(target=self._poll_health, daemon=True)
+            t.start()
 
     def endpoints(self):
         with self._lock:
-            return list(self._endpoints)
+            return [e for e in self._endpoints if e not in self._excluded]
+
+    def set_excluded(self, urls) -> None:
+        """Replace the exclusion set (router urls or bare ip:port). An
+        endpoint stays out of every pick until the view clears it — for
+        a lease-expired replica that is its next-generation re-register."""
+        with self._lock:
+            self._excluded = {_norm_endpoint(u) for u in urls}
+
+    def excluded(self):
+        with self._lock:
+            return set(self._excluded)
+
+    def _poll_health(self):
+        import json
+        import urllib.request
+
+        while True:
+            try:
+                with urllib.request.urlopen(
+                        f"{self._router_url}/kv/instances",
+                        timeout=5) as resp:
+                    body = json.loads(resp.read().decode())
+                self.set_excluded(body.get("expired_urls") or [])
+            except Exception as e:  # noqa: BLE001 - keep picking on a
+                logger.debug("health poll failed: %s", e)  # router outage
+            time.sleep(self._health_interval)
 
     def _watch(self):
         last = None
@@ -246,12 +294,20 @@ def main() -> None:
                              "(ConfigMap mount)")
     parser.add_argument("--algorithm", default="prefix",
                         choices=["prefix", "kv", "roundrobin"])
+    parser.add_argument("--router-url", default=None,
+                        help="router base url; polls GET /kv/instances "
+                             "and excludes lease-expired endpoints from "
+                             "picks (same health view as the router)")
+    parser.add_argument("--health-interval", type=float, default=5.0,
+                        help="seconds between router health polls")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
     state = EndpointState(
         [e for e in args.endpoints.split(",") if e],
-        watch_file=args.endpoints_file)
+        watch_file=args.endpoints_file,
+        router_url=args.router_url,
+        health_interval=args.health_interval)
     server, bound, _ = build_server(args.port, state, args.algorithm)
     server.start()
     logger.info("EPP (ext-proc) on :%d, algorithm=%s", bound, args.algorithm)

@@ -86,6 +86,18 @@
 - --kv-remote-url
 - {{ .model.kvRemoteUrl | quote }}
 {{- end }}
+{{- if .model.kvHeartbeatInterval }}
+- --kv-heartbeat-interval
+- {{ .model.kvHeartbeatInterval | quote }}
+{{- end }}
+{{- if .model.kvResyncInterval }}
+- --kv-resync-interval
+- {{ .model.kvResyncInterval | quote }}
+{{- end }}
+{{- if .model.kvPullMaxConcurrency }}
+- --kv-pull-max-concurrency
+- {{ .model.kvPullMaxConcurrency | quote }}
+{{- end }}
 {{- if .model.quantization }}
 - --quantization
 - {{ .model.quantization | quote }}

@@ -505,7 +505,57 @@ def build_dashboard():
              "capacity"))
     y += 7
 
-    # ---- Row 11: Current Resource Usage (ref panels 14-19) -------------- #
+    # ---- Row 11: Fleet Health (docs/fleet.md failure modes) ------------- #
+    panels.append(row("Fleet Health", y)); y += 1
+    panels.append(panel(
+        "timeseries", "KV controller instances by state",
+        [target("vllm_router:kv_controller_instances",
+                legend="{{state}}")],
+        grid(7, 8, 0, y),
+        desc="Instance table by lease state: live (beating, or no "
+             "lease), expired (missed --kv-lease-misses heartbeats — "
+             "claims swept, URL excluded from routing and EPP picks), "
+             "l3 (the shared-cache pseudo-instance). Persistent "
+             "expired > 0 is a dead replica that never came back"))
+    panels.append(panel(
+        "timeseries", "KV claims swept (rate, by reason)",
+        [target("rate(vllm_router:kv_claims_swept_total[5m])",
+                legend="{{reason}}")],
+        grid(7, 8, 8, y),
+        desc="Self-healing activity: expired = lease lapse (kill -9 / "
+             "OOM-killed replica), regenerated = same instance or URL "
+             "re-registered with a new process generation (restart), "
+             "resync = anti-entropy digest mismatch healed a "
+             "timeout-swallowed admit/evict report"))
+    panels.append(panel(
+        "timeseries", "Pull stampede control",
+        [target("rate(vllm_router:kv_pull_rejected_total[5m])",
+                legend="router rejects {{server}}"),
+         target("rate(tpu:kv_pull_rejected_total[5m])",
+                legend="{{instance}} 503s"),
+         target("tpu:kv_pull_inflight",
+                legend="{{instance}} inflight")],
+        grid(7, 8, 16, y),
+        desc="Holder-side /kv/pull admission (--kv-pull-max-"
+             "concurrency): inflight transfers per engine, engine 503s "
+             "at the gate, and router-side pulls degraded to recompute "
+             "(cap hit or holder rejected); sustained rejects mean a "
+             "hot prefix is pinned to too few holders"))
+    y += 7
+    panels.append(panel(
+        "timeseries", "Evict-report stream health",
+        [target("rate(tpu:prefix_evicts_total[5m])",
+                legend="{{instance}} evicts dispatched"),
+         target("rate(tpu:evict_listener_errors_total[5m])",
+                legend="{{instance}} listener errors")],
+        grid(7, 8, 0, y),
+        desc="Prefix-eviction events dispatched to the controller "
+             "report path and listener callbacks that raised; a "
+             "nonzero error rate means reports are being dropped and "
+             "the anti-entropy resync is doing the healing"))
+    y += 7
+
+    # ---- Row 12: Current Resource Usage (ref panels 14-19) -------------- #
     panels.append(row("Current Resource Usage", y)); y += 1
     panels.append(panel(
         "timeseries", "Router CPU usage",

@@ -380,6 +380,13 @@ class EngineCore:
         # Fired under the engine locks: listeners must not block.
         self.prefix_evict_listener: Optional[
             Callable[[int, int], None]] = None
+        # Eviction accounting for the anti-entropy layer: dispatches vs.
+        # listener failures. A listener that throws (or a report the
+        # server later loses to a timeout) leaves the controller trie
+        # claiming chunks this engine no longer serves — the drift the
+        # periodic resync digest exists to detect and heal.
+        self.prefix_evicts_total = 0
+        self.evict_listener_errors_total = 0
 
         def _dispatch_evict(prefix_hash: int, bid: int) -> None:
             if self.offload is not None:
@@ -390,12 +397,13 @@ class EngineCore:
                 # second tier later drops age out via the admit TTL.
                 self._offload_block(prefix_hash, bid)
                 return
+            self.prefix_evicts_total += 1
             listener = self.prefix_evict_listener
             if listener is not None:
                 try:
                     listener(prefix_hash, bid)
                 except Exception:  # noqa: BLE001 - never break the allocator
-                    pass
+                    self.evict_listener_errors_total += 1
 
         self.kv_mgr.allocator.on_evict = _dispatch_evict
 
@@ -2099,6 +2107,8 @@ class EngineCore:
             "generation_tokens_total": self.generation_tokens_total,
             "offload": self.offload.stats() if self.offload else None,
             "requests_finished_total": self.requests_finished_total,
+            "prefix_evicts_total": self.prefix_evicts_total,
+            "evict_listener_errors_total": self.evict_listener_errors_total,
             "num_preempted_total": self.scheduler.num_preempted_total,
             "num_blocks": self.num_blocks,
             "hbm_headroom_bytes": self.hbm_headroom_bytes,

@@ -125,6 +125,9 @@ class EngineServer:
                  instance_id: Optional[str] = None,
                  advertise_url: Optional[str] = None,
                  api_key: Optional[str] = None,
+                 kv_heartbeat_interval: float = 10.0,
+                 kv_resync_interval: float = 60.0,
+                 kv_pull_max_concurrency: int = 8,
                  trace_buffer: int = 512,
                  slow_trace_threshold_s: float = 0.0,
                  trace_export: Optional[str] = None):
@@ -153,6 +156,23 @@ class EngineServer:
         self.instance_id = instance_id or f"engine-{uuid.uuid4().hex[:8]}"
         self.advertise_url = advertise_url
         self._kv_registered = False
+        # Crash consistency (leases + anti-entropy): each PROCESS gets a
+        # fresh generation id, so a same-URL restart registers as a new
+        # incarnation and the controller atomically sweeps the dead one's
+        # claims. The heartbeat task renews the lease; the resync task
+        # heals drift from timeout-swallowed admit/evict reports.
+        self.generation = uuid.uuid4().hex
+        self.kv_heartbeat_interval = float(kv_heartbeat_interval)
+        self.kv_resync_interval = float(kv_resync_interval)
+        self._kv_tasks: "list[asyncio.Task]" = []
+        # /kv/pull admission: at most this many concurrent transfers are
+        # served before excess pulls get 503 + Retry-After (the router
+        # degrades to recompute). The counter doubles as the
+        # tpu:kv_pull_inflight gauge; single-threaded event loop, so the
+        # check-then-increment below is race-free.
+        self.kv_pull_max_concurrency = max(1, int(kv_pull_max_concurrency))
+        self._pull_inflight = 0
+        self.kv_pull_rejected_total = 0
         # Admission registry for eviction reporting: maps this engine's
         # page chain-hashes back to the controller's text-chunk hashes so
         # a dropped chain is reported with /kv/evict instead of lingering
@@ -215,6 +235,26 @@ class EngineServer:
         if self.advertise_url is None:
             self.advertise_url = own_url
         await self._kv_register()
+        if self.kv_heartbeat_interval > 0:
+            self._kv_tasks.append(
+                self._loop.create_task(self._kv_heartbeat_loop()))
+        if self.kv_resync_interval > 0:
+            self._kv_tasks.append(
+                self._loop.create_task(self._kv_resync_loop()))
+
+    async def stop_kv_reporting(self) -> None:
+        """Cancel the heartbeat/resync background tasks. Called on app
+        cleanup AND on drain: a draining engine that kept beating (or
+        whose heartbeat re-registered after the drain's /kv/deregister)
+        would pull routable claims back onto a disappearing replica."""
+        tasks, self._kv_tasks = self._kv_tasks, []
+        for t in tasks:
+            t.cancel()
+        for t in tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
 
     async def _kv_register(self) -> bool:
         import aiohttp
@@ -224,7 +264,9 @@ class EngineServer:
                 async with s.post(
                     f"{self.kv_controller_url}/kv/register",
                     json={"instance_id": self.instance_id,
-                          "url": self.advertise_url},
+                          "url": self.advertise_url,
+                          "generation": self.generation,
+                          "heartbeat_interval": self.kv_heartbeat_interval},
                     timeout=aiohttp.ClientTimeout(total=5),
                 ) as resp:
                     self._kv_registered = resp.status == 200
@@ -232,6 +274,110 @@ class EngineServer:
             logger.debug("KV controller register failed: %s", e)
             self._kv_registered = False
         return self._kv_registered
+
+    async def _kv_heartbeat_loop(self) -> None:
+        """Lease renewal: a controller that stops hearing these beats
+        expires this instance after ``--kv-lease-misses`` intervals and
+        sweeps its claims, so a kill -9'd replica stops being a pull
+        target within one lease window."""
+        import aiohttp
+
+        while True:
+            await asyncio.sleep(self.kv_heartbeat_interval)
+            body: dict = {}
+            try:
+                async with aiohttp.ClientSession(
+                        headers=self._auth_headers()) as s:
+                    async with s.post(
+                        f"{self.kv_controller_url}/kv/heartbeat",
+                        json={"instance_id": self.instance_id,
+                              "generation": self.generation,
+                              "heartbeat_interval": self.kv_heartbeat_interval,
+                              "url": self.advertise_url},
+                        timeout=aiohttp.ClientTimeout(total=5),
+                    ) as resp:
+                        if resp.status == 200:
+                            body = await resp.json()
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                logger.debug("KV heartbeat failed: %s", e)
+                continue
+            if not body.get("known"):
+                # Controller restarted or superseded this record:
+                # re-register, then push authoritative state.
+                if await self._kv_register():
+                    await self._kv_resync(force=True)
+            elif body.get("revived"):
+                # Our lease HAD expired (process paused, not dead): the
+                # claims were swept — restore them from the registry.
+                logger.info("KV lease revived; resyncing swept claims")
+                await self._kv_resync(force=True)
+
+    async def _kv_resync_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.kv_resync_interval)
+            try:
+                await self._kv_resync()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 - resync is best-effort
+                logger.debug("KV resync failed: %s", e)
+
+    def _admitted_paths(self) -> "list[list[int]]":
+        """Root-anchored chunk-hash paths this engine still serves — the
+        engine-side truth the anti-entropy digest is computed from."""
+        paths: "list[list[int]]" = []
+        seen: "set[tuple]" = set()
+        with self._adm_lock:
+            for chunks, _blocks in self._admissions.values():
+                t = tuple(int(h) for h in chunks)
+                if t and t not in seen:
+                    seen.add(t)
+                    paths.append(list(t))
+        return paths
+
+    async def _kv_resync(self, force: bool = False) -> None:
+        """Anti-entropy round: compare claim digests with the controller
+        and, on mismatch (or ``force``), replace our claims wholesale.
+        Heals admit/evict reports lost to swallowed timeouts."""
+        import aiohttp
+
+        from production_stack_tpu.kv.controller import claim_digest, path_keys
+
+        paths = self._admitted_paths()
+        keys: "set[int]" = set()
+        for p in paths:
+            keys.update(path_keys(p))
+        count, xor = claim_digest(keys)
+        try:
+            async with aiohttp.ClientSession(headers=self._auth_headers()) as s:
+                if not force:
+                    check: dict = {}
+                    async with s.post(
+                        f"{self.kv_controller_url}/kv/resync",
+                        json={"instance_id": self.instance_id,
+                              "count": count, "xor": xor},
+                        timeout=aiohttp.ClientTimeout(total=5),
+                    ) as resp:
+                        if resp.status == 200:
+                            check = await resp.json()
+                    if check.get("match"):
+                        return
+                    if not check.get("known") and not await self._kv_register():
+                        return
+                async with s.post(
+                    f"{self.kv_controller_url}/kv/resync_state",
+                    json={"instance_id": self.instance_id, "paths": paths},
+                    timeout=aiohttp.ClientTimeout(total=10),
+                ) as resp:
+                    if resp.status == 200:
+                        body = await resp.json()
+                        if body.get("swept"):
+                            logger.info(
+                                "KV resync: swept %s drifted claims, %s "
+                                "claim nodes reasserted",
+                                body.get("swept"), body.get("claims", 0))
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            logger.debug("KV resync round failed: %s", e)
 
     def _track_admission(self, text: str, ids: List[int],
                          adapter: str = "",
@@ -1445,6 +1591,12 @@ class EngineServer:
             logger.info("Drain requested: admission stopped, %d in flight",
                         self._inflight)
         self.draining = True
+        if first_drain:
+            # Stop the lease heartbeat/resync tasks FIRST: a beat landing
+            # after the /kv/deregister below would get known=False and
+            # re-register, pulling routable claims back onto a replica
+            # that is going away.
+            await self.stop_kv_reporting()
         if first_drain and self.kv_controller_url is not None:
             # Announce departure to the KV controller immediately: the
             # router must stop treating this replica as a prefix holder
@@ -1792,9 +1944,25 @@ class EngineServer:
     async def handle_kv_pull(self, request: web.Request) -> web.Response:
         """Trace shell for :meth:`_kv_pull_impl`: records one
         ``engine.kv_transfer`` span per pull (path, bytes, seconds) under
-        the router's trace when a ``traceparent`` arrives."""
+        the router's trace when a ``traceparent`` arrives.
+
+        Admission-gated: past ``--kv-pull-max-concurrency`` concurrent
+        transfers the engine answers 503 + Retry-After instead of letting
+        a popular prefix stampede one holder — the router degrades the
+        rejected pull to plain recompute."""
+        if self._pull_inflight >= self.kv_pull_max_concurrency:
+            self.kv_pull_rejected_total += 1
+            return web.json_response(
+                {"status": "rejected",
+                 "error": "pull admission full "
+                          f"({self.kv_pull_max_concurrency} in flight)"},
+                status=503, headers={"Retry-After": "1"})
+        self._pull_inflight += 1
         t0 = time.time()
-        resp = await self._kv_pull_impl(request)
+        try:
+            resp = await self._kv_pull_impl(request)
+        finally:
+            self._pull_inflight -= 1
         if self.trace_recorder is not None:
             rid = (request.headers.get("X-Request-Id")
                    or f"kvpull-{uuid.uuid4().hex[:12]}")
@@ -2065,6 +2233,22 @@ class EngineServer:
             f"tpu:kv_transfer_rx_seconds_total{{{labels}}} {self.kv_transfer_rx_seconds:.6f}",
             "# TYPE tpu:kv_transfer_pulls counter",
             f"tpu:kv_transfer_pulls_total{{{labels}}} {self.kv_transfer_pulls}",
+            # Pull stampede control: concurrent /kv/pull transfers being
+            # served, and pulls bounced 503 at the admission gate.
+            "# TYPE tpu:kv_pull_inflight gauge",
+            f"tpu:kv_pull_inflight{{{labels}}} {self._pull_inflight}",
+            "# TYPE tpu:kv_pull_rejected counter",
+            f"tpu:kv_pull_rejected_total{{{labels}}} "
+            f"{self.kv_pull_rejected_total}",
+            # Eviction-report stream health: dispatched prefix-evict
+            # events and listener callbacks that raised (dropped reports
+            # the anti-entropy resync has to heal).
+            "# TYPE tpu:prefix_evicts counter",
+            f"tpu:prefix_evicts_total{{{labels}}} "
+            f"{s.get('prefix_evicts_total', 0)}",
+            "# TYPE tpu:evict_listener_errors counter",
+            f"tpu:evict_listener_errors_total{{{labels}}} "
+            f"{s.get('evict_listener_errors_total', 0)}",
             "# TYPE tpu:kv_transfer_device_pulls counter",
             f"tpu:kv_transfer_device_pulls_total{{{labels}}} "
             f"{self.kv_transfer_device_pulls}",
@@ -2171,6 +2355,7 @@ async def run_engine_server(server: EngineServer, host: str, port: int) -> web.A
     async def _unregister(app):
         # Drop the local-peer registration so a recycled port can never
         # resolve to this (stopped) server's frozen KV cache.
+        await server.stop_kv_reporting()
         if bound_port and EngineServer._local_peers.get(
                 str(bound_port[0])) is server:
             del EngineServer._local_peers[str(bound_port[0])]
@@ -2275,6 +2460,21 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="router URL to report KV admissions to "
                         "(enables kv-aware routing against this engine)")
     p.add_argument("--instance-id", default=None)
+    p.add_argument("--kv-heartbeat-interval", type=float, default=10.0,
+                   help="seconds between lease heartbeats to the KV "
+                        "controller; the controller expires this "
+                        "instance's claims after --kv-lease-misses "
+                        "missed beats (0 disables heartbeating)")
+    p.add_argument("--kv-resync-interval", type=float, default=60.0,
+                   help="seconds between anti-entropy resync rounds "
+                        "(digest compare + full-state replace on "
+                        "mismatch) against the KV controller; heals "
+                        "admit/evict reports lost to timeouts "
+                        "(0 disables)")
+    p.add_argument("--kv-pull-max-concurrency", type=int, default=8,
+                   help="max concurrent /kv/pull transfers served before "
+                        "excess pulls get 503 + Retry-After (the router "
+                        "degrades them to recompute)")
     p.add_argument("--chat-template", default=None,
                    help="custom jinja chat-template file (HF checkpoints)")
     p.add_argument("--advertise-url", default=None,
@@ -2352,6 +2552,9 @@ def main(argv: Optional[List[str]] = None) -> None:
                           instance_id=args.instance_id,
                           advertise_url=args.advertise_url,
                           api_key=args.api_key,
+                          kv_heartbeat_interval=args.kv_heartbeat_interval,
+                          kv_resync_interval=args.kv_resync_interval,
+                          kv_pull_max_concurrency=args.kv_pull_max_concurrency,
                           trace_buffer=args.trace_buffer,
                           slow_trace_threshold_s=args.slow_trace_threshold_s,
                           trace_export=args.trace_export)

@@ -49,6 +49,34 @@ def chunk_hashes(text: str, chunk_size: int = CHUNK_SIZE) -> List[int]:
     ]
 
 
+def path_key(parent_key: int, chunk_hash: int) -> int:
+    """Stable identifier of one trie node: hash of the root-anchored
+    chunk-hash path down to it. Engines compute the same keys from their
+    admitted prefixes, so controller and engine can compare claim sets
+    without shipping the trie (anti-entropy resync digests)."""
+    return xxhash.xxh64_intdigest(f"{parent_key}:{chunk_hash}")
+
+
+def path_keys(hashes: List[int], root_key: int = 0) -> List[int]:
+    """Node keys for every prefix of a root-anchored chunk-hash path."""
+    keys = []
+    k = root_key
+    for h in hashes:
+        k = path_key(k, h)
+        keys.append(k)
+    return keys
+
+
+def claim_digest(keys: "Set[int]") -> Tuple[int, int]:
+    """Compact (count, xor-of-keys) digest of a claim set. Order-free,
+    incremental on both sides; a mismatch in either field triggers a
+    full-state resync."""
+    x = 0
+    for k in keys:
+        x ^= k
+    return len(keys), x
+
+
 class _Node:
     __slots__ = ("children", "instances")
 
@@ -71,13 +99,31 @@ class KVController:
     """
 
     def __init__(self, chunk_size: int = CHUNK_SIZE,
-                 admit_ttl: float = 600.0):
+                 admit_ttl: float = 600.0,
+                 lease_misses: int = 3,
+                 heartbeat_interval: float = 10.0):
         self.chunk_size = chunk_size
         self.admit_ttl = admit_ttl
+        # Lease policy: an instance that registered with a generation id
+        # (i.e. opted into heartbeating) expires after missing
+        # ``lease_misses`` beats of its reported interval (or the
+        # controller default when it didn't report one). Legacy
+        # registrations without a generation never lease-expire — their
+        # staleness stays bounded by admit_ttl alone, exactly as before.
+        self.lease_misses = max(1, int(lease_misses))
+        self.heartbeat_interval = heartbeat_interval
         self._root = _Node()
-        self._instances: Dict[str, dict] = {}  # id -> {url, last_seen}
+        # id -> {url, last_seen, generation, state, last_beat,
+        #        heartbeat_interval}; generation/last_beat are None for
+        # legacy (non-heartbeating) registrations.
+        self._instances: Dict[str, dict] = {}
         self._l3_url: Optional[str] = None
         self._lock = asyncio.Lock()
+        # Claims removed by the crash-consistency machinery, by reason
+        # (expired lease / superseded generation / anti-entropy resync).
+        # Exported as vllm_router:kv_claims_swept_total by the router.
+        self.swept_totals: Dict[str, int] = {
+            "expired": 0, "regenerated": 0, "resync": 0}
 
     def attach_l3(self, url: Optional[str]) -> None:
         """Attach (or detach) the shared L3 cache server. While set,
@@ -93,10 +139,213 @@ class KVController:
     def _fresh(self, ts: float, now: float) -> bool:
         return self.admit_ttl <= 0 or (now - ts) <= self.admit_ttl
 
+    # -- claim walks (shared by dereg, lease expiry, resync) ---------------
+    def _sweep_claims_locked(self, instance_id: str,
+                             keep_keys: Optional[Set[int]] = None) -> int:
+        """Pop every trie claim of ``instance_id``; returns how many were
+        removed. ``keep_keys`` (resync replace) counts only nodes whose
+        path key is NOT about to be re-claimed, so the swept counter
+        reflects actual drift, not the full claim set. Lock held."""
+        removed = 0
+        stack = [(self._root, 0)]
+        while stack:
+            node, key = stack.pop()
+            if node.instances.pop(instance_id, None) is not None:
+                if keep_keys is None or key not in keep_keys:
+                    removed += 1
+            for h, child in node.children.items():
+                stack.append((child, path_key(key, h)))
+        return removed
+
+    def _claim_keys_locked(self, instance_id: str) -> Set[int]:
+        """Path keys of every trie node claimed by ``instance_id``."""
+        keys: Set[int] = set()
+        stack = [(self._root, 0)]
+        while stack:
+            node, key = stack.pop()
+            if instance_id in node.instances:
+                keys.add(key)
+            for h, child in node.children.items():
+                stack.append((child, path_key(key, h)))
+        return keys
+
     # -- instance registry (reference QueryInstMsg / instance-id→URL map) --
-    async def register_instance(self, instance_id: str, url: str) -> None:
+    async def register_instance(self, instance_id: str, url: str,
+                                generation: Optional[str] = None,
+                                heartbeat_interval: Optional[float] = None,
+                                ) -> dict:
+        """Register (or re-register) an engine incarnation.
+
+        With a ``generation`` id, registration is crash-consistent: any
+        prior incarnation at the same instance id OR the same URL whose
+        generation differs (including legacy generation-less records) is
+        swept atomically — a kill -9'd replica's restart replaces the
+        corpse's claims in one step instead of waiting out the lease or
+        the admit TTL. Returns ``{"swept": N, "superseded": [ids]}``."""
+        now = time.time()
+        swept = 0
+        superseded: List[str] = []
         async with self._lock:
-            self._instances[instance_id] = {"url": url, "last_seen": time.time()}
+            if generation is not None:
+                stale = [
+                    other_id for other_id, info in self._instances.items()
+                    if other_id != L3_INSTANCE
+                    and info.get("generation") != generation
+                    and (other_id == instance_id or info.get("url") == url)
+                ]
+                for other_id in stale:
+                    swept += self._sweep_claims_locked(other_id)
+                    if other_id != instance_id:
+                        self._instances.pop(other_id, None)
+                        superseded.append(other_id)
+                self.swept_totals["regenerated"] += swept
+            self._instances[instance_id] = {
+                "url": url, "last_seen": now,
+                "generation": generation,
+                "state": "live",
+                # Only heartbeat-capable registrations carry a lease: a
+                # generation-less legacy engine, or one that disabled
+                # heartbeating (interval 0/None), must never be expired
+                # for beats it was never going to send.
+                "last_beat": (
+                    now if generation is not None and heartbeat_interval
+                    else None),
+                "heartbeat_interval": heartbeat_interval,
+            }
+        if swept:
+            logger.info(
+                "KV controller: register %s gen=%s swept %d stale claims "
+                "(superseded: %s)", instance_id, generation, swept,
+                superseded or [instance_id])
+        return {"swept": swept, "superseded": superseded}
+
+    async def heartbeat(self, instance_id: str,
+                        generation: Optional[str] = None,
+                        heartbeat_interval: Optional[float] = None) -> dict:
+        """Lease renewal. ``known=False`` tells the engine to re-register
+        (controller restarted, instance expired+superseded, or the
+        generation doesn't match the registered incarnation).
+        ``revived=True`` flags a beat from an instance the lease sweeper
+        had expired — its claims were swept, so the engine should resync
+        to restore them."""
+        now = time.time()
+        async with self._lock:
+            info = self._instances.get(instance_id)
+            if info is None or (
+                    generation is not None
+                    and info.get("generation") is not None
+                    and info["generation"] != generation):
+                return {"known": False, "revived": False}
+            revived = info.get("state") == "expired"
+            info["last_beat"] = now
+            info["last_seen"] = now
+            info["state"] = "live"
+            if heartbeat_interval:
+                info["heartbeat_interval"] = heartbeat_interval
+            if generation is not None and info.get("generation") is None:
+                info["generation"] = generation
+        return {"known": True, "revived": revived}
+
+    async def expire_stale_leases(self, now: Optional[float] = None
+                                  ) -> List[dict]:
+        """Expire instances whose lease lapsed (``lease_misses`` missed
+        heartbeats): sweep their claims (anything spilled to the L3 is
+        already attributed to ``__l3__`` and survives; the rest is gone
+        with the process) and mark them ``expired`` so service discovery
+        and the EPP health view exclude their URLs. The record is kept —
+        a late beat from a paused-not-dead process revives it (and
+        triggers a resync)."""
+        now = time.time() if now is None else now
+        expired: List[dict] = []
+        async with self._lock:
+            for instance_id, info in self._instances.items():
+                if instance_id == L3_INSTANCE:
+                    continue
+                last_beat = info.get("last_beat")
+                if last_beat is None or info.get("state") == "expired":
+                    continue
+                interval = (info.get("heartbeat_interval")
+                            or self.heartbeat_interval)
+                if now - last_beat <= self.lease_misses * interval:
+                    continue
+                swept = self._sweep_claims_locked(instance_id)
+                info["state"] = "expired"
+                self.swept_totals["expired"] += swept
+                expired.append({"instance_id": instance_id,
+                                "url": info.get("url"),
+                                "swept": swept})
+        for item in expired:
+            logger.warning(
+                "KV controller: lease expired for %s (%s) — swept %d "
+                "claims", item["instance_id"], item["url"], item["swept"])
+        return expired
+
+    # -- anti-entropy resync (heals timeout-swallowed admit/evict) ---------
+    async def resync_check(self, instance_id: str, count: int,
+                           xor: int) -> dict:
+        """Compare an engine's claim digest against the controller's view
+        of that instance. ``match=False`` asks the engine to follow up
+        with its full state (:meth:`resync_replace`)."""
+        async with self._lock:
+            if instance_id not in self._instances:
+                return {"known": False, "match": False}
+            have_count, have_xor = claim_digest(
+                self._claim_keys_locked(instance_id))
+        return {"known": True,
+                "match": have_count == count and have_xor == xor}
+
+    async def resync_replace(self, instance_id: str,
+                             paths: List[List[int]]) -> dict:
+        """Replace an instance's claims with the engine's authoritative
+        state: ``paths`` are root-anchored chunk-hash lists (one per
+        admitted prefix). Claims the controller held that the engine no
+        longer does are swept (reason ``resync``); missing ones are
+        re-admitted. Heals silent drift from swallowed reports."""
+        now = time.time()
+        keep: Set[int] = set()
+        for path in paths:
+            keep.update(path_keys(path))
+        async with self._lock:
+            if instance_id not in self._instances:
+                return {"known": False, "swept": 0, "claims": 0}
+            swept = self._sweep_claims_locked(instance_id, keep_keys=keep)
+            self.swept_totals["resync"] += swept
+            for path in paths:
+                node = self._root
+                for h in path:
+                    nxt = node.children.get(h)
+                    if nxt is None:
+                        nxt = _Node()
+                        node.children[h] = nxt
+                    nxt.instances[instance_id] = now
+                    node = nxt
+            info = self._instances[instance_id]
+            info["last_seen"] = now
+        if swept:
+            logger.info(
+                "KV controller: resync for %s swept %d drifted claims "
+                "(%d paths reasserted)", instance_id, swept, len(paths))
+        return {"known": True, "swept": swept, "claims": len(keep)}
+
+    async def instances_snapshot(self) -> List[dict]:
+        """Operator/EPP view of the instance table (GET /kv/instances)."""
+        now = time.time()
+        async with self._lock:
+            out = []
+            for instance_id, info in self._instances.items():
+                last_beat = info.get("last_beat")
+                out.append({
+                    "instance_id": instance_id,
+                    "url": info.get("url"),
+                    "generation": info.get("generation"),
+                    "state": ("l3" if instance_id == L3_INSTANCE
+                              else info.get("state", "live")),
+                    "last_beat_age_s": (
+                        round(now - last_beat, 3)
+                        if last_beat is not None else None),
+                    "claims": len(self._claim_keys_locked(instance_id)),
+                })
+            return out
 
     async def deregister_instance(self, instance_id: str) -> None:
         async with self._lock:
@@ -194,6 +443,9 @@ class KVController:
                 live = {
                     i for i, ts in nxt.instances.items()
                     if i in self._instances and self._fresh(ts, now)
+                    # Lease-expired instances are never routable holders,
+                    # even if a paused-not-dead process kept admitting.
+                    and self._instances[i].get("state", "live") != "expired"
                 }
                 if not live:
                     break
@@ -222,9 +474,14 @@ class KVController:
 
 
 def initialize_kv_controller(chunk_size: int = CHUNK_SIZE,
-                             admit_ttl: float = 600.0) -> KVController:
+                             admit_ttl: float = 600.0,
+                             lease_misses: int = 3,
+                             heartbeat_interval: float = 10.0,
+                             ) -> KVController:
     global _global_kv_controller
-    _global_kv_controller = KVController(chunk_size, admit_ttl=admit_ttl)
+    _global_kv_controller = KVController(
+        chunk_size, admit_ttl=admit_ttl, lease_misses=lease_misses,
+        heartbeat_interval=heartbeat_interval)
     return _global_kv_controller
 
 

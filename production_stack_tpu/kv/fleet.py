@@ -52,6 +52,10 @@ class FleetCacheConfig:
     min_match_chars: int = 256
     l3_url: Optional[str] = None
     api_key: Optional[str] = None
+    # Stampede control: router-side cap on concurrent pull orchestrations
+    # against ONE holder replica (the holder additionally self-protects
+    # with its own /kv/pull admission semaphore → 503 + Retry-After).
+    pull_max_concurrency: int = 8
 
 
 class FleetCache:
@@ -71,7 +75,17 @@ class FleetCache:
         self.pulls_attempted = 0
         self.pulls_succeeded = 0
         self.pulls_failed = 0
+        self.pulls_rejected = 0
+        self.pulls_coalesced = 0
         self.l3_pulls = 0
+        # Stampede control state. _single_flight dedups identical-prefix
+        # pulls to the same target (followers await the leader's
+        # transfer); _inflight_by_holder enforces the per-holder cap;
+        # last_attempt_by_holder lets the chaos harness assert that
+        # transfers against a dead holder stop within one lease interval.
+        self._single_flight: Dict[tuple, "asyncio.Task"] = {}
+        self._inflight_by_holder: Dict[str, int] = {}
+        self.last_attempt_by_holder: Dict[str, float] = {}
 
     def _headers(self, request_id: str) -> Dict[str, str]:
         headers = {"X-Request-Id": request_id}
@@ -115,6 +129,59 @@ class FleetCache:
 
         from production_stack_tpu.router import metrics as router_metrics
 
+        holder_key = holder_url.rstrip("/")
+        flight_key = (server_url.rstrip("/"), holder_key,
+                      hash(prompt[:matched_chars]))
+        task = self._single_flight.get(flight_key)
+        coalesced = task is not None
+        if task is None:
+            if (self._inflight_by_holder.get(holder_key, 0)
+                    >= self.config.pull_max_concurrency):
+                # The holder is already serving the cap's worth of
+                # transfers for the router — recompute is cheaper than
+                # queueing behind a stampede.
+                self.pulls_rejected += 1
+                router_metrics.kv_pull_rejected.labels(
+                    server=server_url).inc()
+                logger.info(
+                    "fleet: pull %s <- %s rejected (holder at "
+                    "max concurrency %d)", server_url, holder_url,
+                    self.config.pull_max_concurrency)
+                return {"holder": holder, "holder_url": holder_url,
+                        "matched_chars": matched_chars,
+                        "outcome": "rejected", "injected_blocks": 0,
+                        "seconds": 0.0}
+            task = asyncio.ensure_future(self._do_pull(
+                server_url, holder_url, holder, matched_chars,
+                request_json, request_id))
+            self._single_flight[flight_key] = task
+            task.add_done_callback(
+                lambda _t: self._single_flight.pop(flight_key, None))
+        else:
+            self.pulls_coalesced += 1
+        try:
+            # Awaiting a shared Task is cancellation-safe: a cancelled
+            # follower abandons its await without killing the transfer.
+            result = await task
+        except Exception as e:  # noqa: BLE001 - pull is best-effort
+            logger.warning("fleet pull task failed: %s", e)
+            return None
+        if result is None:
+            return None
+        if coalesced:
+            return {**result, "coalesced": True}
+        return result
+
+    async def _do_pull(self, server_url: str, holder_url: str, holder: str,
+                       matched_chars: int, request_json: dict,
+                       request_id: str) -> dict:
+        """One actual /kv/pull round-trip (single-flight leader)."""
+        from production_stack_tpu.router import metrics as router_metrics
+
+        holder_key = holder_url.rstrip("/")
+        self._inflight_by_holder[holder_key] = (
+            self._inflight_by_holder.get(holder_key, 0) + 1)
+        self.last_attempt_by_holder[holder_key] = time.monotonic()
         self.pulls_attempted += 1
         router_metrics.kv_pull_attempts.labels(server=server_url).inc()
         if holder == L3_INSTANCE:
@@ -135,7 +202,12 @@ class FleetCache:
                     timeout=aiohttp.ClientTimeout(
                         total=self.config.pull_timeout_s),
                 ) as resp:
-                    if resp.status != 200:
+                    if resp.status == 503:
+                        # The target's pull-admission semaphore is full
+                        # (engine-side --kv-pull-max-concurrency): it
+                        # told us to back off, and prefill recomputes.
+                        outcome = "rejected"
+                    elif resp.status != 200:
                         outcome = f"http_{resp.status}"
                     else:
                         body = await resp.json()
@@ -156,12 +228,21 @@ class FleetCache:
             logger.warning("fleet pull %s <- %s failed: %s",
                            server_url, holder_url, e)
             outcome = "unreachable"
+        finally:
+            left = self._inflight_by_holder.get(holder_key, 1) - 1
+            if left <= 0:
+                self._inflight_by_holder.pop(holder_key, None)
+            else:
+                self._inflight_by_holder[holder_key] = left
         elapsed = time.monotonic() - t0
         router_metrics.kv_pull_latency.labels(server=server_url).observe(
             elapsed)
         if outcome == "ok":
             self.pulls_succeeded += 1
             router_metrics.kv_pull_success.labels(server=server_url).inc()
+        elif outcome == "rejected":
+            self.pulls_rejected += 1
+            router_metrics.kv_pull_rejected.labels(server=server_url).inc()
         else:
             self.pulls_failed += 1
             router_metrics.kv_pull_failures.labels(
@@ -180,8 +261,11 @@ class FleetCache:
             "pulls_attempted": self.pulls_attempted,
             "pulls_succeeded": self.pulls_succeeded,
             "pulls_failed": self.pulls_failed,
+            "pulls_rejected": self.pulls_rejected,
+            "pulls_coalesced": self.pulls_coalesced,
             "l3_pulls": self.l3_pulls,
             "min_match_chars": self.config.min_match_chars,
+            "pull_max_concurrency": self.config.pull_max_concurrency,
             "l3_url": self.config.l3_url,
         }
 
@@ -339,6 +423,8 @@ def initialize_fleet(args, kv_controller, fault_tolerance=None):
                 min_match_chars=args.fleet_min_match_chars,
                 l3_url=args.fleet_l3_url,
                 api_key=key,
+                pull_max_concurrency=getattr(
+                    args, "kv_pull_max_concurrency", 8),
             ),
             kv_controller,
             fault_tolerance=fault_tolerance,

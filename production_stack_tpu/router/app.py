@@ -277,8 +277,79 @@ async def cancel_batch(request: web.Request) -> web.Response:
 async def kv_register(request: web.Request) -> web.Response:
     state = request.app["state"]
     body = await request.json()
-    await state.kv_controller.register_instance(body["instance_id"], body["url"])
-    return web.json_response({"status": "ok"})
+    result = await state.kv_controller.register_instance(
+        body["instance_id"], body["url"],
+        generation=body.get("generation"),
+        heartbeat_interval=body.get("heartbeat_interval"),
+    )
+    swept = result.get("swept", 0)
+    if swept:
+        # A same-URL re-register with a new generation swept the old
+        # incarnation's claims (crashed-and-restarted replica).
+        metrics_mod.kv_claims_swept.labels(reason="regenerated").inc(swept)
+    clear = getattr(state.service_discovery, "clear_lease_expired", None)
+    if clear is not None:
+        clear(body["url"])
+    return web.json_response({"status": "ok", **result})
+
+
+async def kv_heartbeat(request: web.Request) -> web.Response:
+    """Lease renewal. ``known=False`` tells the engine to re-register
+    (controller restarted, or the record was superseded); ``revived=True``
+    tells it its lease HAD expired and claims were swept, so it should
+    resync its admitted state."""
+    state = request.app["state"]
+    body = await request.json()
+    result = await state.kv_controller.heartbeat(
+        body["instance_id"],
+        generation=body.get("generation"),
+        heartbeat_interval=body.get("heartbeat_interval"),
+    )
+    if result.get("known") and body.get("url"):
+        clear = getattr(state.service_discovery, "clear_lease_expired", None)
+        if clear is not None:
+            clear(body["url"])
+    return web.json_response(result)
+
+
+async def kv_resync(request: web.Request) -> web.Response:
+    """Anti-entropy phase 1: compare the engine's claim digest (count +
+    xor of root-anchored path keys) against the controller's view. A
+    mismatch means timeout-swallowed admit/evict reports drifted the trie;
+    the engine follows up with its full state on /kv/resync_state."""
+    state = request.app["state"]
+    body = await request.json()
+    result = await state.kv_controller.resync_check(
+        body["instance_id"], int(body.get("count", 0)), int(body.get("xor", 0))
+    )
+    return web.json_response(result)
+
+
+async def kv_resync_state(request: web.Request) -> web.Response:
+    """Anti-entropy phase 2: replace the instance's claims with the
+    engine-reported truth (list of root-anchored chunk-hash paths)."""
+    state = request.app["state"]
+    body = await request.json()
+    result = await state.kv_controller.resync_replace(
+        body["instance_id"], body.get("paths") or []
+    )
+    swept = result.get("swept", 0)
+    if swept:
+        metrics_mod.kv_claims_swept.labels(reason="resync").inc(swept)
+    return web.json_response(result)
+
+
+async def kv_instances(request: web.Request) -> web.Response:
+    """Controller instance table: lease state, generation, claim counts.
+    ``expired_urls`` is the health view external pickers (EPP gateway)
+    poll to exclude heartbeat-expired endpoints."""
+    state = request.app["state"]
+    snap = await state.kv_controller.instances_snapshot()
+    expired_urls = sorted(
+        {rec["url"] for rec in snap
+         if rec.get("state") == "expired" and rec.get("url")}
+    )
+    return web.json_response({"instances": snap, "expired_urls": expired_urls})
 
 
 async def kv_admit(request: web.Request) -> web.Response:
@@ -334,6 +405,32 @@ async def kv_lookup(request: web.Request) -> web.Response:
     if match is None:
         return web.json_response({"matched": 0, "instance_id": None})
     return web.json_response({"matched": match[0], "instance_id": match[1]})
+
+
+async def lease_sweep_once(state) -> list:
+    """One lease-sweeper pass: expire stale instances, mirror them into
+    service discovery's unhealthy view, refresh the instance-state gauge.
+    Module-level so tests and the chaos harness can drive it with a fast
+    clock instead of waiting out the background task."""
+    expired = await state.kv_controller.expire_stale_leases()
+    for rec in expired:
+        url = rec.get("url")
+        mark = getattr(state.service_discovery, "mark_lease_expired", None)
+        if url and mark is not None:
+            mark(url)
+        if rec.get("swept"):
+            metrics_mod.kv_claims_swept.labels(reason="expired").inc(
+                rec["swept"]
+            )
+    snap = await state.kv_controller.instances_snapshot()
+    counts: dict = {}
+    for rec in snap:
+        counts[rec["state"]] = counts.get(rec["state"], 0) + 1
+    for state_name in ("live", "expired", "l3"):
+        metrics_mod.kv_controller_instances.labels(state=state_name).set(
+            counts.get(state_name, 0)
+        )
+    return expired
 
 
 # -- autoscale recommender (production_stack_tpu/kv/fleet.py) ---------------
@@ -467,6 +564,10 @@ def build_app(args) -> web.Application:
     app.router.add_post("/kv/evict", kv_evict)
     app.router.add_post("/kv/lookup", kv_lookup)
     app.router.add_post("/kv/deregister", kv_deregister)
+    app.router.add_post("/kv/heartbeat", kv_heartbeat)
+    app.router.add_post("/kv/resync", kv_resync)
+    app.router.add_post("/kv/resync_state", kv_resync_state)
+    app.router.add_get("/kv/instances", kv_instances)
     # Autoscale recommender (404 unless --autoscale)
     app.router.add_get("/autoscale/recommendation", autoscale_recommendation)
     app.router.add_post("/autoscale/scale_in", autoscale_scale_in)
@@ -480,10 +581,36 @@ def build_app(args) -> web.Application:
         st = app["state"]
         if st.batch_processor is not None:
             st.batch_processor.start()
+        # Lease sweeper: expire instances that missed N heartbeats and
+        # mirror them into service discovery so routing + EPP stop
+        # picking corpses. Runs at the heartbeat interval (0 disables).
+        interval = float(getattr(args, "kv_heartbeat_interval", 10.0) or 0.0)
+        if interval > 0:
+
+            async def _sweeper():
+                while True:
+                    await asyncio.sleep(interval)
+                    try:
+                        await lease_sweep_once(st)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:  # noqa: BLE001
+                        logger.debug("lease sweep failed: %s", e)
+
+            app["_lease_sweeper"] = asyncio.get_running_loop().create_task(
+                _sweeper()
+            )
 
     async def on_cleanup(app: web.Application):
         from production_stack_tpu.router.httpclient import AiohttpClientWrapper
 
+        sweeper = app.get("_lease_sweeper")
+        if sweeper is not None:
+            sweeper.cancel()
+            try:
+                await sweeper
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
         st = app["state"]
         for closable in (
             st.service_discovery, st.engine_stats_scraper,
@@ -602,7 +729,9 @@ def initialize_all(args) -> RouterState:
     from production_stack_tpu.kv.controller import initialize_kv_controller
 
     state.kv_controller = initialize_kv_controller(
-        admit_ttl=getattr(args, "kv_admit_ttl", 600.0)
+        admit_ttl=getattr(args, "kv_admit_ttl", 600.0),
+        lease_misses=getattr(args, "kv_lease_misses", 3),
+        heartbeat_interval=getattr(args, "kv_heartbeat_interval", 10.0),
     )
 
     # Routing.

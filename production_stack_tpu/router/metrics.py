@@ -154,6 +154,24 @@ autoscale_current_replicas = Gauge(
     "Replica count the recommender currently observes",
     registry=REGISTRY)
 
+# --- Crash-consistent fleet state (leases / resync / stampede control) ---
+kv_controller_instances = Gauge(
+    "vllm_router:kv_controller_instances",
+    "KV controller instance records by lease state (live/expired/l3)",
+    ["state"], registry=REGISTRY)
+kv_claims_swept = Counter(
+    "vllm_router:kv_claims_swept_total",
+    "Prefix claims swept from the controller trie, by cause: expired "
+    "(lease timed out), regenerated (same URL re-registered with a new "
+    "generation), resync (anti-entropy digest mismatch healed drift)",
+    ["reason"], registry=REGISTRY)
+kv_pull_rejected = Counter(
+    "vllm_router:kv_pull_rejected_total",
+    "Cross-replica pulls the router skipped because the holder rejected "
+    "admission (503) or the per-holder in-flight cap was reached "
+    "(target recomputes instead)",
+    _L, registry=REGISTRY)
+
 _PROCESS = psutil.Process()
 
 

@@ -134,6 +134,25 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--fleet-l3-url", type=str, default=None,
                         help="shared L3 cache server URL (kv.cache_server); "
                              "spilled evictions stay routable through it")
+    parser.add_argument("--kv-pull-max-concurrency", type=int, default=8,
+                        help="router-side cap on concurrent /kv/pull "
+                             "orchestrations against ONE holder replica; "
+                             "excess requests skip the pull and recompute "
+                             "(identical-prefix pulls to the same target "
+                             "additionally share one in-flight transfer)")
+    # KV claim leases / anti-entropy (crash consistency for the fleet
+    # cache: a kill -9'd replica's claims are swept after N missed
+    # heartbeats instead of lingering for the full admit TTL).
+    parser.add_argument("--kv-heartbeat-interval", type=float, default=10.0,
+                        help="expected engine heartbeat cadence (s); an "
+                             "instance that registered with a generation "
+                             "id expires after --kv-lease-misses missed "
+                             "beats and its claims are swept (0 disables "
+                             "the lease sweeper; engines that never "
+                             "heartbeat are unaffected either way)")
+    parser.add_argument("--kv-lease-misses", type=int, default=3,
+                        help="missed heartbeats before an instance's "
+                             "lease expires")
     parser.add_argument("--autoscale", action="store_true",
                         help="enable the load-predictive autoscale "
                              "recommender: /autoscale/recommendation and "
@@ -242,6 +261,13 @@ def validate_args(args: argparse.Namespace) -> None:
             raise ValueError("--fleet-pull-timeout must be > 0")
         if args.fleet_min_match_chars < 1:
             raise ValueError("--fleet-min-match-chars must be >= 1")
+        if args.kv_pull_max_concurrency < 1:
+            raise ValueError("--kv-pull-max-concurrency must be >= 1")
+    if getattr(args, "kv_heartbeat_interval", 10.0) < 0:
+        raise ValueError("--kv-heartbeat-interval must be >= 0 "
+                         "(0 disables the lease sweeper)")
+    if getattr(args, "kv_lease_misses", 3) < 1:
+        raise ValueError("--kv-lease-misses must be >= 1")
     if getattr(args, "autoscale", False):
         if args.autoscale_min_replicas < 0:
             raise ValueError("--autoscale-min-replicas must be >= 0")

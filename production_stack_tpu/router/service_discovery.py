@@ -160,6 +160,12 @@ class StaticServiceDiscovery(ServiceDiscovery):
         # get_endpoint_info() — the half-open probe must remain routable;
         # request-level filtering uses breaker.blocked_urls().
         self._breaker_unhealthy: set = set()
+        # URLs whose KV-claim lease expired (missed heartbeats — the
+        # process is presumed dead, kill -9 / OOM-kill). Unlike the
+        # breaker set, these ARE filtered from get_endpoint_info(): a
+        # corpse has no half-open probe to keep routable, and the next
+        # generation's /kv/register clears the mark atomically.
+        self._lease_unhealthy: set = set()
         self._running = True
         self._hc_thread: Optional[threading.Thread] = None
         if static_backend_health_checks:
@@ -191,7 +197,9 @@ class StaticServiceDiscovery(ServiceDiscovery):
 
     def get_unhealthy_endpoint_hashes(self) -> List[str]:
         with self._lock:
-            return sorted(self._unhealthy | self._breaker_unhealthy)
+            return sorted(
+                self._unhealthy | self._breaker_unhealthy | self._lease_unhealthy
+            )
 
     def mark_unhealthy(self, url: str) -> None:
         """Circuit-breaker mirror: report ``url`` unhealthy."""
@@ -202,9 +210,24 @@ class StaticServiceDiscovery(ServiceDiscovery):
         with self._lock:
             self._breaker_unhealthy.discard(url)
 
+    def mark_lease_expired(self, url: str) -> None:
+        """KV lease-sweeper mirror: ``url`` missed enough heartbeats that
+        the controller expired its claims — stop routing to it."""
+        with self._lock:
+            self._lease_unhealthy.add(url)
+
+    def clear_lease_expired(self, url: str) -> None:
+        with self._lock:
+            self._lease_unhealthy.discard(url)
+
     def get_endpoint_info(self) -> List[EndpointInfo]:
         with self._lock:
-            return [ep for ep in self._endpoints if ep.url not in self._unhealthy]
+            return [
+                ep
+                for ep in self._endpoints
+                if ep.url not in self._unhealthy
+                and ep.url not in self._lease_unhealthy
+            ]
 
     def set_sleep_status(self, url: str, sleep: bool) -> None:
         with self._lock:

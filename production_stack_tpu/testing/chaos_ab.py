@@ -52,7 +52,8 @@ async def _start(app, shutdown_timeout: float = 0.5):
 
 
 async def _one_request(session, router_url: str,
-                       client_timeout_s: float) -> Optional[float]:
+                       client_timeout_s: float,
+                       prompt: str = "ping") -> Optional[float]:
     """One streamed chat completion; returns wall latency on a complete
     stream (``[DONE]`` seen), None on any failure."""
     import aiohttp
@@ -62,7 +63,7 @@ async def _one_request(session, router_url: str,
         async with session.post(
             router_url + "/v1/chat/completions",
             json={"model": MODEL, "max_tokens": 4, "stream": True,
-                  "messages": [{"role": "user", "content": "ping"}]},
+                  "messages": [{"role": "user", "content": prompt}]},
             timeout=aiohttp.ClientTimeout(total=client_timeout_s),
         ) as resp:
             if resp.status != 200:
@@ -169,16 +170,170 @@ async def _run_leg(*, ft_on: bool, total: int, concurrency: int,
     }
 
 
+async def _run_kill9_leg(*, total: int = 120, concurrency: int = 12,
+                         chaos_after: int = 30,
+                         client_timeout_s: float = 8.0,
+                         ttft_deadline_s: float = 2.0,
+                         engine_ttft: float = 0.03,
+                         heartbeat_interval: float = 0.15,
+                         lease_misses: int = 3) -> dict:
+    """kill -9 a claim-holding replica mid-storm, fleet cache + FT on.
+
+    Crash semantics come from :meth:`FakeEngine.crash`: heartbeats stop
+    and the socket closes abruptly — no drain, no /kv/deregister. The
+    circuit breaker is effectively disabled (huge threshold) so the
+    LEASE path alone has to stop routing and stale-holder pulls. Asserted
+    downstream: every request completes (FT failover), the controller
+    sweeps the corpse's claims (``swept_totals["expired"] > 0``), and the
+    last /kv/pull aimed at the dead holder lands within one lease window
+    (+ one sweep period + slack) of the kill."""
+    import aiohttp
+
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.parser import build_parser
+    from production_stack_tpu.testing.fake_engine import (
+        FakeEngine,
+        run_fake_engine,
+    )
+
+    _reset_router_singletons()
+    engines = [FakeEngine(model=MODEL, ttft=engine_ttft,
+                          max_tokens_default=4) for _ in range(3)]
+    runners = [await run_fake_engine(e, "127.0.0.1", 0) for e in engines]
+    urls = [e.self_url for e in engines]
+
+    args = build_parser().parse_args([])
+    args.static_backends = ",".join(urls)
+    args.static_models = ",".join([MODEL] * 3)
+    args.routing_logic = "roundrobin"
+    args.engine_stats_interval = 60
+    args.fault_tolerance = True
+    args.ft_max_retries = 3
+    args.ft_backoff_base = 0.02
+    args.ft_backoff_max = 0.25
+    args.ft_breaker_threshold = 10**6  # lease path only — no breaker assist
+    args.ft_breaker_reset = 60.0
+    args.ft_ttft_deadline = ttft_deadline_s
+    args.ft_inter_chunk_deadline = ttft_deadline_s
+    args.fleet_cache = True
+    args.fleet_min_match_chars = 256
+    args.fleet_pull_timeout = 2.0
+    args.kv_heartbeat_interval = heartbeat_interval
+    args.kv_lease_misses = lease_misses
+    router_app = build_app(args)
+    state = router_app["state"]
+    router_runner, router_url = await _start(router_app)
+    for e in engines:
+        await e.configure_kv(router_url,
+                             heartbeat_interval=heartbeat_interval)
+
+    # Shared long prefix (well past min_match_chars) so the fleet layer
+    # orchestrates cross-replica pulls; per-request suffix keeps each
+    # request distinct.
+    shared_prefix = ("The chaos storm prompt shares this long leading "
+                     "context so every replica's admissions overlap. "
+                     ) * 20
+
+    kill_t = [0.0]
+    chaos_fired = asyncio.Event()
+    finished = [0]
+    dead_url = urls[1].rstrip("/")
+
+    latencies: List[float] = []
+    failed = 0
+    sem = asyncio.Semaphore(concurrency)
+
+    async def one(session, i):
+        nonlocal failed
+        async with sem:
+            result = await _one_request(
+                session, router_url, client_timeout_s,
+                prompt=f"{shared_prefix} question #{i}")
+            if result is None:
+                failed += 1
+            else:
+                latencies.append(result)
+            finished[0] += 1
+            if finished[0] == chaos_after and not chaos_fired.is_set():
+                chaos_fired.set()
+                kill_t[0] = time.monotonic()
+                await engines[1].crash()
+
+    t_leg = time.perf_counter()
+    lease_expired_swept = 0
+    post_sweep_stale_pulls = 0
+    try:
+        async with aiohttp.ClientSession() as session:
+            await asyncio.gather(*[one(session, i) for i in range(total)])
+            # Wait (bounded) for the lease sweeper to expire the corpse.
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if state.kv_controller.swept_totals["expired"] > 0:
+                    break
+                await asyncio.sleep(0.05)
+            lease_expired_swept = state.kv_controller.swept_totals["expired"]
+            # Post-sweep probes: same shared prefix — none may pull from
+            # the dead holder anymore.
+            last_before = state.fleet.last_attempt_by_holder.get(dead_url)
+            await asyncio.gather(*[
+                one(session, total + i) for i in range(2 * concurrency)])
+            last_after = state.fleet.last_attempt_by_holder.get(dead_url)
+            if last_after is not None and last_after != last_before:
+                post_sweep_stale_pulls += 1
+    finally:
+        await router_runner.cleanup()
+        for i, runner in enumerate(runners):
+            if i != 1:  # replica 1 crashed mid-storm
+                await runner.cleanup()
+            else:
+                try:
+                    await runner.cleanup()
+                except Exception:  # noqa: BLE001 - site already dead
+                    pass
+        _reset_router_singletons()
+
+    grand_total = total + 2 * concurrency
+    lease_window_s = lease_misses * heartbeat_interval
+    last_stale = state.fleet.last_attempt_by_holder.get(dead_url)
+    stale_pull_window_s = (round(last_stale - kill_t[0], 3)
+                           if last_stale is not None else None)
+    bound_s = lease_window_s + heartbeat_interval + 2.0  # sweep + slack
+    return {
+        "kind": "kill9_lease_sweep",
+        "total": grand_total,
+        "completed": len(latencies),
+        "failed": failed,
+        "completion_rate": round(len(latencies) / grand_total, 4),
+        "p99_latency_s": round(_p99(latencies), 4) if latencies else None,
+        "leg_wall_s": round(time.perf_counter() - t_leg, 2),
+        "heartbeat_interval_s": heartbeat_interval,
+        "lease_misses": lease_misses,
+        "lease_window_s": lease_window_s,
+        "claims_swept_expired": lease_expired_swept,
+        "stale_pull_window_s": stale_pull_window_s,
+        "stale_pull_bound_s": bound_s,
+        "stale_pull_bound_ok": (stale_pull_window_s is None
+                                or stale_pull_window_s <= bound_s),
+        "post_sweep_stale_pulls": post_sweep_stale_pulls,
+        "fleet": state.fleet.health(),
+        "engine_requests": [len(e.requests_seen) for e in engines],
+    }
+
+
 async def run_chaos_ab(*, total: int = 120, concurrency: int = 12,
                        chaos_after: int = 30,
                        client_timeout_s: float = 8.0,
                        ttft_deadline_s: float = 2.0,
                        engine_ttft: float = 0.01,
-                       skip_off: bool = False) -> dict:
+                       skip_off: bool = False,
+                       include_kill9: bool = False) -> dict:
     """Run the ON leg then the OFF baseline; returns the A/B dict.
 
     ``skip_off`` runs only the ON leg (the tier-1 test uses it — the OFF
-    leg deliberately burns client timeouts and would slow the suite)."""
+    leg deliberately burns client timeouts and would slow the suite).
+    ``include_kill9`` adds the lease-sweep leg: a claim-holding replica
+    is kill -9'd mid-storm with the fleet cache on and the breaker
+    disabled, proving the lease path alone stops stale-holder pulls."""
     on = await _run_leg(
         ft_on=True, total=total, concurrency=concurrency,
         chaos_after=chaos_after, client_timeout_s=client_timeout_s,
@@ -188,6 +343,12 @@ async def run_chaos_ab(*, total: int = 120, concurrency: int = 12,
         off = await _run_leg(
             ft_on=False, total=total, concurrency=concurrency,
             chaos_after=chaos_after, client_timeout_s=client_timeout_s,
+            ttft_deadline_s=ttft_deadline_s, engine_ttft=engine_ttft)
+    kill9 = None
+    if include_kill9:
+        kill9 = await _run_kill9_leg(
+            total=total, concurrency=concurrency, chaos_after=chaos_after,
+            client_timeout_s=client_timeout_s,
             ttft_deadline_s=ttft_deadline_s, engine_ttft=engine_ttft)
     return {
         "metric": "chaos_failover_ab",
@@ -201,4 +362,5 @@ async def run_chaos_ab(*, total: int = 120, concurrency: int = 12,
         "ttft_deadline_s": ttft_deadline_s,
         "ft_on": on,
         "ft_off": off,
+        "kill9": kill9,
     }

@@ -116,8 +116,25 @@ class FakeEngine:
         self.self_url: Optional[str] = None
         self.api_key: Optional[str] = None
         self.instance_id = f"fake-{uuid.uuid4().hex[:8]}"
+        # Crash-consistency mirror of the real engine: a per-process
+        # generation id (a restarted FakeEngine object is a new
+        # incarnation), optional lease heartbeats, and the admitted
+        # root-anchored chunk paths the anti-entropy resync reasserts.
+        self.generation = uuid.uuid4().hex
+        self.heartbeat_interval = 0.0
+        self.admitted_paths: "set[tuple]" = set()
+        self.crashed = False
+        self._hb_task: Optional[asyncio.Task] = None
+        self._runner: Optional[web.AppRunner] = None
+        self._site: Optional[web.TCPSite] = None
         self.kv_pulls_received = 0
         self.kv_pulls_served = 0
+        self.kv_pulls_rejected = 0
+        # /kv/pull admission cap, mirroring the engine-side semaphore
+        # (0 = unlimited, the historical fake behavior).
+        self.kv_pull_max_concurrency = 0
+        self._pull_inflight = 0
+        self.pull_delay_s = 0.0
         self.pull_requests: List[dict] = []
         self.prefix_cache_hits = 0
         self.prefix_cache_queries = 0
@@ -178,13 +195,122 @@ class FakeEngine:
             pass
 
     async def configure_kv(self, controller_url: str,
-                           api_key: Optional[str] = None) -> None:
+                           api_key: Optional[str] = None,
+                           heartbeat_interval: float = 0.0) -> None:
         """Register with the router's KV controller (call after
-        run_fake_engine so ``self_url`` is stamped)."""
+        run_fake_engine so ``self_url`` is stamped). A positive
+        ``heartbeat_interval`` also starts the lease-heartbeat task,
+        mirroring the real engine's --kv-heartbeat-interval."""
         self.kv_controller_url = controller_url.rstrip("/")
         self.api_key = api_key
+        self.heartbeat_interval = float(heartbeat_interval)
         await self._kv_post("/kv/register", {
-            "instance_id": self.instance_id, "url": self.self_url})
+            "instance_id": self.instance_id, "url": self.self_url,
+            "generation": self.generation,
+            "heartbeat_interval": self.heartbeat_interval or None})
+        if self.heartbeat_interval > 0 and self._hb_task is None:
+            self._hb_task = asyncio.get_running_loop().create_task(
+                self._heartbeat_loop())
+
+    async def _heartbeat_loop(self) -> None:
+        import aiohttp
+
+        while True:
+            await asyncio.sleep(self.heartbeat_interval)
+            body: dict = {}
+            try:
+                async with aiohttp.ClientSession() as sess:
+                    async with sess.post(
+                        f"{self.kv_controller_url}/kv/heartbeat",
+                        json={"instance_id": self.instance_id,
+                              "generation": self.generation,
+                              "heartbeat_interval": self.heartbeat_interval,
+                              "url": self.self_url},
+                        headers=self._kv_headers(),
+                        timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                        if resp.status == 200:
+                            body = await resp.json()
+            except Exception:  # noqa: BLE001 - router may be gone in tests
+                continue
+            if not body.get("known"):
+                await self._kv_post("/kv/register", {
+                    "instance_id": self.instance_id, "url": self.self_url,
+                    "generation": self.generation,
+                    "heartbeat_interval": self.heartbeat_interval or None})
+                await self.resync_now()
+            elif body.get("revived"):
+                await self.resync_now()
+
+    async def resync_now(self) -> dict:
+        """One anti-entropy round, same protocol as the real engine:
+        digest check against the controller, full-state replace on
+        mismatch. Public so tests can drive a cycle deterministically."""
+        if self.kv_controller_url is None:
+            return {"match": None}
+        import aiohttp
+
+        from production_stack_tpu.kv.controller import claim_digest, path_keys
+
+        paths = [list(p) for p in sorted(self.admitted_paths)]
+        keys: "set[int]" = set()
+        for p in paths:
+            keys.update(path_keys(p))
+        count, xor = claim_digest(keys)
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async with sess.post(
+                    f"{self.kv_controller_url}/kv/resync",
+                    json={"instance_id": self.instance_id,
+                          "count": count, "xor": xor},
+                    headers=self._kv_headers(),
+                    timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                    check = await resp.json() if resp.status == 200 else {}
+                if check.get("match"):
+                    return {"match": True, "swept": 0}
+                async with sess.post(
+                    f"{self.kv_controller_url}/kv/resync_state",
+                    json={"instance_id": self.instance_id, "paths": paths},
+                    headers=self._kv_headers(),
+                    timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                    body = await resp.json() if resp.status == 200 else {}
+            return {"match": False, **body}
+        except Exception:  # noqa: BLE001 - controller may be gone in tests
+            return {"match": None}
+
+    def forget_prefix(self, prompt: str) -> None:
+        """Drop a prompt's chunks locally WITHOUT reporting /kv/evict —
+        the timeout-swallowed-evict drift the anti-entropy resync is
+        built to detect and heal."""
+        from production_stack_tpu.kv.controller import chunk_hashes
+
+        self.admitted_paths.discard(tuple(chunk_hashes(prompt)))
+        self.prefix_cache = set()
+        for p in self.admitted_paths:
+            self.prefix_cache.update(p)
+
+    async def crash(self) -> None:
+        """kill -9 simulation: heartbeats stop and the listening socket
+        closes abruptly; in-flight connections are aborted. NO drain, NO
+        /kv/deregister — the controller can only learn through missed
+        lease beats, which is exactly what the chaos leg asserts."""
+        self.crashed = True
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+            try:
+                await self._hb_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._hb_task = None
+        if self.self_url and FakeEngine._peers.get(self.self_url) is self:
+            del FakeEngine._peers[self.self_url]
+        if self._runner is not None and self._runner.server is not None:
+            for conn in list(self._runner.server.connections):
+                transport = getattr(conn, "transport", None)
+                if transport is not None:
+                    transport.abort()
+        if self._site is not None:
+            await self._site.stop()
+            self._site = None
 
     def _prefix_hashes(self, body: dict) -> "List[int]":
         # The simulated prefix cache only exists once the engine is
@@ -217,6 +343,7 @@ class FakeEngine:
         if not hashes:
             return
         self.prefix_cache.update(hashes)
+        self.admitted_paths.add(tuple(int(h) for h in hashes))
         if self.kv_controller_url:
             await self._kv_post("/kv/admit", {
                 "instance_id": self.instance_id, "hashes": hashes})
@@ -542,10 +669,17 @@ class FakeEngine:
         body = await request.json()
         mode = body.get("mode")
         valid = (None, "error_before_stream", "hang_before_stream",
-                 "hang_mid_stream", "crash_after_n_chunks", "pull_error")
+                 "hang_mid_stream", "crash_after_n_chunks", "pull_error",
+                 "crash")
         if mode not in valid:
             return web.json_response(
                 {"error": f"unknown fault mode {mode!r}"}, status=400)
+        if mode == "crash":
+            # Immediate, not per-request: the whole process "dies" (see
+            # crash()). Scheduled so this response can still be written.
+            self.faults_injected += 1
+            asyncio.get_running_loop().create_task(self.crash())
+            return web.json_response({"mode": "crash", "status": "dying"})
         self.fault_mode = mode
         self.fault_after_chunks = int(body.get("after_chunks", 0))
         self.fault_times = int(body.get("times", -1))
@@ -586,6 +720,13 @@ class FakeEngine:
         request sees them as cached (the TTFT win the router measures)."""
         body = await request.json()
         self.pull_requests.append(body)
+        if (self.kv_pull_max_concurrency > 0
+                and self._pull_inflight >= self.kv_pull_max_concurrency):
+            # Engine-side stampede control mirror: admission full.
+            self.kv_pulls_rejected += 1
+            return web.json_response(
+                {"status": "rejected", "error": "pull admission full"},
+                status=503, headers={"Retry-After": "1"})
         if self.fault_mode == "pull_error" and self.fault_times != 0:
             if self.fault_times > 0:
                 self.fault_times -= 1
@@ -595,21 +736,31 @@ class FakeEngine:
         source_url = str(body.get("source_url") or "").rstrip("/")
         hashes = self._prefix_hashes(body.get("request") or {})
         peer = FakeEngine._peers.get(source_url)
-        if peer is None or not hashes:
-            return web.json_response({"status": "miss", "injected_blocks": 0})
-        injected = 0
-        for h in hashes:
-            if h not in peer.prefix_cache:
-                break
-            self.prefix_cache.add(h)
-            injected += 1
-        if injected == 0:
-            return web.json_response({"status": "miss", "injected_blocks": 0})
-        peer.kv_pulls_served += 1
-        self.kv_pulls_received += 1
-        return web.json_response({
-            "status": "ok", "injected_blocks": injected,
-            "num_tokens": injected})
+        self._pull_inflight += 1
+        try:
+            if self.pull_delay_s > 0:
+                # Simulated transfer time, so stampede tests can observe
+                # real overlap at the admission gate.
+                await asyncio.sleep(self.pull_delay_s)
+            if peer is None or not hashes:
+                return web.json_response(
+                    {"status": "miss", "injected_blocks": 0})
+            injected = 0
+            for h in hashes:
+                if h not in peer.prefix_cache:
+                    break
+                self.prefix_cache.add(h)
+                injected += 1
+            if injected == 0:
+                return web.json_response(
+                    {"status": "miss", "injected_blocks": 0})
+            peer.kv_pulls_served += 1
+            self.kv_pulls_received += 1
+            return web.json_response({
+                "status": "ok", "injected_blocks": injected,
+                "num_tokens": injected})
+        finally:
+            self._pull_inflight -= 1
 
     async def handle_transcription(self, request: web.Request) -> web.Response:
         await request.post()
@@ -623,6 +774,13 @@ async def run_fake_engine(engine: FakeEngine, host: str, port: int) -> web.AppRu
     async def _unregister(app):
         # Drop the peer registration so a recycled port can't resolve to a
         # stopped engine's cache (same guard as the real server).
+        if engine._hb_task is not None:
+            engine._hb_task.cancel()
+            try:
+                await engine._hb_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            engine._hb_task = None
         if bound and FakeEngine._peers.get(bound[0]) is engine:
             del FakeEngine._peers[bound[0]]
 
@@ -636,6 +794,8 @@ async def run_fake_engine(engine: FakeEngine, host: str, port: int) -> web.AppRu
     bound.append(url)
     FakeEngine._peers[url] = engine
     engine.self_url = url
+    engine._runner = runner
+    engine._site = site
     return runner
 
 

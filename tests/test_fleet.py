@@ -199,12 +199,13 @@ class _FleetStack:
     controller) behind one real router."""
 
     def __init__(self, *, fleet_on=True, autoscale=False, ft_on=False,
-                 n=3, engine_ttft=0.05, **argover):
+                 n=3, engine_ttft=0.05, heartbeat=0.0, **argover):
         self.fleet_on = fleet_on
         self.autoscale = autoscale
         self.ft_on = ft_on
         self.n = n
         self.engine_ttft = engine_ttft
+        self.heartbeat = heartbeat
         self.argover = argover
         self.engines = []
         self.runners = []
@@ -252,7 +253,8 @@ class _FleetStack:
         self.app = build_app(args)
         self.router_runner, self.router_url = await _start(self.app)
         for eng in self.engines:
-            await eng.configure_kv(self.router_url)
+            await eng.configure_kv(self.router_url,
+                                   heartbeat_interval=self.heartbeat)
         return self
 
     async def __aexit__(self, *exc):
@@ -262,7 +264,10 @@ class _FleetStack:
 
         await self.router_runner.cleanup()
         for runner in self.runners:
-            await runner.cleanup()
+            try:
+                await runner.cleanup()
+            except Exception:  # noqa: BLE001 - a crash()ed engine's site
+                pass           # is already stopped
         _reset_router_singletons()
 
 
@@ -421,5 +426,171 @@ def test_fleet_flags_off_request_path_untouched():
                     assert r.status == 404
             assert all(e.pull_requests == [] for e in stack.engines)
             assert sum(e.kv_pulls_received for e in stack.engines) == 0
+
+    asyncio.run(run())
+
+
+# --------------------------------------------------------------------- #
+# Crash-consistent fleet state: leases, resync, stampede control
+# --------------------------------------------------------------------- #
+
+def test_lease_expiry_sweeps_crashed_replica():
+    """The CI-fast kill -9 leg (sub-second heartbeat): a replica that
+    crashes without drain or deregister holds routable claims only until
+    its lease lapses. One sweeper pass then sweeps its claims, marks it
+    expired (record kept for revival), and removes its URL from the
+    endpoints the router will pick — with zero request failures."""
+    async def run():
+        import aiohttp
+
+        from production_stack_tpu.router.app import lease_sweep_once
+
+        async with _FleetStack(fleet_on=True, ft_on=True, heartbeat=0.05,
+                               kv_heartbeat_interval=0.05,
+                               kv_lease_misses=4) as stack:
+            state = stack.app["state"]
+            async with aiohttp.ClientSession() as s:
+                # Prime: one distinct prompt per replica (round-robin),
+                # so the victim holds swept-able claims.
+                for i in range(3):
+                    assert await _chat(s, stack.router_url, i) == 200
+                victim = stack.engines[1]
+                assert victim.admitted_paths
+                dead_url = victim.self_url
+                await victim.crash()
+                # Outlive the lease window (4 * 0.05 s), then sweep.
+                # (The background sweeper runs at the same interval and
+                # may well have beaten us to it — the manual pass is
+                # idempotent and only guarantees a sweep has happened.)
+                await asyncio.sleep(0.5)
+                await lease_sweep_once(state)
+                assert state.kv_controller.swept_totals["expired"] >= 1
+                # Expired, not forgotten: a late beat could revive it.
+                snap = await state.kv_controller.instances_snapshot()
+                by_id = {r["instance_id"]: r for r in snap}
+                assert by_id[victim.instance_id]["state"] == "expired"
+                # Its claims no longer resolve, so no pull can target it.
+                match = await state.kv_controller.lookup(_prompt(1))
+                assert match is None or match[1] != victim.instance_id
+                # Service discovery stops offering the corpse.
+                eps = state.service_discovery.get_endpoint_info()
+                assert dead_url not in [ep.url for ep in eps]
+                assert dead_url in \
+                    state.service_discovery.get_unhealthy_endpoint_hashes()
+                # And the storm goes on: requests keep completing.
+                for i in range(6):
+                    assert await _chat(s, stack.router_url, i) == 200
+
+    asyncio.run(run())
+
+
+def test_resync_heals_timeout_swallowed_evict():
+    """Fire-and-forget evict reports can be swallowed by timeouts (the
+    engine treats controller calls as best-effort). The controller then
+    believes a replica holds a prefix it dropped — until one anti-entropy
+    round replaces its claims with the engine's authoritative state."""
+    async def run():
+        import aiohttp
+
+        async with _FleetStack(fleet_on=True) as stack:
+            ctl = stack.app["state"].kv_controller
+            async with aiohttp.ClientSession() as s:
+                assert await _chat(s, stack.router_url, 0) == 200
+            holder = next(e for e in stack.engines if e.requests_seen)
+            match = await ctl.lookup(_prompt(0))
+            assert match is not None and match[1] == holder.instance_id
+
+            # The drift: the engine drops the prefix locally but its
+            # /kv/evict report never lands.
+            holder.forget_prefix(_prompt(0))
+            stale = await ctl.lookup(_prompt(0))
+            assert stale is not None  # controller still points at it
+
+            # One resync cycle heals it: digest mismatch, full replace.
+            res = await holder.resync_now()
+            assert res["match"] is False
+            assert res["swept"] >= 1
+            assert ctl.swept_totals["resync"] >= 1
+            healed = await ctl.lookup(_prompt(0))
+            assert healed is None or healed[1] != holder.instance_id
+
+            # Steady state: the next round is a digest match (no replace).
+            assert (await holder.resync_now())["match"] is True
+
+    asyncio.run(run())
+
+
+def test_same_prefix_stampede_single_flight_and_holder_cap():
+    """32 concurrent requests sharing one prefix must not aim 32 pulls
+    at the holder: identical in-flight pulls per destination coalesce
+    (single-flight), and the holder serves at most
+    --kv-pull-max-concurrency transfers."""
+    async def run():
+        import aiohttp
+
+        cap = 4
+        async with _FleetStack(fleet_on=True,
+                               kv_pull_max_concurrency=cap) as stack:
+            for eng in stack.engines:
+                eng.pull_delay_s = 0.15  # force the pulls to overlap
+                eng.kv_pull_max_concurrency = cap
+            async with aiohttp.ClientSession() as s:
+                assert await _chat(s, stack.router_url, 7) == 200
+                holder = next(e for e in stack.engines if e.requests_seen)
+                statuses = await asyncio.gather(
+                    *[_chat(s, stack.router_url, 7) for _ in range(32)])
+            assert statuses.count(200) == 32, statuses
+            fleet = stack.app["state"].fleet
+            # Single-flight: concurrent identical pulls share one task.
+            assert fleet.pulls_coalesced > 0
+            # Holder-side bound: the stampede collapses to at most one
+            # transfer per non-holder destination, never above the cap.
+            assert 0 < holder.kv_pulls_served <= cap
+
+    asyncio.run(run())
+
+
+def test_same_url_restart_new_generation_sweeps_old_claims():
+    """Restart regression: a replica that comes back on the SAME url
+    with a fresh process generation atomically replaces the dead
+    incarnation — zero old-incarnation claims survive registration."""
+    async def run():
+        ctl = KVController(chunk_size=128)
+        text = "r" * 384
+        hashes = chunk_hashes(text, 128)
+        await ctl.register_instance("inc-1", "http://replica:9",
+                                    generation="g1",
+                                    heartbeat_interval=1.0)
+        await ctl.admit("inc-1", hashes)
+        assert (await ctl.lookup(text))[1] == "inc-1"
+
+        res = await ctl.register_instance("inc-2", "http://replica:9",
+                                          generation="g2",
+                                          heartbeat_interval=1.0)
+        assert res["swept"] >= 1
+        assert "inc-1" in res["superseded"]
+        assert ctl.swept_totals["regenerated"] >= 1
+        # The corpse is gone from the registry AND the trie.
+        assert await ctl.lookup(text) is None
+        snap = await ctl.instances_snapshot()
+        assert [r["instance_id"] for r in snap] == ["inc-2"]
+
+        # Same-generation re-register (e.g. heartbeat recovery) must NOT
+        # sweep its own claims.
+        await ctl.admit("inc-2", hashes)
+        res = await ctl.register_instance("inc-2", "http://replica:9",
+                                          generation="g2",
+                                          heartbeat_interval=1.0)
+        assert res["swept"] == 0
+        assert (await ctl.lookup(text))[1] == "inc-2"
+
+        # A legacy generation-less record at the same URL is also swept
+        # when a generation-bearing incarnation takes over.
+        await ctl.register_instance("legacy", "http://replica:7")
+        await ctl.admit("legacy", hashes)
+        res = await ctl.register_instance("inc-3", "http://replica:7",
+                                          generation="g3",
+                                          heartbeat_interval=1.0)
+        assert "legacy" in res["superseded"]
 
     asyncio.run(run())

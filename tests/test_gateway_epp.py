@@ -138,6 +138,71 @@ def test_epp_completion_prompt_and_file_watch(tmp_path):
         server.stop(0)
 
 
+def test_epp_excludes_heartbeat_expired_endpoints(epp):
+    """The EPP consumes the router's lease health view: an endpoint
+    whose KV heartbeat lease expired is excluded from every pick until
+    the view clears it (next-generation re-register). Router urls
+    (http://ip:port/) normalize to the EPP's bare ip:port form."""
+    pb2, stub, state, _ = epp
+    state.set_excluded(["http://10.0.0.4:8000/"])
+    assert state.excluded() == {"10.0.0.4:8000"}
+    assert state.endpoints() == ["10.0.0.5:8000"]
+    for i in range(4):
+        dest = _dest(_openai_exchange(pb2, stub, {
+            "model": "m", "messages": [
+                {"role": "user", "content": f"distinct pick {i}"}]})[1])
+        assert dest == "10.0.0.5:8000"
+    # Lease cleared: the replica is pickable again.
+    state.set_excluded([])
+    assert "10.0.0.4:8000" in state.endpoints()
+
+
+def test_epp_health_poll_tracks_router_expired_urls():
+    """EndpointState's router poll (--router-url) follows GET
+    /kv/instances: expired_urls leave the pick set, and rejoin when the
+    router stops reporting them."""
+    import http.server
+    import threading
+    import time
+
+    from epp_server import EndpointState
+
+    payload = {"expired_urls": ["http://10.0.0.5:8000"]}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        state = EndpointState(
+            ["10.0.0.4:8000", "10.0.0.5:8000"],
+            router_url=f"http://127.0.0.1:{srv.server_port}",
+            health_interval=0.05)
+        deadline = time.time() + 5
+        while (time.time() < deadline
+               and "10.0.0.5:8000" in state.endpoints()):
+            time.sleep(0.02)
+        assert state.endpoints() == ["10.0.0.4:8000"]
+        payload["expired_urls"] = []
+        deadline = time.time() + 5
+        while (time.time() < deadline
+               and "10.0.0.5:8000" not in state.endpoints()):
+            time.sleep(0.02)
+        assert state.endpoints() == ["10.0.0.4:8000", "10.0.0.5:8000"]
+    finally:
+        srv.shutdown()
+
+
 def _raw_exchange(pb2, stub, raw: bytes):
     """Headers + a raw (possibly hostile) body through the ext-proc
     stream; returns both responses."""

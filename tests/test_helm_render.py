@@ -594,7 +594,13 @@ def test_fleet_cache_and_autoscaling_render():
     values["routerSpec"]["fleetCache"] = {
         "enabled": True, "pullTimeoutSeconds": 10,
         "minMatchChars": 512, "l3Url": "",
+        "heartbeatInterval": 5, "leaseMisses": 4,
+        "pullMaxConcurrency": 6,
     }
+    values["servingEngineSpec"]["modelSpec"][0].update({
+        "kvHeartbeatInterval": 5, "kvResyncInterval": 30,
+        "kvPullMaxConcurrency": 6,
+    })
     values["routerSpec"]["autoscale"] = {
         "enabled": True, "minReplicas": 1, "maxReplicas": 6,
         "queueDepthTarget": 4, "hbmUsageHigh": 0.9,
@@ -615,6 +621,16 @@ def test_fleet_cache_and_autoscaling_render():
     assert "--fleet-cache" in cmd
     assert cmd[cmd.index("--fleet-pull-timeout") + 1] == "10"
     assert cmd[cmd.index("--fleet-min-match-chars") + 1] == "512"
+    # Crash-consistency knobs: claim leases + pull stampede control.
+    assert cmd[cmd.index("--kv-heartbeat-interval") + 1] == "5"
+    assert cmd[cmd.index("--kv-lease-misses") + 1] == "4"
+    assert cmd[cmd.index("--kv-pull-max-concurrency") + 1] == "6"
+    engine = [d for d in _docs(rendered, "Deployment")
+              if d["metadata"]["name"].endswith("-engine")][0]
+    ecmd = engine["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert ecmd[ecmd.index("--kv-heartbeat-interval") + 1] == "5"
+    assert ecmd[ecmd.index("--kv-resync-interval") + 1] == "30"
+    assert ecmd[ecmd.index("--kv-pull-max-concurrency") + 1] == "6"
     # l3Url unset + cache server enabled -> defaults to its Service.
     l3 = cmd[cmd.index("--fleet-l3-url") + 1]
     assert "-cache-server-service:8200" in l3, l3
