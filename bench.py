@@ -213,6 +213,18 @@ FLEET_USERS = _env_int("BENCH_FLEET_USERS", 10)
 FLEET_ROUNDS = _env_int("BENCH_FLEET_ROUNDS", 3)
 FLEET_CONCURRENCY = _env_int("BENCH_FLEET_CONCURRENCY", 4)
 FLEET_TTFT = _env_float("BENCH_FLEET_TTFT", 0.2)
+# Structured-output A/B: BENCH_STRUCTURED=1 runs the conformance +
+# mask-overhead harness (testing/structured_ab.py) — the 30-case corpus
+# through the real router to fake engines on both request surfaces,
+# then masked-vs-unmasked greedy tokens/s on the real CPU engine
+# (decode_steps=1 both legs). Writes BENCH_STRUCTURED_OUT (default
+# BENCH_STRUCTURED_r10.json) with the overhead percentage.
+STRUCTURED = _env_int("BENCH_STRUCTURED", 0)
+STRUCTURED_OUT = os.environ.get("BENCH_STRUCTURED_OUT",
+                                "BENCH_STRUCTURED_r10.json")
+STRUCTURED_REQS = _env_int("BENCH_STRUCTURED_REQS", 8)
+STRUCTURED_MAX_TOKENS = _env_int("BENCH_STRUCTURED_MAX_TOKENS", 32)
+STRUCTURED_REPEATS = _env_int("BENCH_STRUCTURED_REPEATS", 3)
 # --cold-repeat N: N fully cold serves, each in its own subprocess (no
 # warm jit caches, no reused pools — the cold-start number operators
 # actually see on a fresh replica). The artifact is rewritten and
@@ -734,6 +746,22 @@ def _fleet_main() -> None:
     print(json.dumps(result))
 
 
+def _structured_main() -> None:
+    """BENCH_STRUCTURED=1: corpus conformance (router + fake engines)
+    plus the mask-overhead A/B on the real CPU engine."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from production_stack_tpu.testing.structured_ab import run_structured_ab
+
+    result = run_structured_ab(
+        n_requests=STRUCTURED_REQS, max_tokens=STRUCTURED_MAX_TOKENS,
+        repeats=STRUCTURED_REPEATS)
+    result["backend"] = "fake+cpu-engine"
+    with open(os.path.join(REPO, STRUCTURED_OUT), "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result))
+
+
 def _cold_repeat_main(n: int, cpu: bool) -> None:
     """--cold-repeat N: run the configured scenario N times, each in an
     isolated subprocess so every serve is fully cold (fresh interpreter,
@@ -812,6 +840,9 @@ def main() -> None:
         return
     if FLEET:
         _fleet_main()
+        return
+    if STRUCTURED:
+        _structured_main()
         return
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"

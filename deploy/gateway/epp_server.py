@@ -139,12 +139,24 @@ class EndpointState:
         with self._lock:
             return [e for e in self._endpoints if e not in self._excluded]
 
-    def set_excluded(self, urls) -> None:
+    # Exclusion lists past this are garbage (or hostile): honoring one
+    # could exclude the whole fleet and blackhole the gateway, so the
+    # poll keeps its last-good view instead.
+    MAX_EXCLUDED_URLS = 4096
+
+    def set_excluded(self, urls) -> bool:
         """Replace the exclusion set (router urls or bare ip:port). An
         endpoint stays out of every pick until the view clears it — for
-        a lease-expired replica that is its next-generation re-register."""
+        a lease-expired replica that is its next-generation re-register.
+        Returns False — view unchanged — for malformed input: anything
+        but a list of strings, or an absurdly long list."""
+        if not isinstance(urls, (list, tuple)) \
+                or len(urls) > self.MAX_EXCLUDED_URLS \
+                or not all(isinstance(u, str) for u in urls):
+            return False
         with self._lock:
             self._excluded = {_norm_endpoint(u) for u in urls}
+        return True
 
     def excluded(self):
         with self._lock:
@@ -160,7 +172,18 @@ class EndpointState:
                         f"{self._router_url}/kv/instances",
                         timeout=5) as resp:
                     body = json.loads(resp.read().decode())
-                self.set_excluded(body.get("expired_urls") or [])
+                # A malformed response (non-object body, missing or
+                # non-list ``expired_urls``, non-string entries, an
+                # absurdly long list) keeps the LAST-GOOD exclusion
+                # view: clearing it would re-admit known-dead replicas
+                # on a router bug, honoring it could blackhole the
+                # fleet.
+                expired = (body.get("expired_urls")
+                           if isinstance(body, dict) else None)
+                if not self.set_excluded(expired):
+                    logger.debug(
+                        "health poll returned malformed expired_urls; "
+                        "keeping last-good exclusion view")
             except Exception as e:  # noqa: BLE001 - keep picking on a
                 logger.debug("health poll failed: %s", e)  # router outage
             time.sleep(self._health_interval)

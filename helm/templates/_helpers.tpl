@@ -78,6 +78,10 @@
 - --speculative-num-tokens
 - {{ .model.speculativeNumTokens | quote }}
 {{- end }}
+{{- if .model.structuredCacheSize }}
+- --structured-cache-size
+- {{ .model.structuredCacheSize | quote }}
+{{- end }}
 {{- if .model.kvOffloadGb }}
 - --kv-offload-gb
 - {{ .model.kvOffloadGb | quote }}

@@ -283,6 +283,40 @@ def build_dashboard():
              "multiple tokens per forward pass (1.0 = plain decode)"))
     y += 7
 
+    # ---- Row 6b: Structured output (grammar-constrained decoding) ------- #
+    panels.append(row("Structured Output", y)); y += 1
+    panels.append(panel(
+        "timeseries", "Structured requests (rate)",
+        [target("rate(tpu:structured_requests_total[5m])",
+                legend="{{instance}}")],
+        grid(7, 6, 0, y),
+        desc="Requests decoding under a grammar constraint "
+             "(response_format / guided_json / guided_regex)"))
+    panels.append(panel(
+        "timeseries", "Constraint compile time (rate)",
+        [target("rate(tpu:structured_compile_seconds_total[5m])",
+                legend="{{instance}}")],
+        grid(7, 6, 6, y), unit="s",
+        desc="Wall time compiling schemas/regexes to token FSMs — cache "
+             "misses only; a rising rate means schema churn is outrunning "
+             "--structured-cache-size"))
+    panels.append(panel(
+        "timeseries", "FSM mask states materialized (rate)",
+        [target("rate(tpu:structured_mask_states_total[5m])",
+                legend="{{instance}}")],
+        grid(7, 6, 12, y),
+        desc="DFA states whose allowed-token bitmask was classified "
+             "against the vocab (lazy; tracks grammar diversity, not "
+             "request volume)"))
+    panels.append(panel(
+        "stat", "Grammar violations",
+        [target("sum(tpu:structured_violations_total)", instant=True)],
+        grid(7, 6, 18, y),
+        desc="Emitted tokens that left the grammar (mask bug) or "
+             "requests finished mid-grammar by length/stop — nonzero "
+             "deserves a look"))
+    y += 7
+
     # ---- Row 7: TPU KV cache & offload (TPU-native; beyond the ref) ----- #
     panels.append(row("TPU KV Cache & Offload", y)); y += 1
     panels.append(panel(

@@ -98,6 +98,10 @@ class EngineConfig:
     # warmup window before reverting to plain fused decode bursts.
     speculative_accept_threshold: float = 0.35
     speculative_accept_window: int = 32
+    # Structured output: LRU capacity of the compiled token-FSM cache
+    # (entries keyed by (schema-hash, tokenizer); one entry serves every
+    # concurrent request with the same constraint).
+    structured_cache_size: int = 32
     # Sampling safety cap
     max_top_k: int = 64
     seed: int = 0
@@ -153,6 +157,8 @@ class EngineConfig:
                 "speculative_num_tokens must be 0 (off) or >= 2")
         if self.speculative_ngram_size < 1:
             raise ValueError("speculative_ngram_size must be >= 1")
+        if self.structured_cache_size < 1:
+            raise ValueError("structured_cache_size must be >= 1")
         if self.hbm_headroom_reserve < 0:
             raise ValueError("hbm_headroom_reserve must be >= 0")
         if self.pool_shrink_retries < 0:

@@ -30,6 +30,7 @@ from production_stack_tpu.engine.sampling import (
     MAX_STOP_IDS,
     SamplingParams,
     accepted_prefix_len,
+    apply_fsm_mask,
     logprob_outputs,
     make_rng_keys,
     sample_tokens,
@@ -41,6 +42,12 @@ from production_stack_tpu.engine.scheduler import (
     SpecState,
 )
 from production_stack_tpu.engine.tokenizer import build_tokenizer
+from production_stack_tpu.structured.api import compile_char_dfa
+from production_stack_tpu.structured.tokenfsm import (
+    FSMState,
+    StructuredCache,
+    mask_row_bytes,
+)
 from production_stack_tpu.models import build_model, get_model_config
 from production_stack_tpu.parallel import multihost
 from production_stack_tpu.parallel.mesh import build_mesh
@@ -465,6 +472,14 @@ class EngineCore:
         self.spec_disabled_requests_total = 0
         self.spec_verify_bursts_total = 0
         self.decode_forward_steps_total = 0
+        # Structured output: compiled token-FSM cache (LRU, knob-sized)
+        # and the tpu:structured_* counters. The packed mask row width is
+        # fixed by the padded vocab so every program shares one shape.
+        self._structured_cache = StructuredCache(
+            self.config.structured_cache_size)
+        self._mask_row_bytes = mask_row_bytes(self.model_config.vocab_size)
+        self.structured_requests_total = 0
+        self.structured_violations_total = 0
         # Warmup variant counts per program family (compile-budget
         # regression tests read this; also logged at the end of warmup).
         self.warmup_variants: Dict[str, int] = {}
@@ -754,7 +769,8 @@ class EngineCore:
         def fwd(params, kv, token_ids, positions, slot_mapping,
                 block_tables, context_lens, seq_lens, adapter_ids,
                 temperature, top_k, top_p, seq_seeds, steps,
-                suppress_eos, bias_ids, bias_vals, stop_ids, stop_valid):
+                suppress_eos, bias_ids, bias_vals, stop_ids, stop_valid,
+                mask_bits, mask_on):
             # Prefill: only the last REAL token's logits are ever read,
             # so the model slices hidden states to that position before
             # the vocab projection (for 128k-vocab models the full
@@ -780,6 +796,9 @@ class EngineCore:
             shaped = shaped.at[jnp.arange(B)[:, None], stop_ids].add(
                 -1e30 * stop_valid
                 * suppress_eos.astype(jnp.float32)[:, None])
+            # Structured output: grammar FSM mask (packed bitset rows;
+            # all-off for unconstrained sequences).
+            shaped = apply_fsm_mask(shaped, mask_bits, mask_on)
             keys = make_rng_keys(seed_static, steps.max(), seq_seeds + steps)
             sampled = sample_tokens(
                 shaped, keys, temperature, top_k, top_p, max_top_k=max_top_k
@@ -820,7 +839,7 @@ class EngineCore:
                 context0, adapter_ids, temperature, top_k, top_p,
                 seed_base, presence_penalty, frequency_penalty,
                 min_tokens, out_len0, bias_ids, bias_vals,
-                stop_ids, stop_valid):
+                stop_ids, stop_valid, mask_bits, mask_on):
             # tokens_prev: [B, K] the PREVIOUS burst's sampled tokens (device
             # array — the feedback token never round-trips to the host, which
             # is what lets the engine dispatch burst N+1 before reading
@@ -873,6 +892,12 @@ class EngineCore:
                     jnp.arange(B)[:, None], stop_ids].add(
                     -1e30 * stop_valid
                     * suppress.astype(jnp.float32)[:, None])
+                # Structured output: the FSM mask is constant across the
+                # scan (the host advances the automaton only at burst
+                # boundaries), so structured rows are scheduled with
+                # allow=1 — steps past the first are discarded at
+                # emission and their stale mask never reaches a stream.
+                penalized = apply_fsm_mask(penalized, mask_bits, mask_on)
                 keys = make_rng_keys(seed, 0, seed_base + s)
                 sampled = sample_tokens(
                     penalized, keys, temperature, top_k, top_p,
@@ -951,7 +976,7 @@ class EngineCore:
         def fwd(params, kv, tokens, positions0, slot_mat, block_tables,
                 context0, adapter_ids, temperature, top_k, top_p,
                 seed_base, min_tokens, out_len0, bias_ids, bias_vals,
-                stop_ids, stop_valid):
+                stop_ids, stop_valid, mask_bits, mask_on):
             B = tokens.shape[0]
             positions = positions0[:, None] + jnp.arange(K)[None, :]
             logits, kv = apply(
@@ -977,6 +1002,13 @@ class EngineCore:
                     jnp.arange(B)[:, None], stop_ids].add(
                     -1e30 * stop_valid
                     * suppress.astype(jnp.float32)[:, None])
+                # Structured output: position s's mask is precomputed on
+                # the host from the FSM state AFTER drafts 0..s-1 —
+                # exactly the mask plain decode would apply at that step,
+                # so drafts that exit the language are rejected here by
+                # the same term (mask_bits [B, K, MB], mask_on [B, K]).
+                penalized = apply_fsm_mask(
+                    penalized, mask_bits[:, s], mask_on[:, s])
                 keys = make_rng_keys(seed, 0, seed_base + s)
                 sampled = sample_tokens(
                     penalized, keys, temperature, top_k, top_p,
@@ -1638,7 +1670,9 @@ class EngineCore:
                         np.zeros((1, MAX_LOGIT_BIAS), np.int32),
                         np.zeros((1, MAX_LOGIT_BIAS), np.float32),
                         np.zeros((1, MAX_STOP_IDS), np.int32),
-                        np.zeros((1, MAX_STOP_IDS), np.float32))
+                        np.zeros((1, MAX_STOP_IDS), np.float32),
+                        np.zeros((1, self._mask_row_bytes), np.uint8),
+                        np.zeros((1,), bool))
                 # Plain prefill only ever sees context == span -> one tight
                 # table width per bucket.
                 _, self.kv = self._prefill_fn(
@@ -1674,7 +1708,9 @@ class EngineCore:
                           np.zeros((R, MAX_LOGIT_BIAS), np.int32),
                           np.zeros((R, MAX_LOGIT_BIAS), np.float32),
                           np.zeros((R, MAX_STOP_IDS), np.int32),
-                          np.zeros((R, MAX_STOP_IDS), np.float32))
+                          np.zeros((R, MAX_STOP_IDS), np.float32),
+                          np.zeros((R, self._mask_row_bytes), np.uint8),
+                          np.zeros((R,), bool))
                 maxb_b = 4
                 maxb_cap = self._prefill_batch_maxb()
                 while True:
@@ -1738,6 +1774,8 @@ class EngineCore:
                         np.zeros((B, MAX_LOGIT_BIAS), np.float32),
                         np.zeros((B, MAX_STOP_IDS), np.int32),
                         np.zeros((B, MAX_STOP_IDS), np.float32),
+                        np.zeros((B, self._mask_row_bytes), np.uint8),
+                        np.zeros((B,), bool),
                     )
                     n_decode += 1
                     if maxb_w >= cfg.max_blocks_per_seq:
@@ -1772,6 +1810,8 @@ class EngineCore:
                         np.zeros((B, MAX_LOGIT_BIAS), np.float32),
                         np.zeros((B, MAX_STOP_IDS), np.int32),
                         np.zeros((B, MAX_STOP_IDS), np.float32),
+                        np.zeros((B, Ks, self._mask_row_bytes), np.uint8),
+                        np.zeros((B, Ks), bool),
                     )
                     n_spec += 1
                     if maxb_w >= cfg.max_blocks_per_seq:
@@ -1801,6 +1841,18 @@ class EngineCore:
             on_token(None, "error")
             return
         adapter_id = self.lora_slots.get(adapter_name or "", 0)
+        structured = None
+        if sampling.structured is not None:
+            try:
+                structured = FSMState(
+                    self._structured_fsm(sampling.structured))
+            except Exception:  # noqa: BLE001 - server pre-validates; defensive
+                logger.exception(
+                    "Structured constraint failed to compile for %s",
+                    request_id)
+                on_token(None, "error")
+                return
+            self.structured_requests_total += 1
         req = EngineRequest(
             request_id=request_id,
             prompt_token_ids=list(prompt_token_ids),
@@ -1810,10 +1862,36 @@ class EngineCore:
             adapter_name=(adapter_name or "") if adapter_id else "",
             priority=priority,
             trace=trace,
+            structured=structured,
         )
         with self._lock:
             self.scheduler.add(req)
             self._lock.notify()
+
+    def _structured_fsm(self, spec):
+        """Compiled token FSM for a StructuredSpec, LRU-cached by
+        (schema-hash, tokenizer key)."""
+        tok = self.tokenizer
+        tok_key = "%s-%d-%s" % (type(tok).__name__,
+                                self.model_config.vocab_size,
+                                self.config.model)
+        eos = getattr(tok, "eos_token_id", None)
+        return self._structured_cache.get(
+            spec.kind, spec.spec, tok, tok_key,
+            self.model_config.vocab_size,
+            int(eos) if eos is not None else None,
+            lambda: compile_char_dfa(spec))
+
+    def _fill_mask_row(self, mask_bits: np.ndarray, mask_on: np.ndarray,
+                       i: int, req: EngineRequest) -> None:
+        """Install row ``i``'s FSM mask from the request's CURRENT
+        automaton state (no-op for unconstrained or dead-latched rows:
+        the all-off row leaves the logits untouched in-program)."""
+        st = req.structured
+        if st is None or not st.masking:
+            return
+        mask_bits[i, :] = st.mask_row()
+        mask_on[i] = True
 
     def abort_request(self, request_id: str) -> bool:
         with self._lock:
@@ -2140,6 +2218,13 @@ class EngineCore:
             "spec_accepted_tokens_total": self.spec_accepted_tokens_total,
             "spec_disabled_requests_total": self.spec_disabled_requests_total,
             "spec_verify_bursts_total": self.spec_verify_bursts_total,
+            "structured_requests_total": self.structured_requests_total,
+            "structured_compile_seconds_total": round(
+                self._structured_cache.compile_seconds_total, 6),
+            "structured_mask_states_total":
+                self._structured_cache.mask_states_total,
+            "structured_violations_total": self.structured_violations_total,
+            "structured_cache_entries": len(self._structured_cache),
         }
 
     # ------------------------------------------------------------------ #
@@ -2751,6 +2836,8 @@ class EngineCore:
         bias_vals = np.zeros((R, MAX_LOGIT_BIAS), np.float32)
         stop_ids = np.zeros((R, MAX_STOP_IDS), np.int32)
         stop_valid = np.zeros((R, MAX_STOP_IDS), np.float32)
+        mask_bits = np.zeros((R, self._mask_row_bytes), np.uint8)
+        mask_on = np.zeros((R,), bool)
 
         for i, (req, tokens, block_ids, start, end) in enumerate(rows):
             take = end - start
@@ -2776,12 +2863,18 @@ class EngineCore:
                                 self._resume_bias(req))
             self._fill_stop_row(stop_ids[i], stop_valid[i],
                                 req.sampling.stop_token_ids)
+            # Structured: the chunk's sampled token only matters on the
+            # FINAL span, where the FSM is at the request's current state
+            # (re-prefill after preemption included — output tokens were
+            # already advanced through the automaton at emission).
+            self._fill_mask_row(mask_bits, mask_on, i, req)
 
         return self._dispatch("prefill", {"cached": True}, [
             token_arr, positions, slot_mapping,
             block_table, context_lens, seq_lens, adapter_ids,
             temp, topk, topp, seeds, steps,
             suppress_eos, bias_ids, bias_vals, stop_ids, stop_valid,
+            mask_bits, mask_on,
         ])
 
     def _prefill_span(self, req: EngineRequest, tokens, block_ids,
@@ -2831,6 +2924,9 @@ class EngineCore:
         stop_valid = np.zeros((1, MAX_STOP_IDS), np.float32)
         self._fill_stop_row(stop_ids[0], stop_valid[0],
                             req.sampling.stop_token_ids)
+        mask_bits = np.zeros((1, self._mask_row_bytes), np.uint8)
+        mask_on = np.zeros((1,), bool)
+        self._fill_mask_row(mask_bits, mask_on, 0, req)
 
         return self._dispatch("prefill", {"cached": start > 0}, [
             token_arr, positions, slot_mapping,
@@ -2839,6 +2935,7 @@ class EngineCore:
             np.asarray([p_], np.float32), np.asarray([seed], np.int64),
             np.asarray([len(tokens)], np.int64),
             suppress_eos, bias_ids, bias_vals, stop_ids, stop_valid,
+            mask_bits, mask_on,
         ])
 
     # -- decode ------------------------------------------------------------
@@ -2867,6 +2964,17 @@ class EngineCore:
             if plan:
                 self._do_decode_spec(plan)
                 return
+        # Structured rows build their mask from the CURRENT automaton
+        # state, which the host only learns by reading back the in-flight
+        # burst — so a structured participant collapses the dispatch/
+        # readback pipeline exactly like spec mode (flush first, feedback
+        # via host_tokens).
+        with self._lock:
+            has_structured = any(
+                s.req.structured is not None and s.req.structured.masking
+                for s in self.scheduler.running())
+        if has_structured:
+            self._flush_pending_burst()
         B = cfg.max_num_seqs
         K = max(cfg.decode_steps, 1)
         # Prompts waiting AND admissible (free slot — a slot-blocked
@@ -2890,6 +2998,12 @@ class EngineCore:
         # Bounds use all_token_ids which may lag the in-flight burst, so
         # this over-schedules at most one extra burst near the end caps.
         def seq_allow(r: EngineRequest) -> int:
+            if r.structured is not None and r.structured.masking:
+                # The FSM mask is constant across the scan (the host
+                # advances the automaton only at burst boundaries):
+                # schedule one usable step — later steps would sample
+                # under a stale mask — and discard the rest at emission.
+                return 1
             return max(1, min(
                 K,
                 r.sampling.max_tokens - len(r.output_token_ids),
@@ -2962,6 +3076,8 @@ class EngineCore:
         bias_vals = np.zeros((B, MAX_LOGIT_BIAS), np.float32)
         stop_ids = np.zeros((B, MAX_STOP_IDS), np.int32)
         stop_valid = np.zeros((B, MAX_STOP_IDS), np.float32)
+        mask_bits = np.zeros((B, self._mask_row_bytes), np.uint8)
+        mask_on = np.zeros((B,), bool)
         reset_counts = np.zeros((B,), bool)
         with self._lock:
             for slot in self._counts_reset:
@@ -3009,6 +3125,7 @@ class EngineCore:
                                 r.sampling.logit_bias)
             self._fill_stop_row(stop_ids[i], stop_valid[i],
                                 r.sampling.stop_token_ids)
+            self._fill_mask_row(mask_bits, mask_on, i, r)
             r.scheduled_steps += allow
 
         outs = self._dispatch(
@@ -3017,6 +3134,7 @@ class EngineCore:
                 slot_mat, block_table, context0, adapter_ids, temperature,
                 top_k, top_p, seed_base, presence, frequency,
                 min_tok, out_len0, bias_ids, bias_vals, stop_ids, stop_valid,
+                mask_bits, mask_on,
             ])
         self.decode_forward_steps_total += K
         # Read back the PREVIOUS burst (overlaps this burst's execution).
@@ -3130,6 +3248,8 @@ class EngineCore:
         bias_vals = np.zeros((B, MAX_LOGIT_BIAS), np.float32)
         stop_ids = np.zeros((B, MAX_STOP_IDS), np.int32)
         stop_valid = np.zeros((B, MAX_STOP_IDS), np.float32)
+        mask_bits = np.zeros((B, K, self._mask_row_bytes), np.uint8)
+        mask_on = np.zeros((B, K), bool)
 
         for seq in active:
             i = seq.slot
@@ -3162,6 +3282,24 @@ class EngineCore:
                                 r.sampling.logit_bias)
             self._fill_stop_row(stop_ids[i], stop_valid[i],
                                 r.sampling.stop_token_ids)
+            st = r.structured
+            if st is not None and st.masking:
+                # Per-position masks walked through the draft: position
+                # s gets the mask plain decode would apply after
+                # emitting drafts 0..s-1. If the draft exits the
+                # language at position t, position t's mask makes
+                # sampled[t] != draft[t], so acceptance stops there and
+                # the unmasked positions past it are never emitted —
+                # drafts outside the grammar are rejected by the SAME
+                # term the plain path applies.
+                cur = st.state
+                for s in range(allow):
+                    if cur < 0:
+                        break
+                    mask_bits[i, s] = st.fsm.mask_row(cur)
+                    mask_on[i, s] = True
+                    if s < len(draft):
+                        cur = st.fsm.advance(cur, draft[s])
             # scheduled_steps advances at FLUSH by the emitted count —
             # acceptance is data-dependent, unlike the plain burst.
 
@@ -3170,7 +3308,7 @@ class EngineCore:
                 tokens, positions0, slot_mat, block_table, context0,
                 adapter_ids, temperature, top_k, top_p, seed_base,
                 min_tok, out_len0, bias_ids, bias_vals, stop_ids,
-                stop_valid,
+                stop_valid, mask_bits, mask_on,
             ])
         self.spec_verify_bursts_total += 1
         self.decode_forward_steps_total += 1
@@ -3343,6 +3481,15 @@ class EngineCore:
         otherwise the bare int (the common path stays allocation-free)."""
         req = seq.req
         req.output_token_ids.append(token)
+        if req.structured is not None and not req.structured.advance(token):
+            # The emitted token left the grammar — the mask makes this
+            # unreachable, so any hit is a masking bug worth a loud
+            # counter. The request latches mask-off (dead) and finishes
+            # unconstrained rather than sampling from an all -1e30 row.
+            self.structured_violations_total += 1
+            logger.warning(
+                "Structured request %s emitted token %d outside its "
+                "grammar", req.request_id, token)
         if req.trace is not None:
             now = time.time()
             if not req.trace.first_token:
@@ -3366,6 +3513,12 @@ class EngineCore:
         payload = token if lp is None else (token, lp)
         req.on_token(payload, None)
         if finish is not None:
+            st = req.structured
+            if st is not None and not st.dead and not st.accepting:
+                # Finished (length cap / stop sequence) with the
+                # automaton mid-structure: the stream is not a complete
+                # member of the grammar.
+                self.structured_violations_total += 1
             with self._lock:
                 self.scheduler.finish(seq, finish)
             self.requests_finished_total += 1

@@ -16,6 +16,21 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from production_stack_tpu.structured.api import parse_structured
+
+
+def _strict_int(body: dict, key: str) -> Optional[int]:
+    """JSON-typed integer field: present -> must be an actual integer.
+    ``int()`` coercion accepted "7.9", True and floats here before —
+    the QoS admission estimator then charged the coerced value while
+    the client believed the literal one (the PR 8 gaming surface)."""
+    value = body.get(key)
+    if value is None:
+        return None
+    if isinstance(value, bool) or not isinstance(value, int):
+        raise ValueError(f"'{key}' must be an integer")
+    return value
+
 
 @dataclasses.dataclass
 class SamplingParams:
@@ -42,6 +57,10 @@ class SamplingParams:
     logit_bias: Optional[dict] = None
     # Completions-only: prepend the prompt text to the output.
     echo: bool = False
+    # Structured output: a StructuredSpec (guided_json / guided_regex /
+    # response_format), compiled by the engine to a token FSM whose mask
+    # joins the in-program logit shaping.
+    structured: Optional[object] = None
 
     @staticmethod
     def from_request(body: dict, default_max_tokens: int = 16) -> "SamplingParams":
@@ -60,13 +79,34 @@ class SamplingParams:
             logprobs = None
         else:
             logprobs = int(lp_raw)
+        bias_raw = body.get("logit_bias") or {}
+        if not isinstance(bias_raw, dict):
+            raise ValueError("'logit_bias' must be an object")
+        logit_bias = {}
+        for k, v in bias_raw.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ValueError(
+                    "'logit_bias' values must be numbers")
+            try:
+                logit_bias[int(k)] = float(v)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "'logit_bias' keys must be token ids")
+        structured = parse_structured(body)
+        min_tokens = _strict_int(body, "min_tokens") or 0
+        if structured is not None and min_tokens > 0:
+            # The grammar dictates termination: in a completed FSM state
+            # only EOS is legal, while min_tokens masks EOS — the two
+            # constraints are jointly unsatisfiable in-program.
+            raise ValueError(
+                "'min_tokens' is incompatible with structured output")
         return SamplingParams(
             temperature=1.0 if t is None else float(t),
             top_p=1.0 if p is None else float(p),
             top_k=int(body.get("top_k") or 0),
-            max_tokens=int(
-                body.get("max_tokens")
-                or body.get("max_completion_tokens")
+            max_tokens=(
+                _strict_int(body, "max_tokens")
+                or _strict_int(body, "max_completion_tokens")
                 or default_max_tokens
             ),
             stop=stop,
@@ -76,12 +116,12 @@ class SamplingParams:
             frequency_penalty=float(body.get("frequency_penalty") or 0.0),
             n=max(int(body.get("n") or 1), 1),
             logprobs=logprobs,
-            min_tokens=int(body.get("min_tokens") or 0),
+            min_tokens=min_tokens,
             stop_token_ids=[int(t) for t in
                             (body.get("stop_token_ids") or [])] or None,
-            logit_bias={int(k): float(v) for k, v in
-                        (body.get("logit_bias") or {}).items()} or None,
+            logit_bias=logit_bias or None,
             echo=bool(body.get("echo", False)),
+            structured=structured,
         )
 
 
@@ -132,6 +172,33 @@ MAX_LOGIT_BIAS = 32
 # stop_token_ids capacity in the serving programs (masked alongside EOS
 # while min_tokens is unmet, vLLM semantics).
 MAX_STOP_IDS = 8
+
+
+# Structured-output FSM mask: finite large-negative (like the stop-id
+# term) so temperature scaling can't produce NaNs the way -inf can.
+FSM_MASK_NEG = -1e30
+
+
+def apply_fsm_mask(logits: jax.Array, mask_bits: jax.Array,
+                   mask_on: jax.Array) -> jax.Array:
+    """Dense packed-bitmask grammar term for the fused programs.
+
+    ``mask_bits`` is ``uint8 [B, ceil(V/8)]`` with bit ``v`` of row
+    ``b`` (little bitorder, ``numpy.packbits`` layout) = token ``v``
+    allowed; ``mask_on [B] bool`` gates rows so unconstrained sequences
+    pass through bit-identically. Dense rather than sparse: a grammar
+    state routinely allows hundreds of tokens, far past the
+    ``MAX_LOGIT_BIAS`` sparse capacity, and the packed row is only
+    ``V/8`` bytes of host->device traffic. A data-shaped input, so
+    adding it compiles zero new program variants."""
+    V = logits.shape[-1]
+    B, MB = mask_bits.shape
+    # Shift-and-reshape unpack (no gather): byte v//8 bit v%8 -> token v.
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (mask_bits[:, :, None] >> shifts[None, None, :]) & jnp.uint8(1)
+    bits = bits.reshape(B, MB * 8)[:, :V]
+    allowed = (bits != 0) | (~mask_on)[:, None]
+    return jnp.where(allowed, logits, FSM_MASK_NEG)
 
 
 # Static top-K for logprob outputs baked into the serving programs

@@ -148,6 +148,10 @@ class EngineRequest:
     # Prompt-lookup speculative decoding (engine-thread only; created
     # lazily by the engine when --speculative-num-tokens > 0).
     spec: Optional[SpecState] = None
+    # Structured output (engine-thread only): FSMState holding the shared
+    # TokenFSM plus this request's DFA position; set by the engine when
+    # sampling carries a grammar constraint.
+    structured: Optional[object] = None
 
     @property
     def all_token_ids(self) -> List[int]:

@@ -45,6 +45,7 @@ from aiohttp import web
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.core import EngineCore
 from production_stack_tpu.engine.sampling import MAX_LOGIT_BIAS, SamplingParams
+from production_stack_tpu.structured.api import compile_char_dfa
 from production_stack_tpu.engine.scheduler import parse_priority
 from production_stack_tpu.engine.tokenizer import IncrementalDetokenizer
 from production_stack_tpu.engine.tools import (
@@ -720,8 +721,7 @@ class EngineServer:
         adapter = self._resolve_adapter(model)
         self._report_kv_admission(prompt, prompt_ids, adapter or "",
                                   offsets=offs)
-        sampling = SamplingParams.from_request(body, default_max_tokens=128)
-        bad = self._reject_sampling(sampling)
+        sampling, bad = self._parse_sampling(body, default_max_tokens=128)
         if bad is not None:
             return bad
         rid = request.headers.get("X-Request-Id") or f"chatcmpl-{uuid.uuid4().hex[:16]}"
@@ -756,8 +756,7 @@ class EngineServer:
             prompt_ids, offs = self._encode_prompt(str(prompt))
             self._report_kv_admission(
                 str(prompt), prompt_ids, adapter or "", offsets=offs)
-        sampling = SamplingParams.from_request(body, default_max_tokens=16)
-        bad = self._reject_sampling(sampling)
+        sampling, bad = self._parse_sampling(body, default_max_tokens=16)
         if bad is not None:
             return bad
         rid = request.headers.get("X-Request-Id") or f"cmpl-{uuid.uuid4().hex[:16]}"
@@ -765,6 +764,24 @@ class EngineServer:
             request, body, prompt_ids, sampling, rid, model, adapter,
             kind="completion",
         )
+
+    def _parse_sampling(self, body: dict, *, default_max_tokens: int):
+        """(sampling, None) or (None, 400 response). Malformed sampling
+        fields (non-integer max_tokens, non-numeric logit_bias values)
+        and uncompilable structured constraints are client errors — the
+        constraint DFA is compiled here, before the request is admitted,
+        so a bad schema can never reach the engine thread (the compile
+        is memoized, so the engine's own lookup is then a cache hit)."""
+        try:
+            sampling = SamplingParams.from_request(
+                body, default_max_tokens=default_max_tokens)
+            if sampling.structured is not None:
+                compile_char_dfa(sampling.structured)
+        except ValueError as exc:  # StructuredError is a ValueError
+            return None, web.json_response(
+                {"error": {"message": str(exc),
+                           "type": "BadRequestError"}}, status=400)
+        return sampling, self._reject_sampling(sampling)
 
     @staticmethod
     def _reject_sampling(sampling) -> Optional[web.Response]:
@@ -2302,6 +2319,21 @@ class EngineServer:
             "# TYPE tpu:decode_forward_steps counter",
             f"tpu:decode_forward_steps_total{{{labels}}} "
             f"{s.get('decode_forward_steps_total', 0)}",
+            # Structured output (guided_json / guided_regex /
+            # response_format): grammar constraints compiled to token FSMs
+            # applied inside the fused programs.
+            "# TYPE tpu:structured_requests counter",
+            f"tpu:structured_requests_total{{{labels}}} "
+            f"{s.get('structured_requests_total', 0)}",
+            "# TYPE tpu:structured_compile_seconds counter",
+            f"tpu:structured_compile_seconds_total{{{labels}}} "
+            f"{s.get('structured_compile_seconds_total', 0.0):.6f}",
+            "# TYPE tpu:structured_mask_states counter",
+            f"tpu:structured_mask_states_total{{{labels}}} "
+            f"{s.get('structured_mask_states_total', 0)}",
+            "# TYPE tpu:structured_violations counter",
+            f"tpu:structured_violations_total{{{labels}}} "
+            f"{s.get('structured_violations_total', 0)}",
         ]
         # Admission rejections by reason; both reasons always emitted so
         # rate() queries never see a vanishing series.
@@ -2449,6 +2481,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--speculative-ngram-size", type=int, default=3,
                    help="n-gram length matched by the prompt-lookup "
                         "draft index")
+    p.add_argument("--structured-cache-size", type=int, default=32,
+                   help="LRU capacity of the compiled structured-output "
+                        "token-FSM cache (one entry per distinct "
+                        "schema/regex per tokenizer)")
     p.add_argument("--prefill-batch", type=int, default=1,
                    help="batch up to N queued long-prompt prefills into "
                         "one dispatch (1 disables; see EngineConfig."
@@ -2538,6 +2574,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         seed=args.seed,
         speculative_num_tokens=args.speculative_num_tokens,
         speculative_ngram_size=args.speculative_ngram_size,
+        structured_cache_size=args.structured_cache_size,
         kv_offload_bytes=int(args.kv_offload_gb * (1 << 30)),
         kv_remote_url=args.kv_remote_url,
         chat_template=args.chat_template,

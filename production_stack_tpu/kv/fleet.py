@@ -190,6 +190,8 @@ class FleetCache:
         t0 = time.monotonic()
         outcome = "ok"
         injected = 0
+        pulled_bytes = 0
+        tokens_saved = 0
         try:
             import aiohttp
 
@@ -213,6 +215,10 @@ class FleetCache:
                         body = await resp.json()
                         status = body.get("status")
                         injected = int(body.get("injected_blocks", 0) or 0)
+                        tokens_saved = int(body.get("num_tokens", 0) or 0)
+                        pulled_bytes = int(
+                            (body.get("transfer") or {}).get("bytes", 0)
+                            or 0)
                         if status == "ok" and injected > 0:
                             outcome = "ok"
                         elif status == "l3":
@@ -240,6 +246,13 @@ class FleetCache:
         if outcome == "ok":
             self.pulls_succeeded += 1
             router_metrics.kv_pull_success.labels(server=server_url).inc()
+            # Volume counters: what the pull actually moved / saved.
+            if pulled_bytes > 0:
+                router_metrics.kv_pull_bytes.labels(
+                    server=server_url).inc(pulled_bytes)
+            if tokens_saved > 0:
+                router_metrics.kv_pull_tokens_saved.labels(
+                    server=server_url).inc(tokens_saved)
         elif outcome == "rejected":
             self.pulls_rejected += 1
             router_metrics.kv_pull_rejected.labels(server=server_url).inc()

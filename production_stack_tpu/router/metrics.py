@@ -135,6 +135,16 @@ kv_pull_failures = Counter(
     "vllm_router:kv_pull_failures_total",
     "Cross-replica KV pulls that missed or failed (target recomputes)",
     ["server", "reason"], registry=REGISTRY)
+kv_pull_bytes = Counter(
+    "vllm_router:kv_pull_bytes_total",
+    "KV bytes moved by successful cross-replica pulls (from the "
+    "target's transfer report)",
+    _L, registry=REGISTRY)
+kv_pull_tokens_saved = Counter(
+    "vllm_router:kv_pull_tokens_saved_total",
+    "Prompt tokens the target did not have to re-prefill because a "
+    "pull injected their KV blocks",
+    _L, registry=REGISTRY)
 kv_pull_latency = Histogram(
     "vllm_router:kv_pull_latency_seconds",
     "Latency of the /kv/pull control round-trip (s)", _L,

@@ -30,6 +30,8 @@ from aiohttp import web
 
 from production_stack_tpu.obs.trace import format_traceparent
 from production_stack_tpu.router.httpclient import get_client_session
+from production_stack_tpu.structured.api import (
+    StructuredError, compile_char_dfa, parse_structured)
 from production_stack_tpu.utils.log import init_logger
 
 logger = init_logger(__name__)
@@ -256,6 +258,20 @@ async def route_general_request(
         # 500 later at request_json.get(...); reject it up front.
         return web.json_response(
             {"error": "Request body must be a JSON object."}, status=400)
+
+    # Structured-output constraints (guided_json / guided_regex /
+    # response_format) are validated — and their DFA compiled, memoized
+    # process-wide — at the router so an uncompilable schema is a 400
+    # here instead of an engine round-trip that fails after admission
+    # and routing already ran.
+    try:
+        spec = parse_structured(request_json)
+        if spec is not None:
+            compile_char_dfa(spec)
+    except StructuredError as exc:
+        return web.json_response(
+            {"error": {"message": str(exc),
+                       "type": "BadRequestError"}}, status=400)
 
     # Multi-tenant QoS admission (production_stack_tpu/qos/): resolve the
     # caller's tenant from its bearer key and run the token buckets.  With

@@ -42,6 +42,8 @@ from typing import Dict, List, Optional
 from aiohttp import web
 
 from production_stack_tpu.obs.trace import TraceRecorder
+from production_stack_tpu.structured.api import (
+    StructuredError, compile_char_dfa, parse_structured)
 
 
 class FakeEngine:
@@ -82,6 +84,11 @@ class FakeEngine:
         self.spec_proposed_tokens_total = 0
         self.spec_accepted_tokens_total = 0
         self.spec_disabled_requests_total = 0
+        # Structured output: compiled like the real engine (same
+        # parse/compile path) but "generation" is the DFA's example
+        # string, so router e2e conformance runs hermetically on CPU.
+        self.structured_requests_total = 0
+        self.structured_violations_total = 0
         self._engine_lock = asyncio.Lock()
         # QoS surface: the router's X-Priority / X-Tenant headers are
         # honored the way the real scheduler honors them — batch prefill
@@ -145,6 +152,24 @@ class FakeEngine:
         self.trace_recorder = TraceRecorder("fake-engine")
 
     # -- helpers -----------------------------------------------------------
+    def _structured_content(self, body: dict):
+        """(text, None) with a grammar-valid example string when the
+        request carries a structured constraint, (None, 400 response)
+        when the constraint doesn't compile, (None, None) otherwise.
+        Uses the SAME parse/compile path as the real engine, so router
+        e2e conformance tests exercise the production compiler."""
+        try:
+            spec = parse_structured(body)
+            if spec is None:
+                return None, None
+            text = compile_char_dfa(spec).example()
+        except StructuredError as exc:
+            return None, web.json_response(
+                {"error": {"message": str(exc),
+                           "type": "BadRequestError"}}, status=400)
+        self.structured_requests_total += 1
+        return text, None
+
     def _take_fault(self) -> Optional[str]:
         """Claim the armed fault for this request (decrementing ``times``);
         returns the mode or None."""
@@ -455,6 +480,9 @@ class FakeEngine:
         fault = None if self.fault_mode == "pull_error" else self._take_fault()
         body = await request.json()
         self.requests_seen.append(body)
+        structured_text, bad = self._structured_content(body)
+        if bad is not None:
+            return bad
         prefix = self._prefix_hashes(body)
         cached_frac = self._cached_fraction(prefix)
         n_tokens = int(
@@ -462,6 +490,9 @@ class FakeEngine:
             or body.get("max_completion_tokens")
             or self.max_tokens_default
         )
+        pieces = ([structured_text] if structured_text is not None
+                  else ["Hello "] * n_tokens)
+        finish = "stop" if structured_text is not None else "length"
         stream = bool(body.get("stream", False))
         rid = (request.headers.get("X-Request-Id")
                or f"chatcmpl-{uuid.uuid4().hex[:12]}")
@@ -488,7 +519,7 @@ class FakeEngine:
             await self._prefill_sleep(priority, cached_frac)
             t_prefill_end = time.time()
             if not stream:
-                for _ in range(n_tokens):
+                for _ in range(len(pieces)):
                     await self._decode_step()
                 return web.json_response({
                     "id": rid, "object": "chat.completion", "model": model,
@@ -496,17 +527,17 @@ class FakeEngine:
                     "choices": [{
                         "index": 0,
                         "message": {"role": "assistant",
-                                    "content": "Hello " * n_tokens},
-                        "finish_reason": "length",
+                                    "content": "".join(pieces)},
+                        "finish_reason": finish,
                     }],
                     "usage": {"prompt_tokens": 5,
-                              "completion_tokens": n_tokens,
-                              "total_tokens": 5 + n_tokens},
+                              "completion_tokens": len(pieces),
+                              "total_tokens": 5 + len(pieces)},
                 })
             resp = web.StreamResponse()
             resp.content_type = "text/event-stream"
             await resp.prepare(request)
-            for i in range(n_tokens):
+            for i, piece in enumerate(pieces):
                 if fault and i == self.fault_after_chunks:
                     if fault == "hang_mid_stream":
                         # Stall after N chunks: the router's inter-chunk
@@ -523,8 +554,8 @@ class FakeEngine:
                     "created": int(time.time()), "model": model,
                     "choices": [{
                         "index": 0,
-                        "delta": ({"role": "assistant", "content": "Hello "}
-                                  if i == 0 else {"content": "Hello "}),
+                        "delta": ({"role": "assistant", "content": piece}
+                                  if i == 0 else {"content": piece}),
                         "finish_reason": None,
                     }],
                 }
@@ -533,7 +564,7 @@ class FakeEngine:
             final = {
                 "id": rid, "object": "chat.completion.chunk",
                 "created": int(time.time()), "model": model,
-                "choices": [{"index": 0, "delta": {}, "finish_reason": "length"}],
+                "choices": [{"index": 0, "delta": {}, "finish_reason": finish}],
             }
             await resp.write(f"data: {json.dumps(final)}\n\n".encode())
             await resp.write(b"data: [DONE]\n\n")
@@ -553,7 +584,13 @@ class FakeEngine:
                 status=503, headers={"Retry-After": "1"})
         body = await request.json()
         self.requests_seen.append(body)
+        structured_text, bad = self._structured_content(body)
+        if bad is not None:
+            return bad
         n_tokens = int(body.get("max_tokens") or self.max_tokens_default)
+        pieces = ([structured_text] if structured_text is not None
+                  else ["Hello "] * n_tokens)
+        finish = "stop" if structured_text is not None else "length"
         stream = bool(body.get("stream", False))
         rid = (request.headers.get("X-Request-Id")
                or f"cmpl-{uuid.uuid4().hex[:12]}")
@@ -570,19 +607,20 @@ class FakeEngine:
             return web.json_response({
                 "id": rid, "object": "text_completion", "model": model,
                 "created": int(time.time()),
-                "choices": [{"index": 0, "text": "Hello " * n_tokens,
-                             "finish_reason": "length"}],
-                "usage": {"prompt_tokens": 5, "completion_tokens": n_tokens,
-                          "total_tokens": 5 + n_tokens},
+                "choices": [{"index": 0, "text": "".join(pieces),
+                             "finish_reason": finish}],
+                "usage": {"prompt_tokens": 5,
+                          "completion_tokens": len(pieces),
+                          "total_tokens": 5 + len(pieces)},
             })
         resp = web.StreamResponse()
         resp.content_type = "text/event-stream"
         await resp.prepare(request)
-        for _ in range(n_tokens):
+        for piece in pieces:
             chunk = {
                 "id": rid, "object": "text_completion",
                 "created": int(time.time()), "model": model,
-                "choices": [{"index": 0, "text": "Hello ",
+                "choices": [{"index": 0, "text": piece,
                              "finish_reason": None}],
             }
             await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
@@ -638,6 +676,10 @@ class FakeEngine:
             f"{(self.spec_accepted_tokens_total / self.spec_proposed_tokens_total) if self.spec_proposed_tokens_total else 0.0}\n"
             "# TYPE tpu:spec_disabled_requests counter\n"
             f"tpu:spec_disabled_requests_total {self.spec_disabled_requests_total}\n"
+            "# TYPE tpu:structured_requests counter\n"
+            f"tpu:structured_requests_total {self.structured_requests_total}\n"
+            "# TYPE tpu:structured_violations counter\n"
+            f"tpu:structured_violations_total {self.structured_violations_total}\n"
         )
         if self.hbm_headroom_bytes >= 0:
             text += (

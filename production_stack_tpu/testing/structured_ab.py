@@ -1,0 +1,252 @@
+"""Structured-output conformance + overhead harness.
+
+Two hermetic measurements, both CPU-only:
+
+- **Corpus conformance** (``run_corpus_conformance``): every case of the
+  30-case corpus (``structured/corpus.json``) is sent through the REAL
+  router to :class:`FakeEngine` replicas — once over the vLLM guided
+  surface (``guided_json`` / ``guided_regex``) and once over the OpenAI
+  ``response_format`` surface — and the returned content must fullmatch
+  the case's compiled automaton (plus :func:`validate_instance` for
+  schema cases). An uncompilable schema must come back 400. The fake
+  engine compiles constraints with the production compiler, so this
+  exercises the same parse/compile/400 path the engine server runs.
+
+- **Mask overhead A/B** (``run_engine_overhead``): the real
+  :class:`EngineCore` on CPU decodes the same greedy traffic twice —
+  unconstrained, then constrained by a NON-BINDING regex (``(.|\\s)*``,
+  which allows every token) — so the legs emit identical tokens and the
+  delta is pure structured-path cost: packed-mask H2D input, host FSM
+  advance per emitted token, and mask-row fills. Both legs run
+  ``decode_steps=1`` because structured rows are scheduled one step per
+  burst (the host must observe each token before shipping the next
+  mask); pinning the plain leg to the same burst width isolates mask
+  cost from scheduling width.
+
+Used by ``bench.py`` (``BENCH_STRUCTURED=1`` ->
+``BENCH_STRUCTURED_r10.json``) and ``tests/test_structured_output.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import List, Optional
+
+from production_stack_tpu.structured.api import compile_char_dfa
+from production_stack_tpu.structured.corpus import (
+    case_request_fields, case_spec, load_corpus)
+from production_stack_tpu.structured.schema import validate_instance
+from production_stack_tpu.testing.qos_ab import _reset_router_singletons
+
+MODEL = "structured-model"
+
+# Allows every token (``.`` = any non-newline byte, ``\s`` the rest):
+# masking stays ON — rows are computed, shipped, and advanced — but the
+# constraint never changes what greedy decoding picks.
+NON_BINDING_REGEX = r"(.|\s)*"
+
+
+async def _start(app):
+    from aiohttp import web
+
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+async def _chat(session, router_url: str, fields: dict,
+                timeout_s: float = 30.0):
+    """POST one non-streamed chat completion; (status, content)."""
+    import aiohttp
+
+    body = {"model": MODEL, "max_tokens": 64, "stream": False,
+            "messages": [{"role": "user", "content": "emit the value"}]}
+    body.update(fields)
+    async with session.post(
+        router_url + "/v1/chat/completions", json=body,
+        timeout=aiohttp.ClientTimeout(total=timeout_s),
+    ) as resp:
+        if resp.status != 200:
+            return resp.status, None
+        payload = await resp.json()
+        return 200, payload["choices"][0]["message"]["content"]
+
+
+async def run_corpus_conformance(surface: str = "guided",
+                                 engines: int = 2) -> dict:
+    """Replay the corpus through router -> fake engines; per-case
+    automaton fullmatch (+ schema validation) on the returned content."""
+    import aiohttp
+
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.parser import build_parser
+    from production_stack_tpu.testing.fake_engine import (
+        FakeEngine, run_fake_engine)
+
+    _reset_router_singletons()
+    fakes = [FakeEngine(model=MODEL) for _ in range(engines)]
+    runners = [await run_fake_engine(e, "127.0.0.1", 0) for e in fakes]
+
+    args = build_parser().parse_args([])
+    args.static_backends = ",".join(e.self_url for e in fakes)
+    args.static_models = ",".join([MODEL] * engines)
+    args.routing_logic = "roundrobin"
+    args.engine_stats_interval = 60
+    router_app = build_app(args)
+    router_runner, router_url = await _start(router_app)
+
+    passed: List[str] = []
+    failed: List[dict] = []
+    try:
+        async with aiohttp.ClientSession() as session:
+            for case in load_corpus():
+                status, content = await _chat(
+                    session, router_url,
+                    case_request_fields(case, surface=surface))
+                ok = status == 200 and content is not None
+                if ok:
+                    dfa = compile_char_dfa(case_spec(case))
+                    ok = dfa.fullmatch(content)
+                    if ok and case["kind"] == "json_schema":
+                        ok = validate_instance(
+                            case["spec"], json.loads(content))
+                (passed if ok else failed).append(
+                    case["name"] if ok else
+                    {"case": case["name"], "status": status,
+                     "content": content})
+            # The 400 path: an uncompilable schema must be rejected at
+            # the router, never forwarded.
+            bad_status, _ = await _chat(
+                session, router_url,
+                {"guided_json": {"allOf": [{"type": "string"}]}})
+            rejects_uncompilable = bad_status == 400
+    finally:
+        await router_runner.cleanup()
+        for runner in runners:
+            await runner.cleanup()
+        _reset_router_singletons()
+
+    return {
+        "surface": surface,
+        "cases": len(passed) + len(failed),
+        "passed": len(passed),
+        "failed": failed,
+        "conformance": round(
+            len(passed) / max(len(passed) + len(failed), 1), 4),
+        "rejects_uncompilable": rejects_uncompilable,
+        "engine_structured_requests": sum(
+            e.structured_requests_total for e in fakes),
+    }
+
+
+def _collect_all(eng, requests, timeout_s: float = 300.0):
+    """Submit all requests and drain until every one finishes; returns
+    (total_tokens, wall_seconds)."""
+    import queue
+
+    done = queue.Queue()
+    counts = {}
+
+    def make_cb(rid):
+        def on_token(token, finish):
+            if token is not None:
+                counts[rid] = counts.get(rid, 0) + 1
+            if finish is not None:
+                done.put(rid)
+        return on_token
+
+    t0 = time.perf_counter()
+    for rid, prompt_ids, sampling in requests:
+        eng.add_request(rid, prompt_ids, sampling, make_cb(rid))
+    remaining = len(requests)
+    deadline = time.time() + timeout_s
+    while remaining > 0 and time.time() < deadline:
+        try:
+            done.get(timeout=1.0)
+            remaining -= 1
+        except queue.Empty:
+            continue
+    wall = time.perf_counter() - t0
+    if remaining:
+        raise RuntimeError(f"{remaining} bench requests never finished")
+    return sum(counts.values()), wall
+
+
+def run_engine_overhead(*, n_requests: int = 8, max_tokens: int = 32,
+                        repeats: int = 3) -> dict:
+    """Masked vs unmasked greedy tokens/s on the real CPU engine."""
+    import jax
+
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.core import EngineCore
+    from production_stack_tpu.engine.sampling import SamplingParams
+
+    eng = EngineCore(
+        EngineConfig(model="tiny-llama", max_model_len=128,
+                     max_num_seqs=8, block_size=4, num_blocks=256,
+                     min_prefill_bucket=16, max_loras=0,
+                     decode_steps=1),
+        devices=jax.devices()[:1])
+    eng.start()
+    try:
+        def leg(structured: bool) -> float:
+            body = {"temperature": 0, "max_tokens": max_tokens}
+            if structured:
+                body["guided_regex"] = NON_BINDING_REGEX
+            best = 0.0
+            for r in range(repeats):
+                reqs = []
+                for i in range(n_requests):
+                    sampling = SamplingParams.from_request(dict(body))
+                    ids = eng.tokenizer.encode(f"bench prompt {i}")
+                    reqs.append((f"{'m' if structured else 'u'}{r}-{i}",
+                                 ids, sampling))
+                tokens, wall = _collect_all(eng, reqs)
+                best = max(best, tokens / wall if wall > 0 else 0.0)
+            return best
+
+        # Warm pass (first dispatches may still trace), then measure.
+        leg(False)
+        unmasked = leg(False)
+        masked = leg(True)
+    finally:
+        eng.stop()
+
+    overhead_pct = round(100.0 * (1.0 - masked / unmasked), 2) \
+        if unmasked > 0 else None
+    return {
+        "n_requests": n_requests,
+        "max_tokens": max_tokens,
+        "decode_steps": 1,
+        "unmasked_tokens_per_s": round(unmasked, 2),
+        "masked_tokens_per_s": round(masked, 2),
+        "overhead_pct": overhead_pct,
+        "structured_stats": {
+            k: v for k, v in eng.stats().items()
+            if k.startswith("structured")},
+    }
+
+
+def run_structured_ab(*, n_requests: int = 8, max_tokens: int = 32,
+                      repeats: int = 3, skip_overhead: bool = False) -> dict:
+    """Full A/B: both conformance surfaces plus the mask-overhead legs.
+
+    ``skip_overhead`` runs conformance only (no jax import) — the
+    tier-1 router e2e test uses the conformance half directly."""
+    guided = asyncio.run(run_corpus_conformance(surface="guided"))
+    rf = asyncio.run(run_corpus_conformance(surface="response_format"))
+    overhead = None if skip_overhead else run_engine_overhead(
+        n_requests=n_requests, max_tokens=max_tokens, repeats=repeats)
+    return {
+        "metric": "structured_output_ab",
+        "unit": "mask_overhead_pct",
+        "value": overhead["overhead_pct"] if overhead else None,
+        "conformance_guided": guided,
+        "conformance_response_format": rf,
+        "overhead": overhead,
+    }

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Lint the structured-output conformance corpus.
+
+For every case in ``production_stack_tpu/structured/corpus.json``:
+
+- the constraint compiles to a byte-level DFA (``compile_char_dfa``);
+- every positive example fullmatches the automaton;
+- every negative example does NOT fullmatch;
+- for ``json_schema`` cases, every positive example is valid JSON that
+  also passes :func:`validate_instance` (the independent, non-automaton
+  validator), and every negative that parses as JSON fails it or fails
+  the automaton;
+- the corpus holds at least 30 cases with unique names.
+
+Run from the repo root; exits non-zero listing violations. Wired into
+the test suite via tests/test_structured_output.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from production_stack_tpu.structured.api import compile_char_dfa  # noqa: E402
+from production_stack_tpu.structured.corpus import (  # noqa: E402
+    case_spec, load_corpus)
+from production_stack_tpu.structured.regex_dfa import (  # noqa: E402
+    StructuredError)
+from production_stack_tpu.structured.schema import (  # noqa: E402
+    validate_instance)
+
+MIN_CASES = 30
+
+
+def main() -> int:
+    problems = []
+    cases = load_corpus()
+    if len(cases) < MIN_CASES:
+        problems.append(
+            f"corpus has {len(cases)} cases; at least {MIN_CASES} required")
+    names = [c["name"] for c in cases]
+    if len(set(names)) != len(names):
+        dupes = sorted({n for n in names if names.count(n) > 1})
+        problems.append(f"duplicate case names: {dupes}")
+    for case in cases:
+        name = case.get("name", "<unnamed>")
+        try:
+            dfa = compile_char_dfa(case_spec(case))
+        except StructuredError as exc:
+            problems.append(f"{name}: does not compile: {exc}")
+            continue
+        if not case.get("positive"):
+            problems.append(f"{name}: no positive examples")
+        for pos in case.get("positive") or []:
+            if not dfa.fullmatch(pos):
+                problems.append(
+                    f"{name}: positive example rejected by the "
+                    f"automaton: {pos!r}")
+            if case["kind"] in ("json_schema", "json_object"):
+                try:
+                    instance = json.loads(pos)
+                except ValueError:
+                    problems.append(
+                        f"{name}: positive example is not valid JSON: "
+                        f"{pos!r}")
+                    continue
+                if case["kind"] == "json_schema" and \
+                        not validate_instance(case["spec"], instance):
+                    problems.append(
+                        f"{name}: positive example fails "
+                        f"validate_instance: {pos!r}")
+        for neg in case.get("negative") or []:
+            if dfa.fullmatch(neg):
+                problems.append(
+                    f"{name}: negative example accepted by the "
+                    f"automaton: {neg!r}")
+    if problems:
+        print("Corpus lint failures:")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    print(f"all {len(cases)} corpus cases compile and conform")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -604,3 +604,88 @@ def test_native_h2fuzz_smoke():
     assert proc.returncode == 0, (
         f"fuzz smoke failed:\n{proc.stdout[-4000:]}\n{proc.stderr[-4000:]}")
     assert "PASS" in proc.stdout + proc.stderr
+
+
+def test_epp_set_excluded_rejects_malformed_input():
+    """set_excluded is the single write path for the router health view:
+    anything but a sane list of strings returns False and leaves the
+    last-good exclusion set untouched."""
+    from epp_server import EndpointState
+
+    state = EndpointState(["10.0.0.4:8000", "10.0.0.5:8000"])
+    assert state.set_excluded(["http://10.0.0.5:8000/"])
+    assert state.excluded() == {"10.0.0.5:8000"}
+    for garbage in [
+        None,
+        "http://10.0.0.4:8000",            # string, not list
+        {"urls": []},                       # dict
+        ["http://10.0.0.4:8000", 7],        # non-string entry
+        ["u"] * (EndpointState.MAX_EXCLUDED_URLS + 1),  # absurd length
+    ]:
+        assert not state.set_excluded(garbage), garbage
+        assert state.excluded() == {"10.0.0.5:8000"}, garbage
+    assert state.set_excluded([])
+    assert state.excluded() == set()
+
+
+def test_epp_health_poll_survives_garbage_responses():
+    """A router bug (or an interposed proxy) feeding the health poll
+    garbage must not crash the poller NOR clear the exclusion view:
+    every malformed payload keeps the LAST-GOOD excluded set, and a
+    later well-formed response resumes tracking."""
+    import http.server
+    import threading
+    import time
+
+    from epp_server import EndpointState
+
+    reply = {"raw": json.dumps(
+        {"expired_urls": ["http://10.0.0.5:8000"]}).encode()}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = reply["raw"]
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        state = EndpointState(
+            ["10.0.0.4:8000", "10.0.0.5:8000"],
+            router_url=f"http://127.0.0.1:{srv.server_port}",
+            health_interval=0.05)
+        deadline = time.time() + 5
+        while (time.time() < deadline
+               and "10.0.0.5:8000" in state.endpoints()):
+            time.sleep(0.02)
+        assert state.endpoints() == ["10.0.0.4:8000"]
+
+        for garbage in [
+            b"not json at all {",
+            b"[1, 2, 3]",                       # JSON, but not an object
+            json.dumps({}).encode(),             # missing expired_urls
+            json.dumps({"expired_urls": "oops"}).encode(),
+            json.dumps({"expired_urls": [1, None]}).encode(),
+            json.dumps({"expired_urls": ["u"] * 5000}).encode(),
+        ]:
+            reply["raw"] = garbage
+            time.sleep(0.2)  # several poll rounds of garbage
+            assert state.endpoints() == ["10.0.0.4:8000"], garbage
+            assert state.excluded() == {"10.0.0.5:8000"}, garbage
+
+        # Router heals: a well-formed empty view re-admits the replica.
+        reply["raw"] = json.dumps({"expired_urls": []}).encode()
+        deadline = time.time() + 5
+        while (time.time() < deadline
+               and "10.0.0.5:8000" not in state.endpoints()):
+            time.sleep(0.02)
+        assert state.endpoints() == ["10.0.0.4:8000", "10.0.0.5:8000"]
+    finally:
+        srv.shutdown()

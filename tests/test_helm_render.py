@@ -699,3 +699,35 @@ def test_fleet_cache_and_autoscaling_render():
     assert not [d for d in _docs(base, "HorizontalPodAutoscaler")
                 if d["metadata"]["name"].endswith("-engine-hpa")]
     assert not list(_docs(base, "ScaledObject"))
+
+
+def test_structured_cache_size_plumbs_into_engine_command():
+    """structuredCacheSize renders as --structured-cache-size (absent
+    when unset — the engine default of 32 applies), and the schema
+    accepts it."""
+    import copy
+    import json
+
+    import jsonschema
+
+    values = copy.deepcopy(load_values(CHART, os.path.join(
+        CHART, "examples", "values-01-minimal.yaml")))
+    spec = values["servingEngineSpec"]["modelSpec"][0]
+    spec["structuredCacheSize"] = 64
+    with open(os.path.join(CHART, "values.schema.json")) as f:
+        jsonschema.validate(values, json.load(f))
+
+    rendered = MiniHelm(CHART).render(values)
+    deps = [d for d in _docs(rendered, "Deployment")
+            if d["metadata"]["name"].endswith("-engine")]
+    assert deps, "engine deployment missing"
+    cmd = deps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--structured-cache-size" in cmd
+    assert cmd[cmd.index("--structured-cache-size") + 1] == "64"
+
+    base = _render(os.path.join(CHART, "examples",
+                                "values-01-minimal.yaml"))
+    bdeps = [d for d in _docs(base, "Deployment")
+             if d["metadata"]["name"].endswith("-engine")]
+    bcmd = bdeps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--structured-cache-size" not in bcmd

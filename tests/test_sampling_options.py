@@ -151,3 +151,72 @@ def test_stop_token_masked_below_min_tokens_and_echo_n2():
         asyncio.run(run())
     finally:
         server.core.stop()
+
+
+async def _post_status(port, path, body):
+    """Like _post but returns (status, payload) — for 400 assertions."""
+    import aiohttp
+
+    async with aiohttp.ClientSession() as s:
+        async with s.post(f"http://127.0.0.1:{port}{path}",
+                          json=body) as resp:
+            return resp.status, await resp.json()
+
+
+def test_malformed_sampling_options_rejected_400():
+    """Non-integer max_tokens/min_tokens and non-numeric logit_bias
+    values are client errors — a clean 400, never a 500 or silent
+    coercion (vLLM's strict-int semantics)."""
+    server = _server()
+
+    async def run():
+        runner = await run_engine_server(server, "127.0.0.1", 0)
+        port = list(runner.sites)[0]._server.sockets[0].getsockname()[1]
+        bad_bodies = [
+            {"max_tokens": "7.9"},
+            {"max_tokens": 7.5},
+            {"max_tokens": True},
+            {"max_completion_tokens": "16"},
+            {"min_tokens": 2.5},
+            {"min_tokens": "3"},
+            {"logit_bias": {"97": "high"}},
+            {"logit_bias": {"97": True}},
+            {"logit_bias": ["97"]},
+        ]
+        try:
+            for extra in bad_bodies:
+                body = {"model": "tiny-llama", "prompt": "x",
+                        "temperature": 0.0}
+                body.update(extra)
+                status, payload = await _post_status(
+                    port, "/v1/completions", body)
+                assert status == 400, (extra, status, payload)
+                assert payload["error"]["type"] == "BadRequestError", extra
+                # Same contract on the chat surface.
+                chat = {"model": "tiny-llama",
+                        "messages": [{"role": "user", "content": "x"}]}
+                chat.update(extra)
+                status, payload = await _post_status(
+                    port, "/v1/chat/completions", chat)
+                assert status == 400, (extra, status, payload)
+            # min_tokens masks EOS while a completed grammar state
+            # allows ONLY EOS — jointly unsatisfiable, rejected up
+            # front instead of deadlocking a request in-program.
+            status, payload = await _post_status(port, "/v1/completions", {
+                "model": "tiny-llama", "prompt": "x", "max_tokens": 8,
+                "min_tokens": 2, "guided_regex": "[ab]{3}"})
+            assert status == 400
+            assert "min_tokens" in payload["error"]["message"]
+            # Well-typed ints still sail through.
+            status, _ = await _post_status(port, "/v1/completions", {
+                "model": "tiny-llama", "prompt": "x",
+                "max_tokens": 3, "min_tokens": 1, "temperature": 0.0,
+                "logit_bias": {"97": 1}})
+            assert status == 200
+        finally:
+            await runner.cleanup()
+
+    try:
+        asyncio.run(run())
+    finally:
+        server.core.stop()
