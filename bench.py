@@ -528,6 +528,9 @@ async def _main(spec_tokens: int = SPEC,
     args.routing_logic = routing
     args.session_key = "x-user-id"
     args.engine_stats_interval = 5
+    # Hold the whole run in the trace ring so router_overhead_p99 below
+    # is computed over every request, not the newest 512.
+    args.trace_buffer = max(4096, USERS * ROUNDS + STORM_USERS)
     if routing == "disaggregated_prefill":
         args.static_model_labels = "prefill-unit,decode-unit"
         args.prefill_model_labels = "prefill-unit"
@@ -571,6 +574,16 @@ async def _main(spec_tokens: int = SPEC,
             server.core.stop()
 
     tok_s = tokens / elapsed if elapsed > 0 else 0.0
+    # Router overhead clock: per-request in-router time minus upstream
+    # engine time, read from the in-process trace recorder ring.
+    _overheads = sorted(
+        router_app["state"].trace_recorder.root_attribute_values(
+            "overhead_s"))
+    router_overhead_p99 = (
+        round(_overheads[
+            min(len(_overheads) - 1,
+                max(0, -(-99 * len(_overheads) // 100) - 1))], 6)
+        if _overheads else None)
     result = {
         "metric": f"multi_round_qa_gen_throughput({MODEL})",
         "value": round(tok_s, 2),
@@ -634,6 +647,7 @@ async def _main(spec_tokens: int = SPEC,
         ),
         "storm_users": STORM_USERS,
         "storm_done": storm_done,
+        "router_overhead_p99": router_overhead_p99,
         "engine_prefill_chunks": core_stats.get("prefill_chunks_total", 0),
         "engine_deferred_prefill_tokens": core_stats.get(
             "deferred_prefill_tokens_total", 0),

@@ -28,26 +28,26 @@ from __future__ import annotations
 
 import json
 import os
-import statistics
 import sys
-import time
 
 sys.path.insert(0, os.path.abspath(
     os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")))
 
+from benchmarks._profile_common import (  # noqa: E402
+    HBM_GBS,
+    build_engine,
+    install_params_holder,
+    params_bytes,
+    pipelined_seconds,
+)
+
 MODEL = os.environ.get("PROFILE_MODEL", "tpu-llama-1b")
 CTX = int(os.environ.get("PROFILE_CTX", "3000"))
 REPS = int(os.environ.get("PROFILE_REPS", "8"))
-HBM_GBS = 819e9  # v5e HBM bandwidth
 
 
 def _engine(num_blocks=900):
-    from production_stack_tpu.engine.config import EngineConfig
-    from production_stack_tpu.engine.core import EngineCore
-
-    return EngineCore(EngineConfig(
-        model=MODEL, max_model_len=8192, max_num_seqs=16,
-        decode_steps=16, max_loras=0, num_blocks=num_blocks))
+    return build_engine(MODEL, num_blocks=num_blocks)
 
 
 def _burst_args(core, ctx, rng):
@@ -82,7 +82,6 @@ def _burst_args(core, ctx, rng):
 
 def _time_burst(core, fn, ctx, reps=REPS):
     """Pipelined steady-state seconds per burst."""
-    import jax
     import numpy as np
 
     rng = np.random.default_rng(0)
@@ -93,21 +92,8 @@ def _time_burst(core, fn, ctx, reps=REPS):
             args[0], core.kv, core._token_counts, *args[3:])
         return outs
 
-    # Timing rule for the tunneled runtime: block_until_ready does not
-    # reliably wait for device completion — every timed sequence must
-    # END IN A REAL READBACK (np.asarray), and the constant RTT is
-    # differenced out via two pipelined runs of different depth.
-    np.asarray(run()[0])  # compile + settle
-    walls = {}
-    n1, n2 = 2, reps + 2
-    for n in (n1, n2, n1, n2):
-        t0 = time.perf_counter()
-        last = None
-        for _ in range(n):
-            last = run()
-        np.asarray(last[0])
-        walls.setdefault(n, []).append(time.perf_counter() - t0)
-    return (min(walls[n2]) - min(walls[n1])) / (n2 - n1)
+    return pipelined_seconds(run, lambda outs: np.asarray(outs[0]),
+                             reps=reps)
 
 
 def _fresh_decode_fn(core, K=16):
@@ -181,17 +167,9 @@ def _bench_kernel_standalone(core, ctx, reps=REPS):
             jnp.arange(mc.num_layers))
         return out
 
-    np.asarray(all_layers(q, k_pages, v_pages, bt, cl))[0, 0]
-    walls = {}
-    n1, n2 = 2, reps + 2
-    for n in (n1, n2, n1, n2):
-        t0 = time.perf_counter()
-        last = None
-        for _ in range(n):
-            last = all_layers(q, k_pages, v_pages, bt, cl)
-        np.asarray(last)
-        walls.setdefault(n, []).append(time.perf_counter() - t0)
-    return (min(walls[n2]) - min(walls[n1])) / (n2 - n1)
+    return pipelined_seconds(
+        lambda: all_layers(q, k_pages, v_pages, bt, cl),
+        np.asarray, reps=reps)
 
 
 def _bench_sampling_standalone(core, K=16, reps=REPS):
@@ -233,17 +211,8 @@ def _bench_sampling_standalone(core, K=16, reps=REPS):
             jnp.arange(K))
         return acc
 
-    np.asarray(chain(logits0, counts0))
-    walls = {}
-    n1, n2 = 2, reps + 2
-    for n in (n1, n2, n1, n2):
-        t0 = time.perf_counter()
-        last = None
-        for _ in range(n):
-            last = chain(logits0, counts0)
-        np.asarray(last)
-        walls.setdefault(n, []).append(time.perf_counter() - t0)
-    return (min(walls[n2]) - min(walls[n1])) / (n2 - n1)
+    return pipelined_seconds(
+        lambda: chain(logits0, counts0), np.asarray, reps=reps)
 
 
 def main() -> None:
@@ -315,9 +284,7 @@ def main() -> None:
     }
 
     # Floors at this shape.
-    pbytes = sum(
-        x.size * x.dtype.itemsize
-        for x in jax.tree_util.tree_leaves(core_params_holder[0]))
+    pbytes = params_bytes(core_params_holder[0])
     kv_bytes_step = (CTX * B * mc.num_kv_heads * mc.head_dim * 2 * 2
                      * mc.num_layers)
     floors = {
@@ -345,13 +312,5 @@ core_params_holder = []
 
 if __name__ == "__main__":
     # Stash params for the floor calc before main() frees the core.
-    import production_stack_tpu.engine.core as _c
-
-    _orig_init = _c.EngineCore.__init__
-
-    def _patched(self, *a, **kw):
-        _orig_init(self, *a, **kw)
-        core_params_holder.append(self.params)
-
-    _c.EngineCore.__init__ = _patched
+    core_params_holder = install_params_holder()
     main()

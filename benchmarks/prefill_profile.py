@@ -1,0 +1,230 @@
+#!/usr/bin/env python3
+"""Prefill decomposition profile: where does a 2048-token chunk go?
+
+The chunked-prefill serving path runs the cached-prefill program per
+chunk: matmuls over the chunk, a KV page scatter of the fresh keys, and
+context attention over everything written so far. This script decomposes
+that per-chunk time by ABLATION — recompiling the forward with
+individual components replaced by cheap identities and differencing the
+pipelined steady-state times (same timing rule as decode_profile.py;
+shared scaffolding in benchmarks/_profile_common.py):
+
+  full         the engine's cached-prefill program (attends over HBM pages)
+  noattn       both prefill attention variants -> zeros passthrough
+  nowrite      KV page scatter -> identity (isolates layout/copy cost)
+  bare_matmul  both removed -> the pure matmul chain + fused sampling
+
+Derived per chunk: attention_est = full - noattn, copy_est = full -
+nowrite, matmul_est = bare_matmul. The chunk-position sweep shows the
+context-attention term growing with how deep into the prompt the chunk
+lands, while matmuls and copies stay flat.
+
+--hermetic runs tiny-llama at a small chunk so CI can smoke the schema
+on CPU in seconds. Writes ONE JSON line (redirect to
+BENCH_PREFILL_PROFILE_r{N}.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")))
+
+from benchmarks._profile_common import (  # noqa: E402
+    HBM_GBS,
+    build_engine,
+    install_params_holder,
+    params_bytes,
+    pipelined_seconds,
+)
+
+core_params_holder = []
+
+
+def _chunk_args(core, chunk, offset, rng):
+    """Call args for the cached-prefill program: one row, ``chunk`` new
+    tokens landing at prompt position ``offset``, REAL slot ids (the
+    scatter must execute — the nowrite ablation measures it)."""
+    import numpy as np
+
+    from production_stack_tpu.engine.sampling import (
+        MAX_LOGIT_BIAS,
+        MAX_STOP_IDS,
+    )
+
+    bs = core.config.block_size
+    total = offset + chunk
+    nblocks = (total + bs - 1) // bs
+    maxb = 4
+    while maxb < nblocks:
+        maxb *= 2
+    maxb = min(maxb, core.config.max_blocks_per_seq)
+    # Scattered (realistic) page ids, like the pool looks after churn.
+    pages = rng.permutation(core.num_blocks)[:nblocks].astype(np.int32)
+    bt = np.zeros((1, maxb), np.int32)
+    bt[0, :nblocks] = pages
+    pos = np.arange(offset, total, dtype=np.int32)
+    slots = (pages[pos // bs].astype(np.int64) * bs + pos % bs)
+    return (
+        np.zeros((1, chunk), np.int32),          # token ids
+        pos[None, :],                            # positions
+        slots[None, :],                          # slot mapping (real)
+        bt,                                      # block tables
+        np.asarray([total], np.int32),           # context lens
+        np.asarray([chunk], np.int32),           # seq lens
+        np.zeros((1,), np.int32),                # adapter ids
+        np.zeros((1,), np.float32),              # temperature
+        np.zeros((1,), np.int32),                # top_k
+        np.ones((1,), np.float32),               # top_p
+        np.zeros((1,), np.int64),                # seq seeds
+        np.ones((1,), np.int64),                 # steps
+        np.zeros((1,), bool),                    # suppress_eos
+        np.zeros((1, MAX_LOGIT_BIAS), np.int32),
+        np.zeros((1, MAX_LOGIT_BIAS), np.float32),
+        np.zeros((1, MAX_STOP_IDS), np.int32),
+        np.zeros((1, MAX_STOP_IDS), np.float32),
+        np.zeros((1, core._mask_row_bytes), np.uint8),
+        np.zeros((1,), bool),                    # mask on
+    )
+
+
+def _time_chunk(core, fn, chunk, offset, reps):
+    import numpy as np
+
+    rng = np.random.default_rng(offset + 3)
+    args = _chunk_args(core, chunk, offset, rng)
+
+    def run():
+        outs, core.kv = fn(core.params, core.kv, *args)
+        return outs
+
+    return pipelined_seconds(run, lambda outs: np.asarray(outs[0]),
+                             reps=reps)
+
+
+def _ablate(*, attn=False, write=False):
+    """Patch the llama-module component globals; returns a restore
+    callback. Fresh programs built afterwards trace the patched ops."""
+    import jax.numpy as jnp
+
+    from production_stack_tpu.models import llama
+
+    saved = {}
+
+    def zero_prefill_attn(q, k, v, *, scale, seq_lens):
+        return jnp.zeros_like(q)
+
+    def zero_context_attn(q, k_pages, v_pages, block_tables, positions,
+                          context_lens, layer, *, scale):
+        return jnp.zeros_like(q)
+
+    def id_write(k_pages, v_pages, k, v, slots, layer):
+        return k_pages, v_pages
+
+    if attn:
+        saved["prefill_attention"] = llama.prefill_attention
+        saved["context_prefill_attention"] = llama.context_prefill_attention
+        llama.prefill_attention = zero_prefill_attn
+        llama.context_prefill_attention = zero_context_attn
+    if write:
+        saved["write_kv_pages"] = llama.write_kv_pages
+        llama.write_kv_pages = id_write
+
+    def restore():
+        for name, v in saved.items():
+            setattr(llama, name, v)
+
+    return restore
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--hermetic", action="store_true",
+                    help="tiny-llama, small chunk — CPU schema smoke")
+    ap.add_argument("--model", default=os.environ.get(
+        "PROFILE_MODEL", "tpu-llama-1b"))
+    ap.add_argument("--chunk", type=int, default=int(os.environ.get(
+        "PROFILE_CHUNK", "2048")))
+    ap.add_argument("--reps", type=int, default=int(os.environ.get(
+        "PROFILE_REPS", "8")))
+    args = ap.parse_args(argv)
+
+    if args.hermetic:
+        args.model, args.chunk, args.reps = "tiny-llama", 128, 2
+        max_model_len, num_blocks = 512, 64
+        offsets = [0, args.chunk]
+    else:
+        max_model_len, num_blocks = 8192, 900
+        offsets = [0, args.chunk, 2 * args.chunk, 3 * args.chunk]
+
+    import jax
+
+    backend = jax.devices()[0].platform
+    global core_params_holder
+    core_params_holder = install_params_holder()
+    core = build_engine(args.model, max_model_len=max_model_len,
+                        max_num_seqs=1, decode_steps=1,
+                        num_blocks=num_blocks)
+    mc = core.model_config
+
+    chunks = []
+    # One fresh program per ablation (compiled once, reused across the
+    # offset sweep — offsets change only array VALUES at fixed shapes...
+    # except the block-table width, which recompiles per width; that is
+    # the same cost serving pays and stays outside the timed region).
+    variants = {}
+    variants["full_s"] = core._prefill_cached_fn
+    restore = _ablate(attn=True)
+    variants["noattn_s"] = core._make_forward("prefill_cached")
+    restore()
+    restore = _ablate(write=True)
+    variants["nowrite_s"] = core._make_forward("prefill_cached")
+    restore()
+    restore = _ablate(attn=True, write=True)
+    variants["bare_matmul_s"] = core._make_forward("prefill_cached")
+    restore()
+
+    for offset in offsets:
+        row = {"offset": offset, "context": offset + args.chunk}
+        for name, fn in variants.items():
+            row[name] = round(
+                _time_chunk(core, fn, args.chunk, offset, args.reps), 6)
+        row["components"] = {
+            "attention_est_s": round(row["full_s"] - row["noattn_s"], 6),
+            "copy_est_s": round(row["full_s"] - row["nowrite_s"], 6),
+            "matmul_est_s": round(row["bare_matmul_s"], 6),
+        }
+        chunks.append(row)
+
+    core.stop()
+
+    # Roofline floors per chunk at this shape.
+    pbytes = params_bytes(core_params_holder[0])
+    kv_token_bytes = (mc.num_kv_heads * mc.head_dim * 2
+                      * mc.num_layers
+                      * (1 if core.config.kv_cache_dtype == "int8" else 2))
+    floors = {
+        "weights_read_per_chunk_s": round(pbytes / HBM_GBS, 6),
+        "kv_write_per_chunk_s": round(
+            args.chunk * kv_token_bytes / HBM_GBS, 6),
+    }
+
+    out = {
+        "metric": "prefill_profile",
+        "backend": backend,
+        "model": args.model,
+        "hermetic": bool(args.hermetic),
+        "chunk": args.chunk,
+        "reps": args.reps,
+        "chunks": chunks,
+        "floors": floors,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

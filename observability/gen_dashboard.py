@@ -589,7 +589,79 @@ def build_dashboard():
              "the anti-entropy resync is doing the healing"))
     y += 7
 
-    # ---- Row 12: Current Resource Usage (ref panels 14-19) -------------- #
+    # ---- Row 12: Performance Introspection (step flight recorder) ------- #
+    panels.append(row("Performance Introspection", y)); y += 1
+    panels.append(panel(
+        "timeseries", "Engine step duration by kind (avg)",
+        [target("rate(tpu:step_duration_seconds_sum[5m]) / "
+                "rate(tpu:step_duration_seconds_count[5m])",
+                legend="{{instance}} {{kind}}")],
+        grid(7, 8, 0, y), unit="s",
+        desc="Mean wall time per engine step from the step flight "
+             "recorder, split by step kind (prefill, prefill_chunk, "
+             "decode_burst, spec_verify, fused). A drifting "
+             "decode_burst mean at steady batch width is the first "
+             "sign of interconnect or compile-cache trouble; raw "
+             "per-step records are at GET /debug/steps"))
+    panels.append(panel(
+        "timeseries", "Model bandwidth utilization",
+        [target("tpu:model_bandwidth_utilization",
+                legend="{{instance}}")],
+        grid(7, 8, 8, y), unit="percentunit",
+        desc="Roofline accounting over the recorder window: estimated "
+             "HBM traffic (weights per forward + KV read/write) per "
+             "wall second, as a fraction of the device HBM floor "
+             "(TPU_STACK_HBM_GBS). Decode-heavy serving should sit "
+             "high; a collapse under load means steps are stalled on "
+             "something other than memory"))
+    panels.append(panel(
+        "timeseries", "Scheduled tokens by step kind",
+        [target("rate(tpu:step_scheduled_tokens_total[5m])",
+                legend="{{instance}} {{kind}}")],
+        grid(7, 8, 16, y), unit="short",
+        desc="Token throughput attributed per step kind — how much of "
+             "the engine's work is prefill chunks vs decode bursts vs "
+             "accepted speculative tokens"))
+    y += 7
+    panels.append(panel(
+        "timeseries", "Estimated HBM traffic by step kind",
+        [target("rate(tpu:step_hbm_bytes_total[5m])",
+                legend="{{instance}} {{kind}}")],
+        grid(7, 8, 0, y), unit="Bps",
+        desc="Roofline-model bytes moved per second (weights read per "
+             "forward + KV token traffic), by step kind; compare "
+             "against the device HBM floor to see which step kind is "
+             "bandwidth-bound"))
+    panels.append(panel(
+        "timeseries", "Router overhead (p50/p99)",
+        [target("histogram_quantile(0.5, sum(rate("
+                "vllm_router:router_overhead_seconds_bucket[5m])) "
+                "by (le))", legend="p50"),
+         target("histogram_quantile(0.99, sum(rate("
+                "vllm_router:router_overhead_seconds_bucket[5m])) "
+                "by (le))", legend="p99")],
+        grid(7, 8, 8, y), unit="s",
+        desc="Per-request wall time spent inside the router excluding "
+             "the upstream engine exchange: routing + QoS admission + "
+             "KV pull orchestration + proxying. The storm/chaos "
+             "harnesses report the same quantity as "
+             "router_overhead_p99"))
+    panels.append(panel(
+        "timeseries", "Trace sampling & slow-log suppression",
+        [target("rate(vllm_router:trace_sampled_out_total[5m])",
+                legend="router sampled out"),
+         target("rate(tpu:trace_sampled_out_total[5m])",
+                legend="{{instance}} sampled out"),
+         target("rate(vllm_router:slow_trace_logs_suppressed_total[5m])",
+                legend="router slow-logs suppressed")],
+        grid(7, 8, 16, y),
+        desc="Head-sampling activity (--trace-sample-rate): traces "
+             "dropped from the ring/export (stage rollups still count "
+             "them) and slow-trace log lines suppressed by "
+             "--slow-trace-log-interval-s"))
+    y += 7
+
+    # ---- Row 13: Current Resource Usage (ref panels 14-19) -------------- #
     panels.append(row("Current Resource Usage", y)); y += 1
     panels.append(panel(
         "timeseries", "Router CPU usage",
@@ -610,7 +682,7 @@ def build_dashboard():
         "title": "TPU Production Stack",
         "tags": ["tpu", "production-stack"],
         "schemaVersion": 39,
-        "version": 3,
+        "version": 4,
         "refresh": "10s",
         "time": {"from": "now-30m", "to": "now"},
         "templating": {"list": [{

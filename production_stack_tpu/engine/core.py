@@ -42,6 +42,7 @@ from production_stack_tpu.engine.scheduler import (
     SpecState,
 )
 from production_stack_tpu.engine.tokenizer import build_tokenizer
+from production_stack_tpu.obs.steps import StepRecorder
 from production_stack_tpu.structured.api import compile_char_dfa
 from production_stack_tpu.structured.tokenfsm import (
     FSMState,
@@ -480,6 +481,18 @@ class EngineCore:
         self._mask_row_bytes = mask_row_bytes(self.model_config.vocab_size)
         self.structured_requests_total = 0
         self.structured_violations_total = 0
+        # Step flight recorder: one record per model step (kind, batch
+        # composition, wall time, roofline HBM byte estimate). The step
+        # functions stash a pending info dict ONLY when the recorder is
+        # on; _loop completes it with the measured wall time — so the
+        # recorder-off path adds a single attribute check per step.
+        self.step_recorder: Optional[StepRecorder] = (
+            StepRecorder(
+                capacity=config.step_record_capacity,
+                kv_token_bytes=(
+                    self._kv_bytes_per_block() // config.block_size),
+            ) if config.step_recorder else None)
+        self._step_info: Optional[dict] = None
         # Warmup variant counts per program family (compile-budget
         # regression tests read this; also logged at the end of warmup).
         self.warmup_variants: Dict[str, int] = {}
@@ -2225,6 +2238,15 @@ class EngineCore:
                 self._structured_cache.mask_states_total,
             "structured_violations_total": self.structured_violations_total,
             "structured_cache_entries": len(self._structured_cache),
+            "step_records_total": (
+                self.step_recorder.recorded_total
+                if self.step_recorder is not None else 0),
+            "step_kind_stats": (
+                self.step_recorder.kind_stats()
+                if self.step_recorder is not None else {}),
+            "model_bandwidth_utilization": (
+                round(self.step_recorder.bandwidth_utilization(), 6)
+                if self.step_recorder is not None else 0.0),
         }
 
     # ------------------------------------------------------------------ #
@@ -2240,6 +2262,7 @@ class EngineCore:
                 if not self._running:
                     return
                 action, req = self.scheduler.next_action()
+            self._step_info = None  # never carry info across a failed step
             try:
                 with self._step_lock:
                     if self._sleeping or self.params is None:
@@ -2257,18 +2280,24 @@ class EngineCore:
                         self._do_prefill(req)
                         if req.trace is not None and req.trace.prefill_start:
                             req.trace.prefill_end = time.time()
-                        self.prefill_time_total += time.perf_counter() - t0
+                        dt = time.perf_counter() - t0
+                        self.prefill_time_total += dt
                         self.prefill_count += 1
+                        self._record_step(dt)
                     elif action == "prefill_step":
                         t0 = time.perf_counter()
                         self._do_prefill_step(req)
-                        self.prefill_time_total += time.perf_counter() - t0
+                        dt = time.perf_counter() - t0
+                        self.prefill_time_total += dt
                         self.prefill_count += 1
+                        self._record_step(dt)
                     elif action == "decode":
                         t0 = time.perf_counter()
                         self._do_decode()
-                        self.decode_time_total += time.perf_counter() - t0
+                        dt = time.perf_counter() - t0
+                        self.decode_time_total += dt
                         self.decode_burst_count += 1
+                        self._record_step(dt)
                     else:
                         self._flush_pending_prefills()
                         self._flush_pending_burst()
@@ -2309,6 +2338,26 @@ class EngineCore:
                             r.on_token(None, "error")
                     return
             self.step_count += 1
+
+    def _record_step(self, wall_s: float) -> None:
+        """Complete the step record the step function stashed (if any)
+        with the wall time _loop measured around it. No-ops in a single
+        attribute check when the recorder is off or the step dispatched
+        nothing (e.g. an alloc-starved prefill that requeued)."""
+        rec, info = self.step_recorder, self._step_info
+        self._step_info = None
+        if rec is None or info is None:
+            return
+        if rec.param_bytes == 0 and self.params is not None:
+            # Weight bytes for the roofline: resolved lazily because the
+            # checkpoint may replace the init tree after construction.
+            try:
+                rec.param_bytes = sum(
+                    int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
+                    for leaf in jax.tree_util.tree_leaves(self.params))
+            except (TypeError, ValueError, AttributeError):
+                rec.param_bytes = 0
+        rec.record(info.pop("kind"), wall_s, **info)
 
     # -- prefill -----------------------------------------------------------
     def _allocate_for_prefill(self, req: EngineRequest, limit=None):
@@ -2425,6 +2474,17 @@ class EngineCore:
             sampled = self._prefill_span(
                 req, tokens, block_ids, start, end)
             start = end
+        if self.step_recorder is not None:
+            n_chunks = max(1, -(-(n - cached) // max(chunk, 1)))
+            self._step_info = {
+                "kind": "prefill", "rows": 1, "tokens": n - cached,
+                "forwards": n_chunks,
+                # Chunk i's queries attend to the cached + previously
+                # prefilled context via the HBM pages.
+                "kv_read_tokens": (n_chunks * cached
+                                   + chunk * (n_chunks * (n_chunks - 1)) // 2),
+                "kv_write_tokens": n - cached,
+            }
         # Read back the in-flight burst while the chunks execute on device.
         self._flush_pending_burst()
         # Settle the PREVIOUS prefill now — after this one's dispatch —
@@ -2527,6 +2587,17 @@ class EngineCore:
                     req, tokens, block_ids, start, end), 0)
         self.prefill_chunks_total += len(ready)
         self.last_step_batched_tokens = step_tokens
+        if self.step_recorder is not None:
+            self._step_info = {
+                "kind": "prefill_chunk", "rows": len(ready),
+                "tokens": step_tokens,
+                "forwards": 1 if batched else len(ready),
+                # Each chunk's queries attend to its request's context so
+                # far (cached prefix + earlier chunks) via the HBM pages.
+                "kv_read_tokens": sum(
+                    s for (_r, _t, _b, s, _e) in ready),
+                "kv_write_tokens": step_tokens, "batched": batched,
+            }
 
         # Same pipelining as the unchunked paths: read back the in-flight
         # burst and the previous prefill while these chunks execute.
@@ -2788,6 +2859,16 @@ class EngineCore:
         self._flush_pending_burst()
         self._flush_pending_prefills()
         group_end = time.time()
+        if self.step_recorder is not None:
+            new_tokens = sum(
+                len(m["req"].all_token_ids) - m["cached"] for m in group)
+            self._step_info = {
+                "kind": "prefill", "rows": len(group),
+                "tokens": new_tokens, "forwards": max_spans,
+                "kv_read_tokens": sum(
+                    s for s_list in spans.values() for (s, _e) in s_list),
+                "kv_write_tokens": new_tokens, "batched": True,
+            }
         for m, sampled, row in finished:
             req_m = m["req"]
             if req_m.trace is not None:
@@ -3137,6 +3218,18 @@ class EngineCore:
                 mask_bits, mask_on,
             ])
         self.decode_forward_steps_total += K
+        if self.step_recorder is not None:
+            sched = sum(allows.get(s.req.request_id, 1) for s in active)
+            self._step_info = {
+                "kind": "decode_burst", "rows": len(active),
+                "tokens": sched, "forwards": K,
+                # Every scan step re-reads each live row's full context
+                # through paged attention (growing by one per step; the
+                # context0 snapshot is the roofline's lower bound).
+                "kv_read_tokens": K * int(
+                    sum(context0[s.slot] for s in active)),
+                "kv_write_tokens": sched,
+            }
         # Read back the PREVIOUS burst (overlaps this burst's execution).
         self._flush_pending_burst()
         self._pending_burst = {
@@ -3312,6 +3405,15 @@ class EngineCore:
             ])
         self.spec_verify_bursts_total += 1
         self.decode_forward_steps_total += 1
+        if self.step_recorder is not None:
+            sched = sum(allows.get(s.req.request_id, 1) for s in active)
+            self._step_info = {
+                "kind": "spec_verify", "rows": len(active),
+                "tokens": sched, "forwards": 1,
+                "kv_read_tokens": int(
+                    sum(context0[s.slot] for s in active)),
+                "kv_write_tokens": sched,
+            }
         self._pending_burst = {
             "out": outs, "active": active, "allows": allows,
             "spec": True, "drafts": drafts,

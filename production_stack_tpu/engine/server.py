@@ -34,6 +34,7 @@ import asyncio
 import os
 import itertools
 import json
+import tempfile
 import threading
 import time
 import uuid
@@ -131,7 +132,10 @@ class EngineServer:
                  kv_pull_max_concurrency: int = 8,
                  trace_buffer: int = 512,
                  slow_trace_threshold_s: float = 0.0,
-                 trace_export: Optional[str] = None):
+                 trace_export: Optional[str] = None,
+                 trace_sample_rate: float = 1.0,
+                 slow_trace_log_interval_s: float = 0.0,
+                 profile_dir: Optional[str] = None):
         # Serving-surface auth (reference tutorial 11 "secure vLLM
         # serve": VLLM_API_KEY): /v1/* requests must carry
         # `Authorization: Bearer <key>`; the intra-stack control plane
@@ -210,7 +214,17 @@ class EngineServer:
             capacity=trace_buffer,
             slow_threshold_s=slow_trace_threshold_s,
             export=trace_export,
+            sample_rate=trace_sample_rate,
+            slow_log_interval_s=slow_trace_log_interval_s,
         )
+        # Programmatic profiler capture (POST /debug/profile): one
+        # jax.profiler trace at a time, written under profile_dir and
+        # served back at /debug/profile/artifacts/. Privileged (bearer
+        # key) like the other destructive control-plane endpoints.
+        self.profile_dir = profile_dir or os.path.join(
+            tempfile.gettempdir(), f"tpu-stack-profiles-{os.getpid()}")
+        self._profile_lock = threading.Lock()
+        self._profile_runs = 0
         # Last HBM headroom sample: the gauge is exported even when the
         # current stats() sample is missing, so dashboards and alerts
         # never see the series disappear.
@@ -559,6 +573,7 @@ class EngineServer:
         # open so an edge-only-key topology (router key, keyless
         # engines) keeps its kvaware reporting channel.
         gated = (auth.is_gated(request.path)
+                 or auth.is_privileged(request.path)
                  or request.path.startswith("/kv/"))
         if self.api_keys and gated and not auth.check_bearer(
                 request.headers.get("Authorization"), self.api_keys):
@@ -613,9 +628,20 @@ class EngineServer:
         r.add_post("/kv/release", self.handle_kv_release)
         r.add_post("/v1/audio/transcriptions", self.handle_transcriptions)
         # Flight recorder (engine-side stage spans per request).
-        from production_stack_tpu.obs.debug import add_debug_routes
+        from production_stack_tpu.obs.debug import (
+            add_debug_routes,
+            add_step_debug_routes,
+        )
 
         add_debug_routes(r, self.trace_recorder)
+        # Step flight recorder (per-step kind/wall/roofline records).
+        if self.core.step_recorder is not None:
+            add_step_debug_routes(r, self.core.step_recorder)
+        # Programmatic profiler capture + served artifacts (privileged).
+        r.add_post("/debug/profile", self.handle_debug_profile)
+        r.add_get("/debug/profile/artifacts", self.handle_profile_artifacts)
+        r.add_get("/debug/profile/artifacts/{name:.+}",
+                  self.handle_profile_artifact_file)
         app["engine_server"] = self
         return app
 
@@ -1591,6 +1617,101 @@ class EngineServer:
 
         return web.json_response({"version": __version__})
 
+    # -- programmatic profiler capture (POST /debug/profile) ------------- #
+
+    def _run_profile_capture(self, out_dir: str, duration_s: float) -> dict:
+        """Blocking jax.profiler capture, run in an executor thread. The
+        engine thread keeps stepping — that's the point: the trace shows
+        real serving steps, not an idle device. No-op friendly: platforms
+        without profiler support (CPU CI, tunneled backends) report the
+        failure instead of 500ing."""
+        import jax
+
+        os.makedirs(out_dir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(out_dir)
+        except Exception as e:  # noqa: BLE001 — backend-specific errors
+            return {"ok": False, "error": f"profiler unavailable: {e}"}
+        try:
+            time.sleep(duration_s)
+        finally:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001
+                return {"ok": False, "error": f"profiler stop failed: {e}"}
+        files = []
+        for root, _dirs, names in os.walk(out_dir):
+            for name in names:
+                rel = os.path.relpath(os.path.join(root, name),
+                                      self.profile_dir)
+                files.append(rel)
+        return {"ok": True, "files": sorted(files)}
+
+    async def handle_debug_profile(self, request: web.Request) -> web.Response:
+        """Time-bounded ``jax.profiler`` trace into the served artifact
+        dir. Body: ``{"duration_s": 2.0}`` (clamped to (0, 60]). One
+        capture at a time; a second request while one is running gets
+        409. Privileged: requires the deployment key when one is set."""
+        body = await _json_body(request)
+        try:
+            duration_s = float(body.get("duration_s", 2.0))
+        except (TypeError, ValueError):
+            raise _bad_request("duration_s must be a number") from None
+        if not duration_s > 0:
+            raise _bad_request("duration_s must be > 0")
+        duration_s = min(duration_s, 60.0)
+        if not self._profile_lock.acquire(blocking=False):
+            return web.json_response(
+                {"error": {"message": "a profile capture is already running",
+                           "type": "Conflict"}}, status=409)
+        try:
+            self._profile_runs += 1
+            run_name = (f"run-{self._profile_runs:04d}-"
+                        f"{time.strftime('%Y%m%d-%H%M%S')}")
+            out_dir = os.path.join(self.profile_dir, run_name)
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, self._run_profile_capture, out_dir, duration_s)
+        finally:
+            self._profile_lock.release()
+        status = 200 if result.get("ok") else 503
+        return web.json_response({
+            "duration_s": duration_s,
+            "run": run_name,
+            "artifact_dir": out_dir,
+            "artifacts_url": "/debug/profile/artifacts",
+            **result,
+        }, status=status)
+
+    async def handle_profile_artifacts(
+            self, request: web.Request) -> web.Response:
+        """List captured profile artifacts (relative paths under the
+        profile dir)."""
+        files = []
+        if os.path.isdir(self.profile_dir):
+            for root, _dirs, names in os.walk(self.profile_dir):
+                for name in names:
+                    files.append(os.path.relpath(
+                        os.path.join(root, name), self.profile_dir))
+        return web.json_response(
+            {"profile_dir": self.profile_dir, "files": sorted(files)})
+
+    async def handle_profile_artifact_file(
+            self, request: web.Request) -> web.StreamResponse:
+        """Serve one artifact file. Path-traversal safe: the resolved
+        path must stay under the profile dir."""
+        name = request.match_info["name"]
+        base = os.path.realpath(self.profile_dir)
+        full = os.path.realpath(os.path.join(base, name))
+        if not (full == base or full.startswith(base + os.sep)):
+            return web.json_response(
+                {"error": {"message": "invalid artifact path",
+                           "type": "BadRequestError"}}, status=400)
+        if not os.path.isfile(full):
+            return web.json_response(
+                {"error": {"message": "artifact not found",
+                           "type": "NotFoundError"}}, status=404)
+        return web.FileResponse(full)
+
     async def handle_drain(self, request: web.Request) -> web.Response:
         """Graceful drain (the helm preStop hook, and any rollout
         orchestrator): stop admitting inference requests, flip /health
@@ -2335,6 +2456,53 @@ class EngineServer:
             f"tpu:structured_violations_total{{{labels}}} "
             f"{s.get('structured_violations_total', 0)}",
         ]
+        # Step flight recorder: per-kind step duration sum/count pairs,
+        # scheduled tokens, the roofline HBM byte estimate, and the
+        # bandwidth-utilization gauge (achieved bytes/s over the recent
+        # step window vs the device HBM floor). Every kind is always
+        # emitted so rate() queries never see a vanishing series.
+        step_rec = self.core.step_recorder
+        if step_rec is not None:
+            lines += [
+                "# TYPE tpu:step_duration_seconds summary",
+            ]
+            kind_stats = step_rec.kind_stats()
+            for kind in sorted(kind_stats):
+                kl = f'{labels},kind="{kind}"'
+                ks = kind_stats[kind]
+                lines += [
+                    f"tpu:step_duration_seconds_sum{{{kl}}} "
+                    f"{ks['wall_s']:.6f}",
+                    f"tpu:step_duration_seconds_count{{{kl}}} "
+                    f"{ks['count']}",
+                ]
+            lines.append("# TYPE tpu:step_scheduled_tokens counter")
+            for kind in sorted(kind_stats):
+                kl = f'{labels},kind="{kind}"'
+                lines.append(
+                    f"tpu:step_scheduled_tokens_total{{{kl}}} "
+                    f"{kind_stats[kind]['tokens']}")
+            lines.append("# TYPE tpu:step_hbm_bytes counter")
+            for kind in sorted(kind_stats):
+                kl = f'{labels},kind="{kind}"'
+                lines.append(
+                    f"tpu:step_hbm_bytes_total{{{kl}}} "
+                    f"{kind_stats[kind]['hbm_bytes']}")
+            lines += [
+                "# TYPE tpu:model_bandwidth_utilization gauge",
+                f"tpu:model_bandwidth_utilization{{{labels}}} "
+                f"{step_rec.bandwidth_utilization():.6f}",
+            ]
+        # Trace head-sampling activity (--trace-sample-rate /
+        # --slow-trace-log-interval-s).
+        lines += [
+            "# TYPE tpu:trace_sampled_out counter",
+            f"tpu:trace_sampled_out_total{{{labels}}} "
+            f"{self.trace_recorder.sampled_out_total}",
+            "# TYPE tpu:slow_trace_logs_suppressed counter",
+            f"tpu:slow_trace_logs_suppressed_total{{{labels}}} "
+            f"{self.trace_recorder.slow_logs_suppressed_total}",
+        ]
         # Admission rejections by reason; both reasons always emitted so
         # rate() queries never see a vanishing series.
         rejected = s.get("rejected_requests") or {}
@@ -2526,6 +2694,24 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-buffer", type=int, default=512,
                    help="completed traces kept in the in-process flight "
                         "recorder, served at /debug/traces")
+    p.add_argument("--trace-sample-rate", type=float, default=1.0,
+                   help="fraction of requests whose traces are retained "
+                        "and exported (deterministic by trace id, so the "
+                        "router and engine keep the same requests); stage "
+                        "rollup metrics still count every request")
+    p.add_argument("--slow-trace-log-interval-s", type=float, default=0.0,
+                   help="emit at most one slow-trace log line per this "
+                        "many seconds (suppressed lines are still counted "
+                        "as slow requests); 0 logs every slow trace")
+    p.add_argument("--no-step-recorder", dest="step_recorder",
+                   action="store_false", default=True,
+                   help="disable the per-step flight recorder "
+                        "(/debug/steps + tpu:step_* metrics)")
+    p.add_argument("--step-record-capacity", type=int, default=1024,
+                   help="step records kept in the flight-recorder ring")
+    p.add_argument("--profile-dir", default=None,
+                   help="directory for POST /debug/profile jax.profiler "
+                        "artifacts (default: a per-process tempdir)")
     return p
 
 
@@ -2578,6 +2764,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         kv_offload_bytes=int(args.kv_offload_gb * (1 << 30)),
         kv_remote_url=args.kv_remote_url,
         chat_template=args.chat_template,
+        step_recorder=args.step_recorder,
+        step_record_capacity=args.step_record_capacity,
     )
     if mh_env is not None and mh_env["process_id"] != 0:
         _run_follower(config, args)
@@ -2594,7 +2782,10 @@ def main(argv: Optional[List[str]] = None) -> None:
                           kv_pull_max_concurrency=args.kv_pull_max_concurrency,
                           trace_buffer=args.trace_buffer,
                           slow_trace_threshold_s=args.slow_trace_threshold_s,
-                          trace_export=args.trace_export)
+                          trace_export=args.trace_export,
+                          trace_sample_rate=args.trace_sample_rate,
+                          slow_trace_log_interval_s=args.slow_trace_log_interval_s,
+                          profile_dir=args.profile_dir)
 
     async def _run():
         await run_engine_server(server, args.host, args.port)

@@ -1,15 +1,19 @@
-"""``/debug/traces`` HTTP surface, shared by router, engine, and fake engine.
+"""``/debug/*`` HTTP surfaces, shared by router, engine, and fake engine.
 
 - ``GET /debug/traces``                 -- newest-first summaries; filters:
   ``?min_duration_s=0.25`` and ``?limit=50``.
 - ``GET /debug/traces/{request_id}``    -- full span timeline as JSON;
   ``?format=otlp`` returns the OTLP-JSON resourceSpans shape instead.
+- ``GET /debug/steps``                  -- engine-only: newest-first step
+  flight-recorder records; filters: ``?limit=50`` and
+  ``?kind=decode_burst``.
 """
 
 from __future__ import annotations
 
 from aiohttp import web
 
+from production_stack_tpu.obs.steps import STEP_KINDS, StepRecorder
 from production_stack_tpu.obs.trace import TraceRecorder
 
 
@@ -45,3 +49,28 @@ def add_debug_routes(router, recorder: TraceRecorder) -> None:
 
     router.add_get("/debug/traces", list_traces)
     router.add_get("/debug/traces/{request_id}", get_trace)
+
+
+def add_step_debug_routes(router, recorder: StepRecorder) -> None:
+    """Attach ``GET /debug/steps`` (engine step flight recorder)."""
+
+    async def list_steps(request: web.Request) -> web.Response:
+        try:
+            limit = int(request.query.get("limit", 100) or 100)
+        except ValueError:
+            return web.json_response(
+                {"error": "limit must be an integer"}, status=400)
+        if limit < 1:
+            return web.json_response(
+                {"error": "limit must be >= 1"}, status=400)
+        kind = request.query.get("kind") or None
+        if kind is not None and kind not in STEP_KINDS:
+            return web.json_response(
+                {"error": f"unknown kind {kind!r} "
+                          f"(one of: {', '.join(STEP_KINDS)})"},
+                status=400)
+        out = recorder.summary()
+        out["steps"] = recorder.snapshot(limit=limit, kind=kind)
+        return web.json_response(out)
+
+    router.add_get("/debug/steps", list_steps)

@@ -396,15 +396,30 @@ class TraceRecorder:
         slow_threshold_s: float = 0.0,
         export: Optional[str] = None,
         log: Optional[logging.Logger] = None,
+        sample_rate: float = 1.0,
+        slow_log_interval_s: float = 0.0,
     ):
         self.service = service
         self.capacity = max(1, int(capacity))
         self.slow_threshold_s = float(slow_threshold_s or 0.0)
+        # Head sampling for always-on production tracing: traces whose id
+        # hashes above the rate skip the ring buffer, slow-trace logging,
+        # and export — but their stage rollups still feed /metrics, so
+        # the tpu:*_time_seconds series stay exact. Deterministic by
+        # trace id: router and engine keep/drop the SAME requests, so
+        # sampled traces still stitch across services.
+        self.sample_rate = min(1.0, max(0.0, float(sample_rate)))
+        # Minimum seconds between slow_trace log lines (0 = unlimited,
+        # the historical behavior). Slow requests are always COUNTED.
+        self.slow_log_interval_s = float(slow_log_interval_s or 0.0)
+        self._last_slow_log = 0.0
         self._traces: "OrderedDict[str, RequestTrace]" = OrderedDict()
         self._lock = threading.Lock()
         self._stage: Dict[str, List[float]] = {}  # name -> [sum_s, count]
         self.slow_requests = 0
         self.recorded_total = 0
+        self.sampled_out_total = 0
+        self.slow_logs_suppressed_total = 0
         self._exporter = make_exporter(export)
         self._log = log or logger
 
@@ -430,15 +445,33 @@ class TraceRecorder:
             service=self.service,
         )
 
+    def sampled(self, trace_id: str) -> bool:
+        """Deterministic keep/drop decision for a trace id. At the default
+        rate of 1.0 everything is kept (the flag-off path stays
+        byte-identical: ``record`` never even consults this)."""
+        if self.sample_rate >= 1.0:
+            return True
+        if self.sample_rate <= 0.0:
+            return False
+        try:
+            bucket = int(trace_id[:8], 16) / float(0xFFFFFFFF)
+        except (ValueError, TypeError):
+            return True  # malformed ids must never break the request path
+        return bucket < self.sample_rate
+
     def record(self, trace: RequestTrace) -> None:
         """Store a completed trace: ring-buffer it, roll up stage sums,
         flag slow requests, export if configured."""
         trace.close()
+        keep = self.sample_rate >= 1.0 or self.sampled(trace.trace_id)
         with self._lock:
-            self._traces.pop(trace.request_id, None)
-            self._traces[trace.request_id] = trace
-            while len(self._traces) > self.capacity:
-                self._traces.popitem(last=False)
+            if keep:
+                self._traces.pop(trace.request_id, None)
+                self._traces[trace.request_id] = trace
+                while len(self._traces) > self.capacity:
+                    self._traces.popitem(last=False)
+            else:
+                self.sampled_out_total += 1
             for span in trace.spans:
                 agg = self._stage.setdefault(span.name, [0.0, 0])
                 agg[0] += span.duration_s
@@ -446,9 +479,17 @@ class TraceRecorder:
             self.recorded_total += 1
             is_slow = (self.slow_threshold_s > 0
                        and trace.duration_s >= self.slow_threshold_s)
+            log_slow = is_slow and keep
             if is_slow:
                 self.slow_requests += 1
-        if is_slow:
+                if log_slow and self.slow_log_interval_s > 0:
+                    now = time.time()
+                    if now - self._last_slow_log < self.slow_log_interval_s:
+                        self.slow_logs_suppressed_total += 1
+                        log_slow = False  # still counted above
+                    else:
+                        self._last_slow_log = now
+        if log_slow:
             self._log.warning(
                 "slow_trace %s",
                 json.dumps({
@@ -458,7 +499,7 @@ class TraceRecorder:
                     **trace.to_dict(),
                 }, separators=(",", ":")),
             )
-        if self._exporter is not None:
+        if keep and self._exporter is not None:
             try:
                 self._exporter.export({"resourceSpans": [trace.to_otlp()]})
             except OSError as e:
@@ -469,6 +510,21 @@ class TraceRecorder:
     def get(self, request_id: str) -> Optional[RequestTrace]:
         with self._lock:
             return self._traces.get(request_id)
+
+    def root_attribute_values(self, name: str) -> List[float]:
+        """Numeric values of a root-span attribute across the ring, oldest
+        first. The storm/chaos harnesses read ``overhead_s`` this way to
+        report ``router_overhead_p99`` without scraping /metrics."""
+        with self._lock:
+            traces = list(self._traces.values())
+        out: List[float] = []
+        for tr in traces:
+            if tr.root is None:
+                continue
+            v = tr.root.attributes.get(name)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out.append(float(v))
+        return out
 
     def list(self, min_duration_s: float = 0.0, limit: int = 100) -> List[dict]:
         with self._lock:

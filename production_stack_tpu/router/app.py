@@ -156,6 +156,11 @@ async def metrics_handler(request: web.Request) -> web.Response:
         state.request_stats_monitor.get_request_stats(),
         fault_tolerance=state.fault_tolerance,
     )
+    if state.trace_recorder is not None:
+        metrics_mod.trace_sampled_out.set(
+            state.trace_recorder.sampled_out_total)
+        metrics_mod.slow_trace_logs_suppressed.set(
+            state.trace_recorder.slow_logs_suppressed_total)
     return web.Response(
         body=metrics_mod.render_metrics(),
         content_type="text/plain",
@@ -676,6 +681,9 @@ def initialize_all(args) -> RouterState:
         slow_threshold_s=getattr(args, "slow_trace_threshold_s", 0.0),
         export=getattr(args, "trace_export", None)
         or getattr(args, "otel_endpoint", None),
+        sample_rate=getattr(args, "trace_sample_rate", 1.0),
+        slow_log_interval_s=getattr(
+            args, "slow_trace_log_interval_s", 0.0),
     )
 
     # Service discovery.

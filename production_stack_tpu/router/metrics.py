@@ -47,6 +47,31 @@ hist_itl = Histogram(
     buckets=(0.01, 0.025, 0.05, 0.075, 0.1, 0.15, 0.2, 0.3, 0.4, 0.5,
              0.75, 1.0, 2.5),
     registry=REGISTRY)
+# Router overhead clock: wall time a request spent INSIDE the router
+# (routing pick, QoS admission, fleet pull orchestration, tracing,
+# response relay) excluding the upstream engine's own time — root span
+# minus upstream span from the request trace. ms-scale buckets: this
+# measures event-loop work, not model time.
+hist_router_overhead = Histogram(
+    "vllm_router:router_overhead_seconds",
+    "In-router request time excluding upstream engine time (s)", _L,
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+             0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
+    registry=REGISTRY)
+
+# Trace head-sampling activity (--trace-sample-rate /
+# --slow-trace-log-interval-s). Cumulative recorder counts mirrored as
+# gauges at scrape time (the TraceRecorder owns the source of truth);
+# the _total suffix keeps rate() usable in the dashboard.
+trace_sampled_out = Gauge(
+    "vllm_router:trace_sampled_out_total",
+    "Traces dropped by head sampling (stage rollups still counted)",
+    registry=REGISTRY)
+slow_trace_logs_suppressed = Gauge(
+    "vllm_router:slow_trace_logs_suppressed_total",
+    "Slow-trace log lines suppressed by the rate limit "
+    "(slow requests are still counted)",
+    registry=REGISTRY)
 
 current_qps = Gauge("vllm_router:current_qps", "Sliding-window QPS", _L, registry=REGISTRY)
 avg_ttft = Gauge("vllm_router:avg_ttft", "Average time to first token (s)", _L, registry=REGISTRY)

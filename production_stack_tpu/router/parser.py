@@ -216,6 +216,18 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--trace-buffer", type=int, default=512,
                         help="completed traces kept in the in-process "
                              "flight recorder, served at /debug/traces")
+    parser.add_argument("--trace-sample-rate", type=float, default=1.0,
+                        help="fraction of requests whose traces are "
+                             "retained and exported (deterministic by "
+                             "trace id, so router and engine keep the "
+                             "same requests); stage rollup metrics still "
+                             "count every request")
+    parser.add_argument("--slow-trace-log-interval-s", type=float,
+                        default=0.0,
+                        help="emit at most one slow-trace log line per "
+                             "this many seconds (suppressed lines still "
+                             "count as slow requests); 0 logs every slow "
+                             "trace")
     return parser
 
 
@@ -283,6 +295,10 @@ def validate_args(args: argparse.Namespace) -> None:
     if not 0.0 <= args.sentry_profile_session_sample_rate <= 1.0:
         raise ValueError(
             "--sentry-profile-session-sample-rate must be in [0, 1]")
+    if not 0.0 <= getattr(args, "trace_sample_rate", 1.0) <= 1.0:
+        raise ValueError("--trace-sample-rate must be in [0, 1]")
+    if getattr(args, "slow_trace_log_interval_s", 0.0) < 0.0:
+        raise ValueError("--slow-trace-log-interval-s must be >= 0")
 
 
 def expand_static_models_config(config: dict) -> dict:

@@ -36,6 +36,16 @@ from production_stack_tpu.testing.qos_ab import (
 MODEL = "chaos-model"
 
 
+def _overhead_p99(router_app) -> Optional[float]:
+    """p99 of per-request router overhead (in-router time minus upstream
+    engine time), read from the in-process trace recorder ring."""
+    recorder = getattr(router_app["state"], "trace_recorder", None)
+    if recorder is None:
+        return None
+    vals = recorder.root_attribute_values("overhead_s")
+    return round(_p99(vals), 6) if vals else None
+
+
 async def _start(app, shutdown_timeout: float = 0.5):
     """Start an app on an ephemeral port. A short shutdown timeout
     matters here: the hung replica still holds 300 s sleeping handlers
@@ -98,6 +108,9 @@ async def _run_leg(*, ft_on: bool, total: int, concurrency: int,
     args.static_models = ",".join([MODEL] * 3)
     args.routing_logic = "roundrobin"
     args.engine_stats_interval = 60
+    # Ring must hold every request of the leg so router_overhead_p99 is
+    # computed over the full population, not the tail that fit in 512.
+    args.trace_buffer = max(1024, total)
     if ft_on:
         args.fault_tolerance = True
         args.ft_max_retries = 3
@@ -165,6 +178,7 @@ async def _run_leg(*, ft_on: bool, total: int, concurrency: int,
         "p99_latency_s": round(_p99(latencies), 4) if latencies else None,
         "leg_wall_s": round(time.perf_counter() - t_leg, 2),
         "chaos_fired": chaos_fired.is_set(),
+        "router_overhead_p99": _overhead_p99(router_app),
         "engine_requests": [len(e.requests_seen) for e in engines],
         "hung_faults_injected": engines[2].faults_injected,
     }
@@ -207,6 +221,7 @@ async def _run_kill9_leg(*, total: int = 120, concurrency: int = 12,
     args.static_models = ",".join([MODEL] * 3)
     args.routing_logic = "roundrobin"
     args.engine_stats_interval = 60
+    args.trace_buffer = max(1024, total + 2 * concurrency)
     args.fault_tolerance = True
     args.ft_max_retries = 3
     args.ft_backoff_base = 0.02
@@ -315,6 +330,7 @@ async def _run_kill9_leg(*, total: int = 120, concurrency: int = 12,
         "stale_pull_bound_ok": (stale_pull_window_s is None
                                 or stale_pull_window_s <= bound_s),
         "post_sweep_stale_pulls": post_sweep_stale_pulls,
+        "router_overhead_p99": _overhead_p99(router_app),
         "fleet": state.fleet.health(),
         "engine_requests": [len(e.requests_seen) for e in engines],
     }

@@ -36,14 +36,18 @@ def is_gated(path: str) -> bool:
 # a replica's routing claims. Unauthenticated access to either is a
 # one-request denial of service, so — unlike the rest of the /kv
 # reporting channel — they require the deployment key when one is set.
-_PRIVILEGED_EXACT = frozenset({"/kv/deregister"})
-_PRIVILEGED_PREFIX = "/autoscale/"
+# The engine's /debug/profile (programmatic jax.profiler capture, plus
+# the served artifact dir beneath it) is privileged for the same reason:
+# a profiler trace steals device time and writes to disk.
+_PRIVILEGED_EXACT = frozenset({"/kv/deregister", "/debug/profile"})
+_PRIVILEGED_PREFIXES = ("/autoscale/", "/debug/profile/")
 
 
 def is_privileged(path: str) -> bool:
     """True for control-plane paths that can take replicas out of
     service; gated like the inference surface (never open)."""
-    return path in _PRIVILEGED_EXACT or path.startswith(_PRIVILEGED_PREFIX)
+    return (path in _PRIVILEGED_EXACT
+            or path.startswith(_PRIVILEGED_PREFIXES))
 
 
 def _split_keys(value: str) -> Tuple[str, ...]:

@@ -1,0 +1,330 @@
+"""Step flight recorder: roofline math and ring semantics, the
+``/debug/steps`` surface, engine integration (records appear with the
+right kinds during real generation), the recorder-overhead A/B bound,
+and the hermetic prefill-profile artifact schema."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import pytest
+from aiohttp import web
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.core import EngineCore
+from production_stack_tpu.engine.sampling import SamplingParams
+from production_stack_tpu.obs.debug import add_step_debug_routes
+from production_stack_tpu.obs.steps import (
+    DEFAULT_HBM_BYTES_PER_S,
+    STEP_KINDS,
+    StepRecorder,
+    device_hbm_bytes_per_s,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Unit: ring + roofline accounting
+# ---------------------------------------------------------------------------
+
+
+def test_ring_truncation_newest_first():
+    rec = StepRecorder(capacity=5)
+    for i in range(10):
+        rec.record("decode_burst", 0.01, tokens=i)
+    assert rec.recorded_total == 10
+    snap = rec.snapshot()
+    assert len(snap) == 5  # ring bounded at capacity
+    assert [r["step"] for r in snap] == [10, 9, 8, 7, 6]  # newest first
+    assert [r["step"] for r in rec.snapshot(limit=2)] == [10, 9]
+
+
+def test_kind_filter_and_stats_always_complete():
+    rec = StepRecorder(capacity=16)
+    # Every known kind is present in the rollups even before any record,
+    # so the per-kind Prometheus series never vanish between scrapes.
+    assert set(rec.kind_stats()) == set(STEP_KINDS)
+    assert all(v["count"] == 0 for v in rec.kind_stats().values())
+    rec.record("prefill", 0.2, tokens=64)
+    rec.record("decode_burst", 0.1, tokens=16)
+    rec.record("decode_burst", 0.1, tokens=16)
+    snap = rec.snapshot(kind="decode_burst")
+    assert len(snap) == 2 and all(r["kind"] == "decode_burst" for r in snap)
+    stats = rec.kind_stats()
+    assert stats["prefill"]["count"] == 1 and stats["prefill"]["tokens"] == 64
+    assert stats["decode_burst"]["count"] == 2
+    assert stats["spec_verify"]["count"] == 0
+    # Unknown kinds must not crash the engine loop; they get their own
+    # rollup bucket.
+    rec.record("experimental", 0.05)
+    assert rec.kind_stats()["experimental"]["count"] == 1
+
+
+def test_roofline_byte_estimate():
+    rec = StepRecorder(param_bytes=100, kv_token_bytes=2)
+    r = rec.record("decode_burst", 0.5, rows=2, tokens=8, forwards=4,
+                   kv_read_tokens=10, kv_write_tokens=5)
+    # forwards x weights + (kv reads + writes) x per-token KV cost.
+    assert r["hbm_bytes"] == 4 * 100 + (10 + 5) * 2
+    assert rec.kind_stats()["decode_burst"]["hbm_bytes"] == r["hbm_bytes"]
+
+
+def test_bandwidth_utilization_window():
+    rec = StepRecorder(param_bytes=0, kv_token_bytes=1,
+                       hbm_bytes_per_s=1000.0, window_s=60.0)
+    assert rec.bandwidth_utilization() == 0.0  # empty ring
+    r = rec.record("decode_burst", 2.0, kv_write_tokens=1000)
+    # 1000 bytes over 2 s of model-active time against a 1000 B/s floor.
+    assert rec.bandwidth_utilization(now=r["ts_unix"]) == pytest.approx(0.5)
+    # Steps that STARTED before the window are excluded (start is
+    # ts_unix - wall_s, i.e. 2 s before the record timestamp).
+    assert rec.bandwidth_utilization(now=r["ts_unix"] + 59.0) == 0.0
+
+
+def test_device_hbm_floor_env_override(monkeypatch):
+    monkeypatch.delenv("TPU_STACK_HBM_GBS", raising=False)
+    assert device_hbm_bytes_per_s() == DEFAULT_HBM_BYTES_PER_S
+    monkeypatch.setenv("TPU_STACK_HBM_GBS", "1e9")
+    assert device_hbm_bytes_per_s() == 1e9
+    monkeypatch.setenv("TPU_STACK_HBM_GBS", "not-a-number")
+    assert device_hbm_bytes_per_s() == DEFAULT_HBM_BYTES_PER_S
+
+
+# ---------------------------------------------------------------------------
+# /debug/steps endpoint
+# ---------------------------------------------------------------------------
+
+
+def _get_json(recorder, path):
+    app = web.Application()
+    add_step_debug_routes(app.router, recorder)
+
+    async def run():
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        import aiohttp
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"http://127.0.0.1:{port}{path}") as resp:
+                    return resp.status, await resp.json()
+        finally:
+            await runner.cleanup()
+
+    return asyncio.run(run())
+
+
+def test_debug_steps_schema_and_filters():
+    rec = StepRecorder(capacity=8, param_bytes=10, kv_token_bytes=2)
+    rec.record("prefill", 0.2, rows=1, tokens=64, forwards=1,
+               kv_write_tokens=64)
+    for _ in range(3):
+        rec.record("decode_burst", 0.05, rows=2, tokens=8, forwards=4,
+                   kv_read_tokens=100, kv_write_tokens=8, batched=True)
+
+    status, doc = _get_json(rec, "/debug/steps")
+    assert status == 200
+    for key in ("capacity", "recorded_total", "param_bytes",
+                "kv_token_bytes", "hbm_bytes_per_s", "window_s",
+                "bandwidth_utilization", "kinds", "steps"):
+        assert key in doc, key
+    assert doc["recorded_total"] == 4
+    assert set(doc["kinds"]) >= set(STEP_KINDS)
+    assert len(doc["steps"]) == 4
+    for r in doc["steps"]:
+        for key in ("step", "ts_unix", "kind", "wall_s", "rows", "tokens",
+                    "forwards", "kv_read_tokens", "kv_write_tokens",
+                    "hbm_bytes", "batched"):
+            assert key in r, key
+
+    status, doc = _get_json(rec, "/debug/steps?kind=decode_burst&limit=2")
+    assert status == 200
+    assert len(doc["steps"]) == 2
+    assert all(r["kind"] == "decode_burst" for r in doc["steps"])
+
+
+def test_debug_steps_validation():
+    rec = StepRecorder()
+    status, doc = _get_json(rec, "/debug/steps?limit=abc")
+    assert status == 400 and "limit" in doc["error"]
+    status, doc = _get_json(rec, "/debug/steps?limit=0")
+    assert status == 400 and ">= 1" in doc["error"]
+    status, doc = _get_json(rec, "/debug/steps?kind=nope")
+    assert status == 400
+    # The error names the valid kinds so the 400 is self-documenting.
+    assert all(k in doc["error"] for k in STEP_KINDS)
+
+
+# ---------------------------------------------------------------------------
+# Engine integration + overhead A/B
+# ---------------------------------------------------------------------------
+
+
+def _make_engine(**over):
+    kwargs = dict(
+        model="tiny-llama",
+        max_model_len=128,
+        max_num_seqs=4,
+        block_size=4,
+        num_blocks=96,
+        min_prefill_bucket=16,
+        max_loras=0,
+    )
+    kwargs.update(over)
+    eng = EngineCore(EngineConfig(**kwargs), devices=jax.devices()[:1])
+    eng.start()
+    return eng
+
+
+def _generate(engine, rid, max_tokens, timeout=120):
+    import queue
+    q = queue.Queue()
+
+    def on_token(token, finish):
+        q.put((token, finish))
+
+    engine.add_request(
+        rid, [1, 2, 3, 4, 5],
+        SamplingParams(temperature=0.0, max_tokens=max_tokens,
+                       ignore_eos=True),
+        on_token)
+    n = 0
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            token, finish = q.get(timeout=5)
+        except queue.Empty:
+            continue
+        if token is not None:
+            n += 1
+        if finish is not None:
+            return n
+    raise TimeoutError("generation did not finish")
+
+
+def test_engine_populates_recorder_and_stats():
+    eng = _make_engine()
+    try:
+        _generate(eng, "sr-1", 8)
+        rec = eng.step_recorder
+        assert rec is not None
+        kinds = {r["kind"] for r in rec.snapshot()}
+        # One whole-prompt prefill plus fused decode bursts.
+        assert "prefill" in kinds
+        assert "decode_burst" in kinds
+        # The core fills param_bytes in lazily from the live weights, so
+        # roofline bytes are non-zero.
+        assert rec.param_bytes > 0
+        assert all(r["hbm_bytes"] > 0 for r in rec.snapshot())
+        stats = eng.stats()
+        assert stats["step_records_total"] == rec.recorded_total > 0
+        assert stats["step_kind_stats"]["prefill"]["count"] >= 1
+        assert "model_bandwidth_utilization" in stats
+    finally:
+        eng.stop()
+
+
+def test_recorder_disabled_by_config():
+    eng = _make_engine(step_recorder=False)
+    try:
+        _generate(eng, "sr-off", 4)
+        assert eng.step_recorder is None
+        stats = eng.stats()
+        assert stats["step_records_total"] == 0
+        assert stats["step_kind_stats"] == {}
+    finally:
+        eng.stop()
+
+
+def test_recorder_overhead_under_one_percent():
+    """A/B the same engine with the recorder toggled: tokens/s with the
+    recorder on must be within 1% of recorder-off. The recorder is one
+    dict stash + one locked append per step, so on a CPU engine where a
+    leg is tens of milliseconds the true cost is ~0.1%; the estimator
+    has to beat scheduler jitter, not the recorder. Legs are
+    interleaved with alternating order (cancels warming drift) and the
+    bound compares the mean of each side's fastest quartile (stabler
+    than a raw min-of-N)."""
+    eng = _make_engine()
+    recorder = eng.step_recorder
+    assert recorder is not None
+    n_tokens = 64
+    try:
+        # Warm both code paths (compile + caches) before timing.
+        _generate(eng, "warm-on", n_tokens)
+        eng.step_recorder = None
+        _generate(eng, "warm-off", n_tokens)
+        walls = {"on": [], "off": []}
+
+        def floor_s(leg):
+            best = sorted(walls[leg])[:max(1, len(walls[leg]) // 4)]
+            return sum(best) / len(best)
+
+        # Accumulate interleaved legs until the floors converge under
+        # the bound (the floor estimate only improves with samples); a
+        # genuine >1% regression keeps failing through every batch.
+        tok_s_on = tok_s_off = 0.0
+        for i in range(36):
+            order = (("on", recorder), ("off", None))
+            if i % 2:
+                order = order[::-1]
+            for leg, rec in order:
+                eng.step_recorder = rec
+                t0 = time.perf_counter()
+                got = _generate(eng, f"ab-{leg}-{i}", n_tokens)
+                walls[leg].append(time.perf_counter() - t0)
+                assert got == n_tokens
+            tok_s_on = n_tokens / floor_s("on")
+            tok_s_off = n_tokens / floor_s("off")
+            if i >= 5 and tok_s_on >= 0.99 * tok_s_off:
+                break
+        assert tok_s_on >= 0.99 * tok_s_off, (
+            f"recorder overhead above 1%: on={tok_s_on:.1f} tok/s "
+            f"off={tok_s_off:.1f} tok/s over {len(walls['on'])} legs")
+    finally:
+        eng.step_recorder = recorder
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# Prefill decomposition profiler: hermetic artifact schema
+# ---------------------------------------------------------------------------
+
+
+def test_prefill_profile_hermetic_schema():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_ROOT, "benchmarks", "prefill_profile.py"),
+         "--hermetic"],
+        capture_output=True, text=True, timeout=540, env=env, cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert doc["metric"] == "prefill_profile"
+    assert doc["hermetic"] is True
+    assert doc["backend"] == "cpu"
+    assert doc["chunks"], "profiler produced no per-chunk rows"
+    for row in doc["chunks"]:
+        for key in ("offset", "context", "full_s", "noattn_s", "nowrite_s",
+                    "bare_matmul_s"):
+            assert key in row, key
+            assert row[key] is not None
+        for key in ("attention_est_s", "copy_est_s", "matmul_est_s"):
+            assert key in row["components"], key
+        assert row["full_s"] > 0 and row["bare_matmul_s"] > 0
+    assert doc["floors"]["weights_read_per_chunk_s"] > 0
+    # The committed artifact must match the schema the profiler emits
+    # today (drift check for BENCH_PREFILL_PROFILE_*.json).
+    committed = os.path.join(REPO_ROOT, "BENCH_PREFILL_PROFILE_r11.json")
+    with open(committed) as f:
+        art = json.load(f)
+    assert art["metric"] == "prefill_profile"
+    assert set(art["chunks"][0]["components"]) == \
+        set(doc["chunks"][0]["components"])

@@ -120,6 +120,82 @@ def test_file_export_writes_otlp_json(tmp_path):
         assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
 
 
+def test_head_sampling_deterministic_by_trace_id():
+    # The keep/drop decision is a pure function of the trace id, so two
+    # services at the same rate keep the SAME requests and sampled
+    # traces still stitch router -> engine.
+    a = TraceRecorder("router", sample_rate=0.5)
+    b = TraceRecorder("engine", sample_rate=0.5)
+    decisions = []
+    for i in range(64):
+        tid = trace_id_from_request_id(f"req-{i}")
+        d = a.sampled(tid)
+        assert d == a.sampled(tid) == b.sampled(tid)
+        decisions.append(d)
+    # At 50% over 64 ids both outcomes must occur.
+    assert any(decisions) and not all(decisions)
+    # Boundary rates; malformed ids are always kept (sampling must never
+    # break the request path).
+    assert TraceRecorder("t", sample_rate=1.0).sampled("whatever")
+    assert not TraceRecorder("t", sample_rate=0.0).sampled("ab" * 16)
+    assert TraceRecorder("t", sample_rate=0.5).sampled("not-hex!")
+
+
+def test_sampled_out_traces_still_feed_stage_rollups():
+    rec = TraceRecorder("test", sample_rate=0.0, slow_threshold_s=0.001)
+    for i in range(5):
+        _record_one(rec, f"r{i}", dur=0.05)
+    assert rec.recorded_total == 5
+    assert rec.sampled_out_total == 5
+    assert rec.list() == []  # nothing kept in the ring
+    # Stage rollups (the tpu:*_time_seconds series) stay exact, and slow
+    # requests are still counted even when the trace itself is dropped.
+    q_sum, q_count = rec.stage_stats()["engine.queue"]
+    assert q_count == 5 and q_sum > 0
+    assert rec.slow_requests == 5
+
+
+def test_default_sample_rate_keeps_everything():
+    rec = TraceRecorder("test")  # default 1.0: flag-off behavior
+    for i in range(8):
+        _record_one(rec, f"r{i}")
+    assert rec.sampled_out_total == 0
+    assert len(rec.list()) == 8
+
+
+def test_slow_log_rate_limit_counts_suppressed(caplog):
+    log = logging.getLogger("test-slow-limit")
+    rec = TraceRecorder("test", slow_threshold_s=0.001,
+                        slow_log_interval_s=3600.0, log=log)
+    with caplog.at_level(logging.WARNING, logger="test-slow-limit"):
+        for i in range(4):
+            _record_one(rec, f"s{i}", dur=0.05)
+    # All four slow requests are counted; only the first emits a log
+    # line inside the interval, the rest are suppressed-and-counted.
+    assert rec.slow_requests == 4
+    assert rec.slow_logs_suppressed_total == 3
+    lines = [r for r in caplog.records if "slow_trace" in r.getMessage()]
+    assert len(lines) == 1
+
+
+def test_root_attribute_values_collects_numeric():
+    rec = TraceRecorder("test")
+    for i in range(3):
+        tr = rec.begin(f"o{i}")
+        root = tr.start_span("router.request")
+        root.finish(status=200, overhead_s=0.001 * (i + 1))
+        rec.record(tr)
+    # Non-numeric values are skipped (the harness p99 must not choke on
+    # a stray string attribute).
+    tr = rec.begin("o-skip")
+    root = tr.start_span("router.request")
+    root.finish(status=200, overhead_s="n/a")
+    rec.record(tr)
+    vals = rec.root_attribute_values("overhead_s")
+    assert vals == pytest.approx([0.001, 0.002, 0.003])
+    assert rec.root_attribute_values("missing") == []
+
+
 # ---------------------------------------------------------------------------
 # E2E: router -> fake engine over real HTTP
 # ---------------------------------------------------------------------------
