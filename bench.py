@@ -225,6 +225,24 @@ STRUCTURED_OUT = os.environ.get("BENCH_STRUCTURED_OUT",
 STRUCTURED_REQS = _env_int("BENCH_STRUCTURED_REQS", 8)
 STRUCTURED_MAX_TOKENS = _env_int("BENCH_STRUCTURED_MAX_TOKENS", 32)
 STRUCTURED_REPEATS = _env_int("BENCH_STRUCTURED_REPEATS", 3)
+# Router saturation harness: BENCH_SATURATION=1 steps rungs of
+# closed-loop users (BENCH_SATURATION_STEPS, comma-separated counts)
+# against BENCH_SATURATION_REPLICAS fake replicas through the real
+# router running a real --slo-config, until goodput falls below
+# BENCH_SATURATION_COLLAPSE (production_stack_tpu/testing/
+# saturation.py — no TPU, no jax import). Writes BENCH_SATURATION_OUT
+# (default BENCH_SATURATION_r12.json) with the RPS ceiling, the
+# goodput-vs-load curve, per-rung outcome-classifier deltas (which must
+# reconcile with the offered totals), and router_overhead_p99 at the
+# knee.
+SATURATION = _env_int("BENCH_SATURATION", 0)
+SATURATION_OUT = os.environ.get("BENCH_SATURATION_OUT",
+                                "BENCH_SATURATION_r12.json")
+SATURATION_STEPS = os.environ.get("BENCH_SATURATION_STEPS",
+                                  "100,500,1000,2500,5000,10000")
+SATURATION_REQS_PER_USER = _env_int("BENCH_SATURATION_REQS_PER_USER", 2)
+SATURATION_REPLICAS = _env_int("BENCH_SATURATION_REPLICAS", 4)
+SATURATION_COLLAPSE = _env_float("BENCH_SATURATION_COLLAPSE", 0.9)
 # --cold-repeat N: N fully cold serves, each in its own subprocess (no
 # warm jit caches, no reused pools — the cold-start number operators
 # actually see on a fresh replica). The artifact is rewritten and
@@ -247,6 +265,44 @@ def _load_baseline() -> float:
 
 
 BASELINE_TOKS = _load_baseline()
+
+
+def _run_meta() -> dict:
+    """Provenance stamped into every BENCH_*.json artifact (the ``meta``
+    key): enough to tie a number to a commit, interpreter, and knob set
+    months later."""
+    import platform
+    import subprocess
+    from datetime import datetime, timezone
+
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=REPO, capture_output=True,
+            text=True, timeout=10).stdout.strip() or None
+    except Exception:  # noqa: BLE001 - provenance is best-effort
+        sha = None
+    return {
+        "schema": 1,
+        "git_sha": sha,
+        "timestamp_utc": datetime.now(timezone.utc).isoformat(
+            timespec="seconds"),
+        "python": platform.python_version(),
+        "platform": platform.platform(),
+        # Only truthful when jax actually loaded: the hermetic branches
+        # (QoS/chaos/fleet/saturation) never import it.
+        "jax": getattr(sys.modules.get("jax"), "__version__", None),
+        "bench_config": CONFIG_KEY,
+        "env": {k: v for k, v in sorted(os.environ.items())
+                if k.startswith("BENCH_")},
+    }
+
+
+def _write_artifact(path: str, result: dict) -> None:
+    """Write a BENCH_*.json artifact with the run-metadata stamp."""
+    result.setdefault("meta", _run_meta())
+    with open(os.path.join(REPO, path), "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
 
 
 async def _start_site(app):
@@ -699,9 +755,8 @@ def _run_scenario(factory, name: str, partial_out=None, partials=None):
         result = asyncio.run(factory())
     if partials is not None and partial_out is not None:
         partials[name] = result
-        with open(os.path.join(REPO, partial_out), "w") as f:
-            json.dump({"partial": True, "scenarios": partials}, f, indent=2)
-            f.write("\n")
+        _write_artifact(partial_out,
+                        {"partial": True, "scenarios": partials})
     return result
 
 
@@ -722,9 +777,7 @@ def _qos_main() -> None:
             interactive_requests=QOS_INTERACTIVE_REQS,
             ttft_s=QOS_TTFT, prefill_chunks=QOS_PREFILL_CHUNKS))
     result["backend"] = "fake"
-    with open(os.path.join(REPO, QOS_OUT), "w") as f:
-        json.dump(result, f, indent=2)
-        f.write("\n")
+    _write_artifact(QOS_OUT, result)
     print(json.dumps(result))
 
 
@@ -739,9 +792,7 @@ def _chaos_main() -> None:
         ttft_deadline_s=CHAOS_TTFT_DEADLINE,
         include_kill9=bool(CHAOS_KILL9)))
     result["backend"] = "fake"
-    with open(os.path.join(REPO, CHAOS_OUT), "w") as f:
-        json.dump(result, f, indent=2)
-        f.write("\n")
+    _write_artifact(CHAOS_OUT, result)
     print(json.dumps(result))
 
 
@@ -754,9 +805,7 @@ def _fleet_main() -> None:
         users=FLEET_USERS, rounds=FLEET_ROUNDS,
         concurrency=FLEET_CONCURRENCY, engine_ttft=FLEET_TTFT))
     result["backend"] = "fake"
-    with open(os.path.join(REPO, FLEET_OUT), "w") as f:
-        json.dump(result, f, indent=2)
-        f.write("\n")
+    _write_artifact(FLEET_OUT, result)
     print(json.dumps(result))
 
 
@@ -770,10 +819,30 @@ def _structured_main() -> None:
         n_requests=STRUCTURED_REQS, max_tokens=STRUCTURED_MAX_TOKENS,
         repeats=STRUCTURED_REPEATS)
     result["backend"] = "fake+cpu-engine"
-    with open(os.path.join(REPO, STRUCTURED_OUT), "w") as f:
-        json.dump(result, f, indent=2)
-        f.write("\n")
+    _write_artifact(STRUCTURED_OUT, result)
     print(json.dumps(result))
+
+
+def _saturation_main() -> None:
+    """BENCH_SATURATION=1: the router saturation harness. Fully hermetic
+    (fake engines), so this branch never imports jax or touches a
+    device. Per-request router INFO logging is squelched — the top rung
+    alone is 20k+ requests."""
+    import logging
+
+    from production_stack_tpu.testing.saturation import run_saturation
+
+    logging.getLogger(
+        "production_stack_tpu.router.request_service"
+    ).setLevel(logging.WARNING)
+    steps = tuple(int(s) for s in SATURATION_STEPS.split(",") if s.strip())
+    result = asyncio.run(run_saturation(
+        steps=steps, requests_per_user=SATURATION_REQS_PER_USER,
+        replicas=SATURATION_REPLICAS,
+        collapse_threshold=SATURATION_COLLAPSE))
+    result["backend"] = "fake"
+    _write_artifact(SATURATION_OUT, result)
+    print(json.dumps({k: v for k, v in result.items() if k != "rungs"}))
 
 
 def _cold_repeat_main(n: int, cpu: bool) -> None:
@@ -813,6 +882,7 @@ def _cold_repeat_main(n: int, cpu: bool) -> None:
         values = [it["result"]["value"] for it in iters
                   if it["result"] and it["result"].get("value") is not None]
         summary = {
+            "meta": _run_meta(),
             "metric": "cold_serve_repeat",
             "unit": (iters[0]["result"] or {}).get("unit"),
             "value": (statistics.median(values) if values else None),
@@ -857,6 +927,9 @@ def main() -> None:
         return
     if STRUCTURED:
         _structured_main()
+        return
+    if SATURATION:
+        _saturation_main()
         return
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -916,9 +989,7 @@ def main() -> None:
             "spec_off": off,
             "spec_on": on,
         }
-        with open(os.path.join(REPO, SPEC_OUT), "w") as f:
-            json.dump(result, f, indent=2)
-            f.write("\n")
+        _write_artifact(SPEC_OUT, result)
         print(json.dumps(result))
         return
     if KV_QUANT:
@@ -958,9 +1029,7 @@ def main() -> None:
             "kv_bf16": bf16,
             "kv_int8": int8,
         }
-        with open(os.path.join(REPO, KV_QUANT_OUT), "w") as f:
-            json.dump(result, f, indent=2)
-            f.write("\n")
+        _write_artifact(KV_QUANT_OUT, result)
         print(json.dumps(result))
         return
     # Init OOM from residual runtime HBM (llama8b near the ceiling,

@@ -1,0 +1,175 @@
+"""Generate tpu-stack-alerts.yaml (PrometheusRule).
+
+Alert rules as code, same contract as ``gen_dashboard.py``: run
+``python gen_alerts.py`` from this directory to regenerate, and the
+committed YAML must match ``build_alerts()`` exactly (drift check in
+tests/test_observability.py). Every metric referenced by an ``expr``
+must be documented in observability/README.md
+(scripts/check_alert_rules.py is the enforcement).
+
+The goodput alerts follow the SRE-workbook multi-window multi-burn-rate
+pattern over ``vllm_router:request_outcomes_total`` (the router's SLO
+outcome classifier, --slo-config): a page-worthy fast burn must be hot
+in BOTH a 5m and a 1h window (14.4x budget burn: a 99.9% objective's
+30-day budget gone in ~2 days), and a ticket-worthy slow burn in BOTH a
+6h and a 3d window (1x: budget exactly exhausted by month end). The
+two-window AND keeps a brief spike from paging and a long simmer from
+hiding. ``client_abort`` outcomes are excluded from both sides of the
+ratio: the client hanging up is not the service missing its SLO.
+"""
+
+import os
+
+import yaml
+
+# Availability objective the burn rates are computed against. Matches
+# the _DEFAULT_OBJECTIVES availability in production_stack_tpu/router/
+# slo.py; deployments with a different --slo-config objective should
+# regenerate with SLO_AVAILABILITY overridden.
+SLO_AVAILABILITY = 0.999
+ERROR_BUDGET = round(1.0 - SLO_AVAILABILITY, 6)
+
+_BAD = 'vllm_router:request_outcomes_total{outcome!~"ok|client_abort"}'
+_ALL = 'vllm_router:request_outcomes_total{outcome!="client_abort"}'
+
+
+def _burn(window: str) -> str:
+    """Error-budget burn ratio (bad / all, client aborts excluded)."""
+    return (f"(sum(rate({_BAD}[{window}])) "
+            f"/ sum(rate({_ALL}[{window}])))")
+
+
+def rule(alert, expr, for_, severity, summary, description):
+    return {
+        "alert": alert,
+        "expr": expr,
+        "for": for_,
+        "labels": {"severity": severity},
+        "annotations": {"summary": summary, "description": description},
+    }
+
+
+def build_alerts():
+    """Deterministic PrometheusRule dict."""
+    fast = 14.4 * ERROR_BUDGET
+    slow = 1.0 * ERROR_BUDGET
+    groups = [
+        {
+            "name": "tpu-stack-goodput",
+            "rules": [
+                rule(
+                    "TPUStackGoodputFastBurn",
+                    f"{_burn('5m')} > {fast:g} and {_burn('1h')} > {fast:g}",
+                    "2m", "critical",
+                    "Goodput error budget burning 14.4x too fast",
+                    "Requests are finishing outside SLO (slow/shed/"
+                    "failed) fast enough to exhaust a 30-day "
+                    f"{SLO_AVAILABILITY:.1%} budget in ~2 days; hot in "
+                    "both the 5m and 1h windows, so this is sustained, "
+                    "not a blip. See the SLO & Goodput dashboard row "
+                    "and GET /debug/events for what tripped."),
+                rule(
+                    "TPUStackGoodputSlowBurn",
+                    f"{_burn('6h')} > {slow:g} and {_burn('3d')} > {slow:g}",
+                    "1h", "warning",
+                    "Goodput error budget on pace to exhaust this month",
+                    "The bad-outcome ratio has exceeded the "
+                    f"{SLO_AVAILABILITY:.1%} objective's budget across "
+                    "both 6h and 3d windows — a slow leak (persistent "
+                    "tail latency, a flaky replica) that will spend the "
+                    "whole monthly budget if left alone."),
+            ],
+        },
+        {
+            "name": "tpu-stack-canary",
+            "rules": [
+                rule(
+                    "TPUStackCanaryFailing",
+                    "sum by(server, reason) "
+                    "(rate(vllm_router:canary_failures_total[5m])) > 0",
+                    "5m", "critical",
+                    "Canary probes failing against {{ $labels.server }}",
+                    "The router's synthetic canary (--canary-interval) "
+                    "has failed continuously for 5m against this "
+                    "replica ({{ $labels.reason }}): it is broken for "
+                    "real traffic too, or about to be, even if "
+                    "health checks still pass."),
+                rule(
+                    "TPUStackCanarySilent",
+                    "sum(rate(vllm_router:canary_probes_total[15m])) "
+                    "== 0",
+                    "15m", "warning",
+                    "Canary prober has stopped probing",
+                    "No canary probes dispatched in 15m on a router "
+                    "configured with --canary-interval: the prober "
+                    "task died or every replica is excluded — either "
+                    "way the fleet is flying without its smoke "
+                    "detector."),
+            ],
+        },
+        {
+            "name": "tpu-stack-control-plane",
+            "rules": [
+                rule(
+                    "TPUStackBreakerOpen",
+                    "max by(server) (vllm_router:circuit_state) == 1",
+                    "3m", "warning",
+                    "Circuit breaker open for {{ $labels.server }}",
+                    "The router has excluded this replica from routing "
+                    "after consecutive failures (--fault-tolerance). "
+                    "Brief trips self-heal through half-open probes; "
+                    "3m continuously open means the replica is not "
+                    "recovering on its own."),
+                rule(
+                    "TPUStackLeaseSweepStorm",
+                    "sum(rate(vllm_router:kv_claims_swept_total"
+                    '{reason="expired"}[5m])) > 1',
+                    "5m", "warning",
+                    "KV claim leases expiring fleet-wide",
+                    "Sustained lease-expiry sweeps mean replicas are "
+                    "dying or partitioned faster than they re-register "
+                    "(kill -9 loops, node pressure): routing state is "
+                    "churning and prefix-cache hits are being thrown "
+                    "away. GET /debug/events?kind=lease_sweep shows "
+                    "which endpoints."),
+                rule(
+                    "TPUStackBandwidthCollapse",
+                    "avg by(instance) "
+                    "(tpu:model_bandwidth_utilization) < 0.2 "
+                    "and sum by(instance) "
+                    "(vllm_router:num_requests_running) > 0",
+                    "10m", "warning",
+                    "HBM bandwidth utilization collapsed under load",
+                    "An engine with running requests is sustaining "
+                    "<20% of its HBM roofline: decode steps are "
+                    "stalled on something other than memory "
+                    "(recompilation churn, host preprocessing, "
+                    "interconnect). See the Performance Introspection "
+                    "row and GET /debug/steps."),
+            ],
+        },
+    ]
+    return {
+        "apiVersion": "monitoring.coreos.com/v1",
+        "kind": "PrometheusRule",
+        "metadata": {
+            "name": "tpu-stack-alerts",
+            "labels": {"release": "kube-prom-stack"},
+        },
+        "spec": {"groups": groups},
+    }
+
+
+def main():
+    alerts = build_alerts()
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "tpu-stack-alerts.yaml")
+    with open(out, "w") as f:
+        yaml.safe_dump(alerts, f, sort_keys=False, default_flow_style=False,
+                       width=72, allow_unicode=True)
+    n = sum(len(g["rules"]) for g in alerts["spec"]["groups"])
+    print(f"wrote {out}: {n} rules in {len(alerts['spec']['groups'])} groups")
+
+
+if __name__ == "__main__":
+    main()

@@ -107,6 +107,67 @@ def build_dashboard():
         desc="Histogram of end-to-end request latency observed at the router"))
     y += 6
 
+    # ---- Row 1b: SLO & Goodput (outcome classifier, --slo-config) ------- #
+    panels.append(row("SLO & Goodput", y)); y += 1
+    panels.append(panel(
+        "stat", "Goodput (5m)",
+        [target('vllm_router:goodput_ratio{window="5m"}', instant=True)],
+        grid(7, 4, 0, y), unit="percentunit",
+        desc="Fraction of classified requests finishing ok over the "
+             "trailing 5 minutes (shed/failed/slow/client_abort are "
+             "not goodput); absent until the router has traffic and "
+             "--slo-config is set"))
+    panels.append(panel(
+        "timeseries", "Goodput ratio by window",
+        [target("vllm_router:goodput_ratio", legend="{{window}}")],
+        grid(7, 8, 4, y), unit="percentunit",
+        desc="Windowed good/total ratio from the router's SLO outcome "
+             "classifier; the alert rules page on the equivalent "
+             "burn-rate expressions over request_outcomes_total"))
+    panels.append(panel(
+        "timeseries", "Request outcomes (rate)",
+        [target("sum by(outcome) "
+                "(rate(vllm_router:request_outcomes_total[5m]))",
+                legend="{{outcome}}")],
+        grid(7, 12, 12, y), unit="reqps",
+        desc="Every terminated request classified exactly once: ok, "
+             "slow (finished but over the tenant/model TTFT or "
+             "inter-token objective), shed (QoS 429/503), failed "
+             "(upstream/router error), client_abort (caller hung up)"))
+    y += 7
+    panels.append(panel(
+        "timeseries", "Canary TTFT p99",
+        [target("histogram_quantile(0.99, sum(rate("
+                "vllm_router:canary_ttft_seconds_bucket[5m])) "
+                "by (le))", legend="p99")],
+        grid(7, 8, 0, y), unit="s",
+        desc="Time to first token of the router's synthetic probes "
+             "(--canary-interval): a per-replica latency floor with "
+             "constant tiny load, so drift here is the serving path "
+             "slowing down, not the workload changing"))
+    panels.append(panel(
+        "timeseries", "Canary probes & failures (rate)",
+        [target("sum(rate(vllm_router:canary_probes_total[5m]))",
+                legend="probes"),
+         target("sum by(reason) "
+                "(rate(vllm_router:canary_failures_total[5m]))",
+                legend="failures/{{reason}}")],
+        grid(7, 8, 8, y),
+        desc="Probe dispatch rate against every healthy replica and "
+             "failures by reason (status_*, timeout, connect, empty); "
+             "failures also land in the fleet event journal "
+             "(GET /debug/events?kind=canary_failure)"))
+    panels.append(panel(
+        "timeseries", "Outcomes by tenant (bad only, rate)",
+        [target("sum by(tenant, outcome) (rate("
+                'vllm_router:request_outcomes_total{outcome!="ok"}'
+                "[5m]))", legend="{{tenant}}/{{outcome}}")],
+        grid(7, 8, 16, y), unit="reqps",
+        desc="Which tenant is eating the error budget, and how — "
+             "sheds concentrate on over-quota tenants, slows on "
+             "under-provisioned models"))
+    y += 7
+
     # ---- Row 2: QoS Information (ref panels 4-8) ------------------------ #
     panels.append(row("QoS Information", y)); y += 1
     panels.append(panel(
@@ -682,9 +743,25 @@ def build_dashboard():
         "title": "TPU Production Stack",
         "tags": ["tpu", "production-stack"],
         "schemaVersion": 39,
-        "version": 4,
+        "version": 5,
         "refresh": "10s",
         "time": {"from": "now-30m", "to": "now"},
+        # Fleet event journal overlay: GET /debug/events?format=grafana
+        # on the router emits this annotation shape (time/tags/text);
+        # point a JSON-API datasource at it, or paste the export into
+        # the built-in annotation list. Tags match the journal's event
+        # kinds (breaker_open, failover, lease_sweep, qos_shed, ...).
+        "annotations": {"list": [{
+            "name": "Fleet events",
+            "datasource": {"type": "datasource", "uid": "-- Grafana --"},
+            "enable": True,
+            "hide": False,
+            "iconColor": "red",
+            "target": {"limit": 100, "matchAny": True,
+                       "tags": ["breaker_open", "failover", "lease_sweep",
+                                "retry_exhausted", "canary_failure"],
+                       "type": "tags"},
+        }]},
         "templating": {"list": [{
             "name": "datasource", "type": "datasource",
             "query": "prometheus",

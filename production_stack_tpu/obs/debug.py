@@ -7,12 +7,17 @@
 - ``GET /debug/steps``                  -- engine-only: newest-first step
   flight-recorder records; filters: ``?limit=50`` and
   ``?kind=decode_burst``.
+- ``GET /debug/events``                 -- router-only (privileged): the
+  fleet event journal, newest-first; filters ``?limit=50`` and
+  ``?kind=breaker_open``; ``?format=grafana`` returns the Grafana
+  annotations JSON shape for dashboard overlay.
 """
 
 from __future__ import annotations
 
 from aiohttp import web
 
+from production_stack_tpu.obs.events import EventJournal
 from production_stack_tpu.obs.steps import STEP_KINDS, StepRecorder
 from production_stack_tpu.obs.trace import TraceRecorder
 
@@ -74,3 +79,26 @@ def add_step_debug_routes(router, recorder: StepRecorder) -> None:
         return web.json_response(out)
 
     router.add_get("/debug/steps", list_steps)
+
+
+def add_event_debug_routes(router, journal: EventJournal) -> None:
+    """Attach ``GET /debug/events`` (fleet event journal)."""
+
+    async def list_events(request: web.Request) -> web.Response:
+        try:
+            limit = int(request.query.get("limit", 100) or 100)
+        except ValueError:
+            return web.json_response(
+                {"error": "limit must be an integer"}, status=400)
+        if limit < 1:
+            return web.json_response(
+                {"error": "limit must be >= 1"}, status=400)
+        kind = request.query.get("kind") or None
+        if request.query.get("format") == "grafana":
+            return web.json_response(
+                journal.to_grafana(limit=limit, kind=kind))
+        out = journal.summary()
+        out["events"] = journal.snapshot(limit=limit, kind=kind)
+        return web.json_response(out)
+
+    router.add_get("/debug/events", list_events)

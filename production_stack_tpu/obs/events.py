@@ -1,0 +1,128 @@
+"""Fleet event journal: a bounded ring of control-plane events.
+
+Stdlib-only like ``trace.py``/``steps.py``. The control plane already
+*logs* its interesting transitions — breaker trips, failovers, lease
+sweeps, anti-entropy resyncs, drains, scale-in, OOM pool-shrink rungs,
+QoS sheds, canary failures — but log lines are not queryable and cannot
+be overlaid on a dashboard. :class:`EventJournal` records each of those
+transitions as a small structured record stamped with both monotonic and
+wall-clock time, the endpoint it concerns, and the active trace id when
+one exists; ``GET /debug/events`` serves the ring newest-first and can
+render it directly in the Grafana annotations JSON shape so fleet events
+overlay every dashboard row.
+
+Recording is a dict append under a lock — cheap enough that the journal
+is always constructed (like the router's TraceRecorder) and callers never
+need a ``if journal is not None`` guard on the hot control-plane paths.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+#: Event kinds the control plane emits today. The journal accepts any
+#: string (new subsystems should not need a code change here to record),
+#: but the known set is exported for tests and for the /debug/events
+#: ``?kind=`` filter error message.
+EVENT_KINDS = (
+    "breaker_open",
+    "breaker_reset",
+    "failover",
+    "retry_exhausted",
+    "lease_sweep",
+    "kv_resync",
+    "drain",
+    "scale_in",
+    "pool_shrink",
+    "qos_shed",
+    "canary_failure",
+)
+
+
+class EventJournal:
+    """Bounded, thread-safe ring buffer of control-plane events."""
+
+    def __init__(self, service: str = "", capacity: int = 1024):
+        self.service = service
+        self.capacity = max(1, int(capacity))
+        self._events: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self.recorded_total = 0
+        #: per-kind counts survive ring eviction (totals, not a window).
+        self._kind_counts: Dict[str, int] = {}
+
+    def record(
+        self,
+        kind: str,
+        endpoint: Optional[str] = None,
+        trace_id: Optional[str] = None,
+        **attributes: Any,
+    ) -> dict:
+        """Append one event. Returns the stored record (for tests)."""
+        event = {
+            "kind": kind,
+            "time_unix": time.time(),
+            "time_monotonic": time.monotonic(),
+            "endpoint": endpoint,
+            "trace_id": trace_id,
+            "attributes": {k: v for k, v in attributes.items()
+                           if v is not None},
+        }
+        with self._lock:
+            self._events.append(event)
+            self.recorded_total += 1
+            self._kind_counts[kind] = self._kind_counts.get(kind, 0) + 1
+        return event
+
+    def snapshot(
+        self,
+        limit: int = 100,
+        kind: Optional[str] = None,
+    ) -> List[dict]:
+        """Newest-first copies of up to ``limit`` events."""
+        with self._lock:
+            events = list(self._events)
+        out: List[dict] = []
+        for ev in reversed(events):
+            if kind is not None and ev["kind"] != kind:
+                continue
+            out.append(dict(ev))
+            if len(out) >= limit:
+                break
+        return out
+
+    def kind_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._kind_counts)
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "service": self.service,
+                "capacity": self.capacity,
+                "recorded_total": self.recorded_total,
+                "buffered": len(self._events),
+                "kind_counts": dict(self._kind_counts),
+            }
+
+    def to_grafana(self, limit: int = 100, kind: Optional[str] = None) -> List[dict]:
+        """Events in the Grafana annotations JSON shape (one annotation
+        per event: epoch-millis ``time``, ``tags``, markdown ``text``), so
+        a dashboard annotation query can overlay fleet events directly."""
+        out = []
+        for ev in self.snapshot(limit=limit, kind=kind):
+            tags = [ev["kind"]]
+            if ev.get("endpoint"):
+                tags.append(ev["endpoint"])
+            detail = " ".join(
+                f"{k}={v}" for k, v in sorted(ev["attributes"].items()))
+            text = ev["kind"] if not detail else f"{ev['kind']}: {detail}"
+            out.append({
+                "time": int(ev["time_unix"] * 1000),
+                "tags": tags,
+                "text": text,
+            })
+        return out

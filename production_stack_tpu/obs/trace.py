@@ -89,7 +89,7 @@ class Span:
     """One timed stage. ``end`` is None while open; ``finish()`` closes it."""
 
     __slots__ = ("name", "span_id", "parent_span_id", "start", "end",
-                 "attributes")
+                 "attributes", "events")
 
     def __init__(
         self,
@@ -105,6 +105,10 @@ class Span:
         self.start = time.time() if start is None else start
         self.end: Optional[float] = None
         self.attributes: Dict[str, Any] = dict(attributes or {})
+        # Point-in-time span events (OTel semantics): retry, failover...
+        # Serialized only when non-empty, so eventless traces keep their
+        # historical JSON shape byte-for-byte.
+        self.events: List[dict] = []
 
     @property
     def duration_s(self) -> float:
@@ -118,8 +122,18 @@ class Span:
             self.attributes.update(attributes)
         return self
 
+    def add_event(self, name: str, timestamp: Optional[float] = None,
+                  **attributes) -> dict:
+        event = {
+            "name": name,
+            "time_unix": time.time() if timestamp is None else timestamp,
+            "attributes": dict(attributes),
+        }
+        self.events.append(event)
+        return event
+
     def to_dict(self) -> dict:
-        return {
+        out = {
             "name": self.name,
             "span_id": self.span_id,
             "parent_span_id": self.parent_span_id,
@@ -128,6 +142,9 @@ class Span:
             "duration_s": round(self.duration_s, 6),
             "attributes": self.attributes,
         }
+        if self.events:
+            out["events"] = [dict(e) for e in self.events]
+        return out
 
 
 class RequestTrace:
@@ -240,6 +257,13 @@ class RequestTrace:
                 "attributes": [_otlp_attr(k, v)
                                for k, v in s.attributes.items()],
             }
+            if s.events:
+                entry["events"] = [{
+                    "timeUnixNano": str(int(e["time_unix"] * 1e9)),
+                    "name": e["name"],
+                    "attributes": [_otlp_attr(k, v)
+                                   for k, v in e["attributes"].items()],
+                } for e in s.events]
             if s.parent_span_id:
                 entry["parentSpanId"] = s.parent_span_id
             spans.append(entry)

@@ -72,6 +72,9 @@ class RouterState:
     # FaultTolerance bundle (circuit breaker + retry/deadline config)
     # when --fault-tolerance is set, else None (single-attempt path).
     fault_tolerance: Any = None
+    slo: Any = None  # SLOEngine when --slo-config is set, else None
+    canary: Any = None  # CanaryProber when --canary-interval > 0
+    events: Any = None  # EventJournal (always on; bounded ring is cheap)
     extra: dict = field(default_factory=dict)
 
 
@@ -161,6 +164,8 @@ async def metrics_handler(request: web.Request) -> web.Response:
             state.trace_recorder.sampled_out_total)
         metrics_mod.slow_trace_logs_suppressed.set(
             state.trace_recorder.slow_logs_suppressed_total)
+    if state.slo is not None:
+        state.slo.refresh_gauges()
     return web.Response(
         body=metrics_mod.render_metrics(),
         content_type="text/plain",
@@ -341,6 +346,10 @@ async def kv_resync_state(request: web.Request) -> web.Response:
     swept = result.get("swept", 0)
     if swept:
         metrics_mod.kv_claims_swept.labels(reason="resync").inc(swept)
+        if state.events is not None:
+            state.events.record("kv_resync",
+                                instance_id=body.get("instance_id"),
+                                swept=swept)
     return web.json_response(result)
 
 
@@ -418,6 +427,7 @@ async def lease_sweep_once(state) -> list:
     Module-level so tests and the chaos harness can drive it with a fast
     clock instead of waiting out the background task."""
     expired = await state.kv_controller.expire_stale_leases()
+    events = getattr(state, "events", None)
     for rec in expired:
         url = rec.get("url")
         mark = getattr(state.service_discovery, "mark_lease_expired", None)
@@ -427,6 +437,10 @@ async def lease_sweep_once(state) -> list:
             metrics_mod.kv_claims_swept.labels(reason="expired").inc(
                 rec["swept"]
             )
+        if events is not None:
+            events.record("lease_sweep", endpoint=url,
+                          instance_id=rec.get("instance_id"),
+                          swept=rec.get("swept", 0))
     snap = await state.kv_controller.instances_snapshot()
     counts: dict = {}
     for rec in snap:
@@ -477,6 +491,9 @@ async def autoscale_scale_in(request: web.Request) -> web.Response:
         return web.json_response(
             {"error": "no replica available to scale in"}, status=409)
     result = await state.autoscaler.scale_in(url)
+    if state.events is not None:
+        state.events.record("scale_in", endpoint=url,
+                            drained=result.get("drained"))
     return web.json_response(result)
 
 
@@ -581,11 +598,37 @@ def build_app(args) -> web.Application:
         from production_stack_tpu.obs.debug import add_debug_routes
 
         add_debug_routes(app.router, state.trace_recorder)
+    # Fleet event journal (privileged: /debug/events is in
+    # _PRIVILEGED_EXACT, so the auth middleware gates it when a
+    # deployment key is configured).
+    if state.events is not None:
+        from production_stack_tpu.obs.debug import add_event_debug_routes
+
+        add_event_debug_routes(app.router, state.events)
 
     async def on_startup(app: web.Application):
         st = app["state"]
         if st.batch_processor is not None:
             st.batch_processor.start()
+        # Canary prober: tiny synthetic completions straight at each
+        # healthy replica (--canary-interval > 0; off by default).
+        canary_interval = float(getattr(args, "canary_interval", 0.0) or 0.0)
+        if canary_interval > 0:
+            from production_stack_tpu.router.slo import CanaryProber
+
+            st.canary = CanaryProber(
+                st, canary_interval,
+                prompt_tokens=getattr(args, "canary_prompt_tokens", 8),
+                max_tokens=getattr(args, "canary_max_tokens", 4),
+                events=st.events,
+            )
+            app["_canary"] = asyncio.get_running_loop().create_task(
+                st.canary.run()
+            )
+            logger.info(
+                "Canary prober enabled: interval=%.1fs prompt_tokens=%d "
+                "max_tokens=%d", canary_interval,
+                st.canary.prompt_tokens, st.canary.max_tokens)
         # Lease sweeper: expire instances that missed N heartbeats and
         # mirror them into service discovery so routing + EPP stop
         # picking corpses. Runs at the heartbeat interval (0 disables).
@@ -609,13 +652,14 @@ def build_app(args) -> web.Application:
     async def on_cleanup(app: web.Application):
         from production_stack_tpu.router.httpclient import AiohttpClientWrapper
 
-        sweeper = app.get("_lease_sweeper")
-        if sweeper is not None:
-            sweeper.cancel()
-            try:
-                await sweeper
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
+        for task_key in ("_lease_sweeper", "_canary"):
+            task = app.get(task_key)
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
         st = app["state"]
         for closable in (
             st.service_discovery, st.engine_stats_scraper,
@@ -685,6 +729,24 @@ def initialize_all(args) -> RouterState:
         slow_log_interval_s=getattr(
             args, "slow_trace_log_interval_s", 0.0),
     )
+
+    # Fleet event journal (always on, like the trace recorder: a bounded
+    # ring of small dicts; served at the privileged /debug/events).
+    from production_stack_tpu.obs.events import EventJournal
+
+    state.events = EventJournal("tpu-stack-router")
+
+    # SLO engine: outcome classifier + goodput window, only when an
+    # objectives file is configured — without one state.slo is None and
+    # the request path carries no classification code at all.
+    if getattr(args, "slo_config", None):
+        from production_stack_tpu.router.slo import SLOEngine
+
+        state.slo = SLOEngine.from_file(args.slo_config)
+        logger.info(
+            "SLO engine enabled: default=%s tenants=%s models=%s",
+            state.slo.default, sorted(state.slo.tenants),
+            sorted(state.slo.models))
 
     # Service discovery.
     if args.service_discovery == "static":
@@ -845,8 +907,11 @@ def initialize_all(args) -> RouterState:
         # must stop being a pull source / kvaware routing target right
         # away — re-registration on recovery repopulates it.
         kv_controller = state.kv_controller
+        events = state.events
 
         def _on_breaker_open(url: str) -> None:
+            if events is not None:
+                events.record("breaker_open", endpoint=url)
             try:
                 loop = asyncio.get_running_loop()
             except RuntimeError:  # tripped off-loop (tests, threads)

@@ -207,6 +207,39 @@ kv_pull_rejected = Counter(
     "(target recomputes instead)",
     _L, registry=REGISTRY)
 
+# --- SLO engine (production_stack_tpu/router/slo.py) ---------------------
+# All labeled: series appear only once the --slo-config classifier or the
+# canary prober (--canary-interval) actually observes something, so a
+# flag-off deployment's /metrics surface is byte-identical.
+request_outcomes = Counter(
+    "vllm_router:request_outcomes_total",
+    "Requests by terminal outcome against the SLO objectives: ok, slow "
+    "(violated a latency objective), shed (admission control), failed "
+    "(upstream error), client_abort (client went away first)",
+    ["outcome", "tenant", "model"], registry=REGISTRY)
+goodput_ratio = Gauge(
+    "vllm_router:goodput_ratio",
+    "Share of requests classified ok over the trailing window "
+    "(scrape-time refresh from the SLO engine's outcome ring)",
+    ["window"], registry=REGISTRY)
+canary_probes = Counter(
+    "vllm_router:canary_probes_total",
+    "Synthetic canary completions issued per replica "
+    "(--canary-interval; probes bypass QoS, fleet pulls, and the "
+    "prefix-cache trie)",
+    _L, registry=REGISTRY)
+canary_failures = Counter(
+    "vllm_router:canary_failures_total",
+    "Canary probes that failed, by reason (connect, timeout, empty, "
+    "status_NNN)",
+    ["server", "reason"], registry=REGISTRY)
+canary_ttft = Histogram(
+    "vllm_router:canary_ttft_seconds",
+    "Time to first streamed byte of a canary probe (s)", _L,
+    buckets=(0.001, 0.005, 0.01, 0.02, 0.04, 0.06, 0.08, 0.1, 0.25, 0.5,
+             0.75, 1.0, 2.5, 5.0, 7.5, 10.0, 20.0, 40.0),
+    registry=REGISTRY)
+
 _PROCESS = psutil.Process()
 
 

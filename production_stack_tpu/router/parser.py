@@ -228,6 +228,25 @@ def build_parser() -> argparse.ArgumentParser:
                              "this many seconds (suppressed lines still "
                              "count as slow requests); 0 logs every slow "
                              "trace")
+    # SLO engine (production_stack_tpu/router/slo.py)
+    parser.add_argument("--slo-config", type=str, default=None,
+                        help="YAML objectives file (per-tenant/per-model "
+                             "TTFT, inter-token, and availability "
+                             "targets); enables the request outcome "
+                             "classifier behind vllm_router:request_"
+                             "outcomes_total and the goodput_ratio "
+                             "gauge. Unset = no classification, "
+                             "request path byte-identical")
+    parser.add_argument("--canary-interval", type=float, default=0.0,
+                        help="seconds between synthetic canary probes "
+                             "against each healthy replica (0 disables); "
+                             "probes bypass QoS, fleet pulls, and the "
+                             "prefix-cache trie")
+    parser.add_argument("--canary-prompt-tokens", type=int, default=8,
+                        help="approximate prompt length of a canary "
+                             "probe (words)")
+    parser.add_argument("--canary-max-tokens", type=int, default=4,
+                        help="max_tokens requested by a canary probe")
     return parser
 
 
@@ -299,6 +318,12 @@ def validate_args(args: argparse.Namespace) -> None:
         raise ValueError("--trace-sample-rate must be in [0, 1]")
     if getattr(args, "slow_trace_log_interval_s", 0.0) < 0.0:
         raise ValueError("--slow-trace-log-interval-s must be >= 0")
+    if getattr(args, "canary_interval", 0.0) < 0.0:
+        raise ValueError("--canary-interval must be >= 0 (0 disables)")
+    if getattr(args, "canary_prompt_tokens", 8) < 1:
+        raise ValueError("--canary-prompt-tokens must be >= 1")
+    if getattr(args, "canary_max_tokens", 4) < 1:
+        raise ValueError("--canary-max-tokens must be >= 1")
 
 
 def expand_static_models_config(config: dict) -> dict:

@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import json
 import random
+import sys
 import time
 import uuid
 from typing import AsyncGenerator, Optional, Tuple
@@ -278,6 +279,11 @@ async def route_general_request(
     # no tenants file configured state.qos is None and the path below is
     # untouched (today's behavior, byte-identical streams).
     qos = getattr(state, "qos", None)
+    # SLO outcome classifier (--slo-config): every request that reaches
+    # this point terminates as exactly one of ok/slow/shed/failed/
+    # client_abort. None when the flag is off — no classification code
+    # runs and the path below is byte-identical.
+    slo = getattr(state, "slo", None)
     tenant = priority = None
     qos_headers: dict = {}
     if qos is not None:
@@ -293,6 +299,8 @@ async def route_general_request(
         if not verdict.admitted:
             router_metrics.tenant_rejected.labels(
                 tenant=tenant.name, reason=verdict.reason).inc()
+            if slo is not None:
+                slo.observe("shed", tenant.name, request_json.get("model"))
             reject_headers = dict(qos_headers)
             reject_headers["Retry-After"] = str(int(verdict.retry_after) + 1)
             return web.json_response(
@@ -361,6 +369,9 @@ async def route_general_request(
         if trace is not None:
             root.finish(status=400, error="no_endpoints")
             recorder.record(trace)
+        if slo is not None:
+            slo.observe("failed", tenant.name if tenant else None,
+                        requested_model)
         return web.json_response(
             {"error": f"Model {requested_model} not found or all engines sleeping."},
             status=400,
@@ -378,6 +389,9 @@ async def route_general_request(
                 if trace is not None:
                     root.finish(status=503, error="all_circuits_open")
                     recorder.record(trace)
+                if slo is not None:
+                    slo.observe("failed", tenant.name if tenant else None,
+                                requested_model)
                 return web.json_response(
                     {"error": {
                         "message": "All replicas are failing "
@@ -407,6 +421,13 @@ async def route_general_request(
             if trace is not None:
                 root.finish(status=503, error="qos_shed")
                 recorder.record(trace)
+            if slo is not None:
+                slo.observe("shed", tenant.name, requested_model)
+            events = getattr(state, "events", None)
+            if events is not None:
+                events.record(
+                    "qos_shed", tenant=tenant.name,
+                    trace_id=trace.trace_id if trace else None)
             shed_headers = dict(qos_headers)
             shed_headers["Retry-After"] = str(max(1, int(e.retry_after)))
             return web.json_response(
@@ -424,6 +445,12 @@ async def route_general_request(
                 parent=root, tenant=tenant.name, priority=priority)
 
     full_response = bytearray()
+    # SLO bookkeeping (no-ops when --slo-config is off): terminal paths
+    # set slo_outcome; None at the outer finally means the handler
+    # unwound via an exception (client abort or a pre-stream failure).
+    slo_outcome: Optional[str] = None
+    slo_first_chunk = slo_last_chunk = 0.0
+    slo_chunks = 0
     try:
         engine_stats = state.engine_stats_scraper.get_engine_stats()
         request_stats = state.request_stats_monitor.get_request_stats()
@@ -490,6 +517,7 @@ async def route_general_request(
             headers["traceparent"] = format_traceparent(
                 trace.trace_id, upstream.span_id)
 
+        routed_url, attempt_no = server_url, 0
         if ft is not None:
             stream = _stream_with_failover(
                 state, ft, request_id, server_url,
@@ -505,6 +533,23 @@ async def route_general_request(
             try:
                 async for kind, payload in stream:
                     if kind == "attempt":
+                        # Retry/failover become span events on the
+                        # upstream span so a slow trace shows the
+                        # attempt timeline, not just the final URL.
+                        if upstream is not None:
+                            if attempt_no > 0:
+                                upstream.add_event(
+                                    "retry", url=payload,
+                                    attempt=attempt_no)
+                            if payload != routed_url:
+                                upstream.add_event("failover", url=payload)
+                        if payload != routed_url and \
+                                getattr(state, "events", None) is not None:
+                            state.events.record(
+                                "failover", endpoint=payload,
+                                from_url=routed_url,
+                                trace_id=trace.trace_id if trace else None)
+                        attempt_no += 1
                         server_url = payload
                         continue
                     if kind == "failed":
@@ -513,6 +558,12 @@ async def route_general_request(
                             request_id, payload)
                         if upstream is not None:
                             upstream.finish(error=str(payload))
+                        slo_outcome = "failed"
+                        if getattr(state, "events", None) is not None:
+                            state.events.record(
+                                "retry_exhausted", endpoint=server_url,
+                                error=str(payload),
+                                trace_id=trace.trace_id if trace else None)
                         return web.json_response(
                             {"error": {
                                 "message": f"All replicas failed: {payload}",
@@ -541,21 +592,56 @@ async def route_general_request(
                                 "router.first_chunk", upstream.start, time.time(),
                                 parent=upstream,
                             )
+                        if slo is not None:
+                            slo_last_chunk = time.time()
+                            if not slo_chunks:
+                                slo_first_chunk = slo_last_chunk
+                            slo_chunks += 1
                         full_response.extend(payload)
                         assert response is not None
                         await response.write(payload)
             except (aiohttp.ClientError, asyncio.TimeoutError) as e:
-                logger.error("Backend %s failed for %s: %s", server_url, request_id, e)
                 if upstream is not None:
                     upstream.finish(error=str(e))
+                # A reset means the *client's* transport closed under
+                # our write (aiohttp raises it as a ConnectionResetError
+                # subclass) — the engine did nothing wrong. Anything
+                # else is the upstream breaking: before any byte it's a
+                # clean 502, after bytes the raise tears the stream
+                # down.
+                if isinstance(e, ConnectionResetError):
+                    logger.info("Client went away mid-stream for %s: %s",
+                                request_id, e)
+                    slo_outcome = "client_abort"
+                else:
+                    logger.error("Backend %s failed for %s: %s",
+                                 server_url, request_id, e)
+                    slo_outcome = "failed"
                 if response is None:
                     return web.json_response(
                         {"error": f"Backend connection failed: {e}"}, status=502
                     )
                 raise
             if response is None:
+                slo_outcome = "failed"
                 return web.json_response({"error": "Empty backend response"}, status=502)
             await response.write_eof()
+            if slo is not None:
+                if response.status >= 400:
+                    slo_outcome = "failed"
+                else:
+                    # Client-perceived TTFT (router entry -> first byte
+                    # out) and a mean inter-chunk estimate stand in for
+                    # per-token timing the proxy cannot see.
+                    ttft_s = (slo_first_chunk - in_router_time
+                              if slo_first_chunk else None)
+                    inter_s = None
+                    if slo_chunks > 1:
+                        inter_s = ((slo_last_chunk - slo_first_chunk)
+                                   / (slo_chunks - 1))
+                    slo_outcome = slo.latency_outcome(
+                        tenant.name if tenant else None, requested_model,
+                        ttft_s=ttft_s, inter_token_s=inter_s)
 
             # Post-request hooks: semantic cache store + callbacks (reference :129-137).
             if state.semantic_cache is not None and endpoint.endswith("chat/completions"):
@@ -581,6 +667,21 @@ async def route_general_request(
                 root.finish(status=status, overhead_s=round(overhead, 6))
                 recorder.record(trace)
     finally:
+        if slo is not None:
+            outcome = slo_outcome
+            if outcome is None:
+                # No terminal path classified this request: the handler
+                # is unwinding via an exception. A cancelled task or a
+                # reset transport is the client going away; anything
+                # else is our failure.
+                exc = sys.exc_info()[1]
+                if isinstance(exc, (asyncio.CancelledError,
+                                    ConnectionResetError)):
+                    outcome = "client_abort"
+                else:
+                    outcome = "failed"
+            slo.observe(outcome, tenant.name if tenant else None,
+                        requested_model)
         if lease is not None:
             lease.release()
         if qos is not None and tenant is not None:

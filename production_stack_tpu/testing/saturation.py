@@ -1,0 +1,280 @@
+"""Hermetic router saturation harness: step offered load until goodput
+collapses.
+
+No TPU and no model: four :class:`FakeEngine` replicas answer short
+streamed completions through the real router running with a real
+``--slo-config``, while rungs of closed-loop users (each user issues its
+requests back-to-back, so offered load is exactly the rung's user
+count) climb from hundreds to 10k+ concurrent. The engines themselves
+are nearly free, so what saturates is the thing this harness is about:
+the router process — its event loop, proxy streaming, QoS/SLO
+accounting, and socket handling.
+
+Per rung the harness reports throughput (RPS), client-side latency
+percentiles, the router's own SLO outcome deltas (the ``ok`` / ``slow``
+/ ``shed`` / ``failed`` / ``client_abort`` classifier under test), the
+goodput ratio, and ``router_overhead_p99`` from the in-process trace
+ring. The **knee** is the first rung whose goodput falls below the
+collapse threshold; the **RPS ceiling** is the best throughput seen at
+or before it. The per-rung outcome deltas double as the classifier's
+reconciliation proof: every request that obtained an HTTP response got
+exactly one outcome. Past the process fd budget (everything — client,
+router, and engine sockets — shares one rlimit, four fds per in-flight
+request) the kernel sheds connections before the router can accept
+them; those are reported per rung as ``unreached`` and are the only
+requests allowed to go unclassified, so reconciliation tightens to
+``responses <= classified <= offered`` on shedding rungs and stays
+exact everywhere else.
+
+Used by ``bench.py`` (BENCH_SATURATION=1, artifact
+``BENCH_SATURATION_r12.json``) and, at toy scale, by
+``tests/test_slo.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import tempfile
+import time
+from typing import List, Optional
+
+import yaml
+
+from production_stack_tpu.testing.qos_ab import (
+    _p99,
+    _reset_router_singletons,
+)
+
+MODEL = "sat-model"
+
+#: Default rung ladder (concurrent closed-loop users). The top rung is
+#: the 10k+ mark the harness exists for; earlier rungs locate the knee.
+DEFAULT_STEPS = (100, 500, 1000, 2500, 5000, 10000)
+
+#: Objectives served to the router for the run: under saturation the
+#: queueing delay blows through the TTFT bound long before connections
+#: fail, so goodput collapse is observable while requests still finish.
+SLO_CONFIG = {
+    "default": {
+        "ttft_p99_s": 1.0,
+        "inter_token_p99_s": 0.5,
+        "availability": 0.999,
+    },
+}
+
+
+async def _start(app, shutdown_timeout: float = 0.5):
+    from aiohttp import web
+
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0,
+                       shutdown_timeout=shutdown_timeout, backlog=4096)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+async def _one_request(session, router_url: str,
+                       client_timeout_s: float):
+    """One streamed completion.
+
+    Returns ``("done", latency)`` on a complete stream, ``("response",
+    None)`` when the router answered with anything else (an error
+    status, or a stream that broke after the status line — either way
+    the router saw the request and must classify it), and ``("none",
+    None)`` when the connection died before any HTTP status arrived —
+    the request may never have reached the router at all (fd-exhaustion
+    shedding at the socket layer)."""
+    import aiohttp
+
+    t0 = time.perf_counter()
+    got_response = False
+    try:
+        async with session.post(
+            router_url + "/v1/completions",
+            json={"model": MODEL, "prompt": "ping", "max_tokens": 4,
+                  "stream": True},
+            timeout=aiohttp.ClientTimeout(total=client_timeout_s),
+        ) as resp:
+            got_response = True
+            if resp.status != 200:
+                return ("response", None)
+            done = False
+            async for line in resp.content:
+                if line.strip() == b"data: [DONE]":
+                    done = True
+            if done:
+                return ("done", time.perf_counter() - t0)
+            return ("response", None)
+    except (aiohttp.ClientError, asyncio.TimeoutError):
+        return (("response" if got_response else "none"), None)
+
+
+async def run_saturation(*, steps=DEFAULT_STEPS,
+                         requests_per_user: int = 2,
+                         replicas: int = 4,
+                         engine_ttft: float = 0.001,
+                         client_timeout_s: float = 300.0,
+                         collapse_threshold: float = 0.9) -> dict:
+    import aiohttp
+
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.parser import build_parser
+    from production_stack_tpu.testing.fake_engine import FakeEngine
+    from production_stack_tpu.utils.misc import set_ulimit
+
+    # Client + router + engine sockets all live in this one process; the
+    # top rung alone wants ~3x its user count in fds.
+    set_ulimit(target_soft_limit=max(65535, 4 * max(steps) + 8192))
+
+    _reset_router_singletons()
+    engines = [FakeEngine(model=MODEL, ttft=engine_ttft,
+                          max_tokens_default=4) for _ in range(replicas)]
+    started = [await _start(e.make_app()) for e in engines]
+    runners = [r for r, _ in started]
+    urls = [u for _, u in started]
+
+    total_requests = sum(s * requests_per_user for s in steps)
+
+    slo_file = tempfile.NamedTemporaryFile(
+        "w", suffix=".yaml", prefix="slo-sat-", delete=False)
+    yaml.safe_dump(SLO_CONFIG, slo_file)
+    slo_file.close()
+
+    args = build_parser().parse_args([])
+    args.static_backends = ",".join(urls)
+    args.static_models = ",".join([MODEL] * replicas)
+    args.routing_logic = "roundrobin"
+    args.engine_stats_interval = 60
+    args.slo_config = slo_file.name
+    # Ring must hold a whole rung so the per-rung overhead slice is the
+    # full rung population, not whatever survived eviction.
+    args.trace_buffer = max(1024, max(steps) * requests_per_user)
+    router_app = build_app(args)
+    state = router_app["state"]
+    router_runner, router_url = await _start(router_app)
+
+    rungs: List[dict] = []
+    knee = None
+    rps_ceiling = 0.0
+    try:
+        async with aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(limit=0),
+        ) as session:
+            for users in steps:
+                prev_counts = state.slo.counts()
+                recorder = state.trace_recorder
+                overhead_before = len(
+                    recorder.root_attribute_values("overhead_s"))
+                latencies: List[float] = []
+                failed = [0]
+                unreached = [0]
+
+                async def user(n):
+                    for _ in range(n):
+                        kind, latency = await _one_request(
+                            session, router_url, client_timeout_s)
+                        if kind == "done":
+                            latencies.append(latency)
+                        else:
+                            failed[0] += 1
+                            if kind == "none":
+                                unreached[0] += 1
+
+                t0 = time.perf_counter()
+                await asyncio.gather(
+                    *[user(requests_per_user) for _ in range(users)])
+                elapsed = time.perf_counter() - t0
+
+                # An errored-out client returns before the router
+                # handler notices the disconnect; give classification a
+                # bounded window to catch up before reconciling. Only
+                # requests shed before the router accepted them
+                # (unreached) may legitimately never be counted.
+                total = users * requests_per_user
+                expected = total - unreached[0]
+                prev_total = sum(prev_counts.values())
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    if sum(state.slo.counts().values()) - prev_total \
+                            >= expected:
+                        break
+                    await asyncio.sleep(0.05)
+
+                counts = state.slo.counts()
+                outcomes = {k: counts[k] - prev_counts.get(k, 0)
+                            for k in counts
+                            if counts[k] - prev_counts.get(k, 0)}
+                classified = sum(outcomes.values())
+                good = outcomes.get("ok", 0)
+                goodput = round(good / classified, 4) if classified else None
+                overhead_vals = recorder.root_attribute_values(
+                    "overhead_s")[overhead_before:]
+                completed = len(latencies)
+                responses = total - unreached[0]
+                rps = round(completed / elapsed, 1) if elapsed else None
+                rung = {
+                    "users": users,
+                    "requests": total,
+                    "completed": completed,
+                    "failed": failed[0],
+                    "responses": responses,
+                    "unreached": unreached[0],
+                    "elapsed_s": round(elapsed, 2),
+                    "rps": rps,
+                    "p50_latency_s": round(
+                        sorted(latencies)[completed // 2], 4)
+                    if latencies else None,
+                    "p99_latency_s": round(_p99(latencies), 4)
+                    if latencies else None,
+                    "outcomes": outcomes,
+                    "outcomes_classified": classified,
+                    # Classifier reconciliation: every request that got
+                    # an HTTP response got exactly one outcome; only
+                    # connections the kernel shed before accept
+                    # (unreached) may go unclassified.
+                    "outcomes_reconcile": (
+                        classified == total if not unreached[0]
+                        else responses <= classified <= total),
+                    "goodput": goodput,
+                    "router_overhead_p99": round(_p99(overhead_vals), 6)
+                    if overhead_vals else None,
+                }
+                rungs.append(rung)
+                if rps is not None and (knee is None):
+                    rps_ceiling = max(rps_ceiling, rps)
+                if knee is None and goodput is not None \
+                        and goodput < collapse_threshold:
+                    knee = rung
+    finally:
+        await router_runner.cleanup()
+        for runner in runners:
+            await runner.cleanup()
+        _reset_router_singletons()
+        os.unlink(slo_file.name)
+
+    goodput_5m = state.slo.goodput(300.0)
+    return {
+        "metric": "router_saturation",
+        "unit": "rps_ceiling",
+        "value": rps_ceiling or None,
+        "replicas": replicas,
+        "steps": list(steps),
+        "requests_per_user": requests_per_user,
+        "total_requests": total_requests,
+        "collapse_threshold": collapse_threshold,
+        "slo_config": SLO_CONFIG,
+        "knee_users": knee["users"] if knee else None,
+        "knee_goodput": knee["goodput"] if knee else None,
+        "router_overhead_p99_at_knee":
+            knee["router_overhead_p99"] if knee else None,
+        "goodput_5m_final": round(goodput_5m, 4)
+        if goodput_5m is not None else None,
+        "outcomes_total": state.slo.counts(),
+        "outcomes_reconcile_all": all(r["outcomes_reconcile"]
+                                      for r in rungs),
+        "rungs": rungs,
+        "engine_requests": [len(e.requests_seen) for e in engines],
+    }

@@ -38,8 +38,11 @@ def is_gated(path: str) -> bool:
 # reporting channel — they require the deployment key when one is set.
 # The engine's /debug/profile (programmatic jax.profiler capture, plus
 # the served artifact dir beneath it) is privileged for the same reason:
-# a profiler trace steals device time and writes to disk.
-_PRIVILEGED_EXACT = frozenset({"/kv/deregister", "/debug/profile"})
+# a profiler trace steals device time and writes to disk. The router's
+# /debug/events journal exposes control-plane topology (endpoint URLs,
+# breaker/lease churn) and is gated the same way.
+_PRIVILEGED_EXACT = frozenset({"/kv/deregister", "/debug/profile",
+                               "/debug/events"})
 _PRIVILEGED_PREFIXES = ("/autoscale/", "/debug/profile/")
 
 

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Fail if an alert rule queries an undocumented (or nonexistent) metric.
+
+Walks every ``expr`` in ``observability/tpu-stack-alerts.yaml`` and
+checks each ``tpu:*`` / ``vllm_router:*`` metric name against the
+documented set from ``observability/README.md``, reusing the parser and
+normalization rules from ``check_metrics_documented.py`` (which in turn
+enforces that the README tracks what the source tree emits — so an
+alert on a documented metric is an alert on a real one).
+
+Run from the repo root; exits non-zero listing offending rules.
+Wired into the test suite via tests/test_observability.py.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ALERTS = os.path.join(REPO, "observability", "tpu-stack-alerts.yaml")
+
+
+def _metrics_lint():
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_documented",
+        os.path.join(REPO, "scripts", "check_metrics_documented.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def alert_exprs(path: str = ALERTS):
+    """Yield (alert_name, expr) for every rule in the PrometheusRule."""
+    with open(path, encoding="utf-8") as f:
+        doc = yaml.safe_load(f)
+    for group in doc["spec"]["groups"]:
+        for r in group["rules"]:
+            yield r["alert"], r["expr"]
+
+
+def undocumented(path: str = ALERTS):
+    """(alert_name, metric) pairs whose metric the README doesn't know."""
+    lint = _metrics_lint()
+    exact, prefixes = lint.documented_metrics()
+    bad = []
+    for alert, expr in alert_exprs(path):
+        for name in lint.METRIC_RE.findall(expr):
+            norm = lint.normalize(name)
+            if norm in exact or any(norm.startswith(p) for p in prefixes):
+                continue
+            bad.append((alert, name))
+    return bad
+
+
+def main() -> int:
+    bad = undocumented()
+    if bad:
+        print("Alert rules query metrics missing from "
+              "observability/README.md:")
+        for alert, name in bad:
+            print(f"  {alert}: {name}")
+        return 1
+    n = sum(1 for _ in alert_exprs())
+    print(f"all {n} alert rules query documented metrics")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

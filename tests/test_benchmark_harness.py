@@ -55,6 +55,57 @@ def test_multi_round_qa_against_fake_engine(tmp_path):
     assert len(lines) == 1 + 6
 
 
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_artifacts_carry_run_meta(tmp_path):
+    """Every BENCH_*.json writer goes through _write_artifact, which
+    stamps the run-metadata ``meta`` key (commit, timestamp, knobs)."""
+    mod = _load_bench()
+    meta = mod._run_meta()
+    for key in ("schema", "git_sha", "timestamp_utc", "python",
+                "platform", "jax", "bench_config", "env"):
+        assert key in meta, f"missing meta key {key}"
+    assert meta["schema"] == 1
+    assert isinstance(meta["env"], dict)
+    # jax is only stamped when the branch actually imported it; the
+    # hermetic branches must record None, not a guess.
+    assert meta["jax"] is None or isinstance(meta["jax"], str)
+    mod.REPO = str(tmp_path)
+    mod._write_artifact("X.json", {"metric": "m", "value": 1})
+    data = json.loads((tmp_path / "X.json").read_text())
+    assert data["meta"]["schema"] == 1
+    assert data["metric"] == "m"
+
+
+def test_committed_saturation_artifact_schema():
+    """The committed saturation artifact is real: 10k+ users at the top
+    rung, 4 replicas, outcome classifier reconciling on every rung —
+    exactly when every request reached the router, and bounded by
+    responses-received when the kernel shed connections at the socket
+    layer (``unreached``) before the router could accept them."""
+    data = json.load(open(os.path.join(REPO, "BENCH_SATURATION_r12.json")))
+    assert data["metric"] == "router_saturation"
+    assert data["meta"]["schema"] == 1
+    assert data["replicas"] == 4
+    assert max(data["steps"]) >= 10000
+    assert data["outcomes_reconcile_all"] is True
+    for rung in data["rungs"]:
+        classified = rung["outcomes_classified"]
+        assert sum(rung["outcomes"].values()) == classified
+        if rung["unreached"] == 0:
+            assert classified == rung["requests"]
+        else:
+            assert rung["responses"] <= classified <= rung["requests"]
+    assert any(r["goodput"] is not None for r in data["rungs"])
+    assert data["value"] is None or data["value"] > 0
+
+
 def test_plot_table(tmp_path, monkeypatch):
     spec = importlib.util.spec_from_file_location(
         "bench_plot", os.path.join(REPO, "benchmarks", "plot.py"))

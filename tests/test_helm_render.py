@@ -461,6 +461,76 @@ def test_qos_tenants_render_configmap_and_router_flags():
     assert "--qos-tenants-file" not in bcmd
 
 
+def test_slo_and_canary_render_configmap_and_router_flags():
+    """routerSpec.slo.enabled renders the objectives ConfigMap, mounts
+    it at /etc/slo, and passes --slo-config; routerSpec.canary.enabled
+    adds the prober flags. Disabled (the default) renders none of it
+    (flag-off parity in the chart, mirroring the router)."""
+    import copy
+    import json
+
+    import jsonschema
+    import yaml
+
+    values = copy.deepcopy(load_values(CHART))
+    values["routerSpec"]["slo"] = {
+        "enabled": True,
+        "config": {
+            "default": {"ttft_p99_s": 2.0, "inter_token_p99_s": 0.5,
+                        "availability": 0.999},
+            "tenants": {"premium": {"ttft_p99_s": 1.0}},
+        },
+    }
+    values["routerSpec"]["canary"] = {
+        "enabled": True,
+        "interval": 15,
+        "promptTokens": 8,
+        "maxTokens": 4,
+    }
+    with open(os.path.join(CHART, "values.schema.json")) as f:
+        jsonschema.validate(values, json.load(f))
+
+    rendered = MiniHelm(CHART).render(values)
+    cms = [d for d in _docs(rendered, "ConfigMap")
+           if d["metadata"]["name"].endswith("-router-slo-config")]
+    assert len(cms) == 1
+    objectives = yaml.safe_load(cms[0]["data"]["slo.yaml"])
+    assert objectives["default"]["ttft_p99_s"] == 2.0
+    assert objectives["tenants"]["premium"]["ttft_p99_s"] == 1.0
+
+    deps = [d for d in _docs(rendered, "Deployment")
+            if d["metadata"]["name"].endswith("-router")]
+    spec = deps[0]["spec"]["template"]["spec"]
+    cmd = spec["containers"][0]["command"]
+    assert cmd[cmd.index("--slo-config") + 1] == "/etc/slo/slo.yaml"
+    assert cmd[cmd.index("--canary-interval") + 1] == "15"
+    assert cmd[cmd.index("--canary-prompt-tokens") + 1] == "8"
+    assert cmd[cmd.index("--canary-max-tokens") + 1] == "4"
+    mounts = spec["containers"][0]["volumeMounts"]
+    assert any(m["mountPath"] == "/etc/slo" for m in mounts)
+    assert any(v["configMap"]["name"].endswith("-router-slo-config")
+               for v in spec["volumes"])
+
+    # SLO without QoS must not drag the QoS mount in (the shared
+    # volumes block gates each entry independently).
+    assert not any(m["mountPath"] == "/etc/qos" for m in mounts)
+    assert not any("qos" in v["configMap"]["name"]
+                   for v in spec["volumes"])
+
+    # Default chart: SLO/canary fully absent (flag-off parity).
+    base = _render()
+    assert not [d for d in _docs(base, "ConfigMap")
+                if "slo" in d["metadata"]["name"]]
+    bdeps = [d for d in _docs(base, "Deployment")
+             if d["metadata"]["name"].endswith("-router")]
+    bspec = bdeps[0]["spec"]["template"]["spec"]
+    bcmd = bspec["containers"][0]["command"]
+    assert "--slo-config" not in bcmd
+    assert "--canary-interval" not in bcmd
+    assert not any(m.get("mountPath") == "/etc/slo"
+                   for m in bspec["containers"][0].get("volumeMounts", []))
+
+
 def test_kv_cache_dtype_plumbs_into_engine_command():
     """kvCacheDtype renders as --kv-cache-dtype (absent when unset —
     bf16 is the engine default), the schema accepts bf16/int8, and

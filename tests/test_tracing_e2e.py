@@ -421,6 +421,105 @@ async def test_debug_traces_listing_and_filters():
         await _cleanup(runners)
 
 
+async def test_retry_failover_recorded_as_span_events():
+    """A pre-first-byte failover leaves its mark ON the trace: the
+    router.upstream span carries ``retry`` / ``failover`` events naming
+    the replica each attempt went to, and the fleet event journal
+    records the failover."""
+    hung = FakeEngine(model="test-model", ttft=0.02, tokens_per_sec=500.0)
+    good = FakeEngine(model="test-model", ttft=0.02, tokens_per_sec=500.0)
+    hrunner, hurl = await _start(hung.make_app())
+    grunner, gurl = await _start(good.make_app())
+    args = _args(
+        static_backends=f"{hurl},{gurl}",
+        static_models="test-model,test-model",
+        routing_logic="roundrobin",
+        engine_stats_interval=60,
+        fault_tolerance=True,
+        ft_max_retries=3,
+        ft_backoff_base=0.02,
+        ft_backoff_max=0.1,
+        ft_breaker_threshold=10**6,  # keep routing deterministic
+        ft_ttft_deadline=0.3,
+        ft_inter_chunk_deadline=0.3,
+    )
+    app = build_app(args)
+    rrunner, rurl = await _start(app)
+    runners = [hrunner, grunner, rrunner]
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{hurl}/fault",
+                json={"mode": "hang_before_stream", "times": -1},
+            ) as resp:
+                assert resp.status == 200
+            rids = [f"ft-ev-{i}" for i in range(2)]
+            for rid in rids:  # roundrobin: one of the two starts hung
+                async with s.post(
+                    f"{rurl}/v1/chat/completions",
+                    json={"model": "test-model", "max_tokens": 2,
+                          "stream": True,
+                          "messages": [{"role": "user", "content": "hi"}]},
+                    headers={"X-Request-Id": rid},
+                ) as resp:
+                    assert resp.status == 200
+                    async for _ in resp.content:
+                        pass
+            events = []
+            for rid in rids:
+                rt = await _get_trace(s, rurl, rid)
+                events.extend(_span(rt, "router.upstream").get("events", []))
+            # /debug/events is open when no API key is configured.
+            async with s.get(f"{rurl}/debug/events") as resp:
+                assert resp.status == 200
+                journal = await resp.json()
+    finally:
+        await _cleanup(runners)
+
+    by_name = {}
+    for ev in events:
+        by_name.setdefault(ev["name"], []).append(ev)
+    assert "retry" in by_name, events
+    assert "failover" in by_name, events
+    # The rescue attempt names the replica it went TO.
+    assert any(ev["attributes"]["url"] == gurl
+               for ev in by_name["failover"])
+    assert all("time_unix" in ev for ev in events)
+    # The journal saw the same failover, tagged with the trace id.
+    kinds = {e["kind"] for e in journal["events"]}
+    assert "failover" in kinds
+    failover_events = [e for e in journal["events"]
+                       if e["kind"] == "failover"]
+    assert any(e["endpoint"] == gurl for e in failover_events)
+    assert any(e["trace_id"] for e in failover_events)
+
+
+async def test_eventless_spans_keep_byte_identical_trace_shape():
+    """Flag-off parity at the trace layer: a span with no events must
+    serialize exactly as before the events field existed."""
+    engine, eurl, app, rurl, runners = await _router_one_engine()
+    rid = f"no-ev-{uuid.uuid4().hex[:8]}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{rurl}/v1/completions",
+                json={"model": "test-model", "prompt": "hi",
+                      "max_tokens": 2},
+                headers={"X-Request-Id": rid},
+            ) as resp:
+                assert resp.status == 200
+            rt = await _get_trace(s, rurl, rid)
+            async with s.get(f"{rurl}/debug/traces/{rid}",
+                             params={"format": "otlp"}) as resp:
+                otlp = await resp.json()
+    finally:
+        await _cleanup(runners)
+    for span in rt["spans"]:
+        assert "events" not in span
+    for span in otlp["resourceSpans"][0]["scopeSpans"][0]["spans"]:
+        assert "events" not in span
+
+
 async def test_slow_trace_threshold_via_router_flag(tmp_path):
     out = tmp_path / "router-traces.jsonl"
     engine, eurl, app, rurl, runners = await _router_one_engine(
