@@ -231,13 +231,13 @@ STRUCTURED_REPEATS = _env_int("BENCH_STRUCTURED_REPEATS", 3)
 # router running a real --slo-config, until goodput falls below
 # BENCH_SATURATION_COLLAPSE (production_stack_tpu/testing/
 # saturation.py — no TPU, no jax import). Writes BENCH_SATURATION_OUT
-# (default BENCH_SATURATION_r12.json) with the RPS ceiling, the
+# (default BENCH_SATURATION_r13.json) with the RPS ceiling, the
 # goodput-vs-load curve, per-rung outcome-classifier deltas (which must
 # reconcile with the offered totals), and router_overhead_p99 at the
 # knee.
 SATURATION = _env_int("BENCH_SATURATION", 0)
 SATURATION_OUT = os.environ.get("BENCH_SATURATION_OUT",
-                                "BENCH_SATURATION_r12.json")
+                                "BENCH_SATURATION_r13.json")
 SATURATION_STEPS = os.environ.get("BENCH_SATURATION_STEPS",
                                   "100,500,1000,2500,5000,10000")
 SATURATION_REQS_PER_USER = _env_int("BENCH_SATURATION_REQS_PER_USER", 2)

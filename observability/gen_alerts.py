@@ -133,6 +133,21 @@ def build_alerts():
                     "away. GET /debug/events?kind=lease_sweep shows "
                     "which endpoints."),
                 rule(
+                    "RouterEventLoopStalling",
+                    'max(vllm_router:event_loop_lag_seconds'
+                    '{stat="p99"}) > 0.1 '
+                    "and sum(rate("
+                    "vllm_router:loop_stalls_total[5m])) > 0",
+                    "5m", "warning",
+                    "Router event loop stalling (p99 lag > 100ms)",
+                    "The router's asyncio loop is being blocked: p99 "
+                    "scheduling lag over the ring window exceeds 100ms "
+                    "and stalls are still accruing (--loop-monitor). "
+                    "Every in-flight stream shares this loop, so TTFT "
+                    "and inter-token latency degrade fleet-wide. "
+                    "GET /debug/loop names the blocking frames and the "
+                    "per-component on-loop seconds."),
+                rule(
                     "TPUStackBandwidthCollapse",
                     "avg by(instance) "
                     "(tpu:model_bandwidth_utilization) < 0.2 "

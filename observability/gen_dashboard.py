@@ -722,6 +722,71 @@ def build_dashboard():
              "--slow-trace-log-interval-s"))
     y += 7
 
+    # ---- Row 12b: Event Loop Health (--loop-monitor) -------------------- #
+    panels.append(row("Event Loop Health", y)); y += 1
+    panels.append(panel(
+        "timeseries", "Router event-loop lag (p50/p99/max)",
+        [target('vllm_router:event_loop_lag_seconds{stat="p50"}',
+                legend="p50"),
+         target('vllm_router:event_loop_lag_seconds{stat="p99"}',
+                legend="p99"),
+         target('vllm_router:event_loop_lag_seconds{stat="max"}',
+                legend="max")],
+        grid(7, 8, 0, y), unit="s",
+        desc="Scheduling lag of the router's asyncio loop over the "
+             "monitor's ring window (--loop-monitor): how late the "
+             "self-rescheduling tick fires. Every in-flight stream "
+             "shares this loop, so sustained p99 lag is added TTFT and "
+             "inter-token latency for everyone; GET /debug/loop names "
+             "the blocking frames"))
+    panels.append(panel(
+        "timeseries", "Loop stalls by severity",
+        [target("sum by(bucket) "
+                "(rate(vllm_router:loop_stalls_total[5m]))",
+                legend="router {{bucket}}"),
+         target("sum by(instance, bucket) "
+                "(rate(tpu:loop_stalls_total[5m]))",
+                legend="{{instance}} {{bucket}}")],
+        grid(7, 8, 8, y),
+        desc="Stall episodes per second, bucketed by severity in "
+             "multiples of --loop-stall-threshold-ms (1x/5x/20x, "
+             "disjoint: each stall counts once in the highest bucket "
+             "it reached). The RouterEventLoopStalling alert fires on "
+             "sustained p99 lag while these are still accruing"))
+    panels.append(panel(
+        "timeseries", "On-loop seconds by component",
+        [target("sum by(component) (rate("
+                "vllm_router:loop_component_seconds_total[5m]))",
+                legend="{{component}}")],
+        grid(7, 8, 16, y), unit="percentunit",
+        desc="Fraction of each wall second the router's loop spends "
+             "executing each instrumented component (QoS admission, "
+             "fleet pull, KV controller, streaming relay, SLO "
+             "classification, metrics scrape) — awaited time is "
+             "excluded, so this is pure on-loop CPU attribution"))
+    y += 7
+    panels.append(panel(
+        "timeseries", "Engine event-loop lag (p99)",
+        [target("tpu:event_loop_lag_p99_seconds",
+                legend="{{instance}} p99"),
+         target("tpu:event_loop_lag_max_seconds",
+                legend="{{instance}} max")],
+        grid(7, 8, 0, y), unit="s",
+        desc="Same scheduling-lag measurement on each engine's serving "
+             "loop (tpu:event_loop_lag_seconds lifetime accumulators "
+             "carry the sum/count): a stalling engine loop delays "
+             "token flushes for every stream it serves"))
+    panels.append(panel(
+        "timeseries", "Router loop lag average (lifetime)",
+        [target('vllm_router:event_loop_lag_seconds{stat="sum"} / '
+                'vllm_router:event_loop_lag_seconds{stat="count"}',
+                legend="router avg")],
+        grid(7, 8, 8, y), unit="s",
+        desc="Lifetime mean tick lag — the slow-drift complement to "
+             "the windowed percentiles; a rising mean at flat p99 "
+             "means the baseline is degrading, not the tail"))
+    y += 7
+
     # ---- Row 13: Current Resource Usage (ref panels 14-19) -------------- #
     panels.append(row("Current Resource Usage", y)); y += 1
     panels.append(panel(
@@ -743,7 +808,7 @@ def build_dashboard():
         "title": "TPU Production Stack",
         "tags": ["tpu", "production-stack"],
         "schemaVersion": 39,
-        "version": 5,
+        "version": 6,
         "refresh": "10s",
         "time": {"from": "now-30m", "to": "now"},
         # Fleet event journal overlay: GET /debug/events?format=grafana

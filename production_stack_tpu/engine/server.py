@@ -135,7 +135,9 @@ class EngineServer:
                  trace_export: Optional[str] = None,
                  trace_sample_rate: float = 1.0,
                  slow_trace_log_interval_s: float = 0.0,
-                 profile_dir: Optional[str] = None):
+                 profile_dir: Optional[str] = None,
+                 loop_monitor: bool = False,
+                 loop_stall_threshold_ms: float = 100.0):
         # Serving-surface auth (reference tutorial 11 "secure vLLM
         # serve": VLLM_API_KEY): /v1/* requests must carry
         # `Authorization: Bearer <key>`; the intra-stack control plane
@@ -217,6 +219,18 @@ class EngineServer:
             sample_rate=trace_sample_rate,
             slow_log_interval_s=slow_trace_log_interval_s,
         )
+        # Event-loop introspection (--loop-monitor): scheduling-lag
+        # ring + blocking-call watchdog, started with the server's loop
+        # in make_app's on_startup. None when off — the flag-off
+        # /metrics exposition and hot path are byte-identical.
+        self.loop_monitor = None
+        if loop_monitor:
+            from production_stack_tpu.obs.looplag import LoopMonitor
+
+            self.loop_monitor = LoopMonitor(
+                "tpu-stack-engine",
+                stall_threshold_s=float(loop_stall_threshold_ms) / 1000.0,
+            )
         # Programmatic profiler capture (POST /debug/profile): one
         # jax.profiler trace at a time, written under profile_dir and
         # served back at /debug/profile/artifacts/. Privileged (bearer
@@ -642,6 +656,21 @@ class EngineServer:
         r.add_get("/debug/profile/artifacts", self.handle_profile_artifacts)
         r.add_get("/debug/profile/artifacts/{name:.+}",
                   self.handle_profile_artifact_file)
+        # Event-loop health (--loop-monitor): the monitor must start on
+        # the server's own loop, so it hooks app startup/cleanup.
+        if self.loop_monitor is not None:
+            from production_stack_tpu.obs.debug import add_loop_debug_routes
+
+            add_loop_debug_routes(r, self.loop_monitor)
+
+            async def _start_loop_monitor(app: web.Application):
+                self.loop_monitor.start()
+
+            async def _stop_loop_monitor(app: web.Application):
+                self.loop_monitor.stop()
+
+            app.on_startup.append(_start_loop_monitor)
+            app.on_cleanup.append(_stop_loop_monitor)
         app["engine_server"] = self
         return app
 
@@ -2503,6 +2532,35 @@ class EngineServer:
             f"tpu:slow_trace_logs_suppressed_total{{{labels}}} "
             f"{self.trace_recorder.slow_logs_suppressed_total}",
         ]
+        # Event-loop health (--loop-monitor): scheduling-lag lifetime
+        # accumulators, ring-window rollups, and severity-bucketed stall
+        # counts. Omitted entirely when off (flag-off exposition is
+        # byte-identical).
+        mon = self.loop_monitor
+        if mon is not None:
+            pct = mon.percentiles()
+            lines += [
+                "# TYPE tpu:event_loop_lag_seconds summary",
+                f"tpu:event_loop_lag_seconds_sum{{{labels}}} "
+                f"{mon.lag_s_sum:.6f}",
+                f"tpu:event_loop_lag_seconds_count{{{labels}}} "
+                f"{mon.samples_total}",
+                "# TYPE tpu:event_loop_lag_p50_seconds gauge",
+                f"tpu:event_loop_lag_p50_seconds{{{labels}}} "
+                f"{pct['p50']:.6f}",
+                "# TYPE tpu:event_loop_lag_p99_seconds gauge",
+                f"tpu:event_loop_lag_p99_seconds{{{labels}}} "
+                f"{pct['p99']:.6f}",
+                "# TYPE tpu:event_loop_lag_max_seconds gauge",
+                f"tpu:event_loop_lag_max_seconds{{{labels}}} "
+                f"{pct['max']:.6f}",
+                "# TYPE tpu:loop_stalls counter",
+            ]
+            for bucket, count in sorted(mon.stalls().items()):
+                bucket_labels = (f'{labels},bucket="{bucket}"' if labels
+                                 else f'bucket="{bucket}"')
+                lines.append(
+                    f"tpu:loop_stalls_total{{{bucket_labels}}} {count}")
         # Admission rejections by reason; both reasons always emitted so
         # rate() queries never see a vanishing series.
         rejected = s.get("rejected_requests") or {}
@@ -2712,6 +2770,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default=None,
                    help="directory for POST /debug/profile jax.profiler "
                         "artifacts (default: a per-process tempdir)")
+    p.add_argument("--loop-monitor", action="store_true",
+                   help="measure event-loop scheduling lag and detect "
+                        "blocking calls on the server loop (watchdog "
+                        "stack sampler); serves GET /debug/loop and the "
+                        "tpu:event_loop_* metrics. Off = hot path "
+                        "byte-identical")
+    p.add_argument("--loop-stall-threshold-ms", type=float, default=100.0,
+                   help="loop lag counted as a stall and sampled by the "
+                        "blocking-call watchdog once the loop has not "
+                        "ticked for this long")
     return p
 
 
@@ -2785,7 +2853,9 @@ def main(argv: Optional[List[str]] = None) -> None:
                           trace_export=args.trace_export,
                           trace_sample_rate=args.trace_sample_rate,
                           slow_trace_log_interval_s=args.slow_trace_log_interval_s,
-                          profile_dir=args.profile_dir)
+                          profile_dir=args.profile_dir,
+                          loop_monitor=args.loop_monitor,
+                          loop_stall_threshold_ms=args.loop_stall_threshold_ms)
 
     async def _run():
         await run_engine_server(server, args.host, args.port)

@@ -1,5 +1,11 @@
 """``/debug/*`` HTTP surfaces, shared by router, engine, and fake engine.
 
+The whole ``/debug`` tree is privileged (``utils/auth.py``): with a
+deployment key configured every surface below requires it — traces leak
+request ids, backend URLs, and slow-request timelines; steps leak
+workload shape; the loop monitor names source locations of blocking
+code.
+
 - ``GET /debug/traces``                 -- newest-first summaries; filters:
   ``?min_duration_s=0.25`` and ``?limit=50``.
 - ``GET /debug/traces/{request_id}``    -- full span timeline as JSON;
@@ -7,10 +13,14 @@
 - ``GET /debug/steps``                  -- engine-only: newest-first step
   flight-recorder records; filters: ``?limit=50`` and
   ``?kind=decode_burst``.
-- ``GET /debug/events``                 -- router-only (privileged): the
-  fleet event journal, newest-first; filters ``?limit=50`` and
+- ``GET /debug/events``                 -- router-only: the fleet event
+  journal, newest-first; filters ``?limit=50`` and
   ``?kind=breaker_open``; ``?format=grafana`` returns the Grafana
   annotations JSON shape for dashboard overlay.
+- ``GET /debug/loop``                   -- event-loop health
+  (``--loop-monitor``): lag rollups, stall buckets, per-component
+  on-loop seconds, and the blocking-call watchdog's top-blockers table;
+  ``?blockers=10`` bounds the table.
 """
 
 from __future__ import annotations
@@ -102,3 +112,23 @@ def add_event_debug_routes(router, journal: EventJournal) -> None:
         return web.json_response(out)
 
     router.add_get("/debug/events", list_events)
+
+
+def add_loop_debug_routes(router, monitor) -> None:
+    """Attach ``GET /debug/loop`` (event-loop health; ``LoopMonitor``)."""
+
+    async def loop_health(request: web.Request) -> web.Response:
+        try:
+            blockers = int(request.query.get("blockers", 10) or 10)
+        except ValueError:
+            return web.json_response(
+                {"error": "blockers must be an integer"}, status=400)
+        if blockers < 1:
+            return web.json_response(
+                {"error": "blockers must be >= 1"}, status=400)
+        out = monitor.summary()
+        out["top_blockers"] = monitor.detector.top_blockers(
+            limit=blockers)
+        return web.json_response(out)
+
+    router.add_get("/debug/loop", loop_health)

@@ -75,6 +75,7 @@ class RouterState:
     slo: Any = None  # SLOEngine when --slo-config is set, else None
     canary: Any = None  # CanaryProber when --canary-interval > 0
     events: Any = None  # EventJournal (always on; bounded ring is cheap)
+    loop_monitor: Any = None  # LoopMonitor when --loop-monitor is set
     extra: dict = field(default_factory=dict)
 
 
@@ -90,6 +91,14 @@ def _proxy(endpoint: str):
             hit = await state.semantic_cache.check(await request.json())
             if hit is not None:
                 return web.json_response(hit)
+        if state.loop_monitor is not None:
+            # On-loop time of the whole proxied request, dominated by
+            # the chunk-relay loop. The finer-grained components
+            # (qos_admission, fleet_pull, slo_classify) are slices of
+            # this same handler, so component totals are not disjoint.
+            return await state.loop_monitor.components.wrap(
+                "streaming_relay",
+                request_service.route_general_request(request, endpoint))
         return await request_service.route_general_request(request, endpoint)
 
     return handler
@@ -166,6 +175,14 @@ async def metrics_handler(request: web.Request) -> web.Response:
             state.trace_recorder.slow_logs_suppressed_total)
     if state.slo is not None:
         state.slo.refresh_gauges()
+    if state.loop_monitor is not None:
+        # Rendering /metrics is itself synchronous on-loop work worth
+        # attributing (big registries serialize in milliseconds).
+        with state.loop_monitor.components.measure("metrics_scrape"):
+            metrics_mod.mirror_loop_metrics(state.loop_monitor)
+            body = metrics_mod.render_metrics()
+        return web.Response(
+            body=body, content_type="text/plain", charset="utf-8")
     return web.Response(
         body=metrics_mod.render_metrics(),
         content_type="text/plain",
@@ -580,16 +597,28 @@ def build_app(args) -> web.Application:
     app.router.add_get("/v1/batches", list_batches)
     app.router.add_get("/v1/batches/{batch_id}", get_batch)
     app.router.add_post("/v1/batches/{batch_id}/cancel", cancel_batch)
-    # KV controller channel
-    app.router.add_post("/kv/register", kv_register)
-    app.router.add_post("/kv/admit", kv_admit)
-    app.router.add_post("/kv/evict", kv_evict)
-    app.router.add_post("/kv/lookup", kv_lookup)
-    app.router.add_post("/kv/deregister", kv_deregister)
-    app.router.add_post("/kv/heartbeat", kv_heartbeat)
-    app.router.add_post("/kv/resync", kv_resync)
-    app.router.add_post("/kv/resync_state", kv_resync_state)
-    app.router.add_get("/kv/instances", kv_instances)
+    # KV controller channel. With the loop monitor on, each handler's
+    # on-loop time is attributed to the kv_controller component (trie
+    # walks and resync-state replacement are synchronous loop work).
+    def _kv(handler):
+        if state.loop_monitor is None:
+            return handler
+        timers = state.loop_monitor.components
+
+        async def timed(request: web.Request) -> web.StreamResponse:
+            return await timers.wrap("kv_controller", handler(request))
+
+        return timed
+
+    app.router.add_post("/kv/register", _kv(kv_register))
+    app.router.add_post("/kv/admit", _kv(kv_admit))
+    app.router.add_post("/kv/evict", _kv(kv_evict))
+    app.router.add_post("/kv/lookup", _kv(kv_lookup))
+    app.router.add_post("/kv/deregister", _kv(kv_deregister))
+    app.router.add_post("/kv/heartbeat", _kv(kv_heartbeat))
+    app.router.add_post("/kv/resync", _kv(kv_resync))
+    app.router.add_post("/kv/resync_state", _kv(kv_resync_state))
+    app.router.add_get("/kv/instances", _kv(kv_instances))
     # Autoscale recommender (404 unless --autoscale)
     app.router.add_get("/autoscale/recommendation", autoscale_recommendation)
     app.router.add_post("/autoscale/scale_in", autoscale_scale_in)
@@ -605,9 +634,16 @@ def build_app(args) -> web.Application:
         from production_stack_tpu.obs.debug import add_event_debug_routes
 
         add_event_debug_routes(app.router, state.events)
+    # Event-loop health (privileged: /debug/loop is in _PRIVILEGED_EXACT).
+    if state.loop_monitor is not None:
+        from production_stack_tpu.obs.debug import add_loop_debug_routes
+
+        add_loop_debug_routes(app.router, state.loop_monitor)
 
     async def on_startup(app: web.Application):
         st = app["state"]
+        if st.loop_monitor is not None:
+            st.loop_monitor.start()
         if st.batch_processor is not None:
             st.batch_processor.start()
         # Canary prober: tiny synthetic completions straight at each
@@ -661,6 +697,8 @@ def build_app(args) -> web.Application:
                 except (asyncio.CancelledError, Exception):  # noqa: BLE001
                     pass
         st = app["state"]
+        if st.loop_monitor is not None:
+            st.loop_monitor.stop()
         for closable in (
             st.service_discovery, st.engine_stats_scraper,
             st.dynamic_config_watcher, st.batch_processor,
@@ -747,6 +785,25 @@ def initialize_all(args) -> RouterState:
             "SLO engine enabled: default=%s tenants=%s models=%s",
             state.slo.default, sorted(state.slo.tenants),
             sorted(state.slo.models))
+
+    # Event-loop introspection: lag monitor + blocking-call watchdog +
+    # per-component on-loop attribution, only behind --loop-monitor —
+    # without it state.loop_monitor is None and the hot path carries no
+    # instrumentation code at all.
+    if getattr(args, "loop_monitor", False):
+        from production_stack_tpu.obs.looplag import LoopMonitor
+
+        threshold_ms = float(
+            getattr(args, "loop_stall_threshold_ms", 100.0) or 100.0)
+        state.loop_monitor = LoopMonitor(
+            "tpu-stack-router",
+            stall_threshold_s=threshold_ms / 1000.0,
+        )
+        logger.info(
+            "Event-loop monitor enabled: stall_threshold=%.0fms "
+            "tick=%.0fms watchdog_poll=%.0fms", threshold_ms,
+            state.loop_monitor.interval_s * 1000.0,
+            state.loop_monitor.detector.poll_s * 1000.0)
 
     # Service discovery.
     if args.service_discovery == "static":

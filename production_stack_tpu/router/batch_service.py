@@ -136,7 +136,7 @@ class LocalBatchProcessor:
         self._running = True
 
     def start(self) -> None:
-        self._task = asyncio.get_event_loop().create_task(self._loop())
+        self._task = asyncio.get_running_loop().create_task(self._loop())
 
     async def _loop(self) -> None:
         while self._running:

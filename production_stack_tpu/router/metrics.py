@@ -240,6 +240,51 @@ canary_ttft = Histogram(
              0.75, 1.0, 2.5, 5.0, 7.5, 10.0, 20.0, 40.0),
     registry=REGISTRY)
 
+# --- Event-loop introspection (obs/looplag.py, --loop-monitor) -----------
+# All labeled (stat / bucket / component): series appear only once the
+# monitor mirrors its first rollup at scrape time, so a flag-off
+# deployment's /metrics surface stays byte-identical (same convention as
+# the SLO block above). Cumulative values are mirrored as gauges with a
+# _total-suffixed name so rate() stays usable (trace_sampled_out
+# precedent).
+event_loop_lag = Gauge(
+    "vllm_router:event_loop_lag_seconds",
+    "Event-loop scheduling lag of the router process: how late the "
+    "monitor's periodic tick fired. stat=sum|count are lifetime "
+    "accumulators (rate(sum)/rate(count) = mean lag); stat=p50|p99|max "
+    "are rollups over the in-memory ring window",
+    ["stat"], registry=REGISTRY)
+loop_stalls = Gauge(
+    "vllm_router:loop_stalls_total",
+    "Event-loop stalls (tick lag >= --loop-stall-threshold-ms) by "
+    "severity bucket, a multiple of the threshold (1x/5x/20x, disjoint "
+    "— each stall increments the highest bucket it reached)",
+    ["bucket"], registry=REGISTRY)
+loop_component_seconds = Gauge(
+    "vllm_router:loop_component_seconds_total",
+    "Cumulative on-loop CPU seconds per instrumented router component "
+    "(qos_admission, fleet_pull, kv_controller, streaming_relay, "
+    "slo_classify, metrics_scrape): synchronous slices that actually "
+    "held the loop, awaited time excluded",
+    ["component"], registry=REGISTRY)
+
+
+def mirror_loop_metrics(monitor) -> None:
+    """Scrape-time mirror of the LoopMonitor's counters and rollups
+    (the monitor owns the source of truth; /debug/loop, this exposition,
+    and the saturation artifact all read the same numbers)."""
+    pct = monitor.percentiles()
+    event_loop_lag.labels(stat="sum").set(round(monitor.lag_s_sum, 6))
+    event_loop_lag.labels(stat="count").set(monitor.samples_total)
+    event_loop_lag.labels(stat="p50").set(pct["p50"])
+    event_loop_lag.labels(stat="p99").set(pct["p99"])
+    event_loop_lag.labels(stat="max").set(pct["max"])
+    for bucket, count in monitor.stalls().items():
+        loop_stalls.labels(bucket=bucket).set(count)
+    for comp, secs in monitor.components.snapshot().items():
+        loop_component_seconds.labels(component=comp).set(round(secs, 6))
+
+
 _PROCESS = psutil.Process()
 
 

@@ -247,6 +247,19 @@ def build_parser() -> argparse.ArgumentParser:
                              "probe (words)")
     parser.add_argument("--canary-max-tokens", type=int, default=4,
                         help="max_tokens requested by a canary probe")
+    # Event-loop introspection (production_stack_tpu/obs/looplag.py)
+    parser.add_argument("--loop-monitor", action="store_true",
+                        help="measure event-loop scheduling lag, detect "
+                             "blocking calls on the loop (watchdog "
+                             "stack sampler), and attribute on-loop "
+                             "CPU time per router component; serves "
+                             "GET /debug/loop. Off = hot path "
+                             "byte-identical")
+    parser.add_argument("--loop-stall-threshold-ms", type=float,
+                        default=100.0,
+                        help="loop lag counted as a stall and sampled "
+                             "by the blocking-call watchdog once the "
+                             "loop has not ticked for this long")
     return parser
 
 
@@ -324,6 +337,8 @@ def validate_args(args: argparse.Namespace) -> None:
         raise ValueError("--canary-prompt-tokens must be >= 1")
     if getattr(args, "canary_max_tokens", 4) < 1:
         raise ValueError("--canary-max-tokens must be >= 1")
+    if getattr(args, "loop_stall_threshold_ms", 100.0) <= 0.0:
+        raise ValueError("--loop-stall-threshold-ms must be > 0")
 
 
 def expand_static_models_config(config: dict) -> dict:

@@ -19,6 +19,7 @@ Rebuild of reference ``src/vllm_router/services/request_service/request.py``
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import random
 import sys
@@ -55,6 +56,25 @@ MAX_BODY_BYTES = 32 << 20
 # client: forwarding a client-supplied X-Tenant / X-Priority would let
 # anyone spoof tenant accounting and preemption class engine-side.
 _ROUTER_ASSERTED = {"x-tenant", "x-priority"}
+
+
+def _loop_measure(state, component: str):
+    """On-loop attribution for a synchronous section (--loop-monitor).
+    A no-op context when the monitor is off."""
+    monitor = getattr(state, "loop_monitor", None)
+    if monitor is None:
+        return contextlib.nullcontext()
+    return monitor.components.measure(component)
+
+
+def _loop_wrap(state, component: str, coro):
+    """On-loop attribution for an awaited coroutine (--loop-monitor):
+    only the synchronous resume slices count, awaited time does not.
+    Returns the coroutine untouched when the monitor is off."""
+    monitor = getattr(state, "loop_monitor", None)
+    if monitor is None:
+        return coro
+    return monitor.components.wrap(component, coro)
 
 
 def _forward_headers(request: web.Request) -> dict:
@@ -289,11 +309,12 @@ async def route_general_request(
     if qos is not None:
         from production_stack_tpu.router import metrics as router_metrics
 
-        qos.maybe_reload()
-        tenant = qos.resolve(request.headers.get("Authorization"))
-        priority = qos.request_priority(
-            tenant, request.headers.get("X-Priority"))
-        verdict = qos.admit(tenant, request_json)
+        with _loop_measure(state, "qos_admission"):
+            qos.maybe_reload()
+            tenant = qos.resolve(request.headers.get("Authorization"))
+            priority = qos.request_priority(
+                tenant, request.headers.get("X-Priority"))
+            verdict = qos.admit(tenant, request_json)
         qos_headers = dict(verdict.headers)
         qos_headers["x-tenant"] = tenant.name
         if not verdict.admitted:
@@ -415,7 +436,9 @@ async def route_general_request(
         router_metrics.tenant_queued.labels(tenant=tenant.name).inc()
         queue_t0 = time.time()
         try:
-            lease = await qos.lease(tenant, priority, request_json)
+            lease = await _loop_wrap(
+                state, "qos_admission",
+                qos.lease(tenant, priority, request_json))
         except ShedError as e:
             router_metrics.tenant_shed.labels(tenant=tenant.name).inc()
             if trace is not None:
@@ -489,9 +512,11 @@ async def route_general_request(
 
             pull_span = (
                 trace.start_span("router.kv_pull") if trace else None)
-            pull = await fleet.maybe_pull(
-                server_url, _extract_prompt(request_json) or "",
-                request_json, request_id)
+            pull = await _loop_wrap(
+                state, "fleet_pull",
+                fleet.maybe_pull(
+                    server_url, _extract_prompt(request_json) or "",
+                    request_json, request_id))
             if pull_span is not None:
                 if pull is None:
                     pull_span.finish(outcome="skip")
@@ -639,9 +664,11 @@ async def route_general_request(
                     if slo_chunks > 1:
                         inter_s = ((slo_last_chunk - slo_first_chunk)
                                    / (slo_chunks - 1))
-                    slo_outcome = slo.latency_outcome(
-                        tenant.name if tenant else None, requested_model,
-                        ttft_s=ttft_s, inter_token_s=inter_s)
+                    with _loop_measure(state, "slo_classify"):
+                        slo_outcome = slo.latency_outcome(
+                            tenant.name if tenant else None,
+                            requested_model,
+                            ttft_s=ttft_s, inter_token_s=inter_s)
 
             # Post-request hooks: semantic cache store + callbacks (reference :129-137).
             if state.semantic_cache is not None and endpoint.endswith("chat/completions"):
@@ -680,8 +707,9 @@ async def route_general_request(
                     outcome = "client_abort"
                 else:
                     outcome = "failed"
-            slo.observe(outcome, tenant.name if tenant else None,
-                        requested_model)
+            with _loop_measure(state, "slo_classify"):
+                slo.observe(outcome, tenant.name if tenant else None,
+                            requested_model)
         if lease is not None:
             lease.release()
         if qos is not None and tenant is not None:

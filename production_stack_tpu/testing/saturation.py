@@ -26,8 +26,18 @@ requests allowed to go unclassified, so reconciliation tightens to
 ``responses <= classified <= offered`` on shedding rungs and stays
 exact everywhere else.
 
+The router runs with ``--loop-monitor`` on, so every rung also records
+event-loop evidence: ``loop_lag_p99_s`` (scheduling-lag p99 over the
+rung's own samples), ``loop_stall_s`` (lag-measured stall seconds),
+``loop_stall_attributed_s`` / ``loop_stall_attribution`` (how much of
+that stall time the blocking-call watchdog pinned to named
+``file:line:func`` frames), and ``top_blockers`` (the rung's top-3
+frames by stall seconds). This is the scale-out decision artifact
+ROADMAP item 3 asks for: the knee rung names the code holding the loop,
+not just the rung where goodput collapsed.
+
 Used by ``bench.py`` (BENCH_SATURATION=1, artifact
-``BENCH_SATURATION_r12.json``) and, at toy scale, by
+``BENCH_SATURATION_r13.json``) and, at toy scale, by
 ``tests/test_slo.py``.
 """
 
@@ -152,8 +162,21 @@ async def run_saturation(*, steps=DEFAULT_STEPS,
     # Ring must hold a whole rung so the per-rung overhead slice is the
     # full rung population, not whatever survived eviction.
     args.trace_buffer = max(1024, max(steps) * requests_per_user)
+    # Event-loop introspection on: per-rung lag percentiles + the
+    # blocking-call watchdog's frame attribution are the point of the
+    # artifact.
+    args.loop_monitor = True
     router_app = build_app(args)
     state = router_app["state"]
+    # Swap in a monitor whose lag ring holds hours of ticks: per-rung
+    # percentiles must cover the whole rung, not the last few minutes.
+    # (Replaced before startup; on_startup starts whatever is attached.)
+    from production_stack_tpu.obs.looplag import LoopMonitor
+
+    state.loop_monitor = LoopMonitor(
+        "tpu-stack-router",
+        stall_threshold_s=state.loop_monitor.stall_threshold_s,
+        capacity=1 << 18)
     router_runner, router_url = await _start(router_app)
 
     rungs: List[dict] = []
@@ -168,6 +191,11 @@ async def run_saturation(*, steps=DEFAULT_STEPS,
                 recorder = state.trace_recorder
                 overhead_before = len(
                     recorder.root_attribute_values("overhead_s"))
+                monitor = state.loop_monitor
+                lag_seq0 = monitor.seq()
+                stall_s0 = monitor.stall_s_sum
+                attributed0 = monitor.detector.stall_s_attributed
+                blockers0 = monitor.detector.blocker_snapshot()
                 latencies: List[float] = []
                 failed = [0]
                 unreached = [0]
@@ -212,6 +240,28 @@ async def run_saturation(*, steps=DEFAULT_STEPS,
                 goodput = round(good / classified, 4) if classified else None
                 overhead_vals = recorder.root_attribute_values(
                     "overhead_s")[overhead_before:]
+                # Event-loop evidence for this rung: lag percentiles
+                # over the rung's own tick samples, stall seconds from
+                # the lag ring (the measured quantity), and the
+                # watchdog's frame attribution delta (the explanation).
+                loop_pct = monitor.percentiles(since_seq=lag_seq0)
+                loop_stall_s = monitor.stall_s_sum - stall_s0
+                loop_attr_s = (monitor.detector.stall_s_attributed
+                               - attributed0)
+                blockers1 = monitor.detector.blocker_snapshot()
+                blocker_deltas = []
+                for key, rec in blockers1.items():
+                    before = blockers0.get(key, {"stalls": 0,
+                                                 "stall_s": 0.0})
+                    delta_s = rec["stall_s"] - before["stall_s"]
+                    if delta_s > 0:
+                        blocker_deltas.append({
+                            "frame": key,
+                            "stalls": rec["stalls"] - before["stalls"],
+                            "stall_s": round(delta_s, 6),
+                        })
+                blocker_deltas.sort(key=lambda b: b["stall_s"],
+                                    reverse=True)
                 completed = len(latencies)
                 responses = total - unreached[0]
                 rps = round(completed / elapsed, 1) if elapsed else None
@@ -241,6 +291,20 @@ async def run_saturation(*, steps=DEFAULT_STEPS,
                     "goodput": goodput,
                     "router_overhead_p99": round(_p99(overhead_vals), 6)
                     if overhead_vals else None,
+                    "loop_lag_p99_s": loop_pct["p99"],
+                    "loop_lag_max_s": loop_pct["max"],
+                    "loop_stall_s": round(loop_stall_s, 6),
+                    "loop_stall_attributed_s": round(loop_attr_s, 6),
+                    # Share of lag-measured stall time the watchdog
+                    # pinned to named frames. Sampling charges wall time
+                    # between polls, so the ratio can slightly exceed 1
+                    # (the lag ring only sees a stall once the next tick
+                    # lands); None when the rung had no stalls to
+                    # attribute.
+                    "loop_stall_attribution": (
+                        round(loop_attr_s / loop_stall_s, 4)
+                        if loop_stall_s > 0 else None),
+                    "top_blockers": blocker_deltas[:3],
                 }
                 rungs.append(rung)
                 if rps is not None and (knee is None):
@@ -270,6 +334,12 @@ async def run_saturation(*, steps=DEFAULT_STEPS,
         "knee_goodput": knee["goodput"] if knee else None,
         "router_overhead_p99_at_knee":
             knee["router_overhead_p99"] if knee else None,
+        "loop_lag_p99_at_knee": knee["loop_lag_p99_s"] if knee else None,
+        "loop_stall_attribution_at_knee":
+            knee["loop_stall_attribution"] if knee else None,
+        "loop_top_blockers_at_knee":
+            knee["top_blockers"] if knee else None,
+        "loop_summary": state.loop_monitor.summary(),
         "goodput_5m_final": round(goodput_5m, 4)
         if goodput_5m is not None else None,
         "outcomes_total": state.slo.counts(),

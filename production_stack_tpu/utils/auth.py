@@ -40,10 +40,17 @@ def is_gated(path: str) -> bool:
 # the served artifact dir beneath it) is privileged for the same reason:
 # a profiler trace steals device time and writes to disk. The router's
 # /debug/events journal exposes control-plane topology (endpoint URLs,
-# breaker/lease churn) and is gated the same way.
+# breaker/lease churn) and is gated the same way. The remaining /debug
+# surfaces are read-only but leak operational detail all the same —
+# traces carry request ids, backend URLs, and slow-request timelines,
+# steps carry workload shape, and the loop monitor names source
+# locations of blocking code — so the whole /debug tree requires the
+# deployment key when one is set.
 _PRIVILEGED_EXACT = frozenset({"/kv/deregister", "/debug/profile",
-                               "/debug/events"})
-_PRIVILEGED_PREFIXES = ("/autoscale/", "/debug/profile/")
+                               "/debug/events", "/debug/traces",
+                               "/debug/steps", "/debug/loop"})
+_PRIVILEGED_PREFIXES = ("/autoscale/", "/debug/profile/",
+                        "/debug/traces/")
 
 
 def is_privileged(path: str) -> bool:

@@ -58,6 +58,25 @@ def test_engine_requires_bearer_key():
                     assert resp.status == 200
                 async with s.get(f"{base}/is_sleeping") as resp:
                     assert resp.status == 200
+                # The whole /debug tree is privileged: traces leak
+                # request ids and backend URLs, steps leak workload
+                # shape (utils/auth.py _PRIVILEGED_EXACT).
+                async with s.get(f"{base}/debug/traces") as resp:
+                    assert resp.status == 401
+                async with s.get(f"{base}/debug/traces/rid") as resp:
+                    assert resp.status == 401
+                async with s.get(f"{base}/debug/steps") as resp:
+                    assert resp.status == 401
+                async with s.get(
+                        f"{base}/debug/traces",
+                        headers={"Authorization":
+                                 f"Bearer {KEY}"}) as resp:
+                    assert resp.status == 200
+                async with s.get(
+                        f"{base}/debug/steps",
+                        headers={"Authorization":
+                                 f"Bearer {KEY}"}) as resp:
+                    assert resp.status == 200
                 # Correct key -> served.
                 async with s.post(
                         f"{base}/v1/completions", json=body,
@@ -123,11 +142,33 @@ def test_router_edge_auth_and_shared_key_passthrough():
                 async with s.post(f"{base}/kv/deregister",
                                   json={"instance_id": "x"}) as resp:
                     assert resp.status == 401
+                # Read-only /debug surfaces are gated too: traces
+                # carry request ids, endpoint URLs, and slow-request
+                # timelines.
+                async with s.get(f"{base}/debug/traces") as resp:
+                    assert resp.status == 401
+                async with s.get(f"{base}/debug/traces/rid") as resp:
+                    assert resp.status == 401
+                async with s.get(f"{base}/debug/steps") as resp:
+                    assert resp.status == 401
+                async with s.get(f"{base}/debug/loop") as resp:
+                    assert resp.status == 401
+                auth_hdr = {"Authorization": f"Bearer {KEY}"}
+                async with s.get(f"{base}/debug/traces",
+                                 headers=auth_hdr) as resp:
+                    assert resp.status == 200
+                # /debug/steps is engine-only and --loop-monitor is off
+                # here: authenticated callers see 404, never 401.
+                async with s.get(f"{base}/debug/steps",
+                                 headers=auth_hdr) as resp:
+                    assert resp.status == 404
+                async with s.get(f"{base}/debug/loop",
+                                 headers=auth_hdr) as resp:
+                    assert resp.status == 404
                 # With the deployment key they pass the gate: the
                 # autoscaler is not enabled here (404, not 401), the
                 # deregister succeeds, and the non-destructive /kv
                 # reporting channel stays open to keyless engines.
-                auth_hdr = {"Authorization": f"Bearer {KEY}"}
                 async with s.post(f"{base}/autoscale/scale_in",
                                   json={}, headers=auth_hdr) as resp:
                     assert resp.status == 404

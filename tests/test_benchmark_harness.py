@@ -88,8 +88,11 @@ def test_committed_saturation_artifact_schema():
     rung, 4 replicas, outcome classifier reconciling on every rung —
     exactly when every request reached the router, and bounded by
     responses-received when the kernel shed connections at the socket
-    layer (``unreached``) before the router could accept them."""
-    data = json.load(open(os.path.join(REPO, "BENCH_SATURATION_r12.json")))
+    layer (``unreached``) before the router could accept them. Since
+    r13 every rung also carries event-loop evidence (--loop-monitor is
+    forced on in the harness): windowed lag rollups, stalled seconds,
+    the watchdog's attribution ratio, and the top blocking frames."""
+    data = json.load(open(os.path.join(REPO, "BENCH_SATURATION_r13.json")))
     assert data["metric"] == "router_saturation"
     assert data["meta"]["schema"] == 1
     assert data["replicas"] == 4
@@ -102,8 +105,41 @@ def test_committed_saturation_artifact_schema():
             assert classified == rung["requests"]
         else:
             assert rung["responses"] <= classified <= rung["requests"]
+        # Per-rung loop evidence: lag rollups always present; the
+        # attribution ratio exists exactly when the rung stalled, and
+        # the watchdog must then have pinned >=80% of the stalled time
+        # to named frames (watermark accounting can exceed 1.0: the
+        # watchdog's poll clock and the tick's lag clock straddle rung
+        # boundaries independently).
+        assert rung["loop_lag_p99_s"] >= 0.0
+        assert rung["loop_lag_max_s"] >= rung["loop_lag_p99_s"]
+        assert rung["loop_stall_s"] >= 0.0
+        if rung["loop_stall_s"] > 0:
+            assert rung["loop_stall_attribution"] is not None
+            assert rung["loop_stall_attribution"] >= 0.8
+            assert rung["top_blockers"], "stalled rung with no blockers"
+            for blocker in rung["top_blockers"][:3]:
+                assert ":" in blocker["frame"]
+                assert blocker["stall_s"] > 0
+        else:
+            assert rung["loop_stall_attribution"] is None
     assert any(r["goodput"] is not None for r in data["rungs"])
     assert data["value"] is None or data["value"] > 0
+    # The knee-rung evidence is repeated at top level next to the
+    # capacity verdict, and the lifetime summary reconciles with it.
+    if data["knee_users"] is not None:
+        knee = next(r for r in data["rungs"]
+                    if r["users"] == data["knee_users"])
+        assert data["loop_lag_p99_at_knee"] == knee["loop_lag_p99_s"]
+        assert data["loop_stall_attribution_at_knee"] == \
+            knee["loop_stall_attribution"]
+        assert data["loop_top_blockers_at_knee"] == knee["top_blockers"]
+        if data["loop_stall_attribution_at_knee"] is not None:
+            assert data["loop_stall_attribution_at_knee"] >= 0.8
+    summary = data["loop_summary"]
+    assert summary["service"] == "tpu-stack-router"
+    assert summary["samples_total"] >= len(data["rungs"])
+    assert set(summary["stalls"]) == {"1x", "5x", "20x"}
 
 
 def test_plot_table(tmp_path, monkeypatch):
