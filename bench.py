@@ -213,6 +213,19 @@ FLEET_USERS = _env_int("BENCH_FLEET_USERS", 10)
 FLEET_ROUNDS = _env_int("BENCH_FLEET_ROUNDS", 3)
 FLEET_CONCURRENCY = _env_int("BENCH_FLEET_CONCURRENCY", 4)
 FLEET_TTFT = _env_float("BENCH_FLEET_TTFT", 0.2)
+# KV pull-economics A/B: BENCH_KV_ECON=1 runs the hermetic crossover
+# sweep (testing/kv_economics_ab.py) — shared-prefix groups of several
+# lengths through the real router at a range of --fleet-min-match-chars
+# thresholds, against 3 fake replicas with a parameterized
+# transfer-latency model. Writes BENCH_KV_ECON_OUT (default
+# BENCH_KV_ECON_r15.json) with the measured pull-vs-recompute crossover
+# and whether the ledger-fed advisor's recommendation lands inside the
+# empirically-optimal threshold band.
+KV_ECON = _env_int("BENCH_KV_ECON", 0)
+KV_ECON_OUT = os.environ.get("BENCH_KV_ECON_OUT", "BENCH_KV_ECON_r15.json")
+KV_ECON_REUSE = _env_int("BENCH_KV_ECON_REUSE", 2)
+KV_ECON_PULL_BASE = _env_float("BENCH_KV_ECON_PULL_BASE", 0.12)
+KV_ECON_S_PER_BYTE = _env_float("BENCH_KV_ECON_S_PER_BYTE", 1e-6)
 # Structured-output A/B: BENCH_STRUCTURED=1 runs the conformance +
 # mask-overhead harness (testing/structured_ab.py) — the 30-case corpus
 # through the real router to fake engines on both request surfaces,
@@ -809,6 +822,26 @@ def _fleet_main() -> None:
     print(json.dumps(result))
 
 
+def _kv_econ_main() -> None:
+    """BENCH_KV_ECON=1: the KV pull-economics crossover sweep. Fully
+    hermetic (fake engines), so this branch never imports jax or touches
+    a device. Per-request router INFO logging is squelched — the sweep
+    is ~75 sequential timed requests and the lines drown the result."""
+    import logging
+
+    from production_stack_tpu.testing.kv_economics_ab import run_kv_econ_ab
+
+    for name in ("production_stack_tpu.router.request_service",
+                 "production_stack_tpu.kv.fleet"):
+        logging.getLogger(name).setLevel(logging.WARNING)
+    result = asyncio.run(run_kv_econ_ab(
+        reuse_per_group=KV_ECON_REUSE, pull_base_s=KV_ECON_PULL_BASE,
+        s_per_byte=KV_ECON_S_PER_BYTE))
+    result["backend"] = "fake"
+    _write_artifact(KV_ECON_OUT, result)
+    print(json.dumps({k: v for k, v in result.items() if k != "legs"}))
+
+
 def _structured_main() -> None:
     """BENCH_STRUCTURED=1: corpus conformance (router + fake engines)
     plus the mask-overhead A/B on the real CPU engine."""
@@ -924,6 +957,9 @@ def main() -> None:
         return
     if FLEET:
         _fleet_main()
+        return
+    if KV_ECON:
+        _kv_econ_main()
         return
     if STRUCTURED:
         _structured_main()

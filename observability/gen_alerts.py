@@ -163,6 +163,32 @@ def build_alerts():
                     "row and GET /debug/steps."),
             ],
         },
+        {
+            "name": "tpu-stack-kv-economics",
+            "rules": [
+                rule(
+                    "FleetPullsLosingMoney",
+                    "(sum(rate(vllm_router:kv_pull_losses_total[10m])) "
+                    "/ clamp_min("
+                    "sum(rate(vllm_router:kv_pull_wins_total[10m])) + "
+                    "sum(rate(vllm_router:kv_pull_losses_total[10m])), "
+                    "1e-9)) > 0.5",
+                    "15m", "warning",
+                    "Most fleet KV pulls cost more than recomputing",
+                    "Over half of completed /kv/pull transfers are "
+                    "classified as losses by the pull ledger: the "
+                    "estimated prefill recompute time of the tokens "
+                    "they injected is LESS than the pull's wall time, "
+                    "sustained for 15m. The matched prefixes are below "
+                    "the transfer crossover — raise "
+                    "--fleet-min-match-chars toward the "
+                    "recommended_min_match_chars on GET "
+                    "/debug/kv/economics (or enable "
+                    "--fleet-auto-min-match), or fix the slow "
+                    "inter-replica path the bandwidth estimate will "
+                    "be showing."),
+            ],
+        },
     ]
     return {
         "apiVersion": "monitoring.coreos.com/v1",

@@ -650,6 +650,43 @@ def build_dashboard():
              "the anti-entropy resync is doing the healing"))
     y += 7
 
+    # ---- Row: KV Economics (pull ledger + crossover advisor) ------------ #
+    panels.append(row("KV Economics", y)); y += 1
+    panels.append(panel(
+        "timeseries", "Pull ledger: wins vs losses (rate)",
+        [target("sum(rate(vllm_router:kv_pull_wins_total[5m]))",
+                legend="wins"),
+         target("sum(rate(vllm_router:kv_pull_losses_total[5m]))",
+                legend="losses")],
+        grid(7, 8, 0, y),
+        desc="Each completed /kv/pull classified by the pull ledger: a "
+             "win saved net latency (estimated recompute time of the "
+             "tokens it injected exceeded its wall time), a loss would "
+             "have been faster to recompute. Sustained losses > wins "
+             "means --fleet-min-match-chars is below the transfer "
+             "crossover — see /debug/kv/economics for the advisor's "
+             "recommendation"))
+    panels.append(panel(
+        "timeseries", "Net prefill seconds saved (running sum)",
+        [target("vllm_router:kv_pull_net_seconds_saved_total",
+                legend="net saved")],
+        grid(7, 8, 8, y), unit="s",
+        desc="Signed running sum of (estimated recompute seconds - "
+             "pull seconds) over every fleet pull; it goes DOWN on "
+             "losing pulls. Flat or falling while pull volume is "
+             "nonzero means the fleet cache is burning latency, not "
+             "saving it"))
+    panels.append(panel(
+        "timeseries", "KV page occupancy by tier",
+        [target("tpu:kv_page_occupancy",
+                legend="{{instance}} {{tier}}")],
+        grid(7, 8, 16, y),
+        desc="Engine-side KV pages resident in the HBM pool vs parked "
+             "in the host-RAM offload tier; resident pinned at the "
+             "pool size with a growing offload tier is the signature "
+             "of a working set bigger than HBM"))
+    y += 7
+
     # ---- Row 12: Performance Introspection (step flight recorder) ------- #
     panels.append(row("Performance Introspection", y)); y += 1
     panels.append(panel(

@@ -2197,6 +2197,13 @@ class EngineCore:
             "cached_tokens_total": self.cached_tokens_total,
             "generation_tokens_total": self.generation_tokens_total,
             "offload": self.offload.stats() if self.offload else None,
+            # Page residency split: HBM pages currently allocated vs
+            # pages living in the offload tier (host RAM / remote L3).
+            "kv_page_occupancy": {
+                "resident": self.num_blocks - alloc.num_free,
+                "offload": (self.offload.stats()["blocks"]
+                            if self.offload else 0),
+            },
             "requests_finished_total": self.requests_finished_total,
             "prefix_evicts_total": self.prefix_evicts_total,
             "evict_listener_errors_total": self.evict_listener_errors_total,

@@ -648,9 +648,19 @@ class EngineServer:
         )
 
         add_debug_routes(r, self.trace_recorder)
-        # Step flight recorder (per-step kind/wall/roofline records).
+        # Step flight recorder (per-step kind/wall/roofline records),
+        # with the live resident/offload page-occupancy split folded in.
         if self.core.step_recorder is not None:
-            add_step_debug_routes(r, self.core.step_recorder)
+            def _occupancy_stats() -> dict:
+                alloc = self.core.kv_mgr.allocator
+                return {"kv_page_occupancy": {
+                    "resident": self.core.num_blocks - alloc.num_free,
+                    "offload": (self.core.offload.stats()["blocks"]
+                                if self.core.offload else 0),
+                }}
+
+            add_step_debug_routes(r, self.core.step_recorder,
+                                  extra_stats=_occupancy_stats)
         # Programmatic profiler capture + served artifacts (privileged).
         r.add_post("/debug/profile", self.handle_debug_profile)
         r.add_get("/debug/profile/artifacts", self.handle_profile_artifacts)
@@ -2371,6 +2381,14 @@ class EngineServer:
             f"{s['preempted_by_priority']['batch']}",
             "# TYPE tpu:num_kv_blocks gauge",
             f"tpu:num_kv_blocks{{{labels}}} {s['num_blocks']}",
+            # Page residency split (tier=resident is HBM-allocated pages;
+            # tier=offload counts pages in the host/remote tier — 0 when
+            # no offload tier is configured).
+            "# TYPE tpu:kv_page_occupancy gauge",
+            f"tpu:kv_page_occupancy{{{labels},tier=\"resident\"}} "
+            f"{s['kv_page_occupancy']['resident']}",
+            f"tpu:kv_page_occupancy{{{labels},tier=\"offload\"}} "
+            f"{s['kv_page_occupancy']['offload']}",
             "# TYPE tpu:hbm_headroom_bytes gauge",
             f"tpu:hbm_headroom_bytes{{{labels}}} {headroom}",
             # KV cache storage cost per token slot (int8 KV cache roughly

@@ -78,7 +78,7 @@ def claim_digest(keys: "Set[int]") -> Tuple[int, int]:
 
 
 class _Node:
-    __slots__ = ("children", "instances")
+    __slots__ = ("children", "instances", "hits")
 
     def __init__(self):
         self.children: Dict[int, "_Node"] = {}
@@ -89,6 +89,9 @@ class _Node:
         # lookup. Live prefixes stay fresh because engines re-admit on
         # every served request.
         self.instances: Dict[str, float] = {}
+        # Reuse count: how many lookups terminated at this node as their
+        # deepest live match (GET /debug/kv/trie hottest-prefix ranking).
+        self.hits = 0
 
 
 class KVController:
@@ -347,6 +350,70 @@ class KVController:
                 })
             return out
 
+    async def trie_snapshot(self, top: int = 10) -> dict:
+        """Operator view of the chunk-hash trie (GET /debug/kv/trie):
+        per-instance claim counts (incl. ``__l3__``), node-depth
+        distribution, an approximate in-memory footprint, and the top-N
+        hottest prefixes by lookup reuse count. One locked walk; sized
+        for a debug endpoint, not the request path."""
+        import sys
+
+        async with self._lock:
+            node_count = 0
+            claim_count = 0
+            approx_bytes = 0
+            max_depth = 0
+            depth_distribution: Dict[int, int] = {}
+            claims_by_instance: Dict[str, int] = {}
+            hot: List[Tuple[int, int, tuple, "_Node"]] = []
+            stack: List[Tuple["_Node", int, tuple]] = [
+                (self._root, 0, ())]
+            while stack:
+                node, depth, path = stack.pop()
+                node_count += 1
+                approx_bytes += (sys.getsizeof(node)
+                                 + sys.getsizeof(node.children)
+                                 + sys.getsizeof(node.instances))
+                if depth > 0:
+                    depth_distribution[depth] = \
+                        depth_distribution.get(depth, 0) + 1
+                    max_depth = max(max_depth, depth)
+                for instance_id in node.instances:
+                    claim_count += 1
+                    claims_by_instance[instance_id] = \
+                        claims_by_instance.get(instance_id, 0) + 1
+                if node.hits > 0:
+                    hot.append((node.hits, depth, path, node))
+                for h, child in node.children.items():
+                    stack.append((child, depth + 1, path + (h,)))
+            hot.sort(key=lambda item: (-item[0], item[1]))
+            now = time.time()
+            hottest = [{
+                "hits": hits,
+                "depth": depth,
+                "approx_chars": depth * self.chunk_size,
+                # The trie stores chunk hashes, not text: the path is the
+                # prefix's identity (matches path_keys/claim digests).
+                "chunk_hashes": [format(h, "016x") for h in path],
+                "holders": sorted(
+                    i for i, ts in node.instances.items()
+                    if i in self._instances and self._fresh(ts, now)),
+            } for hits, depth, path, node in hot[:max(int(top), 0)]]
+            return {
+                "chunk_size": self.chunk_size,
+                "nodes": node_count,
+                "claims": claim_count,
+                "max_depth": max_depth,
+                "approx_memory_bytes": approx_bytes,
+                # JSON object keys are strings; keep depths sorted.
+                "depth_distribution": {
+                    str(d): depth_distribution[d]
+                    for d in sorted(depth_distribution)},
+                "claims_by_instance": dict(
+                    sorted(claims_by_instance.items())),
+                "hottest_prefixes": hottest,
+            }
+
     async def deregister_instance(self, instance_id: str) -> None:
         async with self._lock:
             self._instances.pop(instance_id, None)
@@ -457,6 +524,10 @@ class KVController:
                 if L3_INSTANCE in live:
                     l3_matched = matched
                 node = nxt
+            if matched > 0:
+                # ``node`` is the deepest chunk with a live claim — this
+                # lookup reused the prefix ending there.
+                node.hits += 1
             if best_engines and engine_matched >= l3_matched:
                 matched_chars = min(engine_matched * self.chunk_size,
                                     len(text))

@@ -1,0 +1,279 @@
+"""Measured fleet-pull economics: the ledger behind the crossover advisor.
+
+The fleet tier (``kv/fleet.py``) can move a prefix's KV blocks across
+replicas instead of recomputing them — but a pull is only worth its
+round-trip when the transfer is faster than the prefill it replaces.
+This module answers that question from *measurement*, not configuration:
+
+- :class:`PullLedger` lands one record per orchestrated pull in a
+  bounded ring: bytes moved, tokens saved, pull wall time, the holder it
+  came from, and an estimated recompute cost derived from prefill
+  throughput (a live measured source where one is wired, else the
+  configured tokens/s floor). Each record is classified **win** or
+  **loss** by net latency (``est_recompute_s - pull_s``); failed and
+  holder-rejected pulls are always losses with zero tokens saved.
+- :meth:`PullLedger.advise` fits the measured transfer model
+  (``pull_s ≈ overhead + bytes / bandwidth``) over *successful* pulls
+  only — failures must not skew the bandwidth estimate — and computes
+  the break-even match length: the shortest prefix for which pulling
+  beats recomputing. Served on ``GET /debug/kv/economics`` as a
+  recommended ``--fleet-min-match-chars``, and applied on a damped
+  interval when ``--fleet-auto-min-match`` is set.
+
+Stdlib-only, like ``obs/``: the ledger itself exports nothing — the
+fleet cache increments ``vllm_router:kv_pull_{wins,losses}_total`` and
+``vllm_router:kv_pull_net_seconds_saved_total`` from the classification
+this module returns, so flag-off deployments emit no series.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from collections import deque
+from typing import Callable, Deque, List, Optional, Tuple
+
+DEFAULT_CAPACITY = 512
+# chars per token for the break-even conversion: the controller trie is
+# character-chunked, so the advisor's output unit is chars. ~4 chars per
+# (BPE) token is the usual English-text rule of thumb.
+DEFAULT_CHARS_PER_TOKEN = 4.0
+# Conservative recompute floor when no measured prefill throughput is
+# wired: well below a real TPU prefill rate, so the advisor errs toward
+# "recompute is cheap" (longer recommended min-match) rather than
+# overselling pulls.
+DEFAULT_PREFILL_TPS_FLOOR = 2000.0
+
+
+def step_recorder_prefill_tps(recorder) -> Optional[float]:
+    """Measured prefill tokens/s from a StepRecorder's per-kind rollups
+    (``obs/steps.py``): tokens over wall seconds across the prefill and
+    prefill_chunk kinds. None when the recorder has no prefill samples —
+    the caller falls back to its configured floor."""
+    try:
+        stats = recorder.kind_stats()
+    except Exception:  # noqa: BLE001 - recorder is optional telemetry
+        return None
+    tokens = 0.0
+    wall = 0.0
+    for kind in ("prefill", "prefill_chunk"):
+        s = stats.get(kind) or {}
+        tokens += float(s.get("tokens", 0) or 0)
+        wall += float(s.get("wall_s", 0.0) or 0.0)
+    if tokens <= 0 or wall <= 0:
+        return None
+    return tokens / wall
+
+
+class PullLedger:
+    """Bounded ring of fleet-pull outcomes plus the economics derived
+    from it. Single event loop, no locking (same contract as ``obs/``).
+
+    ``prefill_tps_fn``: optional zero-arg callable returning a measured
+    prefill tokens/s (or None). When it yields a positive value the
+    recompute estimate uses it (source ``measured``); otherwise the
+    configured ``prefill_tokens_per_s_floor`` applies (source ``floor``).
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 prefill_tokens_per_s_floor: float = DEFAULT_PREFILL_TPS_FLOOR,
+                 prefill_tps_fn: Optional[Callable[[], Optional[float]]] = None,
+                 chars_per_token: float = DEFAULT_CHARS_PER_TOKEN):
+        self.capacity = int(capacity)
+        self.prefill_tokens_per_s_floor = float(prefill_tokens_per_s_floor)
+        self.prefill_tps_fn = prefill_tps_fn
+        self.chars_per_token = float(chars_per_token)
+        self._records: Deque[dict] = deque(maxlen=self.capacity)
+        # Transfer-model samples: (bytes, seconds) of SUCCESSFUL pulls
+        # that actually moved bytes. Failure paths never land here.
+        self._bw_samples: Deque[Tuple[float, float]] = deque(
+            maxlen=self.capacity)
+        # bytes-per-token ratio accumulators (successful pulls only).
+        self._bpt_bytes = 0.0
+        self._bpt_tokens = 0.0
+        self.recorded_total = 0
+        self.wins = 0
+        self.losses = 0
+        self.net_seconds_saved_total = 0.0
+        self.bytes_moved_total = 0
+        self.tokens_saved_total = 0
+        self.pull_seconds_total = 0.0
+
+    # -- recompute model ---------------------------------------------------
+    def prefill_tokens_per_s(self) -> Tuple[float, str]:
+        """(tokens/s, source) — measured when the wired source has data,
+        else the configured floor."""
+        if self.prefill_tps_fn is not None:
+            try:
+                measured = self.prefill_tps_fn()
+            except Exception:  # noqa: BLE001 - source is best-effort
+                measured = None
+            if measured is not None and measured > 0:
+                return float(measured), "measured"
+        return self.prefill_tokens_per_s_floor, "floor"
+
+    # -- recording ---------------------------------------------------------
+    def record(self, *, server_url: str, holder: str, holder_url: str,
+               matched_chars: int, outcome: str, bytes_moved: int = 0,
+               tokens_saved: int = 0, pull_seconds: float = 0.0) -> dict:
+        """Land one pull outcome; returns the classified record.
+
+        Any outcome other than ``ok`` is a loss with zero tokens saved by
+        definition — a failed transfer saved nothing and cost its wall
+        time — and contributes nothing to the transfer model.
+        """
+        ok = outcome == "ok"
+        if not ok:
+            tokens_saved = 0
+            bytes_moved = 0
+        tps, tps_source = self.prefill_tokens_per_s()
+        est_recompute_s = tokens_saved / tps if ok and tokens_saved > 0 \
+            else 0.0
+        net = est_recompute_s - pull_seconds
+        win = ok and net > 0
+        rec = {
+            "t": time.time(),
+            "server_url": server_url,
+            "holder": holder,
+            "holder_url": holder_url,
+            "matched_chars": matched_chars,
+            "outcome": outcome,
+            "bytes_moved": int(bytes_moved),
+            "tokens_saved": int(tokens_saved),
+            "pull_seconds": round(float(pull_seconds), 6),
+            "est_recompute_seconds": round(est_recompute_s, 6),
+            "net_seconds_saved": round(net, 6),
+            "classification": "win" if win else "loss",
+            "prefill_tokens_per_s": round(tps, 3),
+            "prefill_tps_source": tps_source,
+        }
+        self._records.append(rec)
+        self.recorded_total += 1
+        if win:
+            self.wins += 1
+        else:
+            self.losses += 1
+        self.net_seconds_saved_total += net
+        self.bytes_moved_total += int(bytes_moved)
+        self.tokens_saved_total += int(tokens_saved)
+        self.pull_seconds_total += float(pull_seconds)
+        if ok and bytes_moved > 0 and pull_seconds > 0:
+            self._bw_samples.append((float(bytes_moved),
+                                     float(pull_seconds)))
+            if tokens_saved > 0:
+                self._bpt_bytes += float(bytes_moved)
+                self._bpt_tokens += float(tokens_saved)
+        return rec
+
+    # -- transfer model ----------------------------------------------------
+    def _fit(self) -> Tuple[float, float]:
+        """(overhead_s, per_byte_s): least-squares line through the
+        successful-pull samples (``seconds = overhead + bytes*per_byte``).
+        Falls back to a zero-overhead aggregate ratio when the samples
+        don't span distinct transfer sizes (a one-point line has no
+        intercept)."""
+        xs = [b for b, _ in self._bw_samples]
+        ys = [s for _, s in self._bw_samples]
+        n = len(xs)
+        total_bytes = sum(xs)
+        total_secs = sum(ys)
+        ratio = total_secs / total_bytes if total_bytes > 0 else 0.0
+        if n < 2:
+            return 0.0, ratio
+        mean_x = total_bytes / n
+        mean_y = total_secs / n
+        var = sum((x - mean_x) ** 2 for x in xs)
+        if var <= 0:
+            return 0.0, ratio
+        cov = sum((x - mean_x) * (y - mean_y) for x, y in zip(xs, ys))
+        slope = cov / var
+        intercept = mean_y - slope * mean_x
+        if slope <= 0:
+            # Noise swamped the size signal; keep the aggregate ratio and
+            # charge no fixed overhead rather than extrapolate nonsense.
+            return 0.0, ratio
+        return max(intercept, 0.0), slope
+
+    def pull_bandwidth_bytes_per_s(self) -> Optional[float]:
+        """Aggregate measured transfer bandwidth (successful pulls)."""
+        total_bytes = sum(b for b, _ in self._bw_samples)
+        total_secs = sum(s for _, s in self._bw_samples)
+        if total_bytes <= 0 or total_secs <= 0:
+            return None
+        return total_bytes / total_secs
+
+    def bytes_per_token(self) -> Optional[float]:
+        return (self._bpt_bytes / self._bpt_tokens
+                if self._bpt_tokens > 0 else None)
+
+    # -- the crossover advisor --------------------------------------------
+    def advise(self, current_min_match_chars: Optional[int] = None) -> dict:
+        """Break-even match length from the measured transfer model.
+
+        Pulling a prefix of T tokens costs ``overhead + T*bpt*per_byte``;
+        recomputing it costs ``T / prefill_tps``. Pulling wins beyond
+        ``T* = overhead / (1/tps - bpt*per_byte)`` — provided the
+        per-token transfer is cheaper than the per-token recompute at
+        all; otherwise pulling never wins and no threshold helps.
+        """
+        tps, tps_source = self.prefill_tokens_per_s()
+        out = {
+            "prefill_tokens_per_s": round(tps, 3),
+            "prefill_tps_source": tps_source,
+            "chars_per_token": self.chars_per_token,
+            "current_min_match_chars": current_min_match_chars,
+            "samples": len(self._bw_samples),
+            "bandwidth_bytes_per_s": None,
+            "overhead_seconds": None,
+            "bytes_per_token": None,
+            "breakeven_tokens": None,
+            "recommended_min_match_chars": None,
+            "pull_never_wins": False,
+            "reason": None,
+        }
+        bpt = self.bytes_per_token()
+        if not self._bw_samples or bpt is None:
+            out["reason"] = "no successful pulls measured yet"
+            return out
+        overhead, per_byte = self._fit()
+        out["overhead_seconds"] = round(overhead, 6)
+        out["bandwidth_bytes_per_s"] = (
+            round(1.0 / per_byte, 3) if per_byte > 0
+            else self.pull_bandwidth_bytes_per_s())
+        out["bytes_per_token"] = round(bpt, 3)
+        recompute_s_per_token = 1.0 / tps
+        pull_s_per_token = bpt * per_byte
+        if recompute_s_per_token <= pull_s_per_token:
+            out["pull_never_wins"] = True
+            out["reason"] = ("measured per-token transfer cost exceeds "
+                             "per-token recompute; no match length "
+                             "amortizes it")
+            return out
+        breakeven = overhead / (recompute_s_per_token - pull_s_per_token)
+        out["breakeven_tokens"] = round(breakeven, 3)
+        out["recommended_min_match_chars"] = max(
+            1, int(math.ceil(breakeven * self.chars_per_token)))
+        return out
+
+    # -- debug surface -----------------------------------------------------
+    def summary(self) -> dict:
+        return {
+            "capacity": self.capacity,
+            "recorded_total": self.recorded_total,
+            "wins": self.wins,
+            "losses": self.losses,
+            "net_seconds_saved_total": round(
+                self.net_seconds_saved_total, 6),
+            "bytes_moved_total": self.bytes_moved_total,
+            "tokens_saved_total": self.tokens_saved_total,
+            "pull_seconds_total": round(self.pull_seconds_total, 6),
+            "pull_bandwidth_bytes_per_s": self.pull_bandwidth_bytes_per_s(),
+            "bytes_per_token": self.bytes_per_token(),
+        }
+
+    def snapshot(self, limit: int = 100) -> List[dict]:
+        """Newest-first records (same ordering contract as the other
+        ``/debug`` rings)."""
+        out = list(self._records)
+        out.reverse()
+        return out[:max(int(limit), 0)]

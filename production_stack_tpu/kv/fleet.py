@@ -39,9 +39,17 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from production_stack_tpu.kv.controller import L3_INSTANCE, KVController
+from production_stack_tpu.kv.economics import (
+    DEFAULT_CHARS_PER_TOKEN, DEFAULT_PREFILL_TPS_FLOOR, PullLedger)
 from production_stack_tpu.utils.log import init_logger
 
 logger = init_logger(__name__)
+
+# Clamp range for --fleet-auto-min-match applications: the advisor's raw
+# break-even can collapse to ~0 (free transfers) or explode (slow link);
+# neither extreme is a sane routing threshold.
+AUTO_MIN_MATCH_FLOOR = 64
+AUTO_MIN_MATCH_CAP = 1_000_000
 
 
 @dataclass
@@ -56,6 +64,17 @@ class FleetCacheConfig:
     # against ONE holder replica (the holder additionally self-protects
     # with its own /kv/pull admission semaphore → 503 + Retry-After).
     pull_max_concurrency: int = 8
+    # Pull-economics ledger (kv/economics.py): recompute-cost floor used
+    # when no measured prefill throughput is wired, and the chars/token
+    # conversion for the advisor's recommended min-match.
+    prefill_tokens_per_s_floor: float = DEFAULT_PREFILL_TPS_FLOOR
+    chars_per_token: float = DEFAULT_CHARS_PER_TOKEN
+    ledger_capacity: int = 512
+    # --fleet-auto-min-match: apply the advisor's recommendation to
+    # min_match_chars on a damped interval (new = old + damping*(rec-old)).
+    auto_min_match: bool = False
+    auto_min_match_interval_s: float = 30.0
+    auto_min_match_damping: float = 0.3
 
 
 class FleetCache:
@@ -86,6 +105,67 @@ class FleetCache:
         self._single_flight: Dict[tuple, "asyncio.Task"] = {}
         self._inflight_by_holder: Dict[str, int] = {}
         self.last_attempt_by_holder: Dict[str, float] = {}
+        # Pull economics: every orchestrated pull (including rejected and
+        # failed ones) lands one classified record here; the crossover
+        # advisor reads the measured transfer model back out.
+        self.ledger = PullLedger(
+            capacity=config.ledger_capacity,
+            prefill_tokens_per_s_floor=config.prefill_tokens_per_s_floor,
+            chars_per_token=config.chars_per_token)
+        # --fleet-auto-min-match bookkeeping (apply_auto_min_match).
+        self.auto_min_match_applied = 0
+        self.auto_min_match_last: Optional[dict] = None
+
+    def _record_economics(self, server_url: str, holder: str,
+                          holder_url: str, matched_chars: int, outcome: str,
+                          bytes_moved: int = 0, tokens_saved: int = 0,
+                          pull_seconds: float = 0.0) -> dict:
+        """Land a pull in the ledger and export its classification."""
+        from production_stack_tpu.router import metrics as router_metrics
+
+        rec = self.ledger.record(
+            server_url=server_url, holder=holder, holder_url=holder_url,
+            matched_chars=matched_chars, outcome=outcome,
+            bytes_moved=bytes_moved, tokens_saved=tokens_saved,
+            pull_seconds=pull_seconds)
+        if rec["classification"] == "win":
+            router_metrics.kv_pull_wins.labels(server=server_url).inc()
+        else:
+            router_metrics.kv_pull_losses.labels(server=server_url).inc()
+        router_metrics.kv_pull_net_seconds_saved.labels(
+            server=server_url).inc(rec["net_seconds_saved"])
+        return rec
+
+    def apply_auto_min_match(self) -> dict:
+        """One --fleet-auto-min-match application step: move
+        ``min_match_chars`` toward the advisor's recommendation, damped
+        (``new = old + damping*(recommended-old)``) and clamped to
+        [AUTO_MIN_MATCH_FLOOR, AUTO_MIN_MATCH_CAP]. A no-data or
+        pull-never-wins advisory applies nothing. Called by the router's
+        background applier; public so tests can drive one step."""
+        old = self.config.min_match_chars
+        advice = self.ledger.advise(current_min_match_chars=old)
+        recommended = advice.get("recommended_min_match_chars")
+        state = {"applied": False, "old": old, "new": old,
+                 "recommended": recommended,
+                 "pull_never_wins": advice.get("pull_never_wins", False),
+                 "reason": advice.get("reason")}
+        if recommended is not None:
+            target = min(max(int(recommended), AUTO_MIN_MATCH_FLOOR),
+                         AUTO_MIN_MATCH_CAP)
+            new = int(round(
+                old + self.config.auto_min_match_damping * (target - old)))
+            new = min(max(new, AUTO_MIN_MATCH_FLOOR), AUTO_MIN_MATCH_CAP)
+            if new != old:
+                self.config.min_match_chars = new
+                logger.info(
+                    "fleet: auto-min-match %d -> %d (advisor recommends "
+                    "%d from %d measured pulls)", old, new, recommended,
+                    advice.get("samples", 0))
+            state.update({"applied": True, "new": new})
+            self.auto_min_match_applied += 1
+        self.auto_min_match_last = state
+        return state
 
     def _headers(self, request_id: str) -> Dict[str, str]:
         headers = {"X-Request-Id": request_id}
@@ -143,6 +223,8 @@ class FleetCache:
                 self.pulls_rejected += 1
                 router_metrics.kv_pull_rejected.labels(
                     server=server_url).inc()
+                self._record_economics(server_url, holder, holder_url,
+                                       matched_chars, "rejected")
                 logger.info(
                     "fleet: pull %s <- %s rejected (holder at "
                     "max concurrency %d)", server_url, holder_url,
@@ -260,6 +342,10 @@ class FleetCache:
             self.pulls_failed += 1
             router_metrics.kv_pull_failures.labels(
                 server=server_url, reason=outcome).inc()
+        self._record_economics(
+            server_url, holder, holder_url, matched_chars, outcome,
+            bytes_moved=pulled_bytes, tokens_saved=tokens_saved,
+            pull_seconds=elapsed)
         logger.info(
             "fleet pull %s <- %s (%s): %s, %d blocks, %.1f ms",
             server_url, holder_url,
@@ -280,6 +366,12 @@ class FleetCache:
             "min_match_chars": self.config.min_match_chars,
             "pull_max_concurrency": self.config.pull_max_concurrency,
             "l3_url": self.config.l3_url,
+            "economics": self.ledger.summary(),
+            "auto_min_match": {
+                "enabled": self.config.auto_min_match,
+                "applied": self.auto_min_match_applied,
+                "last": self.auto_min_match_last,
+            },
         }
 
 
@@ -438,6 +530,17 @@ def initialize_fleet(args, kv_controller, fault_tolerance=None):
                 api_key=key,
                 pull_max_concurrency=getattr(
                     args, "kv_pull_max_concurrency", 8),
+                prefill_tokens_per_s_floor=getattr(
+                    args, "fleet_prefill_tokens_per_s",
+                    DEFAULT_PREFILL_TPS_FLOOR),
+                chars_per_token=getattr(
+                    args, "fleet_chars_per_token", DEFAULT_CHARS_PER_TOKEN),
+                auto_min_match=getattr(
+                    args, "fleet_auto_min_match", False),
+                auto_min_match_interval_s=getattr(
+                    args, "fleet_auto_min_match_interval", 30.0),
+                auto_min_match_damping=getattr(
+                    args, "fleet_auto_min_match_damping", 0.3),
             ),
             kv_controller,
             fault_tolerance=fault_tolerance,

@@ -21,6 +21,14 @@ code.
   (``--loop-monitor``): lag rollups, stall buckets, per-component
   on-loop seconds, and the blocking-call watchdog's top-blockers table;
   ``?blockers=10`` bounds the table.
+- ``GET /debug/kv/economics``           -- router-only (``--fleet-cache``):
+  the pull ledger's win/loss summary, the crossover advisor's
+  recommended ``--fleet-min-match-chars``, and newest-first pull
+  records; ``?limit=50`` bounds the record list.
+- ``GET /debug/kv/trie``                -- router-only: KV controller trie
+  introspection — per-instance claim counts, depth distribution,
+  approximate memory footprint, hottest prefixes by reuse count;
+  ``?top=10`` bounds the hottest-prefix table.
 """
 
 from __future__ import annotations
@@ -66,8 +74,13 @@ def add_debug_routes(router, recorder: TraceRecorder) -> None:
     router.add_get("/debug/traces/{request_id}", get_trace)
 
 
-def add_step_debug_routes(router, recorder: StepRecorder) -> None:
-    """Attach ``GET /debug/steps`` (engine step flight recorder)."""
+def add_step_debug_routes(router, recorder: StepRecorder,
+                          extra_stats=None) -> None:
+    """Attach ``GET /debug/steps`` (engine step flight recorder).
+
+    ``extra_stats``: optional zero-arg callable returning a dict merged
+    into the summary — the engine folds its resident/offload KV
+    page-occupancy breakdown in here."""
 
     async def list_steps(request: web.Request) -> web.Response:
         try:
@@ -85,6 +98,8 @@ def add_step_debug_routes(router, recorder: StepRecorder) -> None:
                           f"(one of: {', '.join(STEP_KINDS)})"},
                 status=400)
         out = recorder.summary()
+        if extra_stats is not None:
+            out.update(extra_stats())
         out["steps"] = recorder.snapshot(limit=limit, kind=kind)
         return web.json_response(out)
 
@@ -112,6 +127,54 @@ def add_event_debug_routes(router, journal: EventJournal) -> None:
         return web.json_response(out)
 
     router.add_get("/debug/events", list_events)
+
+
+def add_kv_economics_debug_routes(router, fleet) -> None:
+    """Attach ``GET /debug/kv/economics`` (fleet pull ledger + crossover
+    advisor; router-only, registered only with ``--fleet-cache`` on —
+    same convention as the engine-only ``/debug/steps``)."""
+
+    async def economics(request: web.Request) -> web.Response:
+        try:
+            limit = int(request.query.get("limit", 100) or 100)
+        except ValueError:
+            return web.json_response(
+                {"error": "limit must be an integer"}, status=400)
+        if limit < 1:
+            return web.json_response(
+                {"error": "limit must be >= 1"}, status=400)
+        ledger = fleet.ledger
+        out = ledger.summary()
+        out["advisor"] = ledger.advise(
+            current_min_match_chars=fleet.config.min_match_chars)
+        out["auto_min_match"] = {
+            "enabled": fleet.config.auto_min_match,
+            "interval_s": fleet.config.auto_min_match_interval_s,
+            "damping": fleet.config.auto_min_match_damping,
+            "applied": fleet.auto_min_match_applied,
+            "last": fleet.auto_min_match_last,
+        }
+        out["records"] = ledger.snapshot(limit=limit)
+        return web.json_response(out)
+
+    router.add_get("/debug/kv/economics", economics)
+
+
+def add_kv_trie_debug_routes(router, controller) -> None:
+    """Attach ``GET /debug/kv/trie`` (KV controller trie introspection)."""
+
+    async def trie(request: web.Request) -> web.Response:
+        try:
+            top = int(request.query.get("top", 10) or 10)
+        except ValueError:
+            return web.json_response(
+                {"error": "top must be an integer"}, status=400)
+        if top < 1:
+            return web.json_response(
+                {"error": "top must be >= 1"}, status=400)
+        return web.json_response(await controller.trie_snapshot(top=top))
+
+    router.add_get("/debug/kv/trie", trie)
 
 
 def add_loop_debug_routes(router, monitor) -> None:

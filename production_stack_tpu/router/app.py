@@ -639,6 +639,17 @@ def build_app(args) -> web.Application:
         from production_stack_tpu.obs.debug import add_loop_debug_routes
 
         add_loop_debug_routes(app.router, state.loop_monitor)
+    # KV trie introspection (privileged via the /debug/kv/ prefix); the
+    # pull-economics ledger rides only with --fleet-cache — without it
+    # there is no ledger, and authenticated callers see 404, never 401.
+    from production_stack_tpu.obs.debug import add_kv_trie_debug_routes
+
+    add_kv_trie_debug_routes(app.router, state.kv_controller)
+    if state.fleet is not None:
+        from production_stack_tpu.obs.debug import (
+            add_kv_economics_debug_routes)
+
+        add_kv_economics_debug_routes(app.router, state.fleet)
 
     async def on_startup(app: web.Application):
         st = app["state"]
@@ -684,11 +695,33 @@ def build_app(args) -> web.Application:
             app["_lease_sweeper"] = asyncio.get_running_loop().create_task(
                 _sweeper()
             )
+        # Crossover advisor applier: with --fleet-auto-min-match, nudge
+        # the live min-match threshold toward the ledger's measured
+        # break-even on a damped interval. Flag off = no task, and
+        # min_match_chars is never written after init (parity).
+        if st.fleet is not None and st.fleet.config.auto_min_match:
+            apply_interval = st.fleet.config.auto_min_match_interval_s
+
+            async def _auto_min_match():
+                while True:
+                    await asyncio.sleep(apply_interval)
+                    try:
+                        st.fleet.apply_auto_min_match()
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:  # noqa: BLE001
+                        logger.debug("auto-min-match step failed: %s", e)
+
+            app["_auto_min_match"] = \
+                asyncio.get_running_loop().create_task(_auto_min_match())
+            logger.info(
+                "Fleet auto-min-match enabled: interval=%.1fs damping=%.2f",
+                apply_interval, st.fleet.config.auto_min_match_damping)
 
     async def on_cleanup(app: web.Application):
         from production_stack_tpu.router.httpclient import AiohttpClientWrapper
 
-        for task_key in ("_lease_sweeper", "_canary"):
+        for task_key in ("_lease_sweeper", "_canary", "_auto_min_match"):
             task = app.get(task_key)
             if task is not None:
                 task.cancel()

@@ -207,6 +207,30 @@ kv_pull_rejected = Counter(
     "(target recomputes instead)",
     _L, registry=REGISTRY)
 
+# --- KV pull economics (production_stack_tpu/kv/economics.py) ------------
+# Classified by the pull ledger: a pull WINS when its estimated recompute
+# cost (tokens saved / prefill tokens/s) exceeds its wall time, else it
+# LOSES — failed and holder-rejected pulls always lose. All labeled by
+# target server, so a fleet-off deployment emits no series.
+kv_pull_wins = Counter(
+    "vllm_router:kv_pull_wins_total",
+    "Cross-replica pulls whose estimated recompute cost exceeded the "
+    "pull wall time (net latency win)",
+    _L, registry=REGISTRY)
+kv_pull_losses = Counter(
+    "vllm_router:kv_pull_losses_total",
+    "Cross-replica pulls that cost more than the recompute they "
+    "replaced — including every failed or rejected pull",
+    _L, registry=REGISTRY)
+# A Gauge, not a Counter: the running signed sum goes DOWN when a pull
+# loses money (net = est_recompute_s - pull_s can be negative).
+kv_pull_net_seconds_saved = Gauge(
+    "vllm_router:kv_pull_net_seconds_saved_total",
+    "Running signed sum of per-pull net latency saved (estimated "
+    "recompute seconds minus pull wall seconds); negative contributions "
+    "from losing pulls included",
+    _L, registry=REGISTRY)
+
 # --- SLO engine (production_stack_tpu/router/slo.py) ---------------------
 # All labeled: series appear only once the --slo-config classifier or the
 # canary prober (--canary-interval) actually observes something, so a

@@ -134,6 +134,31 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--fleet-l3-url", type=str, default=None,
                         help="shared L3 cache server URL (kv.cache_server); "
                              "spilled evictions stay routable through it")
+    # Pull economics & the crossover advisor (kv/economics.py)
+    parser.add_argument("--fleet-prefill-tokens-per-s", type=float,
+                        default=2000.0,
+                        help="recompute-cost floor (prefill tokens/s) the "
+                             "pull ledger uses when no measured prefill "
+                             "throughput is available")
+    parser.add_argument("--fleet-chars-per-token", type=float, default=4.0,
+                        help="prompt chars per token for the advisor's "
+                             "break-even conversion (the controller trie "
+                             "is character-chunked)")
+    parser.add_argument("--fleet-auto-min-match", action="store_true",
+                        help="apply the crossover advisor's recommended "
+                             "--fleet-min-match-chars on a damped "
+                             "interval. Unset = the configured threshold "
+                             "is never touched (request path "
+                             "byte-identical)")
+    parser.add_argument("--fleet-auto-min-match-interval", type=float,
+                        default=30.0,
+                        help="seconds between auto-min-match applications")
+    parser.add_argument("--fleet-auto-min-match-damping", type=float,
+                        default=0.3,
+                        help="per-application step toward the advisor's "
+                             "recommendation (new = old + damping * "
+                             "(recommended - old)); 1.0 jumps straight "
+                             "to it")
     parser.add_argument("--kv-pull-max-concurrency", type=int, default=8,
                         help="router-side cap on concurrent /kv/pull "
                              "orchestrations against ONE holder replica; "
@@ -307,6 +332,17 @@ def validate_args(args: argparse.Namespace) -> None:
             raise ValueError("--fleet-min-match-chars must be >= 1")
         if args.kv_pull_max_concurrency < 1:
             raise ValueError("--kv-pull-max-concurrency must be >= 1")
+        if args.fleet_prefill_tokens_per_s <= 0:
+            raise ValueError("--fleet-prefill-tokens-per-s must be > 0")
+        if args.fleet_chars_per_token <= 0:
+            raise ValueError("--fleet-chars-per-token must be > 0")
+        if getattr(args, "fleet_auto_min_match", False):
+            if args.fleet_auto_min_match_interval <= 0:
+                raise ValueError(
+                    "--fleet-auto-min-match-interval must be > 0")
+            if not 0.0 < args.fleet_auto_min_match_damping <= 1.0:
+                raise ValueError(
+                    "--fleet-auto-min-match-damping must be in (0, 1]")
     if getattr(args, "kv_heartbeat_interval", 10.0) < 0:
         raise ValueError("--kv-heartbeat-interval must be >= 0 "
                          "(0 disables the lease sweeper)")

@@ -142,6 +142,17 @@ class FakeEngine:
         self.kv_pull_max_concurrency = 0
         self._pull_inflight = 0
         self.pull_delay_s = 0.0
+        # Parameterized transfer-latency model for the pull-economics
+        # ledger and the crossover A/B: a served pull reports
+        # ``transfer.bytes`` (chunks copied x bytes-per-chunk) and costs
+        # ``pull_delay_s + bytes * pull_latency_s_per_byte`` of wall time.
+        self.kv_pull_bytes_per_chunk = 4096
+        self.pull_latency_s_per_byte = 0.0
+        # Prompt-length-proportional prefill: TTFT grows by this much per
+        # prompt character (0 keeps the historical fixed-TTFT behavior).
+        # With it, recompute cost scales with prefix length the way a
+        # real prefill does — the other half of the crossover physics.
+        self.prefill_time_per_char_s = 0.0
         self.pull_requests: List[dict] = []
         self.prefix_cache_hits = 0
         self.prefix_cache_queries = 0
@@ -373,8 +384,14 @@ class FakeEngine:
             await self._kv_post("/kv/admit", {
                 "instance_id": self.instance_id, "hashes": hashes})
 
+    def _prompt_chars(self, body: dict) -> int:
+        from production_stack_tpu.router.routing_logic import _extract_prompt
+
+        return len(_extract_prompt(body) or "")
+
     async def _prefill_sleep(self, priority: str = "interactive",
-                             cached_frac: float = 0.0) -> int:
+                             cached_frac: float = 0.0,
+                             prompt_chars: int = 0) -> int:
         """TTFT wait; under the contention model it holds the engine lock
         in 1 (unchunked) or ``prefill_chunks`` (chunked) slices. Returns
         the chunk count.
@@ -383,7 +400,9 @@ class FakeEngine:
         prefill is in flight — the fake-device analog of the real
         scheduler's priority admission + preemption, so the noisy-neighbor
         A/B observes the same TTFT protection hermetically."""
-        effective_ttft = self.ttft * (1.0 - cached_frac)
+        base_ttft = (self.ttft
+                     + prompt_chars * self.prefill_time_per_char_s)
+        effective_ttft = base_ttft * (1.0 - cached_frac)
         if not self.simulate_contention:
             if effective_ttft > 0:
                 await asyncio.sleep(effective_ttft)
@@ -516,7 +535,8 @@ class FakeEngine:
                     {"error": {"message": "injected hang elapsed",
                                "type": "InternalServerError"}},
                     status=500)
-            await self._prefill_sleep(priority, cached_frac)
+            await self._prefill_sleep(priority, cached_frac,
+                                      self._prompt_chars(body))
             t_prefill_end = time.time()
             if not stream:
                 for _ in range(len(pieces)):
@@ -598,7 +618,8 @@ class FakeEngine:
         t_arrival = time.time()
         priority = self._count_request(request)
         prefix = self._prefix_hashes(body)
-        await self._prefill_sleep(priority, self._cached_fraction(prefix))
+        await self._prefill_sleep(priority, self._cached_fraction(prefix),
+                                  self._prompt_chars(body))
         await self._admit_prefix(prefix)
         t_prefill_end = time.time()
         if not stream:
@@ -779,28 +800,38 @@ class FakeEngine:
         hashes = self._prefix_hashes(body.get("request") or {})
         peer = FakeEngine._peers.get(source_url)
         self._pull_inflight += 1
+        t0 = time.monotonic()
         try:
             if self.pull_delay_s > 0:
-                # Simulated transfer time, so stampede tests can observe
-                # real overlap at the admission gate.
+                # Simulated per-pull overhead (control round-trip), so
+                # stampede tests can observe real overlap at the
+                # admission gate.
                 await asyncio.sleep(self.pull_delay_s)
             if peer is None or not hashes:
                 return web.json_response(
                     {"status": "miss", "injected_blocks": 0})
-            injected = 0
+            matched = []
             for h in hashes:
                 if h not in peer.prefix_cache:
                     break
-                self.prefix_cache.add(h)
-                injected += 1
-            if injected == 0:
+                matched.append(h)
+            if not matched:
                 return web.json_response(
                     {"status": "miss", "injected_blocks": 0})
+            bytes_moved = len(matched) * self.kv_pull_bytes_per_chunk
+            if self.pull_latency_s_per_byte > 0:
+                # Size-proportional transfer time: the measurable half of
+                # the pull-economics model.
+                await asyncio.sleep(bytes_moved * self.pull_latency_s_per_byte)
+            self.prefix_cache.update(matched)
             peer.kv_pulls_served += 1
             self.kv_pulls_received += 1
             return web.json_response({
-                "status": "ok", "injected_blocks": injected,
-                "num_tokens": injected})
+                "status": "ok", "injected_blocks": len(matched),
+                "num_tokens": len(matched),
+                "transfer": {"path": "fake-peer", "bytes": bytes_moved,
+                             "total_seconds": round(
+                                 time.monotonic() - t0, 6)}})
         finally:
             self._pull_inflight -= 1
 

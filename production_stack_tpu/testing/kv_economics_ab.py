@@ -1,0 +1,316 @@
+"""Hermetic KV pull-economics A/B: crossover sweep + advisor validation.
+
+The question this harness answers with wall-clock measurements: *at what
+shared-prefix length does pulling KV from a peer replica beat just
+recomputing the prefill locally?* — and does the crossover advisor
+(:mod:`production_stack_tpu.kv.economics`), fed only by the router's
+pull ledger, recommend a ``--fleet-min-match-chars`` inside the
+empirically-optimal band?
+
+The physics, with no TPU and no model: three :class:`FakeEngine`
+replicas get a *length-proportional* prefill cost
+(``prefill_time_per_char_s``) and a *size-proportional* pull cost
+(``pull_delay_s`` fixed overhead + ``pull_latency_s_per_byte`` per byte
+at ``kv_pull_bytes_per_chunk`` bytes per 128-char chunk). Recompute
+scales linearly with prefix length; a pull pays a fixed base price plus
+a shallower linear term — so short prefixes lose money on pulls and
+long prefixes win, with a crossover at::
+
+    base_s / (prefill_s_per_char - bytes_per_chunk*s_per_byte/128)
+
+The sweep runs one leg per ``--fleet-min-match-chars`` threshold.
+Each leg drives shared-prefix groups of several lengths through the
+real router with **round-robin** routing (so reuse always lands off the
+holder replica) and measures mean reuse TTFT. The lowest-threshold leg
+doubles as the *measurement* leg: its pulls populate the ledger, and
+the harness reads ``GET /debug/kv/economics`` to get the advisor's
+recommendation — which must land inside the band of thresholds whose
+measured TTFT is statistically indistinguishable from the best.
+
+Used by ``bench.py`` (BENCH_KV_ECON=1) and ``tests/test_kv_economics.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional, Sequence
+
+from production_stack_tpu.testing.fleet_ab import (
+    MODEL,
+    _start,
+    _ttft_request,
+)
+from production_stack_tpu.testing.qos_ab import _reset_router_singletons
+
+# Chunk size the controller hashes prompts at; prefix lengths must be
+# multiples of it so matched_chars lands exactly on the shared prefix.
+CHUNK_CHARS = 128
+
+# Default transfer/compute model. With these numbers the theoretical
+# crossover sits at 0.12 / (1e-4 - 4096*1e-6/128) ~= 1765 chars —
+# between the 1536 and 3072 prefix groups, and between the 1024 and
+# 4096 sweep thresholds.
+DEFAULT_PREFIX_LENGTHS = (384, 768, 1536, 3072, 6144)
+DEFAULT_THRESHOLDS = (256, 1024, 2048, 4096, 16384)
+DEFAULT_PREFILL_S_PER_CHAR = 1e-4
+DEFAULT_PULL_BASE_S = 0.12
+DEFAULT_S_PER_BYTE = 1e-6
+DEFAULT_BYTES_PER_CHUNK = 4096
+
+
+def _prefix(leg_tag: str, group: int, chars: int) -> str:
+    """Shared prefix for one (leg, length-group): unique from char 0 so
+    no two groups or legs share leading controller chunks."""
+    seed = f"econ-{leg_tag}-g{group:02d} shared corpus sentence {group}. "
+    return (seed * (chars // len(seed) + 1))[:chars]
+
+
+def _tail(leg_tag: str, group: int, req: int) -> str:
+    """Unique per-request suffix, exactly one controller chunk long, so
+    every request recomputes its tail and matched_chars == prefix len."""
+    seed = f" tail-{leg_tag}-g{group:02d}-r{req:02d} unique continuation. "
+    return (seed * (CHUNK_CHARS // len(seed) + 1))[:CHUNK_CHARS]
+
+
+async def _fetch_json(session, url: str) -> Optional[dict]:
+    import aiohttp
+
+    try:
+        async with session.get(
+            url, timeout=aiohttp.ClientTimeout(total=10.0)
+        ) as resp:
+            if resp.status != 200:
+                return None
+            return await resp.json()
+    except (aiohttp.ClientError, asyncio.TimeoutError):
+        return None
+
+
+async def _run_leg(*, min_match_chars: int,
+                   prefix_lengths: Sequence[int],
+                   reuse_per_group: int,
+                   prefill_s_per_char: float,
+                   pull_base_s: float,
+                   s_per_byte: float,
+                   bytes_per_chunk: int) -> dict:
+    """One threshold leg: prime each shared-prefix group on one replica,
+    then send reuse requests that round-robin onto other replicas.
+    Requests run sequentially so each TTFT is an unloaded measurement of
+    pull-vs-recompute, not a queueing artifact."""
+    import aiohttp
+
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.parser import build_parser
+    from production_stack_tpu.testing.fake_engine import (
+        FakeEngine,
+        run_fake_engine,
+    )
+
+    _reset_router_singletons()
+    engines = []
+    for _ in range(3):
+        e = FakeEngine(model=MODEL, ttft=0.0, max_tokens_default=2)
+        e.prefill_time_per_char_s = prefill_s_per_char
+        e.pull_delay_s = pull_base_s
+        e.pull_latency_s_per_byte = s_per_byte
+        e.kv_pull_bytes_per_chunk = bytes_per_chunk
+        engines.append(e)
+    runners = [await run_fake_engine(e, "127.0.0.1", 0) for e in engines]
+    urls = [e.self_url for e in engines]
+
+    args = build_parser().parse_args([])
+    args.static_backends = ",".join(urls)
+    args.static_models = ",".join([MODEL] * 3)
+    # Round-robin on purpose: reuse requests always land off the holder
+    # replica, which is exactly the pull-or-recompute decision point.
+    args.routing_logic = "roundrobin"
+    args.engine_stats_interval = 60
+    args.fleet_cache = True
+    args.fleet_min_match_chars = min_match_chars
+    # Tell the ledger the true fake-engine compute model: one controller
+    # chunk is one "token" (the fake /kv/pull reports num_tokens in
+    # chunks), so tokens/s = 1 / (CHUNK_CHARS * prefill_s_per_char).
+    args.fleet_chars_per_token = float(CHUNK_CHARS)
+    args.fleet_prefill_tokens_per_s = 1.0 / (
+        CHUNK_CHARS * prefill_s_per_char)
+    router_app = build_app(args)
+    router_runner, router_url = await _start(router_app)
+    for e in engines:
+        await e.configure_kv(router_url)
+
+    leg_tag = f"t{min_match_chars}"
+    per_length: Dict[int, List[float]] = {n: [] for n in prefix_lengths}
+    prime_ttfts: List[float] = []
+    failed = 0
+    economics = None
+    try:
+        async with aiohttp.ClientSession() as session:
+            for gi, length in enumerate(prefix_lengths):
+                prefix = _prefix(leg_tag, gi, length)
+                # Prime: lands on some replica, admits the prefix chain.
+                ttft = await _ttft_request(
+                    session, router_url, prefix + _tail(leg_tag, gi, 0))
+                if ttft is None:
+                    failed += 1
+                else:
+                    prime_ttfts.append(ttft)
+                # Let the engine's post-stream admission reach the
+                # controller before the first reuse lookup.
+                await asyncio.sleep(0.05)
+                for r in range(1, reuse_per_group + 1):
+                    ttft = await _ttft_request(
+                        session, router_url,
+                        prefix + _tail(leg_tag, gi, r))
+                    if ttft is None:
+                        failed += 1
+                    else:
+                        per_length[length].append(ttft)
+                    await asyncio.sleep(0.05)
+            economics = await _fetch_json(
+                session, router_url + "/debug/kv/economics")
+    finally:
+        await router_runner.cleanup()
+        for runner in runners:
+            await runner.cleanup()
+        _reset_router_singletons()
+
+    reuse_all = [t for ttfts in per_length.values() for t in ttfts]
+    summary = economics or {}  # ledger summary keys are top-level
+    return {
+        "min_match_chars": min_match_chars,
+        "failed": failed,
+        "prime_ttft_mean_s": round(
+            sum(prime_ttfts) / len(prime_ttfts), 4) if prime_ttfts else None,
+        "reuse_ttft_mean_s": round(
+            sum(reuse_all) / len(reuse_all), 4) if reuse_all else None,
+        "reuse_ttft_by_length_s": {
+            str(n): round(sum(v) / len(v), 4) if v else None
+            for n, v in per_length.items()},
+        "pulls_received": sum(e.kv_pulls_received for e in engines),
+        "ledger_wins": summary.get("wins"),
+        "ledger_losses": summary.get("losses"),
+        "ledger_net_seconds_saved": summary.get("net_seconds_saved_total"),
+        "advisor": (economics or {}).get("advisor"),
+    }
+
+
+def _optimal_band(legs: List[dict], *, tolerance_abs_s: float,
+                  tolerance_frac: float) -> dict:
+    """Contiguous run of thresholds whose mean reuse TTFT is within
+    tolerance of the best leg. ``hi`` is the first threshold *above*
+    the band (exclusive upper bound), None when the band extends past
+    the largest swept threshold."""
+    measured = [(leg["min_match_chars"], leg["reuse_ttft_mean_s"])
+                for leg in legs if leg["reuse_ttft_mean_s"] is not None]
+    best_thr, best = min(measured, key=lambda kv: kv[1])
+    tol = max(tolerance_abs_s, tolerance_frac * best)
+    in_band = [thr for thr, mean in measured if mean <= best + tol]
+    # Keep only the contiguous run around the best threshold.
+    thresholds = [thr for thr, _ in measured]
+    bi = thresholds.index(best_thr)
+    lo_i = bi
+    while lo_i > 0 and thresholds[lo_i - 1] in in_band:
+        lo_i -= 1
+    hi_i = bi
+    while hi_i + 1 < len(thresholds) and thresholds[hi_i + 1] in in_band:
+        hi_i += 1
+    return {
+        "best_threshold": best_thr,
+        "best_reuse_ttft_mean_s": best,
+        "tolerance_s": round(tol, 4),
+        "lo": thresholds[lo_i],
+        "hi": (thresholds[hi_i + 1]
+               if hi_i + 1 < len(thresholds) else None),
+        "members": thresholds[lo_i:hi_i + 1],
+    }
+
+
+async def run_kv_econ_ab(
+        *, prefix_lengths: Sequence[int] = DEFAULT_PREFIX_LENGTHS,
+        thresholds: Sequence[int] = DEFAULT_THRESHOLDS,
+        reuse_per_group: int = 2,
+        prefill_s_per_char: float = DEFAULT_PREFILL_S_PER_CHAR,
+        pull_base_s: float = DEFAULT_PULL_BASE_S,
+        s_per_byte: float = DEFAULT_S_PER_BYTE,
+        bytes_per_chunk: int = DEFAULT_BYTES_PER_CHUNK,
+        band_tolerance_abs_s: float = 0.010,
+        band_tolerance_frac: float = 0.05) -> dict:
+    """Sweep ``--fleet-min-match-chars`` thresholds and validate the
+    crossover advisor against the measured optimum.
+
+    The lowest threshold pulls every group (its leg is the ledger
+    *measurement* leg — the advisor reads from it); the highest pulls
+    none (its leg is the recompute baseline). Returns the full artifact
+    dict for ``BENCH_KV_ECON_r15.json``."""
+    thresholds = sorted(thresholds)
+    legs: List[dict] = []
+    for thr in thresholds:
+        legs.append(await _run_leg(
+            min_match_chars=thr, prefix_lengths=prefix_lengths,
+            reuse_per_group=reuse_per_group,
+            prefill_s_per_char=prefill_s_per_char,
+            pull_base_s=pull_base_s, s_per_byte=s_per_byte,
+            bytes_per_chunk=bytes_per_chunk))
+
+    measure_leg = legs[0]       # pulls everything: populates the ledger
+    baseline_leg = legs[-1]     # pulls nothing: pure recompute TTFT
+
+    # Measured crossover: first prefix length where pulling (measurement
+    # leg) beats recomputing (baseline leg).
+    measured_crossover = None
+    pull_vs_recompute = []
+    for n in prefix_lengths:
+        pull_t = measure_leg["reuse_ttft_by_length_s"].get(str(n))
+        comp_t = baseline_leg["reuse_ttft_by_length_s"].get(str(n))
+        wins = (pull_t is not None and comp_t is not None
+                and pull_t < comp_t)
+        pull_vs_recompute.append({
+            "prefix_chars": n, "pull_ttft_mean_s": pull_t,
+            "recompute_ttft_mean_s": comp_t, "pull_wins": wins})
+        if wins and measured_crossover is None:
+            measured_crossover = n
+
+    band = _optimal_band(legs, tolerance_abs_s=band_tolerance_abs_s,
+                         tolerance_frac=band_tolerance_frac)
+
+    advisor = measure_leg.get("advisor") or {}
+    rec = advisor.get("recommended_min_match_chars")
+    in_band = (rec is not None and rec >= band["lo"]
+               and (band["hi"] is None or rec < band["hi"]))
+    # Independent sanity bracket: the recommendation should sit between
+    # the largest losing prefix length and the measured crossover.
+    losing = [r["prefix_chars"] for r in pull_vs_recompute
+              if not r["pull_wins"]]
+    bracket_lo = max(losing) if losing else 0
+    in_bracket = (rec is not None and bracket_lo < rec
+                  and (measured_crossover is None
+                       or rec < measured_crossover))
+
+    per_chunk_transfer_s = bytes_per_chunk * s_per_byte
+    denom = prefill_s_per_char - per_chunk_transfer_s / CHUNK_CHARS
+    theoretical = (round(pull_base_s / denom) if denom > 0 else None)
+
+    return {
+        "metric": "kv_pull_crossover_chars",
+        "unit": "chars",
+        "value": measured_crossover,
+        "theoretical_crossover_chars": theoretical,
+        "transfer_model": {
+            "prefill_s_per_char": prefill_s_per_char,
+            "pull_base_s": pull_base_s,
+            "s_per_byte": s_per_byte,
+            "bytes_per_chunk": bytes_per_chunk,
+        },
+        "prefix_lengths": list(prefix_lengths),
+        "reuse_per_group": reuse_per_group,
+        "thresholds_swept": thresholds,
+        "legs": legs,
+        "pull_vs_recompute": pull_vs_recompute,
+        "optimal_band": band,
+        "advisor_recommendation_chars": rec,
+        "advisor_in_optimal_band": in_band,
+        "advisor_in_crossover_bracket": in_bracket,
+        "advisor": advisor,
+        "failed": sum(leg["failed"] for leg in legs),
+    }

@@ -49,8 +49,11 @@ def is_gated(path: str) -> bool:
 _PRIVILEGED_EXACT = frozenset({"/kv/deregister", "/debug/profile",
                                "/debug/events", "/debug/traces",
                                "/debug/steps", "/debug/loop"})
+# /debug/kv/* (pull economics, trie introspection) leaks cache topology,
+# holder URLs, and workload prefix structure — privileged as a prefix so
+# future additions under it are born gated.
 _PRIVILEGED_PREFIXES = ("/autoscale/", "/debug/profile/",
-                        "/debug/traces/")
+                        "/debug/traces/", "/debug/kv/")
 
 
 def is_privileged(path: str) -> bool:

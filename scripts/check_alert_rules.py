@@ -8,6 +8,12 @@ normalization rules from ``check_metrics_documented.py`` (which in turn
 enforces that the README tracks what the source tree emits — so an
 alert on a documented metric is an alert on a real one).
 
+Also asserts the rule-group skeleton: every group in REQUIRED_GROUPS
+must exist with at least one rule, and every rule everywhere must carry
+a severity label and both summary/description annotations — a
+regenerated YAML that silently dropped a group (a bad merge of
+gen_alerts.py) fails here rather than in a pager audit.
+
 Run from the repo root; exits non-zero listing offending rules.
 Wired into the test suite via tests/test_observability.py.
 """
@@ -22,6 +28,15 @@ import yaml
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ALERTS = os.path.join(REPO, "observability", "tpu-stack-alerts.yaml")
+
+# Groups gen_alerts.py must always emit; dropping one is a lint failure.
+REQUIRED_GROUPS = (
+    "tpu-stack-goodput",
+    "tpu-stack-canary",
+    "tpu-stack-control-plane",
+    "tpu-stack-kv-economics",
+)
+VALID_SEVERITIES = ("critical", "warning", "info")
 
 
 def _metrics_lint():
@@ -56,6 +71,34 @@ def undocumented(path: str = ALERTS):
     return bad
 
 
+def structural_problems(path: str = ALERTS):
+    """Skeleton lint: required groups present and non-empty, every rule
+    carries a known severity and both annotations."""
+    with open(path, encoding="utf-8") as f:
+        doc = yaml.safe_load(f)
+    groups = {g["name"]: g.get("rules") or []
+              for g in doc["spec"]["groups"]}
+    problems = []
+    for name in REQUIRED_GROUPS:
+        if name not in groups:
+            problems.append(f"required group missing: {name}")
+        elif not groups[name]:
+            problems.append(f"required group has no rules: {name}")
+    for gname, rules in groups.items():
+        for r in rules:
+            alert = r.get("alert", "<unnamed>")
+            sev = (r.get("labels") or {}).get("severity")
+            if sev not in VALID_SEVERITIES:
+                problems.append(
+                    f"{gname}/{alert}: severity {sev!r} not in "
+                    f"{VALID_SEVERITIES}")
+            ann = r.get("annotations") or {}
+            for key in ("summary", "description"):
+                if not ann.get(key):
+                    problems.append(f"{gname}/{alert}: missing {key}")
+    return problems
+
+
 def main() -> int:
     bad = undocumented()
     if bad:
@@ -63,6 +106,12 @@ def main() -> int:
               "observability/README.md:")
         for alert, name in bad:
             print(f"  {alert}: {name}")
+        return 1
+    problems = structural_problems()
+    if problems:
+        print("Alert rule structure problems:")
+        for p in problems:
+            print(f"  {p}")
         return 1
     n = sum(1 for _ in alert_exprs())
     print(f"all {n} alert rules query documented metrics")

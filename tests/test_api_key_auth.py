@@ -193,6 +193,97 @@ def test_router_edge_auth_and_shared_key_passthrough():
     engine.core.stop()
 
 
+def _debug_routes(app):
+    """Every registered (method, path) under /debug/, with path params
+    filled in — auto-discovered so a future debug route can't ship
+    unauthenticated by being forgotten here."""
+    import re
+
+    seen = set()
+    for route in app.router.routes():
+        method = route.method.upper()
+        if method in ("HEAD", "OPTIONS", "*"):
+            continue
+        canonical = route.resource.canonical
+        if not canonical.startswith("/debug/"):
+            continue
+        seen.add((method, re.sub(r"{[^}]+}", "x", canonical)))
+    return sorted(seen)
+
+
+def test_every_debug_route_requires_key():
+    """Auth coverage by construction: enumerate every registered router
+    and engine route under /debug/ and assert each one 401s without the
+    deployment key. The per-endpoint tests above check semantics; this
+    one makes the privileged set closed under addition."""
+    from aiohttp import web
+
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.parser import build_parser
+
+    engine = EngineServer(_config(), api_key=KEY)
+
+    async def run():
+        e_runner = await run_engine_server(engine, "127.0.0.1", 0)
+        e_port = list(e_runner.sites)[0]._server.sockets[0].getsockname()[1]
+
+        args = build_parser().parse_args([])
+        args.service_discovery = "static"
+        args.static_backends = f"http://127.0.0.1:{e_port}"
+        args.static_models = "tiny-llama"
+        args.routing_logic = "roundrobin"
+        args.api_key = KEY
+        # Turn on the optional subsystems so their debug routes are
+        # registered and therefore enumerated.
+        args.fleet_cache = True
+        args.loop_monitor = True
+        app = build_app(args)
+        r_runner = web.AppRunner(app)
+        await r_runner.setup()
+        site = web.TCPSite(r_runner, "127.0.0.1", 0)
+        await site.start()
+        r_port = site._server.sockets[0].getsockname()[1]
+        import aiohttp
+
+        router_routes = _debug_routes(app)
+        engine_routes = _debug_routes(engine.make_app())
+        # The discovery itself must be working: the known surfaces
+        # appear (an empty enumeration would vacuously pass).
+        router_paths = {p for _, p in router_routes}
+        for expected in ("/debug/traces", "/debug/kv/economics",
+                         "/debug/kv/trie", "/debug/loop"):
+            assert expected in router_paths, router_paths
+        engine_paths = {p for _, p in engine_routes}
+        assert "/debug/steps" in engine_paths, engine_paths
+
+        try:
+            async with aiohttp.ClientSession() as s:
+                for base, routes in (
+                        (f"http://127.0.0.1:{r_port}", router_routes),
+                        (f"http://127.0.0.1:{e_port}", engine_routes)):
+                    for method, path in routes:
+                        async with s.request(
+                                method, base + path,
+                                json={} if method != "GET"
+                                else None) as resp:
+                            assert resp.status == 401, (
+                                f"{method} {path}: {resp.status}")
+                        async with s.request(
+                                method, base + path,
+                                json={} if method != "GET" else None,
+                                headers={"Authorization":
+                                         "Bearer nope"}) as resp:
+                            assert resp.status == 401, (
+                                f"{method} {path} (bad key): "
+                                f"{resp.status}")
+        finally:
+            await r_runner.cleanup()
+            await e_runner.cleanup()
+
+    asyncio.run(run())
+    engine.core.stop()
+
+
 def test_multi_key_resolution_and_constant_time_check(tmp_path,
                                                       monkeypatch):
     """Several deployment keys open the same surface: comma-separated

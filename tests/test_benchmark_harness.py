@@ -83,6 +83,53 @@ def test_bench_artifacts_carry_run_meta(tmp_path):
     assert data["metric"] == "m"
 
 
+def test_committed_kv_econ_artifact_schema():
+    """The committed KV pull-economics artifact is real: a full
+    threshold sweep with a measured pull-vs-recompute crossover, and the
+    ledger-fed advisor's recommendation landing inside both the
+    empirically-optimal threshold band and the bracket between the
+    largest losing and the first winning prefix length."""
+    data = json.load(open(os.path.join(REPO, "BENCH_KV_ECON_r15.json")))
+    assert data["metric"] == "kv_pull_crossover_chars"
+    assert data["meta"]["schema"] == 1
+    assert data["backend"] == "fake"
+    assert data["failed"] == 0
+    # The crossover was actually measured, and it sits where the
+    # transfer model puts it: at or above the theoretical break-even.
+    assert data["value"] in data["prefix_lengths"]
+    assert data["value"] >= data["theoretical_crossover_chars"]
+    # Every swept threshold produced a leg with a measured mean TTFT,
+    # and the sweep's physics hold: the pull-everything leg recorded
+    # losses on short prefixes AND wins on long ones, while the
+    # never-pull leg recorded no pulls at all.
+    legs = {leg["min_match_chars"]: leg for leg in data["legs"]}
+    assert sorted(legs) == data["thresholds_swept"]
+    for leg in data["legs"]:
+        assert leg["reuse_ttft_mean_s"] > 0
+    measure = legs[min(legs)]
+    assert measure["ledger_wins"] >= 1 and measure["ledger_losses"] >= 1
+    assert legs[max(legs)]["pulls_received"] == 0
+    # pull_vs_recompute is monotone in the sense that matters: every
+    # length at/above the crossover wins, every one below loses.
+    for row in data["pull_vs_recompute"]:
+        assert row["pull_wins"] == (row["prefix_chars"] >= data["value"])
+    # The acceptance criterion: the advisor's recommendation (computed
+    # only from the measurement leg's ledger) is inside the A/B-optimal
+    # band and the measured crossover bracket.
+    band = data["optimal_band"]
+    rec = data["advisor_recommendation_chars"]
+    assert band["lo"] <= band["best_threshold"]
+    assert band["best_threshold"] in band["members"]
+    assert rec is not None and rec >= band["lo"]
+    assert band["hi"] is None or rec < band["hi"]
+    assert data["advisor_in_optimal_band"] is True
+    assert data["advisor_in_crossover_bracket"] is True
+    adv = data["advisor"]
+    assert adv["samples"] >= len(data["prefix_lengths"])
+    assert adv["pull_never_wins"] is False
+    assert adv["recommended_min_match_chars"] == rec
+
+
 def test_committed_saturation_artifact_schema():
     """The committed saturation artifact is real: 10k+ users at the top
     rung, 4 replicas, outcome classifier reconciling on every rung —

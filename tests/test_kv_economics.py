@@ -1,0 +1,526 @@
+"""Fleet KV pull economics: the ledger, the crossover advisor, the
+debug surfaces (/debug/kv/economics, /debug/kv/trie), the engine-side
+page-occupancy fold-in, and the --fleet-auto-min-match damped applier
+(with its flag-off parity guarantee)."""
+
+import asyncio
+import math
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from production_stack_tpu.kv.controller import KVController
+from production_stack_tpu.kv.economics import (
+    PullLedger,
+    step_recorder_prefill_tps,
+)
+from production_stack_tpu.kv.fleet import (
+    AUTO_MIN_MATCH_FLOOR,
+    FleetCache,
+    FleetCacheConfig,
+)
+from production_stack_tpu.router import metrics as router_metrics
+
+# ---------------------------------------------------------------------------
+# PullLedger unit: win/loss math, ring bounds, failure paths
+# ---------------------------------------------------------------------------
+
+
+def _rec(ledger, outcome="ok", bytes_moved=0, tokens_saved=0,
+         pull_seconds=0.0, matched=512):
+    return ledger.record(
+        server_url="http://e1", holder="i2", holder_url="http://e2",
+        matched_chars=matched, outcome=outcome, bytes_moved=bytes_moved,
+        tokens_saved=tokens_saved, pull_seconds=pull_seconds)
+
+
+def test_ledger_win_loss_classification():
+    ledger = PullLedger(prefill_tokens_per_s_floor=100.0)
+    # 100 tokens at 100 tok/s = 1.0s recompute, pulled in 0.5s: win +0.5.
+    win = _rec(ledger, bytes_moved=4096, tokens_saved=100,
+               pull_seconds=0.5)
+    assert win["classification"] == "win"
+    assert win["est_recompute_seconds"] == pytest.approx(1.0)
+    assert win["net_seconds_saved"] == pytest.approx(0.5)
+    assert win["prefill_tps_source"] == "floor"
+    # 10 tokens = 0.1s recompute, pulled in 0.5s: loss -0.4.
+    loss = _rec(ledger, bytes_moved=4096, tokens_saved=10,
+                pull_seconds=0.5)
+    assert loss["classification"] == "loss"
+    assert loss["net_seconds_saved"] == pytest.approx(-0.4)
+    s = ledger.summary()
+    assert (s["recorded_total"], s["wins"], s["losses"]) == (2, 1, 1)
+    assert s["net_seconds_saved_total"] == pytest.approx(0.1)
+    assert s["bytes_moved_total"] == 8192
+    assert s["tokens_saved_total"] == 110
+
+
+def test_failure_paths_are_losses_and_never_skew_bandwidth():
+    """Satellite contract: a failed or holder-rejected pull is a loss
+    with zero tokens saved — never a win — and must not contaminate the
+    advisor's transfer-model samples, even when the caller passes
+    nonzero bytes/tokens (a timeout can have moved bytes before dying)."""
+    ledger = PullLedger(prefill_tokens_per_s_floor=100.0)
+    _rec(ledger, bytes_moved=100_000, tokens_saved=50, pull_seconds=0.1)
+    _rec(ledger, bytes_moved=200_000, tokens_saved=100, pull_seconds=0.2)
+    bw_before = ledger.pull_bandwidth_bytes_per_s()
+    assert bw_before == pytest.approx(1_000_000.0)
+    for outcome in ("rejected", "timeout", "http_500", "miss",
+                    "unreachable"):
+        rec = _rec(ledger, outcome=outcome, bytes_moved=999_999,
+                   tokens_saved=500, pull_seconds=3.0)
+        assert rec["classification"] == "loss"
+        assert rec["tokens_saved"] == 0
+        assert rec["bytes_moved"] == 0
+        assert rec["est_recompute_seconds"] == 0.0
+        assert rec["net_seconds_saved"] == pytest.approx(-3.0)
+    s = ledger.summary()
+    assert s["wins"] == 2 and s["losses"] == 5
+    # The transfer model saw only the two ok pulls.
+    assert ledger.advise()["samples"] == 2
+    assert ledger.pull_bandwidth_bytes_per_s() == pytest.approx(bw_before)
+    assert s["tokens_saved_total"] == 150
+    assert s["bytes_moved_total"] == 300_000
+
+
+def test_ledger_ring_bounded_newest_first():
+    ledger = PullLedger(capacity=3, prefill_tokens_per_s_floor=100.0)
+    for i in range(5):
+        _rec(ledger, tokens_saved=i + 1, bytes_moved=1, pull_seconds=0.001)
+    assert ledger.recorded_total == 5
+    snap = ledger.snapshot()
+    assert [r["tokens_saved"] for r in snap] == [5, 4, 3]
+    assert [r["tokens_saved"] for r in ledger.snapshot(limit=1)] == [5]
+
+
+def test_zero_duration_ok_pull_not_a_bandwidth_sample():
+    ledger = PullLedger()
+    _rec(ledger, bytes_moved=4096, tokens_saved=8, pull_seconds=0.0)
+    assert ledger.advise()["samples"] == 0
+    assert ledger.pull_bandwidth_bytes_per_s() is None
+
+
+# ---------------------------------------------------------------------------
+# The crossover advisor
+# ---------------------------------------------------------------------------
+
+
+def test_advisor_breakeven_from_synthetic_transfer_model():
+    """Feed the ledger an exact linear transfer model and check the
+    closed-form break-even comes back: overhead 0.1s, 1e-6 s/byte,
+    100 bytes/token, 100 tok/s -> n* = 0.1/(0.01 - 1e-4) tokens."""
+    ledger = PullLedger(prefill_tokens_per_s_floor=100.0,
+                        chars_per_token=4.0)
+    for tokens in (10, 20, 40, 80):
+        b = tokens * 100
+        _rec(ledger, bytes_moved=b, tokens_saved=tokens,
+             pull_seconds=0.1 + b * 1e-6)
+    adv = ledger.advise(current_min_match_chars=256)
+    assert adv["current_min_match_chars"] == 256
+    assert adv["samples"] == 4
+    assert adv["overhead_seconds"] == pytest.approx(0.1, rel=1e-3)
+    assert adv["bytes_per_token"] == pytest.approx(100.0)
+    expected = 0.1 / (1 / 100.0 - 100 * 1e-6)
+    assert adv["breakeven_tokens"] == pytest.approx(expected, rel=1e-3)
+    assert adv["recommended_min_match_chars"] == int(
+        math.ceil(adv["breakeven_tokens"] * 4.0))
+    assert adv["pull_never_wins"] is False
+
+
+def test_advisor_pull_never_wins_on_slow_interconnect():
+    """Per-token transfer >= per-token recompute: no threshold helps."""
+    ledger = PullLedger(prefill_tokens_per_s_floor=1000.0)
+    # 1000 bytes/token at 2e-6 s/byte = 2ms/token vs 1ms/token recompute.
+    for tokens in (10, 20):
+        b = tokens * 1000
+        _rec(ledger, bytes_moved=b, tokens_saved=tokens,
+             pull_seconds=0.05 + b * 2e-6)
+    adv = ledger.advise()
+    assert adv["pull_never_wins"] is True
+    assert adv["recommended_min_match_chars"] is None
+    assert "per-token" in adv["reason"]
+
+
+def test_advisor_no_samples_reason():
+    adv = PullLedger().advise()
+    assert adv["recommended_min_match_chars"] is None
+    assert adv["reason"] == "no successful pulls measured yet"
+
+
+def test_measured_prefill_tps_from_step_recorder():
+    """Where a StepRecorder is wired in-process, the recompute estimate
+    uses its live prefill rollups instead of the configured floor."""
+    from production_stack_tpu.obs.steps import StepRecorder
+
+    recorder = StepRecorder(capacity=16)
+    assert step_recorder_prefill_tps(recorder) is None  # no samples yet
+    recorder.record("prefill", 0.1, tokens=500)
+    recorder.record("prefill_chunk", 0.1, tokens=300)
+    recorder.record("decode", 5.0, tokens=1)  # decode never counts
+    tps = step_recorder_prefill_tps(recorder)
+    assert tps == pytest.approx(800 / 0.2)
+
+    ledger = PullLedger(prefill_tokens_per_s_floor=100.0,
+                        prefill_tps_fn=lambda: step_recorder_prefill_tps(
+                            recorder))
+    rec = _rec(ledger, bytes_moved=4096, tokens_saved=400,
+               pull_seconds=0.05)
+    assert rec["prefill_tps_source"] == "measured"
+    assert rec["prefill_tokens_per_s"] == pytest.approx(4000.0)
+    # est = 400 / 4000 = 0.1s vs 0.05s pull: win.
+    assert rec["classification"] == "win"
+
+
+# ---------------------------------------------------------------------------
+# Auto-min-match: damped application and flag-off parity
+# ---------------------------------------------------------------------------
+
+
+def _fleet(auto=False, min_match=256, damping=0.5,
+           chars_per_token=40.0) -> FleetCache:
+    cfg = FleetCacheConfig(min_match_chars=min_match,
+                           prefill_tokens_per_s_floor=100.0,
+                           chars_per_token=chars_per_token,
+                           auto_min_match=auto,
+                           auto_min_match_damping=damping)
+    return FleetCache(cfg, KVController(chunk_size=128))
+
+
+def _seed_profitable_model(fleet, overhead=0.1, per_byte=1e-6, bpt=100):
+    # breakeven = overhead / (1/100 - 100e-6) = overhead * 101.01 tokens
+    # ~= 10.1 tokens at the default overhead; every seeded pull is past
+    # it (all wins). At 40 chars/token that recommends ~405 chars — above
+    # the 256 default, so the damped applier has somewhere to go.
+    for tokens in (20, 40, 80, 160):
+        b = tokens * bpt
+        fleet.ledger.record(
+            server_url="http://e1", holder="i2", holder_url="http://e2",
+            matched_chars=tokens * 4, outcome="ok", bytes_moved=b,
+            tokens_saved=tokens, pull_seconds=overhead + b * per_byte)
+
+
+def test_auto_min_match_applies_damped_and_clamped():
+    fleet = _fleet(auto=True, min_match=256, damping=0.5)
+    _seed_profitable_model(fleet)
+    rec = fleet.ledger.advise()["recommended_min_match_chars"]
+    assert rec is not None and rec > 256
+    state = fleet.apply_auto_min_match()
+    assert state["applied"] is True
+    assert state["old"] == 256
+    expected = int(round(256 + 0.5 * (rec - 256)))
+    assert state["new"] == expected
+    assert fleet.config.min_match_chars == expected
+    assert fleet.auto_min_match_applied == 1
+    assert fleet.auto_min_match_last is state
+    # Repeated application converges onto the recommendation.
+    for _ in range(40):
+        fleet.apply_auto_min_match()
+    assert abs(fleet.config.min_match_chars
+               - fleet.ledger.advise()["recommended_min_match_chars"]) <= 1
+    # The floor clamp holds even when the advisor recommends tiny values.
+    fleet2 = _fleet(auto=True, min_match=256, damping=1.0)
+    _seed_profitable_model(fleet2, overhead=0.0001)
+    fleet2.apply_auto_min_match()
+    assert fleet2.config.min_match_chars >= AUTO_MIN_MATCH_FLOOR
+
+
+def test_auto_min_match_no_recommendation_is_a_noop():
+    fleet = _fleet(auto=True, min_match=256)
+    state = fleet.apply_auto_min_match()  # empty ledger
+    assert state["applied"] is False
+    assert fleet.config.min_match_chars == 256
+    assert fleet.auto_min_match_applied == 0
+
+
+def test_health_carries_economics_and_auto_state():
+    fleet = _fleet(auto=False, min_match=256)
+    _seed_profitable_model(fleet)
+    h = fleet.health()
+    assert h["economics"]["wins"] == 4
+    assert h["auto_min_match"]["enabled"] is False
+    assert h["auto_min_match"]["applied"] == 0
+
+
+def _econ_sample_count() -> int:
+    return sum(
+        len(m.samples)
+        for metric in (router_metrics.kv_pull_wins,
+                       router_metrics.kv_pull_losses,
+                       router_metrics.kv_pull_net_seconds_saved)
+        for m in metric.collect())
+
+
+def test_flag_off_parity_min_match_untouched_and_no_series():
+    """With --fleet-auto-min-match off the threshold is never moved no
+    matter what the ledger says, and with --fleet-cache off entirely the
+    new economics metrics add no registry series (deltas, not absolutes:
+    the shared registry may carry series from other tests)."""
+    before = _econ_sample_count()
+    fleet = _fleet(auto=False, min_match=256)
+    _seed_profitable_model(fleet)
+    # The advisor has a (different) recommendation...
+    assert fleet.ledger.advise()["recommended_min_match_chars"] != 256
+    # ...but nothing in the fleet moves the knob unless the app's
+    # auto-apply task (gated on config.auto_min_match) calls
+    # apply_auto_min_match — which build_app never starts with the flag
+    # off (asserted end-to-end in test_debug_routes below).
+    assert fleet.config.auto_min_match is False
+    assert fleet.config.min_match_chars == 256
+    assert fleet.auto_min_match_applied == 0
+    # Direct ledger recording (no fleet `_record_economics`) touches no
+    # prometheus series: flag-off deployments emit nothing new.
+    assert _econ_sample_count() == before
+
+
+def test_fleet_record_economics_increments_metrics():
+    before = _econ_sample_count()
+    fleet = _fleet()
+    fleet._record_economics("http://e-parity-test", "i2", "http://e2",
+                            512, "ok", bytes_moved=4096, tokens_saved=400,
+                            pull_seconds=0.5)
+    fleet._record_economics("http://e-parity-test", "i2", "http://e2",
+                            512, "timeout", pull_seconds=1.0)
+    assert _econ_sample_count() > before
+    assert fleet.ledger.wins == 1 and fleet.ledger.losses == 1
+
+
+# ---------------------------------------------------------------------------
+# Debug surfaces: /debug/kv/economics and /debug/kv/trie
+# ---------------------------------------------------------------------------
+
+
+async def _start(app):
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+async def test_debug_routes_end_to_end():
+    """Router app with --fleet-cache: /debug/kv/economics serves the
+    ledger + advisor + records (with ?limit= validation), /debug/kv/trie
+    serves the controller introspection (with ?top= validation), and the
+    auto-apply task only exists under --fleet-auto-min-match."""
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.parser import build_parser
+    from production_stack_tpu.testing.qos_ab import _reset_router_singletons
+
+    _reset_router_singletons()
+    args = build_parser().parse_args([])
+    args.static_backends = "http://127.0.0.1:1"
+    args.static_models = "econ-model"
+    args.routing_logic = "roundrobin"
+    args.engine_stats_interval = 60
+    args.fleet_cache = True
+    # Match the seed model's economics (100 tok/s, 40 chars/token) so
+    # the seeded pulls classify as wins like the unit tests above.
+    args.fleet_prefill_tokens_per_s = 100.0
+    args.fleet_chars_per_token = 40.0
+    app = build_app(args)
+    runner, url = await _start(app)
+    try:
+        state = app["state"]
+        assert "_auto_min_match" not in app  # flag off: no applier task
+        # Seed the ledger and the trie directly (no engines needed).
+        _seed_profitable_model(state.fleet)
+        state.fleet._record_economics(
+            "http://e1", "i9", "http://e9", 512, "timeout",
+            pull_seconds=2.0)
+        ctrl = state.kv_controller
+        await ctrl.register_instance("i1", "http://e1:8000")
+        await ctrl.admit_text("i1", "a" * 512)
+        await ctrl.lookup("a" * 512)
+        await ctrl.lookup("a" * 512)
+
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{url}/debug/kv/economics") as resp:
+                assert resp.status == 200
+                econ = await resp.json()
+            assert econ["wins"] == 4 and econ["losses"] == 1
+            assert econ["advisor"]["recommended_min_match_chars"] > 0
+            assert econ["auto_min_match"]["enabled"] is False
+            assert len(econ["records"]) == 5
+            # Newest first: the timeout loss leads.
+            assert econ["records"][0]["outcome"] == "timeout"
+            async with s.get(f"{url}/debug/kv/economics?limit=2") as resp:
+                assert len((await resp.json())["records"]) == 2
+            for bad in ("abc", "0", "-3"):
+                async with s.get(
+                        f"{url}/debug/kv/economics?limit={bad}") as resp:
+                    assert resp.status == 400
+
+            async with s.get(f"{url}/debug/kv/trie") as resp:
+                assert resp.status == 200
+                trie = await resp.json()
+            assert trie["chunk_size"] == 128
+            # 4 chunk nodes plus the root.
+            assert trie["nodes"] == 5 and trie["claims"] == 4
+            assert trie["max_depth"] == 4
+            assert trie["claims_by_instance"] == {"i1": 4}
+            assert trie["approx_memory_bytes"] > 0
+            assert trie["depth_distribution"]["1"] == 1
+            hot = trie["hottest_prefixes"][0]
+            assert hot["hits"] == 2
+            assert hot["depth"] == 4
+            assert hot["approx_chars"] == 512
+            assert hot["holders"] == ["i1"]
+            assert len(hot["chunk_hashes"]) == 4
+            async with s.get(f"{url}/debug/kv/trie?top=1") as resp:
+                assert len((await resp.json())["hottest_prefixes"]) == 1
+            for bad in ("abc", "0"):
+                async with s.get(f"{url}/debug/kv/trie?top={bad}") as resp:
+                    assert resp.status == 400
+    finally:
+        await runner.cleanup()
+        _reset_router_singletons()
+
+
+async def test_economics_route_absent_without_fleet():
+    """Same convention as the engine-only /debug/steps: without
+    --fleet-cache the economics route does not exist (404), while the
+    always-on trie route still serves."""
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.parser import build_parser
+    from production_stack_tpu.testing.qos_ab import _reset_router_singletons
+
+    _reset_router_singletons()
+    args = build_parser().parse_args([])
+    args.static_backends = "http://127.0.0.1:1"
+    args.static_models = "econ-model"
+    args.routing_logic = "roundrobin"
+    args.engine_stats_interval = 60
+    app = build_app(args)
+    runner, url = await _start(app)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{url}/debug/kv/economics") as resp:
+                assert resp.status == 404
+            async with s.get(f"{url}/debug/kv/trie") as resp:
+                assert resp.status == 200
+    finally:
+        await runner.cleanup()
+        _reset_router_singletons()
+
+
+async def test_auto_min_match_task_moves_the_live_threshold():
+    """--fleet-auto-min-match end to end: the app starts the damped
+    applier task, and within a couple of intervals the live
+    min_match_chars has moved toward the advisor's recommendation."""
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.parser import build_parser
+    from production_stack_tpu.testing.qos_ab import _reset_router_singletons
+
+    _reset_router_singletons()
+    args = build_parser().parse_args([])
+    args.static_backends = "http://127.0.0.1:1"
+    args.static_models = "econ-model"
+    args.routing_logic = "roundrobin"
+    args.engine_stats_interval = 60
+    args.fleet_cache = True
+    args.fleet_auto_min_match = True
+    args.fleet_auto_min_match_interval = 0.05
+    args.fleet_auto_min_match_damping = 1.0
+    args.fleet_chars_per_token = 40.0  # seed model recommends ~405 chars
+    app = build_app(args)
+    runner, _url = await _start(app)
+    try:
+        state = app["state"]
+        assert "_auto_min_match" in app
+        _seed_profitable_model(state.fleet)
+        rec = state.fleet.ledger.advise()["recommended_min_match_chars"]
+        for _ in range(40):
+            await asyncio.sleep(0.05)
+            if state.fleet.config.min_match_chars == rec:
+                break
+        assert state.fleet.config.min_match_chars == rec
+        assert state.fleet.auto_min_match_applied >= 1
+        assert state.fleet.auto_min_match_last["applied"] is True
+    finally:
+        await runner.cleanup()
+        _reset_router_singletons()
+
+
+# ---------------------------------------------------------------------------
+# Engine-side page occupancy (stats fold-in + exposition)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_page_occupancy_in_stats_and_metrics():
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.server import (
+        EngineServer,
+        run_engine_server,
+    )
+
+    server = EngineServer(EngineConfig(
+        model="tiny-llama", max_model_len=128, max_num_seqs=2,
+        block_size=8, num_blocks=64, max_loras=0))
+
+    async def run():
+        runner = await run_engine_server(server, "127.0.0.1", 0)
+        port = list(runner.sites)[0]._server.sockets[0].getsockname()[1]
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{base}/metrics") as resp:
+                    assert resp.status == 200
+                    text = await resp.text()
+                async with s.get(f"{base}/debug/steps") as resp:
+                    assert resp.status == 200
+                    steps = await resp.json()
+        finally:
+            await runner.cleanup()
+        return text, steps
+
+    text, steps = asyncio.run(run())
+    server.core.stop()
+
+    occ = server.core.stats()["kv_page_occupancy"]
+    assert set(occ) == {"resident", "offload"}
+    assert occ["resident"] >= 0 and occ["offload"] == 0  # no offload tier
+
+    lines = text.splitlines()
+    type_i = lines.index("# TYPE tpu:kv_page_occupancy gauge")
+    # Exposition-format contract: both tier samples contiguous after the
+    # TYPE line (offload present even when unconfigured).
+    assert lines[type_i + 1].startswith("tpu:kv_page_occupancy{")
+    assert 'tier="resident"' in lines[type_i + 1]
+    assert lines[type_i + 2].startswith("tpu:kv_page_occupancy{")
+    assert 'tier="offload"' in lines[type_i + 2]
+    assert lines[type_i + 2].split()[-1] == "0"
+
+    # /debug/steps folds the same counts into its stats block.
+    assert steps["kv_page_occupancy"]["offload"] == 0
+    assert steps["kv_page_occupancy"]["resident"] == occ["resident"]
+
+
+# ---------------------------------------------------------------------------
+# The hermetic crossover A/B (small smoke; the committed artifact runs
+# the full sweep via BENCH_KV_ECON=1)
+# ---------------------------------------------------------------------------
+
+
+async def test_kv_econ_ab_smoke_two_legs():
+    """Tiny end-to-end sweep: one pull-everything leg, one never-pull
+    leg, two prefix lengths that sit on either side of the theoretical
+    crossover. Asserts the measured crossover and that the advisor's
+    recommendation (fed only by the measurement leg's ledger) lands
+    between the losing and the winning length."""
+    from production_stack_tpu.testing.kv_economics_ab import run_kv_econ_ab
+
+    result = await run_kv_econ_ab(
+        prefix_lengths=(384, 3072), thresholds=(256, 99999),
+        reuse_per_group=1)
+    assert result["failed"] == 0
+    assert result["value"] == 3072  # short loses, long wins
+    legs = {leg["min_match_chars"]: leg for leg in result["legs"]}
+    assert legs[256]["pulls_received"] == 2
+    assert legs[99999]["pulls_received"] == 0
+    assert legs[256]["ledger_losses"] >= 1  # the 384-char pull lost
+    assert legs[256]["ledger_wins"] >= 1    # the 3072-char pull won
+    rec = result["advisor_recommendation_chars"]
+    assert rec is not None and 384 < rec < 3072
+    assert result["advisor_in_crossover_bracket"] is True
