@@ -256,6 +256,19 @@ SATURATION_STEPS = os.environ.get("BENCH_SATURATION_STEPS",
 SATURATION_REQS_PER_USER = _env_int("BENCH_SATURATION_REQS_PER_USER", 2)
 SATURATION_REPLICAS = _env_int("BENCH_SATURATION_REPLICAS", 4)
 SATURATION_COLLAPSE = _env_float("BENCH_SATURATION_COLLAPSE", 0.9)
+# Workers A/B: BENCH_SATURATION_WORKERS=1 runs the saturation ladder
+# twice — --router-workers 1 vs --router-workers N (legs from
+# BENCH_SATURATION_WORKERS_LEGS) — with the router as a real pre-fork
+# subprocess, per-worker loop-lag p99 and outcome reconciliation read
+# over the /debug/workers federation plane. Writes
+# BENCH_SATURATION_WORKERS_OUT (default BENCH_SATURATION_r16.json).
+SATURATION_WORKERS = _env_int("BENCH_SATURATION_WORKERS", 0)
+SATURATION_WORKERS_OUT = os.environ.get("BENCH_SATURATION_WORKERS_OUT",
+                                        "BENCH_SATURATION_r16.json")
+SATURATION_WORKERS_STEPS = os.environ.get("BENCH_SATURATION_WORKERS_STEPS",
+                                          "100,500,1000,2500")
+SATURATION_WORKERS_LEGS = os.environ.get("BENCH_SATURATION_WORKERS_LEGS",
+                                         "1,4")
 # --cold-repeat N: N fully cold serves, each in its own subprocess (no
 # warm jit caches, no reused pools — the cold-start number operators
 # actually see on a fresh replica). The artifact is rewritten and
@@ -310,9 +323,17 @@ def _run_meta() -> dict:
     }
 
 
-def _write_artifact(path: str, result: dict) -> None:
-    """Write a BENCH_*.json artifact with the run-metadata stamp."""
-    result.setdefault("meta", _run_meta())
+def _write_artifact(path: str, result: dict,
+                    worker_topology=None) -> None:
+    """Write a BENCH_*.json artifact with the run-metadata stamp.
+
+    ``worker_topology`` (saturation artifacts) records which processes
+    produced the numbers: a list of legs, each ``{"workers": N,
+    "members": [{"worker", "pid", "port"}, ...]}``. An in-process
+    single-loop run is one leg of one member (this pid)."""
+    meta = result.setdefault("meta", _run_meta())
+    if worker_topology is not None:
+        meta["worker_topology"] = worker_topology
     with open(os.path.join(REPO, path), "w") as f:
         json.dump(result, f, indent=2)
         f.write("\n")
@@ -874,8 +895,36 @@ def _saturation_main() -> None:
         replicas=SATURATION_REPLICAS,
         collapse_threshold=SATURATION_COLLAPSE))
     result["backend"] = "fake"
-    _write_artifact(SATURATION_OUT, result)
+    _write_artifact(SATURATION_OUT, result, worker_topology=[
+        {"workers": 1,
+         "members": [{"worker": 0, "pid": os.getpid(), "port": None}]},
+    ])
     print(json.dumps({k: v for k, v in result.items() if k != "rungs"}))
+
+
+def _saturation_workers_main() -> None:
+    """BENCH_SATURATION_WORKERS=1: the 1-vs-N-worker saturation A/B.
+    Fully hermetic — fake engines in this process, the router as a
+    ``--router-workers`` subprocess — so this branch never imports jax
+    or touches a device."""
+    from production_stack_tpu.testing.saturation import (
+        run_saturation_workers_ab,
+    )
+
+    steps = tuple(int(s) for s in
+                  SATURATION_WORKERS_STEPS.split(",") if s.strip())
+    legs = tuple(int(s) for s in
+                 SATURATION_WORKERS_LEGS.split(",") if s.strip())
+    result = asyncio.run(run_saturation_workers_ab(
+        steps=steps, requests_per_user=SATURATION_REQS_PER_USER,
+        replicas=SATURATION_REPLICAS, worker_legs=legs,
+        collapse_threshold=SATURATION_COLLAPSE))
+    result["backend"] = "fake"
+    _write_artifact(SATURATION_WORKERS_OUT, result, worker_topology=[
+        {"workers": leg["workers"], "members": leg["worker_topology"]}
+        for leg in result["legs"]
+    ])
+    print(json.dumps({k: v for k, v in result.items() if k != "legs"}))
 
 
 def _cold_repeat_main(n: int, cpu: bool) -> None:
@@ -966,6 +1015,9 @@ def main() -> None:
         return
     if SATURATION:
         _saturation_main()
+        return
+    if SATURATION_WORKERS:
+        _saturation_workers_main()
         return
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"

@@ -148,6 +148,24 @@ def build_alerts():
                     "GET /debug/loop names the blocking frames and the "
                     "per-component on-loop seconds."),
                 rule(
+                    "RouterWorkerStateDiverged",
+                    "sum(increase("
+                    "vllm_router:worker_state_divergence_total"
+                    "[10m])) > 0",
+                    "10m", "info",
+                    "Router workers disagree on shared state",
+                    "Aggregated reads under --router-workers caught "
+                    "the workers holding different circuit-breaker "
+                    "tables or KV prefix-trie claim digests. This is "
+                    "the designed trade of the pre-fork split — "
+                    "breakers trip per process and KV claims land on "
+                    "whichever worker accepted the connection — but "
+                    "sustained divergence quantifies how much routing "
+                    "quality the process-local state is costing and "
+                    "is the evidence meter for the shared-state "
+                    "service (docs/scale_out.md). GET /debug/workers "
+                    "shows the per-worker views side by side."),
+                rule(
                     "TPUStackBandwidthCollapse",
                     "avg by(instance) "
                     "(tpu:model_bandwidth_utilization) < 0.2 "

@@ -824,6 +824,48 @@ def build_dashboard():
              "means the baseline is degrading, not the tail"))
     y += 7
 
+    # ---- Row 12c: Router Workers (--router-workers federation) ---------- #
+    panels.append(row("Router Workers", y)); y += 1
+    panels.append(panel(
+        "timeseries", "Per-worker event-loop lag (p99)",
+        [target('vllm_router:event_loop_lag_seconds'
+                '{stat="p99", worker!=""}',
+                legend="worker {{worker}}")],
+        grid(7, 8, 0, y), unit="s",
+        desc="Under --router-workers each worker's loop-lag rollups "
+             "export as worker=\"<id>\" series (a p99 is never summed "
+             "across loops). One hot worker at flat siblings means "
+             "SO_REUSEPORT landed a heavy stream set on one process, "
+             "not that the pod needs more workers"))
+    panels.append(panel(
+        "timeseries", "Finished requests by worker",
+        [target("sum by(worker) (vllm_router:num_finished_requests"
+                '{worker!=""})',
+                legend="worker {{worker}}")],
+        grid(7, 8, 8, y),
+        desc="Each worker's own finished-request gauge (counters merge "
+             "worker-free so fleet totals stay continuous; the "
+             "per-process gauges keep the worker label). Persistent "
+             "imbalance here is the kernel's accept distribution, "
+             "visible before it shows up as lag"))
+    panels.append(panel(
+        "timeseries", "Worker state divergence & snapshot errors",
+        [target("sum by(kind) (increase("
+                "vllm_router:worker_state_divergence_total[10m]))",
+                legend="diverged {{kind}}"),
+         target("sum by(worker) (rate("
+                "vllm_router:worker_snapshot_errors_total[5m]))",
+                legend="snapshot errors worker {{worker}}")],
+        grid(7, 8, 16, y),
+        desc="Divergence: aggregated reads that caught workers "
+             "disagreeing on process-local shared state (breaker "
+             "tables, KV trie claim digests) — expected under worker "
+             "mode, and the evidence meter for the future shared-state "
+             "service (docs/scale_out.md). Snapshot errors: fan-in "
+             "fetches that failed; that worker is missing from the "
+             "merged scrape and listed in workers_failed"))
+    y += 7
+
     # ---- Row 13: Current Resource Usage (ref panels 14-19) -------------- #
     panels.append(row("Current Resource Usage", y)); y += 1
     panels.append(panel(

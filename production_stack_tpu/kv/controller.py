@@ -414,6 +414,31 @@ class KVController:
                 "hottest_prefixes": hottest,
             }
 
+    async def fed_digest(self) -> dict:
+        """Whole-trie digest for cross-worker divergence comparison
+        (``obs/federation.py``). Each multi-worker router process keeps
+        its own controller, fed only by the register/admit reports that
+        happened to land on its socket — so tries WILL diverge. The
+        digest xors a deterministic hash of every (instance, path-key)
+        claim pair (``hash()`` is per-process salted; xxhash is not), so
+        equal digests mean identical claim sets regardless of report
+        order, and the claim/instance counts show how lopsided the
+        fragmentation is."""
+        async with self._lock:
+            instance_ids = sorted(self._instances)
+            claims = 0
+            xor = 0
+            for instance_id in instance_ids:
+                for key in self._claim_keys_locked(instance_id):
+                    claims += 1
+                    xor ^= xxhash.xxh64_intdigest(
+                        f"{instance_id}:{key:016x}")
+            return {
+                "instances": len(instance_ids),
+                "claims": claims,
+                "xor": format(xor, "016x"),
+            }
+
     async def deregister_instance(self, instance_id: str) -> None:
         async with self._lock:
             self._instances.pop(instance_id, None)

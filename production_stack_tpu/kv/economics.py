@@ -277,3 +277,14 @@ class PullLedger:
         out = list(self._records)
         out.reverse()
         return out[:max(int(limit), 0)]
+
+    def fed_snapshot(self, limit: int = 100) -> dict:
+        """Worker-local state for the federation plane: the cumulative
+        summary (wins/losses/seconds-saved sum across workers — each
+        worker only ledgers the pulls its own process brokered) plus
+        newest-first ring records for ``federation.merge_rings`` (time
+        key ``t``)."""
+        return {
+            "summary": self.summary(),
+            "records": self.snapshot(limit=limit),
+        }

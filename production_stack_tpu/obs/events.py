@@ -108,6 +108,14 @@ class EventJournal:
                 "kind_counts": dict(self._kind_counts),
             }
 
+    def fed_snapshot(self, limit: int = 100) -> dict:
+        """Worker-local state for the federation plane: the summary
+        (whose ``kind_counts`` the merged view sums) plus newest-first
+        ring records ready for ``federation.merge_rings``."""
+        out = self.summary()
+        out["events"] = self.snapshot(limit=limit)
+        return out
+
     def to_grafana(self, limit: int = 100, kind: Optional[str] = None) -> List[dict]:
         """Events in the Grafana annotations JSON shape (one annotation
         per event: epoch-millis ``time``, ``tags``, markdown ``text``), so

@@ -1,0 +1,269 @@
+"""Worker telemetry federation: snapshot/merge for every router store.
+
+The multi-worker router (``--router-workers N``, SO_REUSEPORT pre-fork)
+runs N identical processes behind one port. Every telemetry surface the
+stack built — the prometheus registry, the TraceRecorder ring, the
+EventJournal, the SLO outcome counts, the loop-monitor rings, the KV
+pull ledger — is process-local in-memory state, so without this module
+going multi-worker silently fragments ``/metrics`` into whichever
+worker the scrape landed on and turns every ``/debug/*`` view into a
+1/N sample. This module is the merge half of the federation protocol:
+
+- Each store exposes a ``fed_snapshot()`` (JSON-serializable local
+  state; see ``obs/trace.py``, ``obs/events.py``, ``obs/looplag.py``,
+  ``router/slo.py``, ``kv/economics.py``) and the registry is dumped by
+  ``router/metrics.py:registry_snapshot()``. Snapshots travel over the
+  privileged per-worker ``GET /debug/snapshot`` (UDS loopback).
+- The functions here merge those snapshots: counters and histogram
+  samples SUM across workers; gauges follow an explicit semantics map
+  (cumulative mirrors sum, identical-view gauges take max, everything
+  else becomes a per-``worker``-labeled series); ring records are
+  stamped ``worker=<id>`` and re-sorted newest-first.
+- Shared mutable state (breaker views, the KV controller trie) is NOT
+  merged — each worker's view is digested and compared, and divergence
+  is reported (``/debug/workers``) instead of papered over.
+
+Stdlib-only, like the rest of ``obs/``: the HTTP fan-in lives in
+``router/workers.py``; everything here is pure data transformation so
+it unit-tests without sockets.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List, Optional, Tuple
+
+#: Label added to per-worker series and merged ring records.
+WORKER_LABEL = "worker"
+
+#: Gauges whose value is a cumulative total mirrored from a monotonic
+#: source at scrape time (the ``_total``-suffixed gauge convention):
+#: summing across workers reproduces the fleet total, exactly like a
+#: counter.
+GAUGE_SUM = frozenset({
+    "vllm_router:trace_sampled_out_total",
+    "vllm_router:slow_trace_logs_suppressed_total",
+    "vllm_router:loop_stalls_total",
+    "vllm_router:loop_component_seconds_total",
+    "vllm_router:kv_pull_net_seconds_saved_total",
+})
+
+#: Gauges every worker computes from the same underlying source (service
+#: discovery, engine-side scrapes): the views are identical up to scrape
+#: phase, so summing would multiply by N — take the max instead.
+GAUGE_MAX = frozenset({
+    "vllm_router:healthy_pods_total",
+    "vllm_router:autoscale_recommended_replicas",
+    "vllm_router:autoscale_current_replicas",
+    "vllm_router:num_requests_running",
+    "vllm_router:num_requests_waiting",
+    "vllm_router:gpu_cache_usage_perc",
+    "vllm_router:gpu_prefix_cache_hit_rate",
+})
+# Every other gauge (per-worker traffic slices like current_qps /
+# avg_ttft, process gauges like mem_usage_bytes, window rollups like
+# event_loop_lag_seconds{stat=p99} and goodput_ratio, per-process views
+# like circuit_state and kv_controller_instances) gets a worker label:
+# those values are only meaningful per process.
+
+
+def _sample_key(name: str, labels: Dict[str, str]) -> Tuple:
+    return (name, tuple(sorted(labels.items())))
+
+
+def merge_metric_families(worker_families: Dict[int, List[dict]]
+                          ) -> List[dict]:
+    """Merge per-worker registry snapshots into one family list.
+
+    ``worker_families``: worker id -> ``registry_snapshot()`` output
+    (list of ``{"name", "type", "documentation", "samples":
+    [[sample_name, labels, value], ...]}``). Counter, histogram, and
+    summary samples sum per (name, labels); ``_created`` timestamps take
+    the earliest. Gauges follow :data:`GAUGE_SUM` / :data:`GAUGE_MAX`,
+    defaulting to a per-worker ``worker=<id>`` label.
+    """
+    order: List[str] = []
+    meta: Dict[str, dict] = {}
+    # family name -> sample key -> [sample_name, labels, value]
+    merged: Dict[str, Dict[Tuple, list]] = {}
+    for wid in sorted(worker_families):
+        for family in worker_families[wid]:
+            name = family["name"]
+            if name not in meta:
+                order.append(name)
+                meta[name] = {"name": name,
+                              "type": family.get("type", "untyped"),
+                              "documentation":
+                                  family.get("documentation", "")}
+                merged[name] = {}
+            ftype = meta[name]["type"]
+            bucket = merged[name]
+            for sample_name, labels, value in family.get("samples", ()):
+                labels = dict(labels)
+                if ftype == "gauge" and name not in GAUGE_SUM \
+                        and name not in GAUGE_MAX:
+                    labels[WORKER_LABEL] = str(wid)
+                key = _sample_key(sample_name, labels)
+                prior = bucket.get(key)
+                if prior is None:
+                    bucket[key] = [sample_name, labels, value]
+                elif sample_name.endswith("_created"):
+                    prior[2] = min(prior[2], value)
+                elif ftype == "gauge" and name in GAUGE_MAX:
+                    prior[2] = max(prior[2], value)
+                else:  # counters, histograms, summaries, GAUGE_SUM
+                    prior[2] = prior[2] + value
+    out = []
+    for name in order:
+        family = dict(meta[name])
+        samples = sorted(merged[name].values(),
+                         key=lambda s: (s[0], sorted(s[1].items())))
+        family["samples"] = samples
+        out.append(family)
+    return out
+
+
+def _escape_label_value(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _format_value(value: float) -> str:
+    # prometheus_client text format: integers render as "1.0".
+    if value == float("inf"):
+        return "+Inf"
+    if value == float("-inf"):
+        return "-Inf"
+    return repr(float(value))
+
+
+def render_exposition(families: List[dict]) -> bytes:
+    """Merged families rendered in the Prometheus text exposition
+    format (the merged ``/metrics`` body worker 0 serves)."""
+    lines: List[str] = []
+    for family in families:
+        doc = (family.get("documentation") or "").replace("\\", "\\\\") \
+            .replace("\n", "\\n")
+        lines.append(f"# HELP {family['name']} {doc}")
+        lines.append(f"# TYPE {family['name']} {family.get('type', 'untyped')}")
+        for sample_name, labels, value in family["samples"]:
+            if labels:
+                label_str = ",".join(
+                    f'{k}="{_escape_label_value(v)}"'
+                    for k, v in sorted(labels.items()))
+                lines.append(
+                    f"{sample_name}{{{label_str}}} {_format_value(value)}")
+            else:
+                lines.append(f"{sample_name} {_format_value(value)}")
+    return ("\n".join(lines) + "\n").encode("utf-8")
+
+
+def merge_rings(worker_records: Dict[int, Iterable[dict]],
+                time_key: str = "time_unix",
+                limit: Optional[int] = None) -> List[dict]:
+    """Merge per-worker ring snapshots (each already newest-first) into
+    one newest-first list with every record stamped ``worker=<id>``."""
+    out: List[dict] = []
+    for wid, records in worker_records.items():
+        for rec in records or ():
+            stamped = dict(rec)
+            stamped[WORKER_LABEL] = wid
+            out.append(stamped)
+    out.sort(key=lambda r: float(r.get(time_key) or 0.0), reverse=True)
+    if limit is not None:
+        out = out[:max(int(limit), 0)]
+    return out
+
+
+def sum_counts(dicts: Iterable[Optional[Dict[str, float]]]
+               ) -> Dict[str, float]:
+    """Per-key sum across worker count dicts (SLO outcomes, event kind
+    counts); ``None`` entries (store absent on that worker) skipped."""
+    out: Dict[str, float] = {}
+    for d in dicts:
+        for key, value in (d or {}).items():
+            out[key] = out.get(key, 0) + value
+    return out
+
+
+def parse_worker_param(raw: Optional[str],
+                       worker_ids: Iterable[int]) -> Optional[int]:
+    """Validate a ``?worker=`` filter. Returns None when absent, the
+    worker id when valid, raises ValueError (the 400 message) otherwise."""
+    if raw is None or raw == "":
+        return None
+    try:
+        wid = int(raw)
+    except (TypeError, ValueError):
+        raise ValueError("worker must be an integer")
+    known = sorted(set(worker_ids))
+    if wid not in known:
+        raise ValueError(f"unknown worker {wid} (workers: {known})")
+    return wid
+
+
+def _canonical(view) -> str:
+    return json.dumps(view, sort_keys=True, separators=(",", ":"),
+                      default=str)
+
+
+#: Shared-mutable-state digests compared across workers. Keys must match
+#: what ``router/workers.py:local_snapshot`` puts under ``divergence``.
+DIVERGENCE_KINDS = ("breaker_view", "trie_digest")
+
+
+def divergence_report(snaps: List[dict]) -> Dict[str, dict]:
+    """Compare each worker's shared-state digests pairwise.
+
+    Divergence here is EXPECTED under ``--router-workers``: breakers
+    trip per process, and KV register/admit reports land on whichever
+    worker accepted the connection. The report (and the
+    ``vllm_router:worker_state_divergence_total`` counter fed from it)
+    exists to measure that fragmentation so the future state-service
+    split is justified by evidence, not assumption.
+    """
+    out: Dict[str, dict] = {}
+    for kind in DIVERGENCE_KINDS:
+        views = {int(s["worker"]): (s.get("divergence") or {}).get(kind)
+                 for s in snaps}
+        canon = {_canonical(v) for v in views.values()}
+        out[kind] = {
+            "diverged": len(canon) > 1,
+            "views": {str(w): views[w] for w in sorted(views)},
+        }
+    return out
+
+
+def merge_worker_snapshots(snaps: List[dict]) -> dict:
+    """The ``/debug/workers`` body: topology, per-worker rollups, summed
+    outcomes, and the shared-state divergence report."""
+    snaps = sorted(snaps, key=lambda s: int(s["worker"]))
+    per_worker = []
+    for snap in snaps:
+        loop = snap.get("loop") or {}
+        summary = loop.get("summary") or {}
+        lag = summary.get("lag") or {}
+        slo = snap.get("slo") or {}
+        per_worker.append({
+            "worker": int(snap["worker"]),
+            "pid": snap.get("pid"),
+            "time_unix": snap.get("time_unix"),
+            "outcomes": slo.get("counts"),
+            "loop_lag_p99_s": lag.get("p99"),
+            "loop_lag_window": loop.get("window"),
+            "loop_samples_total": summary.get("samples_total"),
+            "loop_stall_s": summary.get("stall_s_measured"),
+            "traces_recorded_total":
+                (snap.get("traces") or {}).get("recorded_total"),
+            "events_recorded_total":
+                (snap.get("events") or {}).get("recorded_total"),
+        })
+    return {
+        "workers": [int(s["worker"]) for s in snaps],
+        "per_worker": per_worker,
+        "outcomes": sum_counts(
+            (s.get("slo") or {}).get("counts") for s in snaps),
+        "events_kind_counts": sum_counts(
+            (s.get("events") or {}).get("kind_counts") for s in snaps),
+        "divergence": divergence_report(snaps),
+    }

@@ -460,3 +460,18 @@ class LoopMonitor:
             "watchdog_samples": det.samples_total,
             "components": self.components.stats(),
         }
+
+    def fed_snapshot(self, lag_window_s: Optional[float] = None,
+                     blockers: int = 10) -> dict:
+        """Worker-local state for the federation plane. ``lag_window_s``
+        adds a windowed percentile rollup (the saturation harness reads
+        per-worker lag p99 over exactly one rung's elapsed time)."""
+        out = {
+            "summary": self.summary(),
+            "top_blockers": self.detector.top_blockers(limit=blockers),
+        }
+        if lag_window_s is not None:
+            out["window"] = dict(
+                self.percentiles(window_s=float(lag_window_s)),
+                window_s=float(lag_window_s))
+        return out

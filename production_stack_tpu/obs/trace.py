@@ -567,6 +567,27 @@ class TraceRecorder:
         with self._lock:
             return {k: (v[0], v[1]) for k, v in self._stage.items()}
 
+    def fed_snapshot(self, limit: int = 100,
+                     request_id: Optional[str] = None) -> dict:
+        """Worker-local state for the federation plane
+        (``obs/federation.py``): ring summaries newest-first plus the
+        cumulative counters the merged view sums. ``request_id`` pulls
+        one full trace timeline so the multi-worker
+        ``/debug/traces/{id}`` fan-in can find which worker holds it."""
+        out = {
+            "service": self.service,
+            "capacity": self.capacity,
+            "recorded_total": self.recorded_total,
+            "slow_requests": self.slow_requests,
+            "sampled_out_total": self.sampled_out_total,
+            "slow_logs_suppressed_total": self.slow_logs_suppressed_total,
+            "traces": self.list(limit=limit),
+        }
+        if request_id is not None:
+            tr = self.get(request_id)
+            out["trace"] = tr.to_dict() if tr is not None else None
+        return out
+
     def close(self) -> None:
         if self._exporter is not None:
             self._exporter.close()

@@ -76,6 +76,14 @@ class RouterState:
     canary: Any = None  # CanaryProber when --canary-interval > 0
     events: Any = None  # EventJournal (always on; bounded ring is cheap)
     loop_monitor: Any = None  # LoopMonitor when --loop-monitor is set
+    # Multi-worker plane (--router-workers; router/workers.py). Defaults
+    # describe the single-process router: worker 0 of 1, no snapshot
+    # sockets — /debug/snapshot and /debug/workers then serve local-only
+    # views without any fan-in.
+    worker_id: int = 0
+    worker_count: int = 1
+    worker_uds: tuple = ()
+    worker_port: int = 0
     extra: dict = field(default_factory=dict)
 
 
@@ -571,9 +579,18 @@ def build_app(args) -> web.Application:
     app.router.add_get("/v1/models", show_models)
     app.router.add_get("/models", show_models)
     app.router.add_get("/engines", show_engines)
+    from production_stack_tpu.router import workers as workers_mod
+
     app.router.add_get("/health", health)
     app.router.add_get("/version", version)
-    app.router.add_get("/metrics", metrics_handler)
+    # Multi-worker mode swaps in the aggregated scrape (fan-in over every
+    # worker's /debug/snapshot, merged by obs/federation.py); the
+    # single-worker handler below stays byte-identical to before.
+    if state.worker_count > 1:
+        app.router.add_get(
+            "/metrics", workers_mod.aggregated_metrics_handler)
+    else:
+        app.router.add_get("/metrics", metrics_handler)
     app.router.add_get("/dynamic_config", dynamic_config_handler)
     async def _sleep(r):
         return await request_service.route_sleep_wakeup_request(r, "sleep")
@@ -622,34 +639,50 @@ def build_app(args) -> web.Application:
     # Autoscale recommender (404 unless --autoscale)
     app.router.add_get("/autoscale/recommendation", autoscale_recommendation)
     app.router.add_post("/autoscale/scale_in", autoscale_scale_in)
-    # Flight recorder (router-side spans of every proxied request).
-    if state.trace_recorder is not None:
-        from production_stack_tpu.obs.debug import add_debug_routes
+    if state.worker_count > 1:
+        # Multi-worker: the list-view debug routes fan in over every
+        # worker's /debug/snapshot and serve merged, worker=<id>-stamped
+        # views at the same paths with the same filters (plus ?worker=).
+        # Registration gating matches the single-worker branch below.
+        workers_mod.add_federated_debug_routes(app.router, state)
+    else:
+        # Flight recorder (router-side spans of every proxied request).
+        if state.trace_recorder is not None:
+            from production_stack_tpu.obs.debug import add_debug_routes
 
-        add_debug_routes(app.router, state.trace_recorder)
-    # Fleet event journal (privileged: /debug/events is in
-    # _PRIVILEGED_EXACT, so the auth middleware gates it when a
-    # deployment key is configured).
-    if state.events is not None:
-        from production_stack_tpu.obs.debug import add_event_debug_routes
+            add_debug_routes(app.router, state.trace_recorder)
+        # Fleet event journal (privileged: /debug/events is in
+        # _PRIVILEGED_EXACT, so the auth middleware gates it when a
+        # deployment key is configured).
+        if state.events is not None:
+            from production_stack_tpu.obs.debug import (
+                add_event_debug_routes)
 
-        add_event_debug_routes(app.router, state.events)
-    # Event-loop health (privileged: /debug/loop is in _PRIVILEGED_EXACT).
-    if state.loop_monitor is not None:
-        from production_stack_tpu.obs.debug import add_loop_debug_routes
+            add_event_debug_routes(app.router, state.events)
+        # Event-loop health (privileged: /debug/loop is in
+        # _PRIVILEGED_EXACT).
+        if state.loop_monitor is not None:
+            from production_stack_tpu.obs.debug import (
+                add_loop_debug_routes)
 
-        add_loop_debug_routes(app.router, state.loop_monitor)
+            add_loop_debug_routes(app.router, state.loop_monitor)
+        if state.fleet is not None:
+            from production_stack_tpu.obs.debug import (
+                add_kv_economics_debug_routes)
+
+            add_kv_economics_debug_routes(app.router, state.fleet)
     # KV trie introspection (privileged via the /debug/kv/ prefix); the
     # pull-economics ledger rides only with --fleet-cache — without it
     # there is no ledger, and authenticated callers see 404, never 401.
+    # The trie stays a LOCAL view in every mode: each worker's trie is
+    # genuinely different state; /debug/workers reports the divergence.
     from production_stack_tpu.obs.debug import add_kv_trie_debug_routes
 
     add_kv_trie_debug_routes(app.router, state.kv_controller)
-    if state.fleet is not None:
-        from production_stack_tpu.obs.debug import (
-            add_kv_economics_debug_routes)
-
-        add_kv_economics_debug_routes(app.router, state.fleet)
+    # Worker federation plane, every mode: /debug/snapshot (this
+    # process's telemetry feed) and /debug/workers (topology + shared-
+    # state divergence). Both privileged (utils/auth.py).
+    workers_mod.add_worker_plane_routes(app.router, state)
 
     async def on_startup(app: web.Application):
         st = app["state"]
@@ -785,6 +818,14 @@ def initialize_all(args) -> RouterState:
     """Wire all singletons (reference app.py:112-272)."""
     state = RouterState()
     _init_sentry(args)
+
+    # Multi-worker identity (--router-workers; router/workers.py sets the
+    # private attrs before build_app in each forked process). Defaults
+    # reproduce the single-process router exactly.
+    state.worker_id = int(getattr(args, "_worker_id", 0) or 0)
+    state.worker_count = int(getattr(args, "router_workers", 1) or 1)
+    state.worker_uds = tuple(getattr(args, "_worker_uds", ()) or ())
+    state.worker_port = int(getattr(args, "port", 0) or 0)
 
     # Tracing flight recorder (always on: a bounded ring buffer is cheap;
     # export + slow-trace logging are opt-in flags).
@@ -1090,6 +1131,16 @@ def main(argv=None) -> None:
 
     logging.getLogger().setLevel(args.log_level.upper())
     set_ulimit()
+    workers = int(getattr(args, "router_workers", 1) or 1)
+    if workers > 1:
+        # Pre-fork BEFORE build_app: initialize_all starts scraper
+        # threads and asyncio machinery that must not cross a fork.
+        from production_stack_tpu.router.workers import run_multi_worker
+
+        logger.info("Router pre-forking %d workers on %s:%d "
+                    "(SO_REUSEPORT)", workers, args.host, args.port)
+        run_multi_worker(args)
+        return
     app = build_app(args)
     logger.info("Router listening on %s:%d", args.host, args.port)
     web.run_app(app, host=args.host, port=args.port, access_log=None)

@@ -346,5 +346,45 @@ def update_gauges(endpoints, engine_stats: Dict, request_stats: Dict,
         pass
 
 
+# --- Multi-worker federation (--router-workers, obs/federation.py) -------
+# All labeled: series appear only when the pre-fork plane actually runs a
+# fan-in, so a single-worker deployment's /metrics surface stays
+# byte-identical (flag-off parity, same convention as the SLO and loop
+# blocks above).
+worker_state_divergence = Counter(
+    "vllm_router:worker_state_divergence_total",
+    "Fan-in rounds (aggregated /metrics scrape or /debug/workers read) "
+    "in which the named shared-state digest differed across router "
+    "workers: kind=breaker_view (circuit breaker states) or "
+    "kind=trie_digest (KV controller claim sets). Divergence is expected "
+    "under --router-workers — each process holds its own copy — this "
+    "counter measures how often, as evidence for the state-service "
+    "split",
+    ["kind"], registry=REGISTRY)
+worker_snapshot_errors = Counter(
+    "vllm_router:worker_snapshot_errors_total",
+    "Per-worker GET /debug/snapshot fan-in fetches that failed (worker "
+    "dead, UDS gone, timeout); the merged view is served from the "
+    "workers that answered",
+    ["worker"], registry=REGISTRY)
+
+
+def registry_snapshot() -> list:
+    """The whole registry as JSON-serializable sample families — the
+    metrics leg of a worker's /debug/snapshot body, merged across
+    workers by ``obs/federation.py:merge_metric_families`` (which stays
+    stdlib-only; prometheus_client is only imported here)."""
+    out = []
+    for family in REGISTRY.collect():
+        out.append({
+            "name": family.name,
+            "type": family.type,
+            "documentation": family.documentation,
+            "samples": [[s.name, dict(s.labels), s.value]
+                        for s in family.samples],
+        })
+    return out
+
+
 def render_metrics() -> bytes:
     return generate_latest(REGISTRY)

@@ -22,6 +22,14 @@ def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(description="TPU production-stack router")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8001)
+    parser.add_argument(
+        "--router-workers", type=int, default=1,
+        help="Router worker processes sharing the port via SO_REUSEPORT "
+        "pre-fork. Telemetry federates across workers (aggregated "
+        "/metrics and /debug/* fan in over per-worker snapshots); KV "
+        "claims, token buckets, and circuit breakers stay process-local "
+        "— see docs/scale_out.md. 1 (default) keeps the single-process "
+        "router byte-identical.")
     # Service discovery
     parser.add_argument(
         "--service-discovery",
@@ -375,6 +383,8 @@ def validate_args(args: argparse.Namespace) -> None:
         raise ValueError("--canary-max-tokens must be >= 1")
     if getattr(args, "loop_stall_threshold_ms", 100.0) <= 0.0:
         raise ValueError("--loop-stall-threshold-ms must be > 0")
+    if getattr(args, "router_workers", 1) < 1:
+        raise ValueError("--router-workers must be >= 1")
 
 
 def expand_static_models_config(config: dict) -> dict:

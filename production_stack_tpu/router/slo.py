@@ -181,6 +181,18 @@ class SLOEngine:
             if ratio is not None:
                 router_metrics.goodput_ratio.labels(window=name).set(ratio)
 
+    def fed_snapshot(self) -> dict:
+        """Worker-local state for the federation plane: the outcome ring
+        counts (summed across workers by ``federation.sum_counts`` — the
+        reconciliation invariant Σ workers Σ outcomes == responses rides
+        on this) plus this worker's goodput over each window (a ratio,
+        so merged views report it per worker, never summed)."""
+        return {
+            "counts": self.counts(),
+            "goodput": {name: self.goodput(seconds)
+                        for name, seconds in GOODPUT_WINDOWS},
+        }
+
 
 class CanaryProber:
     """Background synthetic prober: one tiny streamed completion per

@@ -1,0 +1,621 @@
+"""Multi-worker router plane: SO_REUSEPORT pre-fork + telemetry fan-in.
+
+``--router-workers N`` forks N identical router processes that share the
+public TCP port via ``SO_REUSEPORT`` (the kernel load-balances accepted
+connections). Each worker additionally listens on a private Unix socket
+(``worker-<id>.sock`` in a 0700 tempdir) serving the privileged
+``GET /debug/snapshot`` — the federation feed carrying that worker's
+registry samples, trace/event/economics rings, SLO outcome counts,
+loop-monitor rollups, and shared-state digests.
+
+Aggregation is SYMMETRIC: whichever worker receives ``/metrics`` or a
+federated ``/debug/*`` read fans in over every worker's snapshot socket
+(its own included — the self-request over the UDS is async and cheap)
+and serves the merged view from ``obs/federation.py``. The issue frames
+this as "worker 0 aggregates", but under SO_REUSEPORT the kernel picks
+the accepting worker, so pinning aggregation to worker 0 would make the
+merged view reachable only by luck; making every worker an aggregator
+gives the same merged answer on every connection.
+
+What does NOT federate: KV controller claims, tenant token buckets,
+circuit breakers, and single-flight pull dedup stay process-local.
+Their cross-worker drift is *measured* instead — breaker-view and
+trie-digest comparisons surface in ``/debug/workers`` and the
+``vllm_router:worker_state_divergence_total`` counter (see
+docs/scale_out.md for interpretation).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import shutil
+import signal
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+from aiohttp import web
+
+from production_stack_tpu.obs import federation
+from production_stack_tpu.router import metrics as metrics_mod
+from production_stack_tpu.utils import auth
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+#: /debug/snapshot body sections; ``?sections=metrics,divergence`` lets
+#: the aggregated /metrics scrape skip ring payloads it will not use.
+SNAPSHOT_SECTIONS = ("metrics", "traces", "events", "slo", "loop",
+                     "kv_economics", "divergence")
+
+#: Fan-in budget per snapshot fetch. Generous because the saturation
+#: harness reads /debug/workers right after a rung drains, when worker
+#: loops may still be catching up.
+FANIN_TIMEOUT_S = 15.0
+
+
+# ---------------------------------------------------------------------------
+# Local snapshot (the per-worker federation feed)
+# ---------------------------------------------------------------------------
+
+
+def _refresh_scrape_mirrors(state) -> None:
+    # Same scrape-time refresh the single-worker /metrics handler does
+    # (app.metrics_handler keeps its own copy so its flag-off byte
+    # parity never depends on this module).
+    metrics_mod.update_gauges(
+        state.service_discovery.get_endpoint_info(),
+        state.engine_stats_scraper.get_engine_stats(),
+        state.request_stats_monitor.get_request_stats(),
+        fault_tolerance=state.fault_tolerance,
+    )
+    if state.trace_recorder is not None:
+        metrics_mod.trace_sampled_out.set(
+            state.trace_recorder.sampled_out_total)
+        metrics_mod.slow_trace_logs_suppressed.set(
+            state.trace_recorder.slow_logs_suppressed_total)
+    if state.slo is not None:
+        state.slo.refresh_gauges()
+    if state.loop_monitor is not None:
+        metrics_mod.mirror_loop_metrics(state.loop_monitor)
+
+
+async def local_snapshot(state, *, sections=None, limit: int = 100,
+                         lag_window_s: Optional[float] = None,
+                         blockers: int = 10,
+                         trace_id: Optional[str] = None,
+                         trace_format: Optional[str] = None) -> dict:
+    """This worker's federation feed: every store's ``fed_snapshot()``
+    plus the registry dump and shared-state divergence digests."""
+    want = frozenset(sections) if sections else frozenset(SNAPSHOT_SECTIONS)
+    snap: dict = {
+        "worker": state.worker_id,
+        "workers": state.worker_count,
+        "pid": os.getpid(),
+        "port": state.worker_port,
+        "time_unix": time.time(),
+        "sections": sorted(want),
+    }
+    if "metrics" in want:
+        _refresh_scrape_mirrors(state)
+        snap["metrics"] = metrics_mod.registry_snapshot()
+    if "traces" in want and state.trace_recorder is not None:
+        snap["traces"] = state.trace_recorder.fed_snapshot(
+            limit=limit, request_id=trace_id)
+        if trace_id is not None and trace_format == "otlp":
+            tr = state.trace_recorder.get(trace_id)
+            snap["traces"]["trace_otlp"] = (
+                tr.to_otlp() if tr is not None else None)
+    if "events" in want and state.events is not None:
+        snap["events"] = state.events.fed_snapshot(limit=limit)
+    if "slo" in want and state.slo is not None:
+        snap["slo"] = state.slo.fed_snapshot()
+    if "loop" in want and state.loop_monitor is not None:
+        snap["loop"] = state.loop_monitor.fed_snapshot(
+            lag_window_s=lag_window_s, blockers=blockers)
+    if "kv_economics" in want and state.fleet is not None:
+        snap["kv_economics"] = state.fleet.ledger.fed_snapshot(limit=limit)
+    if "divergence" in want:
+        snap["divergence"] = {
+            "breaker_view": (
+                state.fault_tolerance.breaker.snapshot()
+                if state.fault_tolerance is not None else {}),
+            "trie_digest": await state.kv_controller.fed_digest(),
+        }
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# Fan-in over the per-worker snapshot sockets
+# ---------------------------------------------------------------------------
+
+
+def _snapshot_query(*, sections=None, limit: Optional[int] = None,
+                    lag_window_s: Optional[float] = None,
+                    blockers: Optional[int] = None,
+                    trace_id: Optional[str] = None,
+                    trace_format: Optional[str] = None) -> Dict[str, str]:
+    query: Dict[str, str] = {}
+    if sections:
+        query["sections"] = ",".join(sections)
+    if limit is not None:
+        query["limit"] = str(int(limit))
+    if lag_window_s is not None:
+        query["lag_window_s"] = repr(float(lag_window_s))
+    if blockers is not None:
+        query["blockers"] = str(int(blockers))
+    if trace_id is not None:
+        query["trace"] = trace_id
+    if trace_format is not None:
+        query["trace_format"] = trace_format
+    return query
+
+
+async def _fetch_one(wid: int, uds_path: str,
+                     query: Dict[str, str]) -> Optional[dict]:
+    import aiohttp
+
+    try:
+        connector = aiohttp.UnixConnector(path=uds_path)
+        timeout = aiohttp.ClientTimeout(total=FANIN_TIMEOUT_S)
+        async with aiohttp.ClientSession(connector=connector,
+                                         timeout=timeout) as session:
+            async with session.get(
+                    "http://worker/debug/snapshot", params=query,
+                    headers=auth.deployment_auth_headers()) as resp:
+                if resp.status != 200:
+                    raise RuntimeError(f"snapshot HTTP {resp.status}")
+                return await resp.json()
+    except Exception as e:  # noqa: BLE001 — a dead worker must not 500 the view
+        logger.warning("worker %d snapshot fan-in failed: %s", wid, e)
+        metrics_mod.worker_snapshot_errors.labels(worker=str(wid)).inc()
+        return None
+
+
+async def fetch_worker_snapshots(state, **kwargs
+                                 ) -> Tuple[List[dict], List[int]]:
+    """All workers' snapshots (self included, over its own UDS so every
+    worker runs the identical code path). Returns (snapshots, failed
+    worker ids); single-worker mode short-circuits to a local call."""
+    if state.worker_count <= 1 or not state.worker_uds:
+        return [await local_snapshot(state, **kwargs)], []
+    query = _snapshot_query(**kwargs)
+    results = await asyncio.gather(*(
+        _fetch_one(wid, uds_path, query)
+        for wid, uds_path in enumerate(state.worker_uds)))
+    snaps = [s for s in results if s is not None]
+    failed = [wid for wid, s in enumerate(results) if s is None]
+    return snaps, failed
+
+
+def _note_divergence(report: Dict[str, dict]) -> None:
+    for kind, entry in report.items():
+        if entry.get("diverged"):
+            metrics_mod.worker_state_divergence.labels(kind=kind).inc()
+
+
+# ---------------------------------------------------------------------------
+# Query validation (the 400 contract shared with obs/debug.py)
+# ---------------------------------------------------------------------------
+
+
+def _bad(message: str) -> web.Response:
+    return web.json_response({"error": message}, status=400)
+
+
+def _parse_common_query(request: web.Request):
+    """(kwargs for fetch/local_snapshot) or an error Response."""
+    out: dict = {}
+    try:
+        out["limit"] = int(request.query.get("limit", 100) or 100)
+    except ValueError:
+        return _bad("limit must be an integer")
+    if out["limit"] < 1:
+        return _bad("limit must be >= 1")
+    raw_window = request.query.get("lag_window_s")
+    if raw_window:
+        try:
+            out["lag_window_s"] = float(raw_window)
+        except ValueError:
+            return _bad("lag_window_s must be a number")
+        if out["lag_window_s"] <= 0:
+            return _bad("lag_window_s must be > 0")
+    try:
+        out["blockers"] = int(request.query.get("blockers", 10) or 10)
+    except ValueError:
+        return _bad("blockers must be an integer")
+    if out["blockers"] < 1:
+        return _bad("blockers must be >= 1")
+    return out
+
+
+def _parse_worker_query(request: web.Request, state):
+    """validated ``?worker=`` (None when absent) or an error Response."""
+    try:
+        return federation.parse_worker_param(
+            request.query.get("worker"), range(state.worker_count))
+    except ValueError as e:
+        return _bad(str(e))
+
+
+# ---------------------------------------------------------------------------
+# Always-registered worker plane routes
+# ---------------------------------------------------------------------------
+
+
+async def debug_snapshot_handler(request: web.Request) -> web.Response:
+    """Privileged per-worker federation feed. Local by construction —
+    never fans in, so aggregators can call it without recursion."""
+    state = request.app["state"]
+    kwargs = _parse_common_query(request)
+    if isinstance(kwargs, web.Response):
+        return kwargs
+    raw_sections = request.query.get("sections")
+    if raw_sections:
+        sections = tuple(s for s in raw_sections.split(",") if s)
+        unknown = [s for s in sections if s not in SNAPSHOT_SECTIONS]
+        if unknown:
+            return _bad(f"unknown sections {unknown} "
+                        f"(one of: {', '.join(SNAPSHOT_SECTIONS)})")
+        kwargs["sections"] = sections
+    trace_id = request.query.get("trace")
+    if trace_id:
+        kwargs["trace_id"] = trace_id
+        trace_format = request.query.get("trace_format")
+        if trace_format:
+            if trace_format != "otlp":
+                return _bad("trace_format must be otlp")
+            kwargs["trace_format"] = trace_format
+    return web.json_response(await local_snapshot(state, **kwargs))
+
+
+async def debug_workers_handler(request: web.Request) -> web.Response:
+    """Cross-worker topology, per-worker outcome/lag rollups, and the
+    shared-state divergence report. Works in single-worker mode too
+    (one-entry topology, nothing to diverge from)."""
+    state = request.app["state"]
+    kwargs = _parse_common_query(request)
+    if isinstance(kwargs, web.Response):
+        return kwargs
+    worker_filter = _parse_worker_query(request, state)
+    if isinstance(worker_filter, web.Response):
+        return worker_filter
+    kwargs["sections"] = ("traces", "events", "slo", "loop", "divergence")
+    snaps, failed = await fetch_worker_snapshots(state, **kwargs)
+    if not snaps:
+        return web.json_response(
+            {"error": "no worker snapshots reachable",
+             "workers_failed": failed}, status=503)
+    merged = federation.merge_worker_snapshots(snaps)
+    _note_divergence(merged["divergence"])
+    merged["workers_configured"] = state.worker_count
+    merged["workers_failed"] = failed
+    merged["port"] = state.worker_port
+    if worker_filter is not None:
+        merged["per_worker"] = [row for row in merged["per_worker"]
+                                if row["worker"] == worker_filter]
+    return web.json_response(merged)
+
+
+def add_worker_plane_routes(router, state) -> None:
+    """Registered in every mode: single-worker deployments keep the same
+    endpoint shapes (local-only snapshot, 1-entry /debug/workers), so
+    the auth coverage test and operators see one surface."""
+    router.add_get("/debug/snapshot", debug_snapshot_handler)
+    router.add_get("/debug/workers", debug_workers_handler)
+
+
+# ---------------------------------------------------------------------------
+# Multi-worker aggregated /metrics and federated /debug views
+# ---------------------------------------------------------------------------
+
+
+async def aggregated_metrics_handler(request: web.Request) -> web.Response:
+    """Merged /metrics: fan in every worker's registry snapshot and
+    render one exposition (counters summed, gauges per the federation
+    semantics maps, per-worker series labeled ``worker=<id>``)."""
+    state = request.app["state"]
+    snaps, failed = await fetch_worker_snapshots(
+        state, sections=("metrics", "divergence"))
+    if not snaps:
+        return web.json_response(
+            {"error": "no worker snapshots reachable",
+             "workers_failed": failed}, status=503)
+    _note_divergence(federation.divergence_report(snaps))
+    families = federation.merge_metric_families(
+        {int(s["worker"]): s.get("metrics") or [] for s in snaps})
+    return web.Response(body=federation.render_exposition(families),
+                        content_type="text/plain", charset="utf-8")
+
+
+def _ring_by_worker(snaps: List[dict], section: str,
+                    key: str) -> Dict[int, list]:
+    return {int(s["worker"]): (s.get(section) or {}).get(key) or []
+            for s in snaps}
+
+
+def add_federated_debug_routes(router, state) -> None:
+    """Multi-worker replacements for the list-view debug routes: same
+    paths and filters as the single-worker handlers in ``obs/debug.py``,
+    plus a 400-validated ``?worker=`` filter, with every merged record
+    stamped ``worker=<id>`` newest-first. Gating matches single-worker
+    registration (loop only with --loop-monitor, economics only with
+    --fleet-cache) so flag-off still 404s, never half-renders.
+
+    ``/debug/kv/trie`` is NOT federated on purpose: each worker's trie
+    is genuinely different state, and pretending to merge them would
+    hide exactly the fragmentation the divergence digests measure."""
+
+    async def list_traces(request: web.Request) -> web.Response:
+        kwargs = _parse_common_query(request)
+        if isinstance(kwargs, web.Response):
+            return kwargs
+        worker_filter = _parse_worker_query(request, state)
+        if isinstance(worker_filter, web.Response):
+            return worker_filter
+        try:
+            min_duration = float(
+                request.query.get("min_duration_s", 0) or 0)
+        except ValueError:
+            return _bad("min_duration_s must be a number")
+        limit = kwargs["limit"]
+        snaps, failed = await fetch_worker_snapshots(
+            state, sections=("traces",), limit=limit)
+        rings = _ring_by_worker(snaps, "traces", "traces")
+        if worker_filter is not None:
+            rings = {worker_filter: rings.get(worker_filter, [])}
+        traces = [t for t in federation.merge_rings(
+            rings, time_key="start_unix")
+            if t.get("duration_s", 0.0) >= min_duration][:limit]
+        return web.json_response({
+            "workers": sorted(rings),
+            "workers_failed": failed,
+            "recorded_total": sum(
+                (s.get("traces") or {}).get("recorded_total", 0)
+                for s in snaps),
+            "slow_requests": sum(
+                (s.get("traces") or {}).get("slow_requests", 0)
+                for s in snaps),
+            "traces": traces,
+        })
+
+    async def get_trace(request: web.Request) -> web.Response:
+        trace_format = request.query.get("format")
+        if trace_format and trace_format != "otlp":
+            return _bad("format must be otlp")
+        trace_id = request.match_info["request_id"]
+        snaps, _failed = await fetch_worker_snapshots(
+            state, sections=("traces",), limit=1, trace_id=trace_id,
+            trace_format="otlp" if trace_format == "otlp" else None)
+        for snap in snaps:
+            leg = snap.get("traces") or {}
+            if trace_format == "otlp":
+                if leg.get("trace_otlp") is not None:
+                    return web.json_response(
+                        {"resourceSpans": [leg["trace_otlp"]],
+                         "worker": int(snap["worker"])})
+            elif leg.get("trace") is not None:
+                body = dict(leg["trace"])
+                body["worker"] = int(snap["worker"])
+                return web.json_response(body)
+        return web.json_response({"error": "trace not found"}, status=404)
+
+    router.add_get("/debug/traces", list_traces)
+    router.add_get("/debug/traces/{request_id}", get_trace)
+
+    async def list_events(request: web.Request) -> web.Response:
+        kwargs = _parse_common_query(request)
+        if isinstance(kwargs, web.Response):
+            return kwargs
+        worker_filter = _parse_worker_query(request, state)
+        if isinstance(worker_filter, web.Response):
+            return worker_filter
+        kind = request.query.get("kind") or None
+        limit = kwargs["limit"]
+        snaps, failed = await fetch_worker_snapshots(
+            state, sections=("events",), limit=limit)
+        rings = _ring_by_worker(snaps, "events", "events")
+        if worker_filter is not None:
+            rings = {worker_filter: rings.get(worker_filter, [])}
+        events = [ev for ev in federation.merge_rings(
+            rings, time_key="time_unix")
+            if kind is None or ev.get("kind") == kind][:limit]
+        if request.query.get("format") == "grafana":
+            out = []
+            for ev in events:
+                tags = [ev["kind"], f"worker={ev['worker']}"]
+                if ev.get("endpoint"):
+                    tags.append(ev["endpoint"])
+                detail = " ".join(
+                    f"{k}={v}"
+                    for k, v in sorted(ev["attributes"].items()))
+                out.append({
+                    "time": int(ev["time_unix"] * 1000),
+                    "tags": tags,
+                    "text": (ev["kind"] if not detail
+                             else f"{ev['kind']}: {detail}"),
+                })
+            return web.json_response(out)
+        return web.json_response({
+            "workers": sorted(rings),
+            "workers_failed": failed,
+            "recorded_total": sum(
+                (s.get("events") or {}).get("recorded_total", 0)
+                for s in snaps),
+            "buffered": sum(
+                (s.get("events") or {}).get("buffered", 0)
+                for s in snaps),
+            "kind_counts": federation.sum_counts(
+                (s.get("events") or {}).get("kind_counts")
+                for s in snaps),
+            "events": events,
+        })
+
+    router.add_get("/debug/events", list_events)
+
+    if state.loop_monitor is not None:
+        async def loop_health(request: web.Request) -> web.Response:
+            kwargs = _parse_common_query(request)
+            if isinstance(kwargs, web.Response):
+                return kwargs
+            worker_filter = _parse_worker_query(request, state)
+            if isinstance(worker_filter, web.Response):
+                return worker_filter
+            snaps, failed = await fetch_worker_snapshots(
+                state, sections=("loop",),
+                lag_window_s=kwargs.get("lag_window_s"),
+                blockers=kwargs["blockers"])
+            per_worker = {}
+            for snap in snaps:
+                wid = int(snap["worker"])
+                if worker_filter is not None and wid != worker_filter:
+                    continue
+                per_worker[str(wid)] = snap.get("loop")
+            summaries = [v["summary"] for v in per_worker.values() if v]
+            return web.json_response({
+                "workers": sorted(int(w) for w in per_worker),
+                "workers_failed": failed,
+                "per_worker": per_worker,
+                "merged": {
+                    "samples_total": sum(
+                        s.get("samples_total", 0) for s in summaries),
+                    "stall_s_measured": round(sum(
+                        s.get("stall_s_measured", 0.0)
+                        for s in summaries), 6),
+                    "stalls": federation.sum_counts(
+                        s.get("stalls") for s in summaries),
+                    "lag_p99_max": max(
+                        ((s.get("lag") or {}).get("p99", 0.0)
+                         for s in summaries), default=0.0),
+                },
+            })
+
+        router.add_get("/debug/loop", loop_health)
+
+    if state.fleet is not None:
+        async def economics(request: web.Request) -> web.Response:
+            kwargs = _parse_common_query(request)
+            if isinstance(kwargs, web.Response):
+                return kwargs
+            worker_filter = _parse_worker_query(request, state)
+            if isinstance(worker_filter, web.Response):
+                return worker_filter
+            limit = kwargs["limit"]
+            snaps, failed = await fetch_worker_snapshots(
+                state, sections=("kv_economics",), limit=limit)
+            rings = _ring_by_worker(snaps, "kv_economics", "records")
+            if worker_filter is not None:
+                rings = {worker_filter: rings.get(worker_filter, [])}
+            per_worker = {
+                str(int(s["worker"])):
+                    (s.get("kv_economics") or {}).get("summary")
+                for s in snaps}
+            summed = {}
+            for field in ("recorded_total", "wins", "losses",
+                          "net_seconds_saved_total", "bytes_moved_total",
+                          "tokens_saved_total", "pull_seconds_total"):
+                summed[field] = round(sum(
+                    (v or {}).get(field, 0) for v in per_worker.values()
+                ), 6)
+            return web.json_response({
+                "workers": sorted(rings),
+                "workers_failed": failed,
+                "summary": summed,
+                "per_worker": per_worker,
+                "records": federation.merge_rings(
+                    rings, time_key="t", limit=limit),
+            })
+
+        router.add_get("/debug/kv/economics", economics)
+
+
+# ---------------------------------------------------------------------------
+# Pre-fork runner
+# ---------------------------------------------------------------------------
+
+
+async def _serve_worker(args, wid: int, uds_path: str) -> None:
+    # Imported here, not at module top: app.py imports this module's
+    # handlers, and the runner is only reached from main().
+    from production_stack_tpu.router.app import build_app
+
+    app = build_app(args)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, args.host, args.port, reuse_port=True,
+                       backlog=4096)
+    await site.start()
+    uds_site = web.UnixSite(runner, uds_path)
+    await uds_site.start()
+    logger.info("Router worker %d/%d listening on %s:%d (pid %d, uds %s)",
+                wid, args.router_workers, args.host, args.port,
+                os.getpid(), uds_path)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    try:
+        await stop.wait()
+    finally:
+        await runner.cleanup()
+
+
+def _worker_main(args, wid: int, uds_paths: Tuple[str, ...]) -> None:
+    # Worker identity rides on private args attributes so build_app /
+    # initialize_all stay signature-compatible with every existing
+    # caller (tests build apps without going through the runner).
+    args._worker_id = wid
+    args._worker_uds = uds_paths
+    asyncio.run(_serve_worker(args, wid, uds_paths[wid]))
+
+
+def _terminate_children(pids: List[int], grace_s: float = 5.0) -> None:
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+    deadline = time.monotonic() + grace_s
+    remaining = set(pids)
+    while remaining and time.monotonic() < deadline:
+        for pid in list(remaining):
+            try:
+                done, _status = os.waitpid(pid, os.WNOHANG)
+            except ChildProcessError:
+                remaining.discard(pid)
+                continue
+            if done:
+                remaining.discard(pid)
+        if remaining:
+            time.sleep(0.05)
+    for pid in remaining:  # leak-free teardown even for a hung worker
+        try:
+            os.kill(pid, signal.SIGKILL)
+            os.waitpid(pid, 0)
+        except (ProcessLookupError, ChildProcessError):
+            pass
+
+
+def run_multi_worker(args) -> None:
+    """Fork ``--router-workers`` processes BEFORE any app state exists
+    (build_app starts scraper threads and asyncio machinery that must
+    not cross a fork), serve until signaled, reap leak-free."""
+    workers = int(getattr(args, "router_workers", 1) or 1)
+    uds_dir = tempfile.mkdtemp(prefix="tpu-router-workers-")
+    uds_paths = tuple(os.path.join(uds_dir, f"worker-{wid}.sock")
+                      for wid in range(workers))
+    children: List[int] = []
+    for wid in range(1, workers):
+        pid = os.fork()
+        if pid == 0:
+            try:
+                _worker_main(args, wid, uds_paths)
+            finally:
+                os._exit(0)
+        children.append(pid)
+    try:
+        _worker_main(args, 0, uds_paths)
+    finally:
+        _terminate_children(children)
+        shutil.rmtree(uds_dir, ignore_errors=True)

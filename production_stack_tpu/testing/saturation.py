@@ -348,3 +348,297 @@ async def run_saturation(*, steps=DEFAULT_STEPS,
         "rungs": rungs,
         "engine_requests": [len(e.requests_seen) for e in engines],
     }
+
+
+# ---------------------------------------------------------------------------
+# Workers A/B: does SO_REUSEPORT alone move the rps ceiling?
+# ---------------------------------------------------------------------------
+
+#: Rung ladder for the A/B. Stops at the r13 single-loop ceiling
+#: neighborhood (knee at 1000 users) plus headroom to see whether the
+#: 4-worker leg pushes the knee out.
+WORKERS_AB_STEPS = (100, 500, 1000, 2500, 5000)
+
+
+async def _debug_workers(session, router_url: str,
+                         lag_window_s: Optional[float] = None) -> dict:
+    params = {}
+    if lag_window_s is not None:
+        params["lag_window_s"] = repr(float(lag_window_s))
+    async with session.get(router_url + "/debug/workers",
+                           params=params) as resp:
+        resp.raise_for_status()
+        return await resp.json()
+
+
+def _outcomes_by_worker(workers_body: dict) -> dict:
+    return {int(row["worker"]): dict(row.get("outcomes") or {})
+            for row in workers_body["per_worker"]}
+
+
+async def _run_workers_leg(*, workers: int, steps, requests_per_user: int,
+                           replicas: int, engine_ttft: float,
+                           client_timeout_s: float,
+                           collapse_threshold: float,
+                           slo_config_path: str) -> dict:
+    """One leg: the router as a REAL ``--router-workers N`` subprocess
+    (the pre-fork path under test — in-process build_app cannot fork),
+    FakeEngine replicas and the closed-loop clients in this process.
+    Outcome deltas and per-worker loop lag come from ``/debug/workers``,
+    so the leg exercises the federation plane it measures."""
+    import signal
+    import socket
+    import subprocess
+    import sys
+
+    import aiohttp
+
+    from production_stack_tpu.testing.fake_engine import FakeEngine
+
+    engines = [FakeEngine(model=MODEL, ttft=engine_ttft,
+                          max_tokens_default=4) for _ in range(replicas)]
+    started = [await _start(e.make_app()) for e in engines]
+    runners = [r for r, _ in started]
+    urls = [u for _, u in started]
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        router_port = s.getsockname()[1]
+    router_url = f"http://127.0.0.1:{router_port}"
+    trace_buffer = max(1024, max(steps) * requests_per_user)
+    proc = subprocess.Popen([
+        sys.executable, "-m", "production_stack_tpu.router.app",
+        "--host", "127.0.0.1", "--port", str(router_port),
+        "--router-workers", str(workers),
+        "--static-backends", ",".join(urls),
+        "--static-models", ",".join([MODEL] * replicas),
+        "--routing-logic", "roundrobin",
+        "--engine-stats-interval", "60",
+        "--slo-config", slo_config_path,
+        "--trace-buffer", str(trace_buffer),
+        "--loop-monitor",
+        "--log-level", "warning",
+        # init_logger gives each module its own level from this env var;
+        # without it per-request INFO routing lines (20k+ at the top
+        # rung) would tax the workers under measurement.
+    ], env=dict(os.environ, TPU_STACK_LOG_LEVEL="warning"))
+
+    rungs: List[dict] = []
+    knee = None
+    rps_ceiling = 0.0
+    topology: List[dict] = []
+    try:
+        async with aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(limit=0),
+            timeout=aiohttp.ClientTimeout(total=60.0),
+        ) as probe:
+            deadline = time.monotonic() + 30.0
+            up = False
+            while time.monotonic() < deadline:
+                try:
+                    async with probe.get(router_url + "/health") as resp:
+                        if resp.status == 200:
+                            up = True
+                            break
+                except aiohttp.ClientError:
+                    pass
+                await asyncio.sleep(0.2)
+            if not up:
+                raise RuntimeError(
+                    f"router ({workers} workers) never became healthy")
+
+            async with aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(limit=0),
+            ) as session:
+                for users in steps:
+                    before = _outcomes_by_worker(
+                        await _debug_workers(probe, router_url))
+                    latencies: List[float] = []
+                    failed = [0]
+                    unreached = [0]
+
+                    async def user(n):
+                        for _ in range(n):
+                            kind, latency = await _one_request(
+                                session, router_url, client_timeout_s)
+                            if kind == "done":
+                                latencies.append(latency)
+                            else:
+                                failed[0] += 1
+                                if kind == "none":
+                                    unreached[0] += 1
+
+                    t0 = time.perf_counter()
+                    await asyncio.gather(
+                        *[user(requests_per_user) for _ in range(users)])
+                    elapsed = time.perf_counter() - t0
+
+                    total = users * requests_per_user
+                    expected = total - unreached[0]
+                    prev_total = sum(sum(c.values())
+                                     for c in before.values())
+                    catchup_deadline = time.monotonic() + 10.0
+                    body = None
+                    while time.monotonic() < catchup_deadline:
+                        body = await _debug_workers(
+                            probe, router_url,
+                            lag_window_s=time.perf_counter() - t0)
+                        now_total = sum(
+                            sum(c.values()) for c in
+                            _outcomes_by_worker(body).values())
+                        if now_total - prev_total >= expected:
+                            break
+                        await asyncio.sleep(0.1)
+                    after = _outcomes_by_worker(body)
+                    topology = [{"worker": row["worker"],
+                                 "pid": row["pid"],
+                                 "port": body.get("port", router_port)}
+                                for row in body["per_worker"]]
+
+                    outcomes_by_worker = {}
+                    for wid in sorted(after):
+                        prev = before.get(wid, {})
+                        delta = {k: after[wid][k] - prev.get(k, 0)
+                                 for k in after[wid]
+                                 if after[wid][k] - prev.get(k, 0)}
+                        if delta:
+                            outcomes_by_worker[str(wid)] = delta
+                    outcomes: dict = {}
+                    for delta in outcomes_by_worker.values():
+                        for k, v in delta.items():
+                            outcomes[k] = outcomes.get(k, 0) + v
+                    classified = sum(outcomes.values())
+                    good = outcomes.get("ok", 0)
+                    goodput = (round(good / classified, 4)
+                               if classified else None)
+                    lag_by_worker = {
+                        str(row["worker"]):
+                            (row.get("loop_lag_window") or {}).get("p99")
+                        for row in body["per_worker"]}
+                    completed = len(latencies)
+                    responses = total - unreached[0]
+                    rps = (round(completed / elapsed, 1)
+                           if elapsed else None)
+                    rung = {
+                        "users": users,
+                        "requests": total,
+                        "completed": completed,
+                        "failed": failed[0],
+                        "responses": responses,
+                        "unreached": unreached[0],
+                        "elapsed_s": round(elapsed, 2),
+                        "rps": rps,
+                        "p50_latency_s": round(
+                            sorted(latencies)[completed // 2], 4)
+                        if latencies else None,
+                        "p99_latency_s": round(_p99(latencies), 4)
+                        if latencies else None,
+                        "outcomes": outcomes,
+                        "outcomes_by_worker": outcomes_by_worker,
+                        "outcomes_classified": classified,
+                        # Same invariant as r12/r13, now summed across
+                        # workers: Σ per-worker classified outcomes ==
+                        # responses (relaxed only on fd-shed rungs).
+                        "outcomes_reconcile": (
+                            classified == total if not unreached[0]
+                            else responses <= classified <= total),
+                        "goodput": goodput,
+                        "loop_lag_p99_by_worker": lag_by_worker,
+                        "loop_lag_p99_max_s": max(
+                            (v for v in lag_by_worker.values()
+                             if v is not None), default=None),
+                    }
+                    rungs.append(rung)
+                    if rps is not None and knee is None:
+                        rps_ceiling = max(rps_ceiling, rps)
+                    if knee is None and goodput is not None \
+                            and goodput < collapse_threshold:
+                        knee = rung
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=5)
+        for runner in runners:
+            await runner.cleanup()
+
+    return {
+        "workers": workers,
+        "rps_ceiling": rps_ceiling or None,
+        "knee_users": knee["users"] if knee else None,
+        "knee_goodput": knee["goodput"] if knee else None,
+        "loop_lag_p99_at_knee":
+            knee["loop_lag_p99_max_s"] if knee else None,
+        "worker_topology": topology,
+        "outcomes_reconcile_all": all(r["outcomes_reconcile"]
+                                      for r in rungs),
+        "rungs": rungs,
+        "engine_requests": [len(e.requests_seen) for e in engines],
+    }
+
+
+async def run_saturation_workers_ab(*, steps=WORKERS_AB_STEPS,
+                                    requests_per_user: int = 2,
+                                    replicas: int = 4,
+                                    worker_legs=(1, 4),
+                                    engine_ttft: float = 0.001,
+                                    client_timeout_s: float = 300.0,
+                                    collapse_threshold: float = 0.9,
+                                    ) -> dict:
+    """1-vs-N-worker saturation A/B over the same engine fleet: the
+    answer to "does SO_REUSEPORT alone move the r13 672 rps ceiling
+    before the relay-off-loop work lands?" (ROADMAP item 2). The value
+    is the multi-worker ceiling as a ratio of the single-worker one."""
+    from production_stack_tpu.utils.misc import set_ulimit
+
+    # Engines + clients share this process's fd budget (the router is a
+    # subprocess and raises its own rlimit in main()).
+    set_ulimit(target_soft_limit=max(65535, 4 * max(steps) + 8192))
+
+    slo_file = tempfile.NamedTemporaryFile(
+        "w", suffix=".yaml", prefix="slo-sat-ab-", delete=False)
+    yaml.safe_dump(SLO_CONFIG, slo_file)
+    slo_file.close()
+
+    legs = []
+    try:
+        for workers in worker_legs:
+            legs.append(await _run_workers_leg(
+                workers=workers, steps=steps,
+                requests_per_user=requests_per_user, replicas=replicas,
+                engine_ttft=engine_ttft,
+                client_timeout_s=client_timeout_s,
+                collapse_threshold=collapse_threshold,
+                slo_config_path=slo_file.name))
+    finally:
+        os.unlink(slo_file.name)
+
+    baseline = next((l for l in legs if l["workers"] == 1), legs[0])
+    multi = next((l for l in legs if l["workers"] != 1), legs[-1])
+    ratio = None
+    if baseline["rps_ceiling"] and multi["rps_ceiling"]:
+        ratio = round(multi["rps_ceiling"] / baseline["rps_ceiling"], 3)
+    return {
+        "metric": "router_saturation_workers_ab",
+        "unit": "rps_ceiling_ratio",
+        "value": ratio,
+        # The single number that decides how to read the ratio: workers
+        # beyond the core count share CPU, so SO_REUSEPORT spreads loop
+        # lag without raising the ceiling.
+        "host_cpus": os.cpu_count(),
+        "replicas": replicas,
+        "steps": list(steps),
+        "requests_per_user": requests_per_user,
+        "worker_legs": [l["workers"] for l in legs],
+        "collapse_threshold": collapse_threshold,
+        "slo_config": SLO_CONFIG,
+        "rps_ceiling_1w": baseline["rps_ceiling"],
+        "rps_ceiling_multi": multi["rps_ceiling"],
+        "knee_users_1w": baseline["knee_users"],
+        "knee_users_multi": multi["knee_users"],
+        "outcomes_reconcile_all": all(l["outcomes_reconcile_all"]
+                                      for l in legs),
+        "legs": legs,
+    }

@@ -51,9 +51,14 @@ _PRIVILEGED_EXACT = frozenset({"/kv/deregister", "/debug/profile",
                                "/debug/steps", "/debug/loop"})
 # /debug/kv/* (pull economics, trie introspection) leaks cache topology,
 # holder URLs, and workload prefix structure — privileged as a prefix so
-# future additions under it are born gated.
+# future additions under it are born gated. /debug/snapshot is the
+# per-worker federation feed (the union of every other /debug surface in
+# one body) and /debug/workers carries pids and shared-state divergence
+# views — both prefixes so ?query variants and future sub-paths stay
+# gated.
 _PRIVILEGED_PREFIXES = ("/autoscale/", "/debug/profile/",
-                        "/debug/traces/", "/debug/kv/")
+                        "/debug/traces/", "/debug/kv/",
+                        "/debug/snapshot", "/debug/workers")
 
 
 def is_privileged(path: str) -> bool:

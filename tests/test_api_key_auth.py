@@ -251,7 +251,11 @@ def test_every_debug_route_requires_key():
         # appear (an empty enumeration would vacuously pass).
         router_paths = {p for _, p in router_routes}
         for expected in ("/debug/traces", "/debug/kv/economics",
-                         "/debug/kv/trie", "/debug/loop"):
+                         "/debug/kv/trie", "/debug/loop",
+                         # The worker-federation plane (PR 16): the
+                         # snapshot feed would leak every telemetry
+                         # store at once if it ever shipped open.
+                         "/debug/snapshot", "/debug/workers"):
             assert expected in router_paths, router_paths
         engine_paths = {p for _, p in engine_routes}
         assert "/debug/steps" in engine_paths, engine_paths

@@ -81,6 +81,15 @@ def test_bench_artifacts_carry_run_meta(tmp_path):
     data = json.loads((tmp_path / "X.json").read_text())
     assert data["meta"]["schema"] == 1
     assert data["metric"] == "m"
+    # Saturation artifacts record which processes produced the numbers:
+    # _write_artifact plumbs worker_topology into meta.
+    mod._write_artifact(
+        "Y.json", {"metric": "m", "value": 1},
+        worker_topology=[{"workers": 1, "members": [
+            {"worker": 0, "pid": 42, "port": None}]}])
+    data = json.loads((tmp_path / "Y.json").read_text())
+    assert data["meta"]["worker_topology"][0]["workers"] == 1
+    assert data["meta"]["worker_topology"][0]["members"][0]["pid"] == 42
 
 
 def test_committed_kv_econ_artifact_schema():
@@ -187,6 +196,67 @@ def test_committed_saturation_artifact_schema():
     assert summary["service"] == "tpu-stack-router"
     assert summary["samples_total"] >= len(data["rungs"])
     assert set(summary["stalls"]) == {"1x", "5x", "20x"}
+
+
+def test_committed_saturation_workers_ab_artifact_schema():
+    """The committed 1-vs-4-worker saturation A/B (r16) is real: both
+    legs ran the same rung ladder through a real ``--router-workers``
+    subprocess, every rung reconciles the sum of per-worker classified
+    outcomes against responses (the r12/r13 invariant, now summed
+    across workers), every rung carries per-worker loop-lag p99 read
+    over the /debug/workers federation plane, and the topology in meta
+    names the actual worker processes (distinct pids, shared
+    SO_REUSEPORT port)."""
+    data = json.load(open(
+        os.path.join(REPO, "BENCH_SATURATION_r16.json")))
+    assert data["metric"] == "router_saturation_workers_ab"
+    assert data["meta"]["schema"] == 1
+    assert data["backend"] == "fake"
+    assert data["replicas"] == 4
+    assert data["outcomes_reconcile_all"] is True
+    assert sorted(data["worker_legs"]) == [1, 4]
+    # The ratio is the answer to "does SO_REUSEPORT alone move the
+    # ceiling" — its sign is host-dependent (host_cpus says how to read
+    # it), but it must have been measured from two real ceilings.
+    assert data["value"] is not None and data["value"] > 0
+    assert data["host_cpus"] >= 1
+    assert data["rps_ceiling_1w"] > 0 and data["rps_ceiling_multi"] > 0
+    assert round(data["rps_ceiling_multi"] / data["rps_ceiling_1w"], 3) \
+        == data["value"]
+
+    legs = {leg["workers"]: leg for leg in data["legs"]}
+    assert sorted(legs) == [1, 4]
+    for workers, leg in legs.items():
+        topo = leg["worker_topology"]
+        assert [m["worker"] for m in topo] == list(range(workers))
+        assert len({m["pid"] for m in topo}) == workers
+        assert len({m["port"] for m in topo}) == 1
+        assert leg["outcomes_reconcile_all"] is True
+        for rung in leg["rungs"]:
+            classified = rung["outcomes_classified"]
+            assert sum(rung["outcomes"].values()) == classified
+            # Per-worker deltas sum exactly to the merged outcomes.
+            by_worker: dict = {}
+            for delta in rung["outcomes_by_worker"].values():
+                for k, v in delta.items():
+                    by_worker[k] = by_worker.get(k, 0) + v
+            assert by_worker == rung["outcomes"]
+            if rung["unreached"] == 0:
+                assert classified == rung["requests"]
+            else:
+                assert rung["responses"] <= classified \
+                    <= rung["requests"]
+            lag = rung["loop_lag_p99_by_worker"]
+            assert set(lag) <= {str(w) for w in range(workers)}
+            assert any(v is not None for v in lag.values())
+            assert rung["loop_lag_p99_max_s"] == max(
+                v for v in lag.values() if v is not None)
+    # meta.worker_topology mirrors the per-leg topologies.
+    meta_topo = {t["workers"]: t["members"]
+                 for t in data["meta"]["worker_topology"]}
+    assert sorted(meta_topo) == [1, 4]
+    for workers, leg in legs.items():
+        assert meta_topo[workers] == leg["worker_topology"]
 
 
 def test_plot_table(tmp_path, monkeypatch):

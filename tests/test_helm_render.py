@@ -801,3 +801,38 @@ def test_structured_cache_size_plumbs_into_engine_command():
              if d["metadata"]["name"].endswith("-engine")]
     bcmd = bdeps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
     assert "--structured-cache-size" not in bcmd
+
+
+def test_router_workers_plumbs_into_router_command():
+    """routerSpec.workers renders as --router-workers on the router
+    command when >1 (absent at the default of 1 — single-process mode
+    must stay byte-identical), and the schema accepts the knob."""
+    import copy
+    import json
+
+    import jsonschema
+
+    values = copy.deepcopy(load_values(CHART))
+    values["routerSpec"]["workers"] = 4
+    with open(os.path.join(CHART, "values.schema.json")) as f:
+        schema = json.load(f)
+    jsonschema.validate(values, schema)
+
+    rendered = MiniHelm(CHART).render(values)
+    deps = [d for d in _docs(rendered, "Deployment")
+            if d["metadata"]["name"].endswith("-router")]
+    assert deps, "router deployment missing"
+    cmd = deps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--router-workers" in cmd
+    assert cmd[cmd.index("--router-workers") + 1] == "4"
+
+    base = _render()
+    bdeps = [d for d in _docs(base, "Deployment")
+             if d["metadata"]["name"].endswith("-router")]
+    bcmd = bdeps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--router-workers" not in bcmd
+
+    bad = copy.deepcopy(load_values(CHART))
+    bad["routerSpec"]["workers"] = 0
+    with pytest.raises(jsonschema.ValidationError):
+        jsonschema.validate(bad, schema)
