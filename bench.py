@@ -269,6 +269,27 @@ SATURATION_WORKERS_STEPS = os.environ.get("BENCH_SATURATION_WORKERS_STEPS",
                                           "100,500,1000,2500")
 SATURATION_WORKERS_LEGS = os.environ.get("BENCH_SATURATION_WORKERS_LEGS",
                                          "1,4")
+# Relay A/B: BENCH_SATURATION_RELAY=1 runs the saturation ladder three
+# times — relay off, relay on (both --router-workers 1), and
+# --router-workers N + relay — each a real pre-fork subprocess. Per-rung
+# outcome reconciliation, per-worker streaming_relay/relay_feed on-loop
+# seconds, and pump counters come over the /debug/workers + /metrics
+# federation planes. Writes BENCH_SATURATION_RELAY_OUT (default
+# BENCH_SATURATION_r17.json).
+SATURATION_RELAY = _env_int("BENCH_SATURATION_RELAY", 0)
+SATURATION_RELAY_OUT = os.environ.get("BENCH_SATURATION_RELAY_OUT",
+                                      "BENCH_SATURATION_r17.json")
+# The relay ladder tops out at the old 1000-user knee: with paced
+# 32-token streams, deeper rungs are bound by the closed-loop harness
+# itself (TTFT ~= users/rps for both legs), not the router.
+SATURATION_RELAY_STEPS = os.environ.get("BENCH_SATURATION_RELAY_STEPS",
+                                        "100,250,500,1000")
+SATURATION_RELAY_REQS = _env_int("BENCH_SATURATION_RELAY_REQS", 3)
+SATURATION_RELAY_WORKERS = _env_int("BENCH_SATURATION_RELAY_WORKERS", 4)
+SATURATION_RELAY_PUMPS = _env_int("BENCH_SATURATION_RELAY_PUMPS", 2)
+SATURATION_RELAY_MAX_TOKENS = _env_int("BENCH_SATURATION_RELAY_MAX_TOKENS",
+                                       32)
+SATURATION_RELAY_TOKS = _env_float("BENCH_SATURATION_RELAY_TOKS", 200.0)
 # --cold-repeat N: N fully cold serves, each in its own subprocess (no
 # warm jit caches, no reused pools — the cold-start number operators
 # actually see on a fresh replica). The artifact is rewritten and
@@ -927,6 +948,34 @@ def _saturation_workers_main() -> None:
     print(json.dumps({k: v for k, v in result.items() if k != "legs"}))
 
 
+def _saturation_relay_main() -> None:
+    """BENCH_SATURATION_RELAY=1: the relay-off-vs-on saturation A/B
+    plus the workers+relay composition leg. Fully hermetic — fake
+    engines in this process, the router as a subprocess — so this
+    branch never imports jax or touches a device."""
+    from production_stack_tpu.testing.saturation import (
+        run_saturation_relay_ab,
+    )
+
+    steps = tuple(int(s) for s in
+                  SATURATION_RELAY_STEPS.split(",") if s.strip())
+    result = asyncio.run(run_saturation_relay_ab(
+        steps=steps, requests_per_user=SATURATION_RELAY_REQS,
+        replicas=SATURATION_REPLICAS,
+        relay_pump_threads=SATURATION_RELAY_PUMPS,
+        multi_workers=SATURATION_RELAY_WORKERS,
+        max_tokens=SATURATION_RELAY_MAX_TOKENS,
+        engine_tokens_per_sec=SATURATION_RELAY_TOKS,
+        collapse_threshold=SATURATION_COLLAPSE))
+    result["backend"] = "fake"
+    _write_artifact(SATURATION_RELAY_OUT, result, worker_topology=[
+        {"workers": leg["workers"], "relay": leg["relay"],
+         "members": leg["worker_topology"]}
+        for leg in result["legs"]
+    ])
+    print(json.dumps({k: v for k, v in result.items() if k != "legs"}))
+
+
 def _cold_repeat_main(n: int, cpu: bool) -> None:
     """--cold-repeat N: run the configured scenario N times, each in an
     isolated subprocess so every serve is fully cold (fresh interpreter,
@@ -1018,6 +1067,9 @@ def main() -> None:
         return
     if SATURATION_WORKERS:
         _saturation_workers_main()
+        return
+    if SATURATION_RELAY:
+        _saturation_relay_main()
         return
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"

@@ -166,6 +166,25 @@ def build_alerts():
                     "service (docs/scale_out.md). GET /debug/workers "
                     "shows the per-worker views side by side."),
                 rule(
+                    "RouterRelayHandoffFailing",
+                    "sum by(reason) (rate("
+                    "vllm_router:relay_handoff_failures_total[5m])) > 0 "
+                    "and max(vllm_router:relay_active_pumps) > 0",
+                    "10m", "warning",
+                    "Relay pump handoffs failing "
+                    "({{ $labels.reason }})",
+                    "A router running --relay-off-loop is persistently "
+                    "failing to hand committed streams to its pump "
+                    "threads, so the byte copy is back on the event "
+                    "loop (responses stay correct — this is a lost "
+                    "optimization, and under load it resurfaces as "
+                    "loop lag). tls/compression mean the tier is "
+                    "configured on a listener it cannot serve; "
+                    "buffer_not_drained means client reads outlast "
+                    "the drain window; pump_not_running means the "
+                    "pump pool died. The Router Workers dashboard "
+                    "row breaks failures down by reason."),
+                rule(
                     "TPUStackBandwidthCollapse",
                     "avg by(instance) "
                     "(tpu:model_bandwidth_utilization) < 0.2 "

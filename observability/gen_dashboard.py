@@ -865,6 +865,48 @@ def build_dashboard():
              "fetches that failed; that worker is missing from the "
              "merged scrape and listed in workers_failed"))
     y += 7
+    panels.append(panel(
+        "timeseries", "Relay pump throughput",
+        [target("sum(rate(vllm_router:relay_bytes_total[1m]))",
+                legend="bytes/s off-loop"),
+         target("sum(rate(vllm_router:relay_chunks_total[1m]))",
+                legend="chunks/s off-loop")],
+        grid(7, 8, 0, y),
+        desc="Streamed payload the relay pump tier (--relay-off-loop) "
+             "wrote through dup'd client sockets instead of the event "
+             "loop. Zero with traffic flowing means the flag is off or "
+             "every handoff is failing (next panel); compare against "
+             "loop_component_seconds_total{component=\"streaming_"
+             "relay\"} — bytes here should move that rate toward zero"))
+    panels.append(panel(
+        "timeseries", "Relay handoff failures",
+        [target("sum by(reason) (rate("
+                "vllm_router:relay_handoff_failures_total[5m]))",
+                legend="{{reason}}")],
+        grid(7, 8, 8, y),
+        desc="Committed streams that could not move to a pump and fell "
+             "back to on-loop writes (response stays correct). "
+             "Sustained tls/compression is a config mismatch with the "
+             "deployment; buffer_not_drained under load means clients "
+             "read slower than the drain window; pump_not_running "
+             "means the tier died. RouterRelayHandoffFailing pages on "
+             "this"))
+    panels.append(panel(
+        "timeseries", "Relay pump pool",
+        [target('vllm_router:relay_active_pumps{worker=""} or '
+                "vllm_router:relay_active_pumps",
+                legend="pumps worker {{worker}}"),
+         target('vllm_router:relay_queue_depth{worker=""} or '
+                "vllm_router:relay_queue_depth",
+                legend="jobs worker {{worker}}")],
+        grid(7, 8, 16, y),
+        desc="Live pump threads (--relay-pump-threads) and streams "
+             "currently owned by them, per worker under "
+             "--router-workers (per-process gauges keep the worker "
+             "label; the throughput counters merge worker-free). Queue "
+             "depth tracking concurrent streams is healthy; pumps "
+             "below the configured count means threads died"))
+    y += 7
 
     # ---- Row 13: Current Resource Usage (ref panels 14-19) -------------- #
     panels.append(row("Current Resource Usage", y)); y += 1

@@ -253,6 +253,10 @@ def merge_worker_snapshots(snaps: List[dict]) -> dict:
             "loop_lag_window": loop.get("window"),
             "loop_samples_total": summary.get("samples_total"),
             "loop_stall_s": summary.get("stall_s_measured"),
+            # Per-component on-loop seconds (streaming_relay vs
+            # relay_feed is how the relay A/B proves the byte copy left
+            # the loop on each worker, not just in aggregate).
+            "loop_components": summary.get("components"),
             "traces_recorded_total":
                 (snap.get("traces") or {}).get("recorded_total"),
             "events_recorded_total":

@@ -65,6 +65,7 @@ ROUTER_COMPONENTS = (
     "fleet_pull",
     "kv_controller",
     "streaming_relay",
+    "relay_feed",
     "slo_classify",
     "metrics_scrape",
 )
@@ -213,9 +214,22 @@ class BlockingCallDetector(threading.Thread):
         self._stalled = False
         self._stall_keys: set = set()
         self._watermark: Optional[float] = None
+        self._charge_floor = 0.0
         self.samples_total = 0
         self.stall_s_attributed = 0.0
         self.stall_s_unattributed = 0.0
+
+    def mark_boundary(self, now: Optional[float] = None) -> None:
+        """Clamp attribution at a measurement-window boundary. A stall
+        that straddles the boundary otherwise charges its pre-boundary
+        seconds into the new window's delta, which is how the r13
+        artifact recorded a per-rung ``loop_stall_attribution`` of 1.37
+        (> 1.0): the harness snapshots blocker/stall counters at rung
+        start, but the first in-rung poll charged time reaching back to
+        a tick *before* the snapshot. Callers (e.g. the saturation
+        harness at each rung boundary) invoke this right where they
+        snapshot, and no in-window charge will predate it."""
+        self._charge_floor = time.monotonic() if now is None else now
 
     def run(self) -> None:
         while not self._stop_event.wait(self.poll_s):
@@ -263,6 +277,7 @@ class BlockingCallDetector(threading.Thread):
         # point: the tick that started the stall on the first poll, the
         # previous poll afterwards.
         floor = last if self._watermark is None else self._watermark
+        floor = max(floor, self._charge_floor)
         charged = max(0.0, now - max(last, floor))
         self._watermark = now
         with self._lock:

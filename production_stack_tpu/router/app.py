@@ -76,6 +76,7 @@ class RouterState:
     canary: Any = None  # CanaryProber when --canary-interval > 0
     events: Any = None  # EventJournal (always on; bounded ring is cheap)
     loop_monitor: Any = None  # LoopMonitor when --loop-monitor is set
+    relay: Any = None  # RelayPump when --relay-off-loop is set, else None
     # Multi-worker plane (--router-workers; router/workers.py). Defaults
     # describe the single-process router: worker 0 of 1, no snapshot
     # sockets — /debug/snapshot and /debug/workers then serve local-only
@@ -104,8 +105,14 @@ def _proxy(endpoint: str):
             # the chunk-relay loop. The finer-grained components
             # (qos_admission, fleet_pull, slo_classify) are slices of
             # this same handler, so component totals are not disjoint.
+            # With the relay pump on, the byte copy leaves the loop and
+            # the residual control-plane cost is attributed under
+            # "relay_feed" instead — so streaming_relay collapsing to
+            # ~0 is a real measurement, not a relabeling.
+            component = ("relay_feed" if state.relay is not None
+                         else "streaming_relay")
             return await state.loop_monitor.components.wrap(
-                "streaming_relay",
+                component,
                 request_service.route_general_request(request, endpoint))
         return await request_service.route_general_request(request, endpoint)
 
@@ -183,6 +190,8 @@ async def metrics_handler(request: web.Request) -> web.Response:
             state.trace_recorder.slow_logs_suppressed_total)
     if state.slo is not None:
         state.slo.refresh_gauges()
+    if state.relay is not None:
+        metrics_mod.mirror_relay_metrics(state.relay)
     if state.loop_monitor is not None:
         # Rendering /metrics is itself synchronous on-loop work worth
         # attributing (big registries serialize in milliseconds).
@@ -688,6 +697,8 @@ def build_app(args) -> web.Application:
         st = app["state"]
         if st.loop_monitor is not None:
             st.loop_monitor.start()
+        if st.relay is not None:
+            st.relay.start()
         if st.batch_processor is not None:
             st.batch_processor.start()
         # Canary prober: tiny synthetic completions straight at each
@@ -763,6 +774,8 @@ def build_app(args) -> web.Application:
                 except (asyncio.CancelledError, Exception):  # noqa: BLE001
                     pass
         st = app["state"]
+        if st.relay is not None:
+            st.relay.stop()
         if st.loop_monitor is not None:
             st.loop_monitor.stop()
         for closable in (
@@ -878,6 +891,20 @@ def initialize_all(args) -> RouterState:
             "tick=%.0fms watchdog_poll=%.0fms", threshold_ms,
             state.loop_monitor.interval_s * 1000.0,
             state.loop_monitor.detector.poll_s * 1000.0)
+
+    # Relay pump tier: committed streamed responses copied to the
+    # client socket by pump threads instead of await response.write()
+    # (--relay-off-loop; router/relay.py). Flag off = state.relay is
+    # None and the streaming path is byte-identical.
+    if getattr(args, "relay_off_loop", False):
+        from production_stack_tpu.router.relay import RelayPump
+
+        state.relay = RelayPump(
+            threads=int(getattr(args, "relay_pump_threads", 2) or 2),
+            name=f"w{state.worker_id}",
+        )
+        logger.info("Relay pump tier enabled: pump_threads=%d",
+                    state.relay.thread_count)
 
     # Service discovery.
     if args.service_discovery == "static":

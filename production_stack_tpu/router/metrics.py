@@ -288,9 +288,55 @@ loop_component_seconds = Gauge(
     "vllm_router:loop_component_seconds_total",
     "Cumulative on-loop CPU seconds per instrumented router component "
     "(qos_admission, fleet_pull, kv_controller, streaming_relay, "
-    "slo_classify, metrics_scrape): synchronous slices that actually "
-    "held the loop, awaited time excluded",
+    "relay_feed, slo_classify, metrics_scrape): synchronous slices that "
+    "actually held the loop, awaited time excluded",
     ["component"], registry=REGISTRY)
+
+
+# --- Relay pump tier (router/relay.py, --relay-off-loop) -----------------
+# All labeled (server / reason / pool): counters first increment, and the
+# pool gauges first mirror, only when a RelayPump exists — a flag-off
+# deployment's /metrics surface stays byte-identical (same convention as
+# the loop block above).
+relay_bytes = Counter(
+    "vllm_router:relay_bytes_total",
+    "Response payload bytes moved to clients by the relay pump tier "
+    "(off-loop socket writes; chunked framing overhead excluded), per "
+    "backend server the stream came from",
+    _L, registry=REGISTRY)
+relay_chunks = Counter(
+    "vllm_router:relay_chunks_total",
+    "Upstream chunks delivered by the relay pump tier, per backend "
+    "server (compare with the flag-off path where every one of these "
+    "was an await response.write() on the event loop)",
+    _L, registry=REGISTRY)
+relay_handoff_failures = Counter(
+    "vllm_router:relay_handoff_failures_total",
+    "Committed streams that could NOT be handed to a pump and fell "
+    "back to the on-loop relay, by reason (tls, no_transport, "
+    "no_socket, compression, buffer_not_drained, dup_failed, "
+    "pump_not_running). The fallback keeps responses byte-identical; "
+    "a sustained rate means the flag is on but not paying",
+    ["reason"], registry=REGISTRY)
+relay_active_pumps = Gauge(
+    "vllm_router:relay_active_pumps",
+    "Live pump worker threads in this router process "
+    "(--relay-pump-threads; mirrored at scrape time while the relay "
+    "tier is enabled)",
+    ["pool"], registry=REGISTRY)
+relay_queue_depth = Gauge(
+    "vllm_router:relay_queue_depth",
+    "In-flight relay jobs (committed streams currently owned by a pump "
+    "thread) across the process's pump pool, mirrored at scrape time",
+    ["pool"], registry=REGISTRY)
+
+
+def mirror_relay_metrics(relay) -> None:
+    """Scrape-time mirror of the RelayPump's pool state (counters are
+    settled per request by the jobs themselves)."""
+    stats = relay.stats()
+    relay_active_pumps.labels(pool="router").set(stats["active_pumps"])
+    relay_queue_depth.labels(pool="router").set(stats["queue_depth"])
 
 
 def mirror_loop_metrics(monitor) -> None:

@@ -293,6 +293,18 @@ def build_parser() -> argparse.ArgumentParser:
                         help="loop lag counted as a stall and sampled "
                              "by the blocking-call watchdog once the "
                              "loop has not ticked for this long")
+    # Relay pump tier (production_stack_tpu/router/relay.py)
+    parser.add_argument("--relay-off-loop", action="store_true",
+                        help="hand committed streamed responses to a "
+                             "pool of pump threads that copy upstream "
+                             "chunks to the client socket off the "
+                             "event loop (coalesced sends, GIL "
+                             "released in syscalls); the loop keeps "
+                             "control flow only. Off = streaming path "
+                             "byte-identical")
+    parser.add_argument("--relay-pump-threads", type=int, default=2,
+                        help="pump worker threads per router process "
+                             "when --relay-off-loop is set")
     return parser
 
 
@@ -385,6 +397,8 @@ def validate_args(args: argparse.Namespace) -> None:
         raise ValueError("--loop-stall-threshold-ms must be > 0")
     if getattr(args, "router_workers", 1) < 1:
         raise ValueError("--router-workers must be >= 1")
+    if getattr(args, "relay_pump_threads", 2) < 1:
+        raise ValueError("--relay-pump-threads must be >= 1")
 
 
 def expand_static_models_config(config: dict) -> dict:

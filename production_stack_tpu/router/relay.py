@@ -1,0 +1,769 @@
+"""Relay pump tier: stream committed responses off the event loop.
+
+``BENCH_SATURATION_r13.json`` convicted the streaming relay — the
+per-chunk ``await response.write()`` path through aiohttp's payload
+writer — as the router's throughput ceiling (~50 of ~80 attributed
+on-loop seconds at the knee), and ``BENCH_SATURATION_r16.json`` proved
+SO_REUSEPORT workers alone cannot buy it back on a small host. This
+module takes that copy off the loop: once a streamed response is
+COMMITTED (headers sent, first chunk delivered through the normal
+aiohttp path, so the PR 6 failover window is closed), the handler hands
+the client socket to a small pool of pump threads that move the
+remaining upstream chunks with direct socket I/O. The event loop keeps
+doing what only it can do — upstream reads, failover, deadlines, SLO
+bookkeeping — and stops burning CPU on byte shoveling:
+
+- The pump duplicates the client socket fd (``sock.dup()``: same open
+  file description, so kernel-level ordering with the bytes aiohttp
+  already buffered is preserved once the transport's write buffer is
+  drained — ``try_handoff`` waits for exactly that before duping).
+  The dup shares ``O_NONBLOCK`` with the asyncio transport, so each
+  pump thread runs a tiny ``selectors`` write loop instead of blocking
+  sends; the GIL is released inside ``select()`` and ``send()`` either
+  way.
+- Chunk payloads cross loop→pump over a plain ``deque`` (thread-safe
+  appends/pops); the pump COALESCES every queued payload into one wire
+  buffer per ``send()`` — replicating aiohttp's chunked framing
+  (``<hex>CRLF payload CRLF``, terminal ``0CRLFCRLF``) — so N small SSE
+  frames cost one syscall instead of N writer round-trips.
+- Per-chunk write-completion timestamps flow pump→loop over a lock-free
+  SPSC deque (``RelayJob.write_timestamps``); byte/chunk totals are
+  settled into the prometheus counters once per request on the loop.
+  SLO TTFT/inter-token classification keeps using the loop-side
+  receive timestamps taken at feed time — the same statement position
+  the flag-off path samples at, so classification inputs are identical
+  by construction.
+- Feeding the pump from the handler's ``async for`` still pays the
+  per-chunk coroutine resumption chain (upstream ``readany`` waiter →
+  ``process_request`` generator → failover wrapper → handler), which on
+  a 1-CPU host costs more loop time than the socket write it replaced.
+  :class:`StreamTap` removes that too: once a job exists, the upstream
+  response's ``StreamReader`` is retargeted (``__class__`` swap onto a
+  zero-``__slots__`` subclass — the reader's own slots forbid instance
+  method overrides) so aiohttp's ``data_received`` → parser path calls
+  ``tap.on_data`` directly with each decoded payload. The tap does the
+  minimal loop-side bookkeeping (SLO stamp, QoS body buffer, engine
+  token accounting via a caller-supplied callback) and ``feed_nowait``s
+  the pump; the handler PARKS on :meth:`RelayJob.wait_done` with zero
+  per-chunk resumptions. Backpressure maps HIGH_WATER onto the upstream
+  protocol's ``pause_reading`` instead of an awaited drain future, and
+  the fault-tolerance inter-chunk deadline moves pump-side (the select
+  loop watches feed progress and fails the job with the same
+  ``asyncio.TimeoutError`` the on-loop ``wait_for`` raised).
+- A pump-detected client disconnect (EPIPE/ECONNRESET on send) is
+  re-raised on the loop from ``feed()``/``finish()`` as aiohttp's
+  ``ClientConnectionResetError`` — the exact class the flag-off
+  ``response.write()`` raises — so the existing except/finally path
+  classifies ``client_abort`` and releases the QoS lease unchanged.
+  An upstream fault (inter-chunk deadline, engine crash) aborts the
+  job: the dup closes without the terminal chunk and the handler's
+  raise tears the connection down exactly as before.
+
+Handoff is strictly best-effort: TLS transports, missing sockets, or a
+write buffer that never drains fall back to the on-loop relay (counted
+in ``vllm_router:relay_handoff_failures_total``), and with
+``--relay-off-loop`` unset this module is never constructed — the
+request path is byte-identical to a build that predates it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import selectors
+import socket
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+import aiohttp
+import aiohttp.streams
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+#: The class feed()/finish() raise when the pump saw the client go away.
+#: aiohttp's own response.write() raises exactly this on a closed client
+#: transport, so the handler's classification path needs no new branch.
+CLIENT_RESET = aiohttp.ClientConnectionResetError
+
+#: Loop-side backpressure: feed() awaits once this many payload bytes
+#: are queued to a job, resuming below the low watermark — the pump-tier
+#: stand-in for the transport write-buffer backpressure response.write()
+#: exerted (the client's read pace still bounds router memory).
+HIGH_WATER = 256 * 1024
+LOW_WATER = 64 * 1024
+
+#: Per-send coalescing cap: payloads are concatenated into one wire
+#: buffer up to this size (send() usually takes the whole thing in one
+#: syscall on loopback/LAN sockets).
+COALESCE_MAX = 256 * 1024
+
+#: How long try_handoff waits for aiohttp's transport buffer (headers +
+#: first chunk) to reach the kernel before giving up on the handoff.
+DRAIN_WAIT_S = 0.25
+
+
+def seal_response(response) -> None:
+    """Mark an aiohttp StreamResponse as finished after the pump wrote
+    the body (terminal chunk included) through the dup'd socket.
+    ``write_eof()`` — ours and the one ``finish_response`` always calls —
+    becomes a no-op, and keep-alive proceeds normally: every byte the
+    pump sent is already in the kernel buffer, in order, ahead of
+    whatever the transport writes next."""
+    response._eof_sent = True
+
+
+class StreamTap:
+    """Loop-side sink for an upstream response's decoded payloads.
+
+    Installed over the aiohttp client ``StreamReader`` once a relay job
+    exists (detached mode): the parser's ``feed_data`` lands here
+    instead of buffering for a reader that no longer exists. Every hook
+    runs ON the event loop (inside ``data_received``) — single-threaded
+    with the handler, which is parked in :meth:`RelayJob.wait_done`.
+    """
+
+    __slots__ = ("job", "on_chunk", "protocol", "chunks",
+                 "last_chunk_unix", "bytes")
+
+    def __init__(self, job: "RelayJob", on_chunk=None, protocol=None):
+        self.job = job
+        # Caller-supplied loop-side bookkeeping: (payload, unix_now) —
+        # SLO stamps, QoS body buffer, engine token accounting.
+        self.on_chunk = on_chunk
+        # The upstream connection's protocol: HIGH_WATER backpressure
+        # maps onto pause_reading()/resume_reading() because a sync hook
+        # cannot await the drain future.
+        self.protocol = protocol
+        self.chunks = 0
+        self.last_chunk_unix = 0.0
+        self.bytes = 0
+
+    def on_data(self, data: bytes) -> None:
+        job = self.job
+        if job._completed or job._failed is not None:
+            # Client already gone / job torn down: drop — the parked
+            # handler is being woken to unwind and close the upstream.
+            return
+        now = time.time()
+        self.chunks += 1
+        self.bytes += len(data)
+        self.last_chunk_unix = now
+        cb = self.on_chunk
+        if cb is not None:
+            try:
+                cb(data, now)
+            except Exception:  # pragma: no cover - bookkeeping only
+                logger.exception("relay tap bookkeeping failed")
+        try:
+            fut = job.feed_nowait(data)
+        except CLIENT_RESET:
+            return  # wait_done() surfaces it to the handler
+        proto = self.protocol
+        if fut is not None and proto is not None:
+            try:
+                proto.pause_reading()
+            except Exception:  # pragma: no cover - transport torn down
+                return
+            fut.add_done_callback(lambda _f: self._resume())
+
+    def _resume(self) -> None:
+        try:
+            self.protocol.resume_reading()
+        except Exception:  # pragma: no cover - transport torn down
+            pass
+
+    def on_eof(self) -> None:
+        self.job.finish_nowait()
+
+    def on_error(self, exc: BaseException) -> None:
+        self.job.fail(exc)
+
+
+#: Live taps keyed by id(StreamReader). Entries are removed by the eof/
+#: exception hooks and by remove_tap() in the detach path's finally, so
+#: a reader never outlives its entry (id() reuse is therefore safe).
+_TAPS: dict = {}
+
+
+class _TapStream(aiohttp.streams.StreamReader):
+    """Zero-slot subclass a live upstream ``StreamReader`` is retargeted
+    to (``__class__`` assignment — layout-compatible because this adds
+    no slots). The base class bookkeeping still runs on eof/exception so
+    aiohttp's connection-reuse checks (``is_eof``) stay truthful; data
+    itself bypasses the buffer entirely."""
+
+    __slots__ = ()
+
+    def feed_data(self, data, size=0):  # noqa: D102 - hot hook
+        tap = _TAPS.get(id(self))
+        if tap is None:  # pragma: no cover - racing uninstall
+            return aiohttp.streams.StreamReader.feed_data(self, data, size)
+        tap.on_data(data)
+
+    def feed_eof(self):
+        tap = _TAPS.pop(id(self), None)
+        aiohttp.streams.StreamReader.feed_eof(self)
+        if tap is not None:
+            tap.on_eof()
+
+    def set_exception(self, exc, exc_cause=None):
+        tap = _TAPS.pop(id(self), None)
+        try:
+            aiohttp.streams.StreamReader.set_exception(self, exc, exc_cause)
+        except TypeError:  # pragma: no cover - older aiohttp signature
+            aiohttp.streams.StreamReader.set_exception(self, exc)
+        if tap is not None:
+            tap.on_error(exc)
+
+
+def install_tap(content, tap: StreamTap) -> bool:
+    """Retarget a live upstream ``StreamReader`` onto the tap. False if
+    the object is not the plain StreamReader this build understands
+    (the caller then stays on the per-chunk feed path)."""
+    if type(content) is not aiohttp.streams.StreamReader:
+        return False
+    _TAPS[id(content)] = tap
+    try:
+        content.__class__ = _TapStream
+    except TypeError:  # pragma: no cover - layout mismatch
+        _TAPS.pop(id(content), None)
+        return False
+    return True
+
+
+def remove_tap(content) -> None:
+    """Idempotent uninstall (detach path's finally)."""
+    _TAPS.pop(id(content), None)
+    if type(content) is _TapStream:
+        content.__class__ = aiohttp.streams.StreamReader
+
+
+class RelayJob:
+    """One committed response being pumped. Loop-side API: ``feed()``
+    per chunk, then ``finish()`` (clean EOF) or ``abort()`` (upstream
+    fault); ``ensure_closed()`` + ``settle()`` in the handler's finally.
+    Everything else runs on the owning pump thread."""
+
+    __slots__ = (
+        "server_url", "_sock", "_chunked", "_loop", "_thread",
+        "_lock", "_pending", "_pending_bytes", "_finishing", "_aborted",
+        "_completed", "_failed", "_terminal_queued", "_done",
+        "_drain_fut", "_wire", "_wire_sent", "_wire_marks", "_marks_done",
+        "_registered", "_settled", "_scheduled", "write_timestamps",
+        "bytes_total", "chunks_total", "_seq",
+        "deadline_s", "last_activity",
+    )
+
+    def __init__(self, sock: socket.socket, chunked: bool,
+                 loop: asyncio.AbstractEventLoop, server_url: str):
+        self.server_url = server_url
+        self._sock = sock
+        self._chunked = chunked
+        self._loop = loop
+        self._thread: Optional["_PumpThread"] = None
+        self._lock = threading.Lock()
+        self._pending: deque = deque()
+        self._pending_bytes = 0
+        self._finishing = False
+        self._aborted = False
+        self._completed = False
+        self._failed: Optional[BaseException] = None
+        self._terminal_queued = False
+        self._done = asyncio.Event()
+        self._drain_fut: Optional[asyncio.Future] = None
+        # Pump-side send cursor over the current coalesced wire buffer.
+        self._wire = b""
+        self._wire_sent = 0
+        self._wire_marks: list = []  # (end_offset, payload_len)
+        self._marks_done = 0
+        self._registered = False
+        self._settled = False
+        # True while the pump owes this job a service pass. Guards the
+        # waker: feeding an already-scheduled job is a pure lock+append
+        # (no syscall), which is what makes the loop-side cost of a
+        # chunk cheaper than the aiohttp write it replaces.
+        self._scheduled = False
+        # Lock-free SPSC feedback channel (pump appends, loop reads):
+        # (chunk_seq, unix_time) per payload fully handed to the kernel.
+        self.write_timestamps: deque = deque(maxlen=4096)
+        self.bytes_total = 0
+        self.chunks_total = 0
+        self._seq = 0
+        # Pump-enforced inter-chunk deadline (detached mode only): if no
+        # feed arrives within deadline_s the pump fails the job with the
+        # same asyncio.TimeoutError the on-loop wait_for() raised.
+        self.deadline_s: Optional[float] = None
+        self.last_activity = time.monotonic()
+
+    # -- loop-side API -------------------------------------------------
+
+    @property
+    def completed(self) -> bool:
+        return self._completed
+
+    @property
+    def failed(self) -> bool:
+        return self._failed is not None
+
+    def _raise_failed(self) -> None:
+        err = self._failed
+        if isinstance(err, (asyncio.TimeoutError, aiohttp.ClientError)):
+            # Typed upstream faults (pump-side inter-chunk deadline,
+            # upstream connection errors recorded via fail()) keep their
+            # class so the handler's except arm classifies them exactly
+            # as the on-loop path would ("failed", not "client_abort").
+            raise err
+        raise CLIENT_RESET(
+            f"client transport closed under the relay pump: {err}"
+        ) from err
+
+    def feed_nowait(self, payload: bytes) -> Optional[asyncio.Future]:
+        """Queue one upstream chunk for the pump; the per-chunk hot
+        path. Returns None (common case) or a drain future the caller
+        must await (HIGH_WATER backpressure). Raises the same
+        ``ClientConnectionResetError`` ``response.write()`` would if the
+        pump already saw the client disconnect."""
+        if self._failed is not None:
+            self._raise_failed()
+        self.last_activity = time.monotonic()
+        with self._lock:
+            self._pending.append(payload)
+            self._pending_bytes += len(payload)
+            backlog = self._pending_bytes
+            need_wake = not self._scheduled
+            self._scheduled = True
+        if need_wake:
+            self._thread.notify(self)
+        if backlog >= HIGH_WATER and not self._completed:
+            fut = self._loop.create_future()
+            self._drain_fut = fut
+            # Unconditional wake: the pump must observe the future even
+            # if it drained the backlog between our append and here.
+            self._thread.notify(self)
+            return fut
+        return None
+
+    async def feed(self, payload: bytes) -> None:
+        """Awaitable wrapper over :meth:`feed_nowait` (blocks only at
+        the high watermark)."""
+        fut = self.feed_nowait(payload)
+        if fut is not None:
+            await fut
+            if self._failed is not None:
+                self._raise_failed()
+
+    def finish_nowait(self) -> None:
+        """Signal clean EOF without waiting (StreamTap's eof hook —
+        the parked handler observes completion via wait_done())."""
+        self._finishing = True
+        self._thread.notify(self)
+
+    async def finish(self) -> None:
+        """Signal clean EOF, wait for the pump to flush everything
+        (terminal chunk included), re-raise a pump-side disconnect."""
+        self.finish_nowait()
+        await self._done.wait()
+        if self._failed is not None:
+            self._raise_failed()
+
+    async def wait_done(self) -> None:
+        """Park until the pump completes the job (clean flush, client
+        disconnect, upstream fail(), or deadline breach), then re-raise
+        the job's failure with its original class. The detached-mode
+        replacement for the per-chunk feed loop."""
+        await self._done.wait()
+        if self._failed is not None:
+            self._raise_failed()
+
+    def fail(self, exc: BaseException) -> None:
+        """Record an upstream fault (StreamTap's set_exception hook):
+        stop pumping and close the dup WITHOUT the terminal chunk, and
+        make wait_done() raise ``exc`` (same class the on-loop read
+        would have raised)."""
+        if self._completed:
+            return
+        if self._failed is None:
+            self._failed = exc
+        self._aborted = True
+        self._thread.notify(self)
+
+    def abort(self) -> None:
+        """Upstream fault: stop pumping and close the dup WITHOUT the
+        terminal chunk — the client sees the same truncated stream the
+        on-loop path produces when the handler raises mid-body."""
+        if self._completed:
+            return
+        self._aborted = True
+        self._thread.notify(self)
+
+    def ensure_closed(self) -> None:
+        """Finally-path safety net: abort if the pump is still running
+        (handler unwound via an exception or cancellation)."""
+        if not self._completed:
+            self.abort()
+
+    def settle(self) -> None:
+        """Account the job's totals into the prometheus counters, once.
+        Loop-side, from the handler's finally."""
+        if self._settled:
+            return
+        self._settled = True
+        from production_stack_tpu.router import metrics as router_metrics
+
+        if self.bytes_total:
+            router_metrics.relay_bytes.labels(
+                server=self.server_url).inc(self.bytes_total)
+        if self.chunks_total:
+            router_metrics.relay_chunks.labels(
+                server=self.server_url).inc(self.chunks_total)
+
+    # -- pump-side machinery (owning thread only) ----------------------
+
+    def _queued_bytes(self) -> int:
+        with self._lock:
+            return self._pending_bytes + (len(self._wire) - self._wire_sent)
+
+    def _try_sleep(self) -> bool:
+        """Pump-side: atomically go quiet (clear the scheduled flag) if
+        there is truly nothing left to do. False means a feed, finish,
+        or abort raced in — the service loop must take another pass
+        (the racing caller saw ``_scheduled`` still True and skipped
+        the waker, so this pass is its only wakeup)."""
+        with self._lock:
+            if self._pending or self._finishing or self._aborted:
+                return False
+            self._scheduled = False
+            return True
+
+    def _refill_wire(self) -> bool:
+        """Coalesce queued payloads (and the terminal chunk at EOF) into
+        one wire buffer. True if there are bytes to send."""
+        if self._wire_sent < len(self._wire):
+            return True
+        parts: list = []
+        marks: list = []
+        size = 0
+        with self._lock:
+            while self._pending and size < COALESCE_MAX:
+                payload = self._pending.popleft()
+                self._pending_bytes -= len(payload)
+                if self._chunked:
+                    head = b"%x\r\n" % len(payload)
+                    parts += (head, payload, b"\r\n")
+                    size += len(head) + len(payload) + 2
+                else:
+                    parts.append(payload)
+                    size += len(payload)
+                marks.append((size, len(payload)))
+            drained = not self._pending
+        if (self._finishing and drained and self._chunked
+                and not self._terminal_queued):
+            parts.append(b"0\r\n\r\n")
+            size += 5
+            self._terminal_queued = True
+        if not parts:
+            return False
+        self._wire = b"".join(parts)
+        self._wire_sent = 0
+        self._wire_marks = marks
+        self._marks_done = 0
+        return True
+
+    def _note_progress(self) -> None:
+        now = time.time()
+        while self._marks_done < len(self._wire_marks):
+            end, payload_len = self._wire_marks[self._marks_done]
+            if end > self._wire_sent:
+                break
+            self._marks_done += 1
+            self._seq += 1
+            self.bytes_total += payload_len
+            self.chunks_total += 1
+            self.write_timestamps.append((self._seq, now))
+
+    def _release_waiters(self) -> None:
+        fut = self._drain_fut
+        if fut is not None and (
+                self._completed or self._queued_bytes() < LOW_WATER):
+            self._drain_fut = None
+            self._call_on_loop(lambda: fut.done() or fut.set_result(None))
+
+    def _call_on_loop(self, fn) -> None:
+        try:
+            self._loop.call_soon_threadsafe(fn)
+        except RuntimeError:
+            pass  # loop already closed (teardown race): nothing to wake
+
+    def _complete(self) -> None:
+        self._completed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._release_waiters()
+        self._call_on_loop(self._done.set)
+
+
+class _PumpThread(threading.Thread):
+    """One pump worker: a selectors write loop over its jobs' dup'd
+    client sockets plus a socketpair waker the loop pokes on feed/
+    finish/abort."""
+
+    def __init__(self, name: str):
+        super().__init__(daemon=True, name=name)
+        self.selector = selectors.DefaultSelector()
+        self._waker_r, self._waker_w = socket.socketpair()
+        self._waker_r.setblocking(False)
+        self._waker_w.setblocking(False)
+        self.selector.register(self._waker_r, selectors.EVENT_READ, None)
+        self._dirty: deque = deque()
+        self._jobs: set = set()
+        self._stopping = False
+
+    # Called from the event-loop thread.
+    def notify(self, job: RelayJob) -> None:
+        self._dirty.append(job)
+        self.wake()
+
+    def wake(self) -> None:
+        try:
+            self._waker_w.send(b"\x01")
+        except (BlockingIOError, OSError):
+            pass  # already signaled / tearing down
+
+    def stop(self) -> None:
+        self._stopping = True
+        self.wake()
+
+    def job_count(self) -> int:
+        return len(self._jobs)
+
+    def queued_bytes(self) -> int:
+        return sum(job._queued_bytes() for job in list(self._jobs))
+
+    def _deadline_sweep(self) -> float:
+        """Fail jobs whose pump-enforced inter-chunk deadline lapsed and
+        return the select timeout that observes the nearest remaining
+        deadline (0.5s idle cadence otherwise)."""
+        timeout = 0.5
+        now = time.monotonic()
+        for job in list(self._jobs):
+            deadline = job.deadline_s
+            if not deadline or job._finishing or job._aborted \
+                    or job._completed:
+                continue
+            age = now - job.last_activity
+            if age >= deadline:
+                self._drop(job, error=asyncio.TimeoutError(
+                    f"no upstream chunk within {deadline}s "
+                    f"(relay pump inter-chunk deadline)"))
+            else:
+                timeout = min(timeout, max(0.02, deadline - age))
+        return timeout
+
+    def run(self) -> None:
+        while True:
+            events = self.selector.select(timeout=self._deadline_sweep())
+            if self._stopping:
+                break
+            ready = []
+            for key, _mask in events:
+                if key.data is None:
+                    try:
+                        while self._waker_r.recv(4096):
+                            pass
+                    except (BlockingIOError, OSError):
+                        pass
+                else:
+                    ready.append(key.data)
+            while True:
+                try:
+                    job = self._dirty.popleft()
+                except IndexError:
+                    break
+                self._jobs.add(job)
+                if job not in ready:
+                    ready.append(job)
+            for job in ready:
+                try:
+                    self._service(job)
+                except Exception:  # pragma: no cover - never kill a pump
+                    logger.exception("relay pump job failed")
+                    self._drop(job, error=OSError("pump internal error"))
+        # Teardown: abort whatever is left so no handler waits forever.
+        for job in list(self._jobs):
+            self._drop(job, error=OSError("relay pump stopped"))
+        try:
+            self.selector.unregister(self._waker_r)
+        except (KeyError, ValueError):
+            pass
+        self.selector.close()
+        self._waker_r.close()
+        self._waker_w.close()
+
+    def _register(self, job: RelayJob) -> None:
+        if not job._registered:
+            try:
+                self.selector.register(
+                    job._sock, selectors.EVENT_WRITE, job)
+                job._registered = True
+            except (KeyError, ValueError, OSError):
+                pass
+
+    def _unregister(self, job: RelayJob) -> None:
+        if job._registered:
+            job._registered = False
+            try:
+                self.selector.unregister(job._sock)
+            except (KeyError, ValueError, OSError):
+                pass
+
+    def _drop(self, job: RelayJob, error: Optional[BaseException] = None
+              ) -> None:
+        self._unregister(job)
+        self._jobs.discard(job)
+        if not job._completed:
+            if error is not None and job._failed is None \
+                    and not job._aborted:
+                job._failed = error
+            job._complete()
+
+    def _service(self, job: RelayJob) -> None:
+        if job._completed:
+            self._jobs.discard(job)
+            job._release_waiters()
+            return
+        while True:
+            if job._aborted:
+                self._drop(job)
+                return
+            if not job._refill_wire():
+                # Nothing sendable right now. Done only at clean EOF
+                # with everything flushed (terminal chunk included for
+                # chunked bodies).
+                if job._finishing and (
+                        job._terminal_queued or not job._chunked):
+                    self._drop(job)
+                    return
+                if job._try_sleep():
+                    self._unregister(job)
+                    job._release_waiters()
+                    return
+                continue
+            view = memoryview(job._wire)[job._wire_sent:]
+            try:
+                sent = job._sock.send(view)
+            except (BlockingIOError, InterruptedError):
+                self._register(job)
+                job._release_waiters()
+                return
+            except OSError as e:
+                # EPIPE/ECONNRESET: the client went away mid-stream.
+                self._drop(job, error=e)
+                return
+            job._wire_sent += sent
+            job._note_progress()
+            job._release_waiters()
+
+
+class RelayPump:
+    """The pump pool (--relay-off-loop / --relay-pump-threads). One
+    instance per router process; jobs are assigned round-robin."""
+
+    def __init__(self, threads: int = 2, name: str = "router"):
+        self.thread_count = max(1, int(threads))
+        self._name = name
+        self._threads: list = []
+        self._rr = 0
+        self._started = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self._threads = [
+            _PumpThread(f"relay-pump-{self._name}-{i}")
+            for i in range(self.thread_count)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> None:
+        for t in self._threads:
+            t.stop()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads = []
+        self._started = False
+
+    # -- introspection (scrape-time mirror) ----------------------------
+
+    def stats(self) -> dict:
+        threads = [t for t in self._threads if t.is_alive()]
+        return {
+            "active_pumps": len(threads),
+            "queue_depth": sum(t.job_count() for t in threads),
+            "queued_bytes": sum(t.queued_bytes() for t in threads),
+        }
+
+    # -- handoff -------------------------------------------------------
+
+    async def try_handoff(self, request, response,
+                          server_url: str = "") -> Optional[RelayJob]:
+        """Attempt to move a COMMITTED streamed response onto a pump.
+
+        Returns the job, or None (counted per reason in
+        ``relay_handoff_failures_total``) — the caller then stays on the
+        on-loop relay, which keeps the response byte-identical."""
+        from production_stack_tpu.router import metrics as router_metrics
+
+        reason = None
+        transport = getattr(request, "transport", None)
+        writer = getattr(response, "_payload_writer", None)
+        if not self._started or not self._threads:
+            reason = "pump_not_running"
+        elif transport is None or transport.is_closing():
+            reason = "no_transport"
+        elif transport.get_extra_info("sslcontext") is not None:
+            reason = "tls"
+        elif writer is None:
+            reason = "no_writer"
+        elif getattr(response, "_compression", False):
+            reason = "compression"
+        if reason is None:
+            sock = transport.get_extra_info("socket")
+            if sock is None:
+                reason = "no_socket"
+        if reason is None:
+            # The bytes aiohttp already accepted (headers + the first,
+            # committing chunk) must reach the kernel before raw writes
+            # on the dup may follow them — otherwise they'd reorder.
+            deadline = time.monotonic() + DRAIN_WAIT_S
+            while transport.get_write_buffer_size() > 0:
+                if time.monotonic() >= deadline or transport.is_closing():
+                    reason = "buffer_not_drained"
+                    break
+                await asyncio.sleep(0.005)
+        if reason is None:
+            try:
+                dup = sock.dup()
+            except OSError:
+                reason = "dup_failed"
+        if reason is not None:
+            router_metrics.relay_handoff_failures.labels(
+                reason=reason).inc()
+            return None
+        chunked = bool(getattr(writer, "chunked", False))
+        job = RelayJob(dup, chunked, asyncio.get_running_loop(),
+                       server_url)
+        thread = self._threads[self._rr % len(self._threads)]
+        self._rr += 1
+        job._thread = thread
+        job._scheduled = True
+        thread.notify(job)
+        return job

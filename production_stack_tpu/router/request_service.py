@@ -32,6 +32,9 @@ from aiohttp import web
 
 from production_stack_tpu.obs.trace import format_traceparent
 from production_stack_tpu.router.httpclient import get_client_session
+from production_stack_tpu.router.relay import (
+    StreamTap, install_tap, remove_tap,
+    seal_response as relay_seal_response)
 from production_stack_tpu.structured.api import (
     StructuredError, compile_char_dfa, parse_structured)
 from production_stack_tpu.utils.log import init_logger
@@ -85,6 +88,65 @@ def _forward_headers(request: web.Request) -> dict:
     }
 
 
+class _RelayDetach:
+    """Handoff slot connecting route_general_request (which owns the
+    relay job and the client-side bookkeeping) to process_request
+    (which owns the upstream response object the handler never sees).
+
+    The handler arms it (``job`` + ``on_chunk``) right after a
+    successful pump handoff; at its next resume the generator detaches
+    the upstream ``StreamReader`` onto a :class:`StreamTap` and PARKS in
+    ``RelayJob.wait_done()`` — from then on each upstream payload costs
+    one sync hook (SLO stamp, QoS buffer, engine token accounting, pump
+    feed) instead of a four-frame coroutine resumption chain plus an
+    aiohttp write. Never armed when --relay-off-loop is unset."""
+
+    __slots__ = ("job", "on_chunk", "tap", "content")
+
+    def __init__(self):
+        self.job = None
+        self.on_chunk = None
+        self.tap = None
+        self.content = None
+
+
+def _begin_detach(detach: _RelayDetach, resp, monitor,
+                  backend_url: str, request_id: str) -> bool:
+    """Switch a committed stream to detached mode. Synchronous — no
+    await between the checks, the tap install, and the buffered-payload
+    drain, so no upstream byte can slip past the tap. False (tap not
+    installed) falls back to the per-chunk feed path."""
+    content = resp.content
+    if content.exception() is not None:
+        return False
+    handler_cb = detach.on_chunk
+
+    def on_chunk(data, now):
+        monitor.on_token(backend_url, request_id, now)
+        if handler_cb is not None:
+            handler_cb(data, now)
+
+    tap = StreamTap(detach.job, on_chunk,
+                    getattr(content, "_protocol", None))
+    if not install_tap(content, tap):
+        return False
+    detach.tap = tap
+    detach.content = content
+    # Payloads the parser delivered before the swap sit in the reader's
+    # buffer; route them through the same hook path, then replay a
+    # pre-swap EOF (the tapped feed_eof will never fire for it).
+    try:
+        buffered = content.read_nowait(-1)
+    except Exception:
+        buffered = b""
+    if buffered:
+        tap.on_data(buffered)
+    if content.is_eof():
+        remove_tap(content)
+        tap.on_eof()
+    return True
+
+
 async def process_request(
     state,
     request_id: str,
@@ -95,6 +157,7 @@ async def process_request(
     method: str = "POST",
     ttft_deadline: Optional[float] = None,
     inter_chunk_deadline: Optional[float] = None,
+    detach: Optional[_RelayDetach] = None,
 ) -> AsyncGenerator[Tuple[str, object], None]:
     """Stream a backend request; yields ("headers", (status, hdrs)) then
     ("chunk", bytes)... — mirroring reference request.py:55-137.
@@ -124,6 +187,11 @@ async def process_request(
                     else:
                         monitor.on_token(backend_url, request_id, now)
                     yield "chunk", chunk
+                    if detach is not None and detach.job is not None \
+                            and _begin_detach(detach, resp, monitor,
+                                              backend_url, request_id):
+                        await detach.job.wait_done()
+                        return
             return
         t0 = time.monotonic()
         req = session.request(
@@ -155,7 +223,17 @@ async def process_request(
                 else:
                     monitor.on_token(backend_url, request_id, now)
                 yield "chunk", chunk
+                if detach is not None and detach.job is not None \
+                        and _begin_detach(detach, resp, monitor,
+                                          backend_url, request_id):
+                    # Parked: the pump enforces the inter-chunk
+                    # deadline (job.deadline_s) and wait_done raises
+                    # the same asyncio.TimeoutError wait_for() did.
+                    await detach.job.wait_done()
+                    return
     finally:
+        if detach is not None and detach.content is not None:
+            remove_tap(detach.content)
         monitor.on_request_complete(backend_url, request_id, time.time())
 
 
@@ -168,6 +246,7 @@ async def _stream_with_failover(
     endpoint: str,
     body: bytes,
     headers: dict,
+    detach: Optional[_RelayDetach] = None,
 ) -> AsyncGenerator[Tuple[str, object], None]:
     """Retry/failover wrapper around :func:`process_request`.
 
@@ -208,6 +287,7 @@ async def _stream_with_failover(
                 state, request_id, url, endpoint, body, headers,
                 ttft_deadline=cfg.ttft_deadline_s or None,
                 inter_chunk_deadline=cfg.inter_chunk_deadline_s or None,
+                detach=detach,
             )
             async for kind, payload in stream:
                 if kind == "headers":
@@ -543,14 +623,28 @@ async def route_general_request(
                 trace.trace_id, upstream.span_id)
 
         routed_url, attempt_no = server_url, 0
+        # Relay pump tier (--relay-off-loop): after the first chunk has
+        # gone out through the normal aiohttp writer (the response is
+        # then COMMITTED — failover window closed), the client socket
+        # is handed to a pump thread, the upstream StreamReader is
+        # detached onto a StreamTap, and the handler parks until EOF —
+        # subsequent chunks never resume a coroutine or touch the
+        # aiohttp writer. relay is None when the flag is off and none
+        # of this changes the byte stream.
+        relay = getattr(state, "relay", None)
+        relay_job = None
+        relay_tried = False
+        relay_detach = _RelayDetach() if relay is not None else None
         if ft is not None:
             stream = _stream_with_failover(
                 state, ft, request_id, server_url,
                 [ep.url for ep in endpoints], endpoint, body, headers,
+                detach=relay_detach,
             )
         else:
             stream = process_request(
-                state, request_id, server_url, endpoint, body, headers
+                state, request_id, server_url, endpoint, body, headers,
+                detach=relay_detach,
             )
         response: Optional[web.StreamResponse] = None
         got_first_chunk = False
@@ -624,7 +718,46 @@ async def route_general_request(
                             slo_chunks += 1
                         full_response.extend(payload)
                         assert response is not None
-                        await response.write(payload)
+                        if relay_job is not None:
+                            # Pump-side disconnects surface here as the
+                            # same ClientConnectionResetError the write
+                            # below raises, into the same except arm.
+                            # Sync fast path; awaits only at HIGH_WATER.
+                            waiter = relay_job.feed_nowait(payload)
+                            if waiter is not None:
+                                await waiter
+                        else:
+                            await response.write(payload)
+                            if relay is not None and not relay_tried:
+                                relay_tried = True
+                                relay_job = await relay.try_handoff(
+                                    request, response,
+                                    server_url=server_url)
+                                if relay_job is not None:
+                                    if ft is not None:
+                                        relay_job.deadline_s = (
+                                            ft.config.inter_chunk_deadline_s
+                                            or None)
+
+                                    def _relay_chunk_cb(data, now):
+                                        # Loop-side, from the upstream
+                                        # protocol's data_received while
+                                        # the handler is parked: the
+                                        # exact bookkeeping the per-
+                                        # chunk loop above does.
+                                        nonlocal slo_chunks, \
+                                            slo_last_chunk
+                                        if slo is not None:
+                                            slo_last_chunk = now
+                                            slo_chunks += 1
+                                        full_response.extend(data)
+
+                                    relay_detach.on_chunk = \
+                                        _relay_chunk_cb
+                                    # Arm LAST: the generator detaches
+                                    # at its next resume once job is
+                                    # non-None.
+                                    relay_detach.job = relay_job
             except (aiohttp.ClientError, asyncio.TimeoutError) as e:
                 if upstream is not None:
                     upstream.finish(error=str(e))
@@ -650,7 +783,14 @@ async def route_general_request(
             if response is None:
                 slo_outcome = "failed"
                 return web.json_response({"error": "Empty backend response"}, status=502)
-            await response.write_eof()
+            if relay_job is not None:
+                # Pump flushes everything (terminal chunk included) and
+                # the response is sealed so aiohttp's own write_eof
+                # becomes a no-op; keep-alive proceeds normally.
+                await relay_job.finish()
+                relay_seal_response(response)
+            else:
+                await response.write_eof()
             if slo is not None:
                 if response.status >= 400:
                     slo_outcome = "failed"
@@ -679,6 +819,13 @@ async def route_general_request(
                 )
             return response
         finally:
+            if relay_job is not None:
+                # Exception/cancellation unwind: abort the pump (dup
+                # closes without the terminal chunk — same truncated
+                # stream the on-loop path leaves), then account the
+                # job's byte/chunk totals once.
+                relay_job.ensure_closed()
+                relay_job.settle()
             if trace is not None:
                 status = response.status if response is not None else 0
                 upstream.finish(status=status, bytes=len(full_response))
@@ -870,6 +1017,9 @@ async def route_disaggregated_prefill_request(
     )
     response: Optional[web.StreamResponse] = None
     got_first_chunk = False
+    relay = getattr(state, "relay", None)
+    relay_job = None
+    relay_tried = False
     try:
         async for kind, payload in stream:
             if kind == "headers":
@@ -888,12 +1038,28 @@ async def route_disaggregated_prefill_request(
                         parent=upstream,
                     )
                 assert response is not None
-                await response.write(payload)
+                if relay_job is not None:
+                    waiter = relay_job.feed_nowait(payload)
+                    if waiter is not None:
+                        await waiter
+                else:
+                    await response.write(payload)
+                    if relay is not None and not relay_tried:
+                        relay_tried = True
+                        relay_job = await relay.try_handoff(
+                            request, response, server_url=decode_url)
         if response is None:
             return web.json_response({"error": "Empty decode response"}, status=502)
-        await response.write_eof()
+        if relay_job is not None:
+            await relay_job.finish()
+            relay_seal_response(response)
+        else:
+            await response.write_eof()
         return response
     finally:
+        if relay_job is not None:
+            relay_job.ensure_closed()
+            relay_job.settle()
         if trace is not None:
             status = response.status if response is not None else 0
             upstream.finish(status=status)

@@ -77,6 +77,8 @@ def _refresh_scrape_mirrors(state) -> None:
             state.trace_recorder.slow_logs_suppressed_total)
     if state.slo is not None:
         state.slo.refresh_gauges()
+    if state.relay is not None:
+        metrics_mod.mirror_relay_metrics(state.relay)
     if state.loop_monitor is not None:
         metrics_mod.mirror_loop_metrics(state.loop_monitor)
 

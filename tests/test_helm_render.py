@@ -836,3 +836,46 @@ def test_router_workers_plumbs_into_router_command():
     bad["routerSpec"]["workers"] = 0
     with pytest.raises(jsonschema.ValidationError):
         jsonschema.validate(bad, schema)
+
+
+def test_router_relay_plumbs_into_router_command():
+    """routerSpec.relay.{enabled,pumpThreads} renders as
+    --relay-off-loop / --relay-pump-threads on the router command when
+    enabled (absent at the default — the flag-off path must stay
+    byte-identical), and the schema accepts/rejects the knob shape."""
+    import copy
+    import json
+
+    import jsonschema
+
+    values = copy.deepcopy(load_values(CHART))
+    values["routerSpec"]["relay"] = {"enabled": True, "pumpThreads": 3}
+    with open(os.path.join(CHART, "values.schema.json")) as f:
+        schema = json.load(f)
+    jsonschema.validate(values, schema)
+
+    rendered = MiniHelm(CHART).render(values)
+    deps = [d for d in _docs(rendered, "Deployment")
+            if d["metadata"]["name"].endswith("-router")]
+    assert deps, "router deployment missing"
+    cmd = deps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--relay-off-loop" in cmd
+    assert "--relay-pump-threads" in cmd
+    assert cmd[cmd.index("--relay-pump-threads") + 1] == "3"
+
+    base = _render()
+    bdeps = [d for d in _docs(base, "Deployment")
+             if d["metadata"]["name"].endswith("-router")]
+    bcmd = bdeps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--relay-off-loop" not in bcmd
+    assert "--relay-pump-threads" not in bcmd
+
+    bad = copy.deepcopy(load_values(CHART))
+    bad["routerSpec"]["relay"] = {"enabled": True, "pumpThreads": 0}
+    with pytest.raises(jsonschema.ValidationError):
+        jsonschema.validate(bad, schema)
+
+    bad2 = copy.deepcopy(load_values(CHART))
+    bad2["routerSpec"]["relay"] = {"enabled": True, "unknown": 1}
+    with pytest.raises(jsonschema.ValidationError):
+        jsonschema.validate(bad2, schema)

@@ -1,0 +1,426 @@
+"""Relay pump tier (router/relay.py, --relay-off-loop): flag-on vs
+flag-off client-visible byte identity for streamed responses (SSE and
+plain JSON), pump-side fault semantics (client disconnect ->
+client_abort + QoS slot released, upstream inter-chunk deadline ->
+failed + truncated stream), QoS usage-reconciliation parity for a
+gamed ``max_tokens`` stream, flag-off registry sample-delta parity (no
+relay series without the flag), and a 2-worker pre-fork leg asserting
+pump metrics come back worker-stamped through the federation plane."""
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import aiohttp
+import pytest
+import yaml
+from aiohttp import web
+
+from production_stack_tpu.router import metrics as router_metrics
+from production_stack_tpu.router import routing_logic as rl
+from production_stack_tpu.router.app import build_app
+from production_stack_tpu.router.engine_stats import EngineStatsScraper
+from production_stack_tpu.router.request_stats import RequestStatsMonitor
+from production_stack_tpu.testing.fake_engine import FakeEngine
+from production_stack_tpu.utils.misc import SingletonABCMeta, SingletonMeta
+
+MODEL = "test-model"
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    def _reset():
+        for cls in (
+            rl.RoundRobinRouter, rl.SessionRouter, rl.PrefixAwareRouter,
+            rl.KvawareRouter, rl.DisaggregatedPrefillRouter,
+        ):
+            SingletonABCMeta._reset_instance(cls)
+        SingletonMeta._reset_instance(RequestStatsMonitor)
+        SingletonMeta._reset_instance(EngineStatsScraper)
+
+    _reset()
+    yield
+    _reset()
+
+
+def _args(**overrides) -> argparse.Namespace:
+    from production_stack_tpu.router.parser import build_parser
+
+    args = build_parser().parse_args([])
+    for k, v in overrides.items():
+        setattr(args, k, v)
+    return args
+
+
+async def _start(app: web.Application):
+    runner = web.AppRunner(app)
+    await runner.setup()
+    # Short shutdown grace: a deliberately hung fake-engine handler
+    # (hang_mid_stream) must not hold teardown for aiohttp's default
+    # 60 s drain.
+    site = web.TCPSite(runner, "127.0.0.1", 0, shutdown_timeout=0.5)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+async def _router(engine=None, **argover):
+    engine = engine or FakeEngine(model=MODEL, ttft=0.01,
+                                  tokens_per_sec=500.0)
+    erunner, eurl = await _start(engine.make_app())
+    args = _args(
+        static_backends=eurl,
+        static_models=MODEL,
+        routing_logic="roundrobin",
+        engine_stats_interval=60,
+        **argover,
+    )
+    app = build_app(args)
+    rrunner, rurl = await _start(app)
+    return engine, eurl, app, rurl, [erunner, rrunner]
+
+
+async def _cleanup(runners):
+    for r in reversed(runners):
+        await r.cleanup()
+
+
+def _counter_total(counter) -> float:
+    return sum(s.value for m in counter.collect() for s in m.samples
+               if s.name.endswith("_total"))
+
+
+def _relay_sample_counts() -> dict:
+    return {
+        name: sum(len(m.samples) for m in metric.collect())
+        for name, metric in (
+            ("bytes", router_metrics.relay_bytes),
+            ("chunks", router_metrics.relay_chunks),
+            ("handoff_failures", router_metrics.relay_handoff_failures),
+            ("active_pumps", router_metrics.relay_active_pumps),
+            ("queue_depth", router_metrics.relay_queue_depth),
+        )
+    }
+
+
+async def _stream_body(s, rurl, *, stream=True, max_tokens=8,
+                       headers=None, **extra) -> tuple:
+    body = {"model": MODEL, "prompt": "ping", "max_tokens": max_tokens,
+            "stream": stream, **extra}
+    async with s.post(f"{rurl}/v1/completions", json=body,
+                      headers=headers or {}) as resp:
+        return resp.status, await resp.content.read()
+
+
+async def _stream_chat(s, rurl, *, max_tokens=8, headers=None) -> tuple:
+    # Fault injection and SSE usage frames only exist on the fake
+    # engine's chat endpoint. A truncated chunked body (mid-stream
+    # fault) surfaces as a ClientError while reading — keep the bytes.
+    body = {"model": MODEL, "max_tokens": max_tokens, "stream": True,
+            "messages": [{"role": "user", "content": "ping"}]}
+    async with s.post(f"{rurl}/v1/chat/completions", json=body,
+                      headers=headers or {}) as resp:
+        raw = b""
+        try:
+            async for chunk in resp.content.iter_any():
+                raw += chunk
+        except aiohttp.ClientError:
+            pass
+        return resp.status, raw
+
+
+# ---------------------------------------------------------------------------
+# Byte identity: flag-on output == flag-off output
+# ---------------------------------------------------------------------------
+
+
+def _normalize(raw: bytes) -> bytes:
+    """Zero out the per-request fields (id, created) the engine stamps
+    into every frame so two runs of the same request compare equal."""
+    import re
+
+    raw = re.sub(rb'"id": "[^"]*"', b'"id": "X"', raw)
+    return re.sub(rb'"created": \d+', b'"created": 0', raw)
+
+
+async def test_stream_bytes_identical_flag_on_vs_off():
+    """The same SSE completion and the same non-streamed JSON body must
+    reach the client byte-for-byte equal (modulo the engine's random
+    request id / timestamp) whether the pump moved them or the event
+    loop did — and the flag-on leg must actually have pumped (relay
+    chunk counter advanced, so this is not two on-loop runs)."""
+    results = {}
+    for leg in ("off", "on"):
+        engine, _, app, rurl, runners = await _router(
+            relay_off_loop=(leg == "on"))
+        try:
+            assert (app["state"].relay is not None) == (leg == "on")
+            async with aiohttp.ClientSession() as s:
+                status, sse = await _stream_body(s, rurl, stream=True)
+                assert status == 200
+                status, body = await _stream_body(s, rurl, stream=False)
+                assert status == 200
+            results[leg] = (_normalize(sse), _normalize(body))
+        finally:
+            await _cleanup(runners)
+
+    assert results["on"][0] == results["off"][0]  # SSE stream
+    assert results["on"][1] == results["off"][1]  # buffered JSON
+    sse = results["on"][0]
+    assert sse.count(b"data: ") >= 8 and b"data: [DONE]" in sse
+
+
+async def test_flag_on_pumps_and_counts():
+    """Flag-on: the handoff engages (no fallback reasons except the
+    benign ones), and the per-server relay byte/chunk counters settle to
+    exactly what streamed."""
+    chunks_before = _counter_total(router_metrics.relay_chunks)
+    bytes_before = _counter_total(router_metrics.relay_bytes)
+    engine, eurl, app, rurl, runners = await _router(relay_off_loop=True)
+    try:
+        async with aiohttp.ClientSession() as s:
+            status, sse = await _stream_body(s, rurl, stream=True)
+            assert status == 200
+    finally:
+        await _cleanup(runners)
+    pumped_chunks = _counter_total(router_metrics.relay_chunks) \
+        - chunks_before
+    pumped_bytes = _counter_total(router_metrics.relay_bytes) \
+        - bytes_before
+    # The first chunk goes out on-loop (commit), the rest through the
+    # pump; upstream chunk coalescing makes the exact count variable.
+    assert pumped_chunks >= 1
+    assert 0 < pumped_bytes < len(sse)
+
+
+# ---------------------------------------------------------------------------
+# Fault semantics through the pump
+# ---------------------------------------------------------------------------
+
+
+def _slo_file(tmp_path, config) -> str:
+    p = tmp_path / "slo.yaml"
+    p.write_text(yaml.safe_dump(config))
+    return str(p)
+
+
+async def _wait_counts(state, total, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if sum(state.slo.counts().values()) >= total:
+            return state.slo.counts()
+        await asyncio.sleep(0.02)
+    return state.slo.counts()
+
+
+async def test_pump_client_disconnect_client_abort_and_slot_release(
+        tmp_path):
+    """A client that hangs up while the pump owns its socket must
+    classify client_abort (not failed), and the QoS concurrency slot
+    must come back — the finally-path the flag-off build runs is the
+    same one the pump feeds."""
+    tenants_file = str(tmp_path / "tenants.json")
+    with open(tenants_file, "w") as f:
+        json.dump({"tenants": [], "max_concurrency": 1}, f)
+    engine = FakeEngine(model=MODEL, ttft=0.01, tokens_per_sec=5.0)
+    _, _, app, rurl, runners = await _router(
+        engine=engine, relay_off_loop=True,
+        qos_tenants_file=tenants_file,
+        slo_config=_slo_file(tmp_path, {"default": {"ttft_p99_s": 30.0}}))
+    state = app["state"]
+    try:
+        async with aiohttp.ClientSession() as s:
+            resp = await s.post(
+                f"{rurl}/v1/completions",
+                json={"model": MODEL, "prompt": "hi",
+                      "max_tokens": 200, "stream": True})
+            assert resp.status == 200
+            await resp.content.readany()  # committed (handoff window)
+            resp.close()                  # client vanishes mid-pump
+        counts = await _wait_counts(state, 1)
+        # The slot freed: with max_concurrency=1 a leaked lease would
+        # park this next request behind a dead one.
+        deadline = time.monotonic() + 10.0
+        while state.qos.queue.inflight and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        assert state.qos.queue.inflight == 0
+        async with aiohttp.ClientSession() as s:
+            status, _ = await _stream_body(s, rurl, max_tokens=2)
+            assert status == 200
+    finally:
+        await _cleanup(runners)
+    assert counts["client_abort"] == 1
+    assert counts["failed"] == 0
+
+
+async def test_pump_inter_chunk_deadline_still_fires(tmp_path):
+    """The inter-chunk deadline is enforced loop-side on the upstream
+    read, so a replica that hangs mid-stream while the pump owns the
+    client socket must still classify failed, truncate the stream, and
+    abort the pump job (no terminal chunk, connection torn down)."""
+    engine = FakeEngine(model=MODEL, ttft=0.01, tokens_per_sec=500.0)
+    _, eurl, app, rurl, runners = await _router(
+        engine=engine, relay_off_loop=True,
+        fault_tolerance=True, ft_inter_chunk_deadline=0.4,
+        slo_config=_slo_file(tmp_path, {"default": {"ttft_p99_s": 30.0}}))
+    state = app["state"]
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{eurl}/fault", json={
+                    "mode": "hang_mid_stream", "after_chunks": 2,
+                    "times": -1}) as resp:
+                assert resp.status == 200
+            t0 = time.perf_counter()
+            status, body = await _stream_chat(s, rurl, max_tokens=200)
+            wall = time.perf_counter() - t0
+        assert status == 200
+        assert b"data: [DONE]" not in body  # truncated, not completed
+        assert wall < 5.0                   # deadline, not a hang
+        counts = await _wait_counts(state, 1)
+    finally:
+        await _cleanup(runners)
+    assert counts["failed"] == 1
+    assert counts["client_abort"] == 0
+
+
+async def test_usage_reconciliation_parity_gamed_max_tokens(tmp_path):
+    """A tenant gaming the admission estimator (string max_tokens) is
+    debited from what actually streamed; the pump buffers the same
+    full_response, so the reconciled overage must match the flag-off
+    leg exactly."""
+    debits = {}
+    for leg in ("off", "on"):
+        tenants_file = str(tmp_path / f"tenants-{leg}.json")
+        with open(tenants_file, "w") as f:
+            json.dump({"tenants": [
+                {"name": "gamer", "api_keys": ["sk-g"], "weight": 1,
+                 "tokens_per_second": 100, "burst_seconds": 2.0}]}, f)
+        before = _counter_total(router_metrics.qos_usage_reconciled)
+        _, _, app, rurl, runners = await _router(
+            relay_off_loop=(leg == "on"), qos_tenants_file=tenants_file)
+        try:
+            async with aiohttp.ClientSession() as s:
+                # Gamed: a string max_tokens is invisible to the
+                # admission estimator but honored by the engine, so
+                # reconciliation must debit the overage post-stream.
+                status, _ = await _stream_chat(
+                    s, rurl, max_tokens="400",
+                    headers={"Authorization": "Bearer sk-g"})
+                assert status == 200
+        finally:
+            await _cleanup(runners)
+        debits[leg] = _counter_total(
+            router_metrics.qos_usage_reconciled) - before
+    assert debits["off"] > 0
+    assert debits["on"] == debits["off"]
+
+
+# ---------------------------------------------------------------------------
+# Flag-off parity: no relay series, no relay state
+# ---------------------------------------------------------------------------
+
+
+async def test_flag_off_no_relay_state_and_no_series():
+    """Without --relay-off-loop nothing is constructed and no relay
+    series ever appears: sample-count deltas across a served streamed
+    request and a scrape are zero (the registry is shared across tests,
+    so deltas — not absolutes — are the invariant)."""
+    before = _relay_sample_counts()
+    _, _, app, rurl, runners = await _router()
+    try:
+        assert app["state"].relay is None
+        async with aiohttp.ClientSession() as s:
+            status, _ = await _stream_body(s, rurl, stream=True)
+            assert status == 200
+            async with s.get(f"{rurl}/metrics") as resp:
+                assert resp.status == 200
+    finally:
+        await _cleanup(runners)
+    assert _relay_sample_counts() == before
+
+
+# ---------------------------------------------------------------------------
+# 2-worker federation leg: pump metrics worker-stamped
+# ---------------------------------------------------------------------------
+
+
+def _get(url: str, timeout: float = 10.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def _post_stream(url: str, timeout: float = 10.0) -> int:
+    req = urllib.request.Request(
+        url + "/v1/completions",
+        data=json.dumps({"model": MODEL, "prompt": "hi",
+                         "max_tokens": 8, "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        resp.read()
+        return resp.status
+
+
+async def test_two_worker_relay_metrics_worker_stamped():
+    """``--router-workers 2 --relay-off-loop``: every worker runs its
+    own pump pool; the aggregated scrape must carry the pool gauges
+    per-worker (``worker="0"``/``worker="1"``) and the relay counters
+    summed fleet-wide without a worker label. The engine paces its
+    token frames so chunks keep arriving after the handoff commit point
+    — an unpaced body lands whole in the first read and leaves the pump
+    nothing to count."""
+    engine = FakeEngine(model=MODEL, ttft=0.0, tokens_per_sec=200)
+    erunner, eurl = await _start(engine.make_app())
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    rurl = f"http://127.0.0.1:{port}"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "production_stack_tpu.router.app",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--router-workers", "2",
+         "--relay-off-loop", "--relay-pump-threads", "1",
+         "--static-backends", eurl, "--static-models", MODEL,
+         "--routing-logic", "roundrobin",
+         "--engine-stats-interval", "60",
+         "--log-level", "warning"],
+        env=dict(os.environ, TPU_STACK_LOG_LEVEL="warning"))
+    try:
+        for _ in range(150):
+            try:
+                await asyncio.to_thread(_get, rurl + "/health", 2.0)
+                break
+            except OSError:
+                await asyncio.sleep(0.2)
+        else:
+            raise RuntimeError("2-worker relay router never became healthy")
+
+        for _ in range(4):
+            assert await asyncio.to_thread(_post_stream, rurl) == 200
+
+        exposition = (await asyncio.to_thread(
+            _get, rurl + "/metrics")).decode()
+        pump_lines = [l for l in exposition.splitlines()
+                      if l.startswith("vllm_router:relay_active_pumps{")]
+        assert any('worker="0"' in l for l in pump_lines), pump_lines
+        assert any('worker="1"' in l for l in pump_lines), pump_lines
+        assert all(float(l.split()[-1]) == 1.0 for l in pump_lines)
+        chunk_lines = [l for l in exposition.splitlines()
+                       if l.startswith("vllm_router:relay_chunks_total{")]
+        assert chunk_lines and all(
+            "worker=" not in l for l in chunk_lines), chunk_lines
+        assert sum(float(l.split()[-1]) for l in chunk_lines) >= 1
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise
+        await erunner.cleanup()
