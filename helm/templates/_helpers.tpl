@@ -74,6 +74,9 @@
 {{- if .model.enableChunkedPrefill }}
 - --enable-chunked-prefill
 {{- end }}
+{{- if .model.fusedStep }}
+- --fused-step
+{{- end }}
 {{- if .model.speculativeNumTokens }}
 - --speculative-num-tokens
 - {{ .model.speculativeNumTokens | quote }}

@@ -758,6 +758,21 @@ def build_dashboard():
              "them) and slow-trace log lines suppressed by "
              "--slow-trace-log-interval-s"))
     y += 7
+    panels.append(panel(
+        "timeseries", "Cached-prefill attention dispatch path",
+        [target("rate(tpu:prefill_attention_dispatch_total[5m])",
+                legend="{{instance}} {{path}}"),
+         target("rate(tpu:fused_steps_total[5m])",
+                legend="{{instance}} fused steps")],
+        grid(7, 8, 0, y),
+        desc="Cached-prefill dispatches by attention backend: the "
+             "flash pallas kernel streams only the live prefix pages; "
+             "the xla path regathers the full context every chunk. "
+             "path=\"xla\" climbing on a TPU deployment means the page "
+             "tile shape fails the kernel gate (block size / kv heads "
+             "/ head dim). Overlaid: --fused-step steps that ran a "
+             "prefill chunk + decode burst as one dispatch"))
+    y += 7
 
     # ---- Row 12b: Event Loop Health (--loop-monitor) -------------------- #
     panels.append(row("Event Loop Health", y)); y += 1

@@ -66,6 +66,13 @@ class EngineConfig:
     # (long, uncached-span) prompts are waiting. 0 = batch whenever a
     # group can form (round-4 always-on behavior).
     prefill_batch_min_waiting: int = 2
+    # Fused step program: when the chunked-prefill scheduler has BOTH a
+    # prefill plan and running decodes, execute the prefill chunk(s) and
+    # the decode burst as ONE dispatch (the device runs the already-
+    # compiled programs back to back; no new compilation variants). Off
+    # by default; flag-off behavior is byte-identical to alternating
+    # dispatches. Requires enable_chunked_prefill.
+    fused_step: bool = False
     # Fused multi-step decode: exactly this many decode iterations
     # (forward + sampling + token feedback) run inside one compiled
     # lax.scan per dispatch; sequences that cannot use the full burst are

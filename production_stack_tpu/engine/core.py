@@ -183,6 +183,30 @@ def _regroup_kv_payload(arrays):
     return head, k, v
 
 
+class _FusedPlaceholder:
+    """Result slot for an op diverted into a fused-step capture. Filled
+    when the fused dispatch (or the degraded per-op drain) executes;
+    ``error`` carries a dispatch failure to the deferred readback that
+    would otherwise wait on a value that will never arrive."""
+
+    __slots__ = ("value", "error", "ready")
+
+    def __init__(self):
+        self.value = None
+        self.error = None
+        self.ready = False
+
+
+def _unwrap_fused(x):
+    """Resolve a possibly-placeholder dispatch result (raises the
+    captured dispatch error, if any)."""
+    if isinstance(x, _FusedPlaceholder):
+        if x.error is not None:
+            raise x.error
+        return x.value
+    return x
+
+
 class EngineCore:
     def __init__(
         self,
@@ -370,6 +394,7 @@ class EngineCore:
             # warmup only compiles when prefill_batch > 1.
             max_prefill_rows=(
                 config.prefill_batch if config.prefill_batch > 1 else 1),
+            fused_step=config.fused_step,
         )
 
         # -- KV offload tier (LMCache-equivalent, SURVEY §7 step 4) --------
@@ -462,6 +487,18 @@ class EngineCore:
         self.decode_burst_count = 0
         self.dispatch_count_total = 0
         self.dispatch_enqueue_s = 0.0
+        # Fused step program: prefill-span + decode-burst pairs executed
+        # as ONE dispatch (scheduler action "fused"); and cached-prefill
+        # dispatches by attention path — "pallas" when the flash prefix
+        # kernel's trace-time tile gate admits the page shape, "xla" for
+        # the gather reference (exported as
+        # tpu:prefill_attention_dispatch_total{path=...}).
+        self.fused_steps_total = 0
+        self.prefill_attention_dispatch_total = {"pallas": 0, "xla": 0}
+        # While set, _dispatch diverts prefill/decode ops into this list
+        # (each entry (name, static, arrays, placeholder)) instead of
+        # executing them; _do_fused then issues them as one "fused" op.
+        self._fused_capture: "Optional[list]" = None
         # Speculative decoding (prompt lookup): draft tokens sent to the
         # verify program / accepted by it, requests latched back to plain
         # decode by the adaptive fallback, verify bursts dispatched, and
@@ -1094,6 +1131,21 @@ class EngineCore:
     # stays process-local as addressable shards of the global arrays.
 
     def _dispatch(self, name: str, static: dict, arrays: list):
+        cap = self._fused_capture
+        if cap is not None:
+            if name in ("prefill", "decode"):
+                # Fused capture: divert the op; _do_fused issues the
+                # whole pair as ONE "fused" dispatch.
+                ph = _FusedPlaceholder()
+                cap.append((name, static, arrays, ph))
+                return ph
+            # An op the fused program cannot carry (spec verify,
+            # counts-row rebuild, KV offload/restore...) arrived
+            # mid-capture. Device-op ORDER is the correctness contract,
+            # so degrade: stop capturing, issue what was captured as
+            # individual dispatches, then this op normally below.
+            self._fused_capture = None
+            self._drain_captured(cap)
         mh = self._mh
         t0 = time.perf_counter()
         try:
@@ -1128,6 +1180,40 @@ class EngineCore:
             self.dispatch_count_total += 1
             self.dispatch_enqueue_s += time.perf_counter() - t0
 
+    def _drain_captured(self, cap: list) -> None:
+        """Issue captured-but-unexecuted ops as individual dispatches, in
+        capture order (the degraded path: capture aborted, or the fused
+        dispatch itself failed). A failure poisons every remaining
+        placeholder so deferred readbacks surface the error instead of
+        waiting forever, then re-raises."""
+        err = None
+        for name, static, arrays, ph in cap:
+            if ph.ready:
+                continue
+            if err is None:
+                try:
+                    ph.value = self._dispatch(name, static, arrays)
+                except Exception as e:  # noqa: BLE001
+                    err = e
+                    ph.error = e
+            else:
+                ph.error = err
+            ph.ready = True
+        if err is not None:
+            raise err
+
+    def _abort_fused_capture(self) -> None:
+        """Leave fused-capture mode and really execute anything already
+        captured. Called by step paths that need host-visible results
+        mid-step (spec drafting, structured masking) — fusion cannot
+        carry those, and their builds read tokens the captured prefill
+        has not produced yet."""
+        cap = self._fused_capture
+        if cap is None:
+            return
+        self._fused_capture = None
+        self._drain_captured(cap)
+
     def _exec_op(self, name: str, static: dict, arrays: list):
         """The single source of truth for what each op does on-device;
         leader and followers both run exactly this."""
@@ -1152,6 +1238,18 @@ class EngineCore:
             # The feedback tokens for the NEXT burst live on device on
             # every process (the host never sees them mid-pipeline).
             self._last_burst_tokens = outs[0]
+            return outs
+        if name == "fused":
+            # One dispatch, several already-compiled programs back to
+            # back: the constituent ops run through this same method, so
+            # leader and followers replay identically and warmup needs
+            # ZERO new variants for the fused path.
+            outs = []
+            off = 0
+            for n_i, s_i, c_i in zip(static["names"], static["statics"],
+                                     static["counts"]):
+                outs.append(self._exec_op(n_i, s_i, arrays[off:off + c_i]))
+                off += c_i
             return outs
         if name == "spec_verify":
             # Speculative verify burst. Does NOT touch _last_burst_tokens:
@@ -2231,6 +2329,9 @@ class EngineCore:
             "preempted_by_priority":
                 dict(self.scheduler.preempted_by_priority),
             "decode_burst_count": self.decode_burst_count,
+            "fused_steps_total": self.fused_steps_total,
+            "prefill_attention_dispatch_total":
+                dict(self.prefill_attention_dispatch_total),
             "dispatch_count_total": self.dispatch_count_total,
             "dispatch_enqueue_s": round(self.dispatch_enqueue_s, 3),
             "decode_forward_steps_total": self.decode_forward_steps_total,
@@ -2298,6 +2399,13 @@ class EngineCore:
                         self.prefill_time_total += dt
                         self.prefill_count += 1
                         self._record_step(dt)
+                    elif action == "fused":
+                        t0 = time.perf_counter()
+                        self._do_fused(req)
+                        dt = time.perf_counter() - t0
+                        # prefill/decode split accounting happens inside
+                        # _do_fused (per leg).
+                        self._record_step(dt)
                     elif action == "decode":
                         t0 = time.perf_counter()
                         self._do_decode()
@@ -2312,7 +2420,7 @@ class EngineCore:
             except Exception as e:  # noqa: BLE001
                 logger.exception("Engine step failed: %s", e)
                 failed_reqs = []
-                if action == "prefill_step" and req:
+                if action in ("prefill_step", "fused") and req:
                     with self._lock:
                         for pc in req:  # req is the [PrefillChunk] plan
                             if pc.req in self.scheduler.prefilling:
@@ -2595,14 +2703,20 @@ class EngineCore:
         self.prefill_chunks_total += len(ready)
         self.last_step_batched_tokens = step_tokens
         if self.step_recorder is not None:
+            path = self._prefill_attn_path()
             self._step_info = {
                 "kind": "prefill_chunk", "rows": len(ready),
                 "tokens": step_tokens,
                 "forwards": 1 if batched else len(ready),
                 # Each chunk's queries attend to its request's context so
-                # far (cached prefix + earlier chunks) via the HBM pages.
+                # far (cached prefix + earlier chunks). The flash kernel
+                # streams ONLY the prefix pages (the chunk's own K/V is
+                # attended from VMEM before it ever leaves the chip); the
+                # XLA gather path re-reads the full written context —
+                # prefix AND the just-scattered suffix.
                 "kv_read_tokens": sum(
-                    s for (_r, _t, _b, s, _e) in ready),
+                    (s if path == "pallas" else e)
+                    for (_r, _t, _b, s, e) in ready),
                 "kv_write_tokens": step_tokens, "batched": batched,
             }
 
@@ -2640,6 +2754,109 @@ class EngineCore:
                 {"req": req, "seq": seq, "slot": slot,
                  "sampled": sampled, "row": row})
 
+    def _prefill_attn_path(self) -> str:
+        """Which attention path cached-prefill dispatches take at this
+        engine's page shape: "pallas" (flash prefix kernel) or "xla"
+        (gather reference). Trace-time static — labels
+        tpu:prefill_attention_dispatch_total and the roofline's
+        KV-read-byte model."""
+        from production_stack_tpu.ops.attention import (
+            prefill_attention_path,
+        )
+
+        mc = self.model_config
+        return prefill_attention_path(
+            self.config.block_size, mc.num_kv_heads, mc.head_dim,
+            self.config.kv_cache_dtype == "int8")
+
+    def _do_fused(self, plan) -> None:
+        """Execute one scheduler "fused" action: the budgeted prefill
+        chunk span AND the decode burst as ONE dispatch. Both legs run
+        their normal host-side build/bookkeeping code; _dispatch diverts
+        their device ops into a capture list, and the pair is issued as
+        a single "fused" op (the already-compiled programs run back to
+        back on device — zero new warmup variants, one op-channel send,
+        one enqueue). Any op fusion cannot carry (spec verify, counts
+        rebuild, KV restores...) aborts the capture and the step
+        degrades to the alternating dispatches — the token streams are
+        byte-identical either way; only dispatch counts differ.
+
+        A sequence whose FINAL prefill chunk rides the fused op has no
+        readable first token while the decode leg is being built, so it
+        sits that burst out and joins the next one (per-row positions,
+        seeds, and penalty state make its stream identical to the
+        alternating schedule's)."""
+        self._fused_capture = cap = []
+        fused = False
+        info_p = info_d = None
+        dt_p = dt_d = 0.0
+        pc0 = self.prefill_chunks_total
+        df0 = self.decode_forward_steps_total
+        try:
+            t0 = time.perf_counter()
+            self._do_prefill_step(plan)
+            dt_p = time.perf_counter() - t0
+            info_p, self._step_info = self._step_info, None
+            t0 = time.perf_counter()
+            self._do_decode()
+            dt_d = time.perf_counter() - t0
+            info_d, self._step_info = self._step_info, None
+        finally:
+            aborted = self._fused_capture is None
+            self._fused_capture = None
+            names = [c[0] for c in cap]
+            fused = (not aborted and "prefill" in names
+                     and names[-1] == "decode")
+            if fused:
+                try:
+                    results = self._dispatch("fused", {
+                        "names": names,
+                        "statics": [c[1] for c in cap],
+                        "counts": [len(c[2]) for c in cap],
+                    }, [a for c in cap for a in c[2]])
+                except Exception as e:  # noqa: BLE001
+                    for _n, _s, _a, ph in cap:
+                        if not ph.ready:
+                            ph.error, ph.ready = e, True
+                    raise
+                for (_n, _s, _a, ph), out in zip(cap, results):
+                    ph.value, ph.ready = out, True
+                self.fused_steps_total += 1
+            else:
+                # Degraded (capture aborted, or a leg dispatched
+                # nothing): issue whatever is still pending one by one.
+                self._drain_captured(cap)
+        # Wall-time attribution: the legs ran back to back; charge each
+        # to its own split only if it actually dispatched work.
+        if self.prefill_chunks_total > pc0:
+            self.prefill_time_total += dt_p
+            self.prefill_count += 1
+        if self.decode_forward_steps_total > df0:
+            self.decode_time_total += dt_d
+            self.decode_burst_count += 1
+        if self.step_recorder is not None:
+            if fused and info_p is not None and info_d is not None:
+                self._step_info = {
+                    "kind": "fused",
+                    "rows": info_p["rows"] + info_d["rows"],
+                    "tokens": info_p["tokens"] + info_d["tokens"],
+                    "forwards": info_p["forwards"] + info_d["forwards"],
+                    "kv_read_tokens": (info_p["kv_read_tokens"]
+                                       + info_d["kv_read_tokens"]),
+                    "kv_write_tokens": (info_p["kv_write_tokens"]
+                                        + info_d["kv_write_tokens"]),
+                    "batched": info_p.get("batched", False),
+                }  # _loop records it with the full step wall time
+            else:
+                # Degraded: record the legs as the individual step kinds
+                # they actually were, with their own wall times.
+                if info_p is not None:
+                    self._step_info = info_p
+                    self._record_step(dt_p)
+                if info_d is not None:
+                    self._step_info = info_d
+                    self._record_step(dt_d)
+
     def _flush_pending_prefills(self) -> None:
         """Read back and emit deferred prefill first tokens, in dispatch
         order. Must run before a decode burst is built (the burst's
@@ -2647,13 +2864,23 @@ class EngineCore:
         if not self._pending_prefills:
             return
         pending, self._pending_prefills = self._pending_prefills, []
+        keep: "list[dict]" = []
         t0 = time.perf_counter()
         for entry in pending:
+            sampled = entry["sampled"]
+            if isinstance(sampled, _FusedPlaceholder) and not sampled.ready:
+                # Captured for a fused dispatch that has not issued yet:
+                # the readback waits for the fused op. Unready entries
+                # are always the queue's tail (they were captured this
+                # step), so dispatch-order emission still holds.
+                keep.append(entry)
+                continue
             req, seq, slot = entry["req"], entry["seq"], entry["slot"]
             row_i = entry.get("row", 0)  # batched prefills: row per req
             try:
                 s_arr, lp_arr, top_lp_arr, top_id_arr = (
-                    np.asarray(a) for a in jax.device_get(entry["sampled"]))
+                    np.asarray(a)
+                    for a in jax.device_get(_unwrap_fused(sampled)))
             except Exception:  # noqa: BLE001 - async device failure
                 # The deferred readback failed AFTER the dispatch
                 # succeeded: the request would otherwise hang with its
@@ -2703,6 +2930,8 @@ class EngineCore:
             # Decode position bookkeeping starts from the emitted tokens
             # (a re-prefill after preemption carries prior outputs).
             req.scheduled_steps = len(req.output_token_ids)
+        if keep:
+            self._pending_prefills = keep + self._pending_prefills
         self.flush_time_total += time.perf_counter() - t0
 
     def _cached_prefix_len(self, tokens: List[int],
@@ -2957,6 +3186,7 @@ class EngineCore:
             # already advanced through the automaton at emission).
             self._fill_mask_row(mask_bits, mask_on, i, req)
 
+        self.prefill_attention_dispatch_total[self._prefill_attn_path()] += 1
         return self._dispatch("prefill", {"cached": True}, [
             token_arr, positions, slot_mapping,
             block_table, context_lens, seq_lens, adapter_ids,
@@ -3016,6 +3246,9 @@ class EngineCore:
         mask_on = np.zeros((1,), bool)
         self._fill_mask_row(mask_bits, mask_on, 0, req)
 
+        if start > 0:
+            self.prefill_attention_dispatch_total[
+                self._prefill_attn_path()] += 1
         return self._dispatch("prefill", {"cached": start > 0}, [
             token_arr, positions, slot_mapping,
             block_table, context_lens, seq_lens, adapter_ids,
@@ -3047,6 +3280,10 @@ class EngineCore:
             # pipeline (flush first, then dispatch; use_prev stays
             # False). That trades the one-burst overlap for verifying
             # up to K tokens per model forward when drafts accept.
+            # Fusion cannot carry this: a captured prefill's sample must
+            # actually execute (and emit) before it can seed a draft.
+            self._abort_fused_capture()
+            self._flush_pending_prefills()
             self._flush_pending_burst()
             plan = self._propose_spec_drafts()
             if plan:
@@ -3062,6 +3299,11 @@ class EngineCore:
                 s.req.structured is not None and s.req.structured.masking
                 for s in self.scheduler.running())
         if has_structured:
+            # Masks read the CURRENT automaton state, which only the
+            # emitted tokens advance — a captured prefill's sample must
+            # really execute (and flush) before a mask row is built.
+            self._abort_fused_capture()
+            self._flush_pending_prefills()
             self._flush_pending_burst()
         B = cfg.max_num_seqs
         K = max(cfg.decode_steps, 1)
@@ -3104,13 +3346,25 @@ class EngineCore:
              for s in prev["active"]} if prev else {}
         )
 
+        # Sequences whose first token is still captured for the fused
+        # dispatch being built: no host-visible sample yet, so they sit
+        # this burst out and join the next one (per-row positions/seeds
+        # keep their stream identical to the alternating schedule's).
+        pending_first = {
+            e["req"].request_id for e in self._pending_prefills
+            if isinstance(e["sampled"], _FusedPlaceholder)
+            and not e["sampled"].ready}
+
         with self._lock:
-            active0 = self.scheduler.running()
+            active0 = [s for s in self.scheduler.running()
+                       if s.req.request_id not in pending_first]
             allows: Dict[str, int] = {}
             # Account the about-to-be-written tokens; preempt on OOM.
             for seq in list(self.scheduler.running()):
                 if self.scheduler.slots[seq.slot] is not seq:
                     continue  # already preempted this pass
+                if seq.req.request_id in pending_first:
+                    continue  # first token still in the fused capture
                 need = seq_allow(seq.req)
                 allows[seq.req.request_id] = need
                 while need > 0:
@@ -3431,10 +3685,16 @@ class EngineCore:
         pending = self._pending_burst
         if pending is None:
             return
+        out = pending["out"]
+        if isinstance(out, _FusedPlaceholder) and not out.ready:
+            # Captured for a fused dispatch that has not issued yet —
+            # nothing to read back. (Defensive: _do_fused settles every
+            # placeholder before returning.)
+            return
         self._pending_burst = None
         t0 = time.perf_counter()
         sampled, lps, top_lps, top_idxs = (
-            np.asarray(a) for a in jax.device_get(pending["out"])
+            np.asarray(a) for a in jax.device_get(_unwrap_fused(out))
         )  # [B, K], [B, K], [B, K, LOGPROB_K] x2
         self.flush_time_total += time.perf_counter() - t0
         if pending.get("spec"):

@@ -192,6 +192,7 @@ class Scheduler:
         token_budget: int = 0,
         max_consecutive_prefills: int = 2,
         max_prefill_rows: int = 1,
+        fused_step: bool = False,
     ):
         self.kv_mgr = kv_mgr
         self.max_num_seqs = max_num_seqs
@@ -201,6 +202,10 @@ class Scheduler:
         self.token_budget = max(token_budget, chunk_tokens)
         self.max_consecutive_prefills = max(max_consecutive_prefills, 1)
         self.max_prefill_rows = max(max_prefill_rows, 1)
+        # Emit ("fused", plan) instead of ("prefill_step", plan) when
+        # sequences are also decoding — the engine runs both legs as one
+        # dispatch. Prefill-only and decode-only steps are unchanged.
+        self.fused_step = fused_step
         self.waiting: Deque[EngineRequest] = deque()
         self.slots: List[Optional[RunningSeq]] = [None] * max_num_seqs
         # Requests mid-prefill under the chunked scheduler: admitted (KV
@@ -393,7 +398,8 @@ class Scheduler:
     # -- scheduling decisions ---------------------------------------------
     def next_action(self) -> Tuple[str, object]:
         """Returns ("prefill", req) | ("prefill_step", [PrefillChunk, ...])
-        | ("decode", None) | ("idle", None)."""
+        | ("fused", [PrefillChunk, ...]) | ("decode", None)
+        | ("idle", None)."""
         if self.chunked_prefill:
             return self._next_action_chunked()
         slot = self._free_slot()
@@ -423,6 +429,12 @@ class Scheduler:
             return "decode", None
         plan = self._build_prefill_step()
         if plan:
+            if self.fused_step and self.num_running > 0:
+                # Both queues nonempty: one dispatch runs the chunk span
+                # AND a decode burst, so decodes advance every step and
+                # the starvation cap never has to trip.
+                self._prefill_streak = 0
+                return "fused", plan
             self._prefill_streak += 1
             return "prefill_step", plan
         self._prefill_streak = 0

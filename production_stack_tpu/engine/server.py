@@ -2487,6 +2487,22 @@ class EngineServer:
             "# TYPE tpu:decode_forward_steps counter",
             f"tpu:decode_forward_steps_total{{{labels}}} "
             f"{s.get('decode_forward_steps_total', 0)}",
+            # Fused step program (--fused-step): prefill-chunk + decode-
+            # burst pairs issued as ONE dispatch.
+            "# TYPE tpu:fused_steps counter",
+            f"tpu:fused_steps_total{{{labels}}} "
+            f"{s.get('fused_steps_total', 0)}",
+            # Cached-prefill attention path taken per dispatch: "pallas"
+            # (flash prefix kernel — prefix pages streamed, suffix from
+            # VMEM) vs "xla" (full-context gather reference). Both label
+            # values always emitted so rate() never sees a vanishing
+            # series.
+            "# TYPE tpu:prefill_attention_dispatch counter",
+            f'tpu:prefill_attention_dispatch_total{{{labels},'
+            f'path="pallas"}} '
+            f"{s.get('prefill_attention_dispatch_total', {}).get('pallas', 0)}",
+            f'tpu:prefill_attention_dispatch_total{{{labels},path="xla"}} '
+            f"{s.get('prefill_attention_dispatch_total', {}).get('xla', 0)}",
             # Structured output (guided_json / guided_regex /
             # response_format): grammar constraints compiled to token FSMs
             # applied inside the fused programs.
@@ -2717,6 +2733,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="chunked prefill: force a decode step after this "
                         "many consecutive prefill steps while sequences "
                         "are running (the decode-starvation cap)")
+    p.add_argument("--fused-step", action="store_true", default=False,
+                   help="fused step program: when the chunked-prefill "
+                        "scheduler has both a prefill plan and running "
+                        "decodes, dispatch the prefill chunk span AND "
+                        "the decode burst as ONE device program "
+                        "(requires --enable-chunked-prefill; compiles "
+                        "zero new variants)")
     p.add_argument("--speculative-num-tokens", type=int, default=0,
                    help="prompt-lookup speculative decoding: verify up to "
                         "this many tokens per forward pass (the drafts come "
@@ -2831,6 +2854,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         enable_chunked_prefill=args.enable_chunked_prefill,
         max_num_batched_tokens=args.max_num_batched_tokens,
         max_consecutive_prefills=args.max_consecutive_prefills,
+        fused_step=args.fused_step,
         max_model_len=args.max_model_len,
         max_num_seqs=args.max_num_seqs,
         block_size=args.block_size,

@@ -180,10 +180,12 @@ def _layer(
         attn = prefill_attention(q, k, v, scale=scale, seq_lens=seq_lens)
     elif mode == "prefill_cached":
         # Suffix prefill after a prefix-cache hit: attend over HBM pages
-        # (cached prefix + just-written suffix).
+        # (cached prefix + just-written suffix). The chunk's own fresh
+        # k/v ride along so the flash kernel can serve the suffix from
+        # VMEM and stream only the cached prefix pages.
         attn = context_prefill_attention(
             q, k_pages, v_pages, block_tables, positions, context_lens,
-            layer, scale=scale,
+            layer, scale=scale, k_new=k, v_new=v, suffix_lens=seq_lens,
         )
     else:
         attn = paged_decode_attention(

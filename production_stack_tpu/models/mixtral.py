@@ -99,7 +99,7 @@ def _layer(
         # (cached prefix + just-written suffix).
         attn = context_prefill_attention(
             q, k_pages, v_pages, block_tables, positions, context_lens,
-            layer, scale=scale,
+            layer, scale=scale, k_new=k, v_new=v, suffix_lens=seq_lens,
         )
     else:
         attn = paged_decode_attention(

@@ -63,6 +63,35 @@ def _use_pallas() -> bool:
         return False
 
 
+def _page_tile_ok(block_size: int, kvh: int, head_dim: int,
+                  quantized: bool) -> bool:
+    """Trace-time tile-alignment gate shared by the paged kernels. The
+    manual page DMAs slice [bs, KVH, D] out of HBM: Mosaic requires the
+    sliced dims tile-aligned (KVH to the 8-row sublane, D to the 128
+    lanes, bs to 8); the int8 kernels additionally DMA per-page scale
+    rows [bs*KVH], whose last dim must fill whole 128-lane tiles.
+    Misaligned models (e.g. OPT: 12 kv-heads, head_dim 64) take the XLA
+    reference — and this MUST be decided at trace time: a Mosaic
+    failure surfaces at AOT compile where no fallback is possible."""
+    ok = block_size % 8 == 0 and kvh % 8 == 0 and head_dim % 128 == 0
+    if quantized:
+        ok = ok and (block_size * kvh) % 128 == 0
+    return ok
+
+
+def prefill_attention_path(block_size: int, kvh: int, head_dim: int,
+                           quantized: bool) -> str:
+    """Which backend a cached-prefill dispatch with these (static) page
+    shapes will take: ``"pallas"`` or ``"xla"``. Evaluates the same
+    trace-time predicate as the dispatcher plus the runtime platform/env
+    gate — the engine calls this per dispatch to label
+    ``tpu:prefill_attention_dispatch_total`` (the env override can flip
+    between steps)."""
+    if _page_tile_ok(block_size, kvh, head_dim, quantized) and _use_pallas():
+        return "pallas"
+    return "xla"
+
+
 def prefill_attention(
     q: jax.Array,  # [B, T, H, D]
     k: jax.Array,  # [B, T, KVH, D]
@@ -98,8 +127,14 @@ def _gather_ctx(pages, block_tables: jax.Array, layer: jax.Array,
     """Gather a batch's context from stacked pages [L, NB, bs, KVH, D]
     without materializing a whole layer: page-level indices into the
     (L*NB)-page flat view. Quantized (data, scales) pages are gathered
-    page-wise too — int8 bytes over the wire — then dequantized into
-    ``out_dtype`` right before use."""
+    page-wise too — int8 bytes over the wire — then dequantized (f32
+    multiply, always) right before use.
+
+    The returned dtype is ``out_dtype`` when given, float32 otherwise —
+    for BOTH page encodings. (Historically the bf16 branch returned the
+    raw page dtype under the default while the int8 branch returned
+    f32; parity tolerances against the pallas kernels, which accumulate
+    in f32 unconditionally, depend on this being explicit.)"""
     data = kv_page_data(pages)
     L, NB, bs, KVH, D = data.shape
     B, MAXB = block_tables.shape
@@ -109,9 +144,8 @@ def _gather_ctx(pages, block_tables: jax.Array, layer: jax.Array,
     if isinstance(pages, tuple):
         flat_s = pages[1].reshape(L * NB, bs, KVH)
         ctx_s = flat_s[idx].reshape(B, MAXB * bs, KVH)
-        ctx = (ctx.astype(jnp.float32) * ctx_s[..., None]).astype(
-            out_dtype or jnp.float32)
-    return ctx
+        ctx = ctx.astype(jnp.float32) * ctx_s[..., None]
+    return ctx.astype(out_dtype if out_dtype is not None else jnp.float32)
 
 
 def context_prefill_attention(
@@ -124,13 +158,65 @@ def context_prefill_attention(
     layer: jax.Array,  # scalar layer index
     *,
     scale: float,
+    k_new: jax.Array | None = None,  # [B, T, KVH, D] the chunk's fresh K
+    v_new: jax.Array | None = None,  # [B, T, KVH, D]
+    suffix_lens: jax.Array | None = None,  # [B] valid fresh tokens
 ) -> jax.Array:
     """Prefill attention for a suffix whose K/V (and the cached prefix's)
     already live in HBM pages: query at absolute position p attends to page
     positions 0..p. This is what makes prefix-cache hits skip recompute —
     only the suffix runs through the model, attending to reused pages
     (reference buys this from vLLM ``--enable-prefix-caching`` +
-    LMCache offload; here it is native). Returns [B, T, H, D]."""
+    LMCache offload; here it is native). Returns [B, T, H, D].
+
+    When the caller also passes the chunk's own fresh ``k_new``/``v_new``
+    (+ ``suffix_lens``, their per-row valid counts) AND the page shapes
+    are tile-aligned, the flash pallas kernel serves the cached prefix
+    straight from its live pages (int8 dequant on-chip) while the suffix
+    attends from the fresh values — no full-context materialization, no
+    write-then-regather round trip. The contract is the engine's chunk
+    layout: ``positions`` contiguous ascending per row and
+    ``total_lens = positions[:, 0] + suffix_lens`` for live rows.
+    Elsewhere (misaligned shapes, CPU, fresh values not provided) the
+    XLA gather reference below runs — identical math, so the dispatch
+    choice never changes results beyond accumulation order."""
+    if k_new is not None and v_new is not None and suffix_lens is not None:
+        k_data = kv_page_data(k_pages)
+        if (_page_tile_ok(k_data.shape[2], k_data.shape[3], k_data.shape[4],
+                          isinstance(k_pages, tuple))
+                and _use_pallas()):
+            from production_stack_tpu.ops.pallas_prefill_attention import (
+                pallas_prefill_attention,
+            )
+
+            try:
+                return pallas_prefill_attention(
+                    q, k_pages, v_pages, block_tables, positions,
+                    total_lens, layer, k_new, v_new, suffix_lens,
+                    scale=scale,
+                )
+            except Exception:  # noqa: BLE001 - fall back, don't fail serving
+                pass
+    return _context_prefill_reference(
+        q, k_pages, v_pages, block_tables, positions, total_lens, layer,
+        scale=scale,
+    )
+
+
+def _context_prefill_reference(
+    q: jax.Array,  # [B, T, H, D] suffix queries
+    k_pages: jax.Array,  # [L, NB, bs, KVH, D] stacked pages
+    v_pages: jax.Array,  # [L, NB, bs, KVH, D]
+    block_tables: jax.Array,  # [B, MAXB]
+    positions: jax.Array,  # [B, T] absolute positions of the queries
+    total_lens: jax.Array,  # [B] full context length (cached + suffix)
+    layer: jax.Array,  # scalar layer index
+    *,
+    scale: float,
+) -> jax.Array:
+    """XLA reference: gather the whole padded context (suffix included —
+    it was scattered to the pages by write_kv_pages one op earlier),
+    mask causally against ``positions``, softmax."""
     B, T, H, D = q.shape
     k_data = kv_page_data(k_pages)
     bs = k_data.shape[2]
@@ -288,18 +374,8 @@ def paged_decode_attention(
     k_data = kv_page_data(k_pages)
     block_size = k_data.shape[2]
     kvh, head_dim = k_data.shape[3], k_data.shape[4]
-    # The kernel's manual page DMAs slice [bs, KVH, D] out of HBM:
-    # Mosaic requires the sliced dims tile-aligned (KVH to the 8-row
-    # sublane, D to the 128 lanes; bs to 8). Misaligned models (e.g.
-    # OPT: 12 kv-heads, head_dim 64) take the XLA reference — and this
-    # MUST be decided here, at trace time: a Mosaic failure surfaces at
-    # AOT compile where no fallback is possible.
-    tile_ok = (block_size % 8 == 0 and kvh % 8 == 0
-               and head_dim % 128 == 0)
-    if isinstance(k_pages, tuple):
-        # The int8 kernel DMAs per-page scale rows [bs*KVH] out of the
-        # flat scale array: that last dim must fill whole 128-lane tiles.
-        tile_ok = tile_ok and (block_size * kvh) % 128 == 0
+    tile_ok = _page_tile_ok(block_size, kvh, head_dim,
+                            isinstance(k_pages, tuple))
     if tile_ok and _use_pallas():
         from production_stack_tpu.ops.pallas_paged_attention import (
             pallas_paged_attention,

@@ -1,0 +1,359 @@
+"""Pallas TPU kernel: flash cached-prefill attention over paged KV.
+
+The chunked-prefill hot path (``prefill_cached``) attends a bucket of
+fresh query tokens to (a) the request's cached prefix, living in paged
+HBM, and (b) the chunk's own just-computed K/V. The XLA reference path
+(``ops/attention.py::context_prefill_attention``) services both from
+HBM: ``_gather_ctx`` materializes and dequantizes the ENTIRE
+``[B, MAXB*bs, KVH, D]`` context per layer — including the suffix span
+it scattered to the pages one op earlier. At int8 that is a gather +
+f32 upcast of every byte of context per chunk per layer.
+
+This kernel restructures the read path the same way the decode kernel
+(``pallas_paged_attention.py``) did for the decode loop:
+
+- **Only live prefix pages stream from HBM**, chunk by chunk through
+  the same ring-buffered manual DMAs (``_chunk_copies`` is imported,
+  not copied) — no full-table materialization, and rows whose prefix
+  is short stop streaming at their own boundary.
+- **int8 pages dequantize on-chip**: the HBM stream stays int8 plus
+  the tiny f32 scale rows, halving prefill KV read traffic exactly as
+  PR 5 did for decode.
+- **The suffix never makes the HBM round trip**: the kernel emits the
+  prefix's online-softmax partials (acc, m, l); the chunk's own fresh
+  K/V attends in-register via plain XLA, and the two are merged with
+  the standard flash recombination. The write-then-regather of the
+  suffix span disappears.
+
+Grid ``(B, nq, nc)``: query tiles are an outer loop, prefix-page
+chunks the innermost (serial) reduction, so the DMA ring's global step
+``g = (b*nq + qi)*nc + c`` crosses both tile and sequence boundaries.
+Each (b, qi) owns ``KVH * group * TQ`` head-batched score rows — the
+decode kernel's layout with the query-tile axis folded in.
+
+Correctness is pinned by tests/test_prefill_kernel.py (interpret-mode
+parity vs the XLA reference on CPU, bf16 and int8, ragged lengths).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from production_stack_tpu.ops.pallas_paged_attention import (
+    RING,
+    _start_chunk_copy,
+    _wait_chunk_copy,
+)
+
+NEG_INF = -1e30
+
+# Head-batched score rows per (b, qi) program: KVH * group * TQ. Capped
+# so the f32 scratch set (scores [rows, span] + acc [rows, D] + m/l
+# [rows, 128] x2) plus the DMA ring stays well inside ~16 MB VMEM.
+_MAX_TILE_ROWS = 4096
+
+
+def _prefill_kernel(
+    # scalar prefetch
+    block_tables_ref,  # [B, MAXB]
+    prefix_lens_ref,  # [B] cached-prefix tokens (pages to stream)
+    layer_ref,  # [1]
+    # inputs
+    q_ref,  # [1, 1, KVH*gq, D] query tile for (b, qi); pre-scaled
+    k_hbm_ref,  # [L, NB, bs, KVH, D] in ANY/HBM (int8 when quantized)
+    v_hbm_ref,
+    # quantized only: ks_hbm_ref / vs_hbm_ref [L, NB, bs*KVH] f32 in
+    # ANY; then outputs o_acc [1, 1, KVH*gq, D] f32 (unnormalized),
+    # o_m / o_l [1, 1, KVH*gq, 128] f32; then scratch: k_buf/v_buf
+    # VMEM [RING, P, bs, KVH, D], (quantized: ks_buf/vs_buf VMEM
+    # [RING, P, bs*KVH] f32,) sems DMA [RING, 2|4, P], s_ref
+    # [KVH*gq, span] f32, acc_ref [KVH*gq, D] f32, m_ref/l_ref
+    # [KVH*gq, 128] f32.
+    *refs,
+    block_size: int,
+    kvh: int,
+    gq: int,  # group * TQ rows per kv head
+    pages_per_block: int,
+    ring: int,
+    quantized: bool,
+):
+    if quantized:
+        (ks_hbm_ref, vs_hbm_ref, o_acc_ref, o_m_ref, o_l_ref,
+         k_buf, v_buf, ks_buf, vs_buf, sems,
+         s_ref, acc_ref, m_ref, l_ref) = refs
+        scale_kwargs = dict(ks_hbm=ks_hbm_ref, vs_hbm=vs_hbm_ref,
+                            ks_buf=ks_buf, vs_buf=vs_buf)
+    else:
+        (o_acc_ref, o_m_ref, o_l_ref, k_buf, v_buf, sems,
+         s_ref, acc_ref, m_ref, l_ref) = refs
+        scale_kwargs = {}
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    c = pl.program_id(2)
+    nb = pl.num_programs(0)
+    nq = pl.num_programs(1)
+    nc = pl.num_programs(2)
+    layer = layer_ref[0]
+    prefix = prefix_lens_ref[b]
+    P = pages_per_block
+    span_tokens = P * block_size
+    chunk_start = c * span_tokens
+    # Global step: the prefetch window crosses query-tile AND sequence
+    # boundaries (each tile re-streams its row's prefix pages).
+    g = (b * nq + qi) * nc + c
+    slot = jax.lax.rem(g, ring)
+
+    @pl.when(g == 0)
+    def _fill():
+        # Cold start: fill the ring for the first live chunks
+        # (liveness-guarded with the same predicate the consumer uses,
+        # so every started copy is waited exactly once).
+        for k in range(min(ring - 1, nb * nq * nc)):
+            gb = k // (nq * nc)
+            gc = k % nc
+
+            @pl.when(gc * span_tokens < prefix_lens_ref[gb])
+            def _(gb=gb, gc=gc, k=k):
+                _start_chunk_copy(
+                    k_hbm_ref, v_hbm_ref, k_buf, v_buf, sems,
+                    block_tables_ref, layer, gb, gc, k % ring, P,
+                    **scale_kwargs)
+
+    @pl.when(c == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # Issue the chunk RING-1 global steps ahead (lands in the slot just
+    # consumed, which the serial grid has already finished reading).
+    g_pre = g + ring - 1
+    b_pre = g_pre // (nq * nc)
+    c_pre = jax.lax.rem(g_pre, nc)
+
+    @pl.when(jnp.logical_and(
+        b_pre < nb,
+        c_pre * span_tokens < prefix_lens_ref[jnp.minimum(b_pre, nb - 1)]))
+    def _prefetch():
+        _start_chunk_copy(k_hbm_ref, v_hbm_ref, k_buf, v_buf, sems,
+                          block_tables_ref, layer, b_pre, c_pre,
+                          jax.lax.rem(g_pre, ring), P, **scale_kwargs)
+
+    @pl.when(chunk_start < prefix)
+    def _compute():
+        _wait_chunk_copy(k_hbm_ref, v_hbm_ref, k_buf, v_buf, sems,
+                         block_tables_ref, layer, b, c, slot, P,
+                         **scale_kwargs)
+        if quantized:
+            # [P, bs*KVH] -> token-major [span, KVH]: row p*bs+t, col h.
+            k_sc = ks_buf[slot].reshape(span_tokens, kvh)
+            v_sc = vs_buf[slot].reshape(span_tokens, kvh)
+        for h in range(kvh):  # static unroll over kv heads
+            rows = slice(h * gq, (h + 1) * gq)
+            q = q_ref[0, 0, rows, :].astype(jnp.float32)  # [gq, D]
+            k = (k_buf[slot, :, :, h, :]
+                 .reshape(span_tokens, -1).astype(jnp.float32))
+            if quantized:
+                # Dequantize on-chip: the HBM stream stayed int8.
+                k = k * k_sc[:, h:h + 1]
+            s_ref[rows, :] = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        # Every query row in the chunk sits at an absolute position
+        # >= prefix, so the prefix side needs NO per-row causal mask —
+        # only the prefix-length bound. (The causal structure lives
+        # entirely in the fresh-suffix merge on the host side.)
+        span = chunk_start + jax.lax.broadcasted_iota(
+            jnp.int32, (1, span_tokens), 1
+        )
+        valid = span < prefix  # [1, span]
+        s = jnp.where(valid, s_ref[...], NEG_INF)  # [KVH*gq, span]
+        m_prev = m_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)  # [KVH*gq, 1]
+        p_ = jnp.exp(s - m_new)
+        l_ref[...] = jnp.broadcast_to(
+            alpha * l_ref[:, :1] + jnp.sum(p_, axis=1, keepdims=True),
+            l_ref.shape,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        acc_ref[...] = acc_ref[...] * alpha  # one batched rescale
+        for h in range(kvh):
+            rows = slice(h * gq, (h + 1) * gq)
+            v = (v_buf[slot, :, :, h, :]
+                 .reshape(span_tokens, -1).astype(jnp.float32))
+            if quantized:
+                v = v * v_sc[:, h:h + 1]
+            acc_ref[rows, :] = acc_ref[rows, :] + jax.lax.dot(
+                p_[rows, :], v, preferred_element_type=jnp.float32)
+
+    @pl.when(c == nc - 1)
+    def _finalize():
+        # Emit the UN-normalized partials: the caller merges them with
+        # the fresh-suffix partials (flash recombination), so dividing
+        # by l here would just be undone. Rows with an empty prefix
+        # leave (acc=0, m=NEG_INF, l=0), which the merge handles.
+        o_acc_ref[0, 0] = acc_ref[...]
+        o_m_ref[0, 0] = m_ref[...]
+        o_l_ref[0, 0] = l_ref[...]
+
+
+def _query_tile(T: int, H: int) -> int:
+    """Static query-tile width: a multiple of 8 (sublane alignment of
+    the per-head row slices), capped so KVH*group*TQ = H*TQ scratch
+    rows stay within the VMEM budget."""
+    cap = max(8, (_MAX_TILE_ROWS // max(H, 1)) // 8 * 8)
+    t_pad = (T + 7) // 8 * 8
+    return min(128, cap, t_pad)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "pages_per_block", "ring", "q_tile",
+                     "interpret"))
+def pallas_prefill_attention(
+    q: jax.Array,  # [B, T, H, D] the chunk's query tokens
+    k_pages,  # [L, NB, bs, KVH, D] stacked pages (or (data, scales))
+    v_pages,
+    block_tables: jax.Array,  # [B, MAXB] int32
+    positions: jax.Array,  # [B, T] absolute, contiguous ascending
+    total_lens: jax.Array,  # [B] context length incl. this chunk
+    layer,  # scalar layer index (traced)
+    k_new: jax.Array,  # [B, T, KVH, D] the chunk's own fresh K
+    v_new: jax.Array,  # [B, T, KVH, D]
+    suffix_lens: jax.Array,  # [B] valid fresh tokens (= seq_lens)
+    *,
+    scale: float,
+    pages_per_block: int = 0,  # 0 -> largest of (8,4,2,1) dividing MAXB
+    ring: int = 0,  # DMA ring depth; 0 -> RING default
+    q_tile: int = 0,  # query-tile width; 0 -> heuristic
+    interpret: bool = False,
+) -> jax.Array:
+    quantized = isinstance(k_pages, tuple)
+    if quantized:
+        k_pages, k_scales = k_pages
+        v_pages, v_scales = v_pages
+    B, T, H, D = q.shape
+    L, NB, bs, KVH, _ = k_pages.shape
+    MAXB = block_tables.shape[1]
+    group = H // KVH
+    P = pages_per_block or next(p for p in (8, 4, 2, 1) if MAXB % p == 0)
+    if MAXB % P != 0:
+        raise ValueError(
+            f"pages_per_block {P} does not divide table width {MAXB}")
+    nc = MAXB // P
+    TQ = q_tile or _query_tile(T, H)
+    T_pad = (T + TQ - 1) // TQ * TQ
+    nq = T_pad // TQ
+    gq = group * TQ
+
+    # The contract with the engine's chunk layout: positions are
+    # contiguous ascending per row, so the cached prefix the pages must
+    # serve is everything before the row's first query position.
+    prefix_lens = jnp.clip(
+        jnp.minimum(positions[:, 0], total_lens), 0, None
+    ).astype(jnp.int32)
+
+    qs = (q * scale).astype(q.dtype)
+    qg = qs.reshape(B, T, KVH, group, D)
+    # Row layout per (b, qi) tile: (h * group + g) * TQ + t — the
+    # decode kernel's head-major layout with the tile axis innermost.
+    qt = qg.transpose(0, 2, 3, 1, 4)  # [B, KVH, group, T, D]
+    if T_pad != T:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, 0), (0, T_pad - T), (0, 0)))
+    qt = qt.reshape(B, KVH, group, nq, TQ, D).transpose(0, 3, 1, 2, 4, 5)
+    qt = qt.reshape(B, nq, KVH * gq, D)
+
+    R = ring or RING
+    kernel = functools.partial(
+        _prefill_kernel, block_size=bs, kvh=KVH, gq=gq,
+        pages_per_block=P, ring=R, quantized=quantized,
+    )
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, KVH * gq, D), lambda b, qi, c, bt, pfx, lr: (b, qi, 0, 0)
+        ),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((R, P, bs, KVH, D), k_pages.dtype),
+        pltpu.VMEM((R, P, bs, KVH, D), v_pages.dtype),
+    ]
+    operands = [qt, k_pages, v_pages]
+    if quantized:
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                     pl.BlockSpec(memory_space=pl.ANY)]
+        scratch_shapes += [pltpu.VMEM((R, P, bs * KVH), jnp.float32),
+                           pltpu.VMEM((R, P, bs * KVH), jnp.float32)]
+        operands += [k_scales, v_scales]
+    scratch_shapes += [
+        pltpu.SemaphoreType.DMA((R, 4 if quantized else 2, P)),
+        pltpu.VMEM((KVH * gq, P * bs), jnp.float32),
+        pltpu.VMEM((KVH * gq, D), jnp.float32),
+        pltpu.VMEM((KVH * gq, 128), jnp.float32),
+        pltpu.VMEM((KVH * gq, 128), jnp.float32),
+    ]
+    out_block = lambda b, qi, c, bt, pfx, lr: (b, qi, 0, 0)  # noqa: E731
+    acc_p, m_p, l_p = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(B, nq, nc),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, 1, KVH * gq, D), out_block),
+                pl.BlockSpec((1, 1, KVH * gq, 128), out_block),
+                pl.BlockSpec((1, 1, KVH * gq, 128), out_block),
+            ],
+            scratch_shapes=scratch_shapes,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, nq, KVH * gq, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, nq, KVH * gq, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, nq, KVH * gq, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), prefix_lens, layer_arr, *operands)
+
+    def _untile(x):
+        # [B, nq, KVH*gq, ...] -> [B, KVH, group, T, ...]
+        x = x.reshape((B, nq, KVH, group, TQ) + x.shape[3:])
+        x = jnp.moveaxis(x, 1, 3)  # [B, KVH, group, nq, TQ, ...]
+        x = x.reshape((B, KVH, group, T_pad) + x.shape[5:])
+        return x[:, :, :, :T]
+
+    acc_p = _untile(acc_p)  # [B, KVH, group, T, D] f32
+    m_p = _untile(m_p)[..., 0]  # [B, KVH, group, T]
+    l_p = _untile(l_p)[..., 0]
+
+    # Fresh-suffix attention straight from the chunk's own K/V — the
+    # one part of the context that never needs to round-trip HBM.
+    qf = qs.reshape(B, T, KVH, group, D).astype(jnp.float32)
+    s = jnp.einsum("btkgd,bskd->bkgts", qf, k_new.astype(jnp.float32))
+    causal = positions[:, None, :] <= positions[:, :, None]  # [B, t, s]
+    fresh = (jnp.arange(T, dtype=jnp.int32)[None, :]
+             < suffix_lens[:, None])  # [B, s]
+    mask = jnp.logical_and(causal, fresh[:, None, :])
+    s = jnp.where(mask[:, None, None, :, :], s, NEG_INF)
+    m_s = jnp.max(s, axis=-1)  # [B, KVH, group, T]
+    p = jnp.exp(s - m_s[..., None])
+    l_s = jnp.sum(p, axis=-1)
+    acc_s = jnp.einsum("bkgts,bskd->bkgtd", p, v_new.astype(jnp.float32))
+
+    # Flash recombination of the two partial softmaxes.
+    m_tot = jnp.maximum(m_p, m_s)
+    a_p = jnp.exp(m_p - m_tot)
+    a_s = jnp.exp(m_s - m_tot)
+    l_tot = jnp.maximum(l_p * a_p + l_s * a_s, 1e-30)
+    out = (acc_p * a_p[..., None] + acc_s * a_s[..., None]) / l_tot[..., None]
+    out = out.swapaxes(2, 3).swapaxes(1, 2).reshape(B, T, H, D)
+    return out.astype(q.dtype)

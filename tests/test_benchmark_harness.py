@@ -259,6 +259,52 @@ def test_committed_saturation_workers_ab_artifact_schema():
         assert meta_topo[workers] == leg["worker_topology"]
 
 
+def test_committed_prefill_kernel_ab_artifact_schema():
+    """The committed flash-prefill A/B (r18) is real and carries the
+    tentpole's acceptance numbers: interpret-mode kernel parity on both
+    page encodings, a per-chunk attention+copy share strictly below the
+    XLA gather path's on every swept offset, a >= 40% prefill KV-read
+    byte drop at int8, and a fused-dispatch leg whose streams match the
+    alternating engine byte-for-byte while issuing strictly fewer
+    dispatches, with kind="fused" step records."""
+    data = json.load(open(
+        os.path.join(REPO, "BENCH_PREFILL_PROFILE_r18.json")))
+    assert data["metric"] == "prefill_profile"
+    assert data["meta"]["schema"] == 1
+    for key in ("git_sha", "timestamp_utc", "python", "platform", "jax",
+                "bench_config", "env"):
+        assert key in data["meta"], key
+
+    ab = data["kernel_ab"]
+    assert ab["path_configured"] in ("pallas", "xla")
+    # Interpret-mode parity: the flash kernel is numerically the gather
+    # reference on both page encodings.
+    assert 0 <= ab["interpret_parity"]["bf16_max_abs_err"] < 1e-4
+    assert 0 <= ab["interpret_parity"]["int8_max_abs_err"] < 1e-4
+    assert ab["per_chunk"], "A/B leg swept no chunks"
+    for row in ab["per_chunk"]:
+        # The flash path walks only the live prefix pages; the gather
+        # path re-reads the full context every chunk.
+        assert row["kv_read_tokens_flash"] < row["kv_read_tokens_xla"]
+        assert row["attn_copy_share_flash_est"] \
+            < row["attn_copy_share_xla"]
+    assert ab["kv_read_bytes_flash_int8"] < ab["kv_read_bytes_xla_int8"]
+    assert ab["kv_read_bytes_drop_pct"] >= 40.0
+
+    fd = data["fused_dispatch"]
+    assert fd["streams_equal"] is True
+    assert fd["fused"]["fused_steps_total"] >= 1
+    assert fd["alternating"]["fused_steps_total"] == 0
+    assert fd["fused"]["step_kinds"].get("fused", 0) \
+        == fd["fused"]["fused_steps_total"]
+    assert "fused" not in fd["alternating"]["step_kinds"]
+    assert fd["dispatches_saved"] >= 1
+    assert fd["fused"]["dispatch_count_total"] \
+        < fd["alternating"]["dispatch_count_total"]
+    assert fd["dispatches_per_pair"]["fused"] \
+        < fd["dispatches_per_pair"]["alternating"]
+
+
 def test_plot_table(tmp_path, monkeypatch):
     spec = importlib.util.spec_from_file_location(
         "bench_plot", os.path.join(REPO, "benchmarks", "plot.py"))

@@ -159,6 +159,12 @@ def test_metrics_exposition(server_url):
         assert "vllm:gpu_cache_usage_perc" in text
         assert "tpu:hbm_kv_usage_perc" in text
         assert "vllm:generation_tokens_total" in text
+        # Flag-off exposition parity: the fused/dispatch-path series
+        # export (at zero / with both label values) without --fused-step.
+        assert "tpu:fused_steps_total" in text
+        assert "tpu:prefill_attention_dispatch_total{" in text
+        assert 'path="pallas"}' in text
+        assert 'path="xla"}' in text
     asyncio.run(run())
 
 

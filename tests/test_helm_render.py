@@ -368,6 +368,38 @@ def test_chunked_prefill_flags_plumb_into_engine_command():
     assert "--enable-chunked-prefill" not in bcmd
 
 
+def test_fused_step_flag_plumbs_into_engine_command():
+    """fusedStep renders as --fused-step (and stays absent when unset —
+    the fused step program is opt-in), and the schema accepts it."""
+    import copy
+    import json
+
+    import jsonschema
+
+    values = copy.deepcopy(load_values(CHART, os.path.join(
+        CHART, "examples", "values-01-minimal.yaml")))
+    spec = values["servingEngineSpec"]["modelSpec"][0]
+    spec["enableChunkedPrefill"] = True
+    spec["fusedStep"] = True
+    with open(os.path.join(CHART, "values.schema.json")) as f:
+        jsonschema.validate(values, json.load(f))
+
+    rendered = MiniHelm(CHART).render(values)
+    deps = [d for d in _docs(rendered, "Deployment")
+            if d["metadata"]["name"].endswith("-engine")]
+    assert deps, "engine deployment missing"
+    cmd = deps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--fused-step" in cmd
+    assert "--enable-chunked-prefill" in cmd
+
+    base = _render(os.path.join(CHART, "examples",
+                                "values-01-minimal.yaml"))
+    bdeps = [d for d in _docs(base, "Deployment")
+             if d["metadata"]["name"].endswith("-engine")]
+    bcmd = bdeps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--fused-step" not in bcmd
+
+
 def test_speculative_num_tokens_plumbs_into_engine_command():
     """speculativeNumTokens renders as --speculative-num-tokens (and stays
     absent when unset — spec decoding is opt-in), and the schema accepts
