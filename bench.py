@@ -238,6 +238,20 @@ STRUCTURED_OUT = os.environ.get("BENCH_STRUCTURED_OUT",
 STRUCTURED_REQS = _env_int("BENCH_STRUCTURED_REQS", 8)
 STRUCTURED_MAX_TOKENS = _env_int("BENCH_STRUCTURED_MAX_TOKENS", 32)
 STRUCTURED_REPEATS = _env_int("BENCH_STRUCTURED_REPEATS", 3)
+# LoRA adapter-plane A/B: BENCH_LORA=1 runs the hermetic noisy-neighbor
+# harness (testing/lora_ab.py) — 4 adapters + base across 3 fake
+# replicas with 2 adapter slots each, adapter-affinity pinning ON then
+# OFF. Writes BENCH_LORA_OUT (default BENCH_LORA_r19.json) with hit
+# rate, loads/evictions, and adapter p99 TTFT for both legs.
+# Acceptance: affinity-on has the higher hit rate and lower p99 TTFT at
+# equal offered load, with 0 failed requests in both legs.
+LORA = _env_int("BENCH_LORA", 0)
+LORA_OUT = os.environ.get("BENCH_LORA_OUT", "BENCH_LORA_r19.json")
+LORA_ADAPTERS = _env_int("BENCH_LORA_ADAPTERS", 4)
+LORA_ROUNDS = _env_int("BENCH_LORA_ROUNDS", 3)
+LORA_PER_ADAPTER = _env_int("BENCH_LORA_PER_ADAPTER", 3)
+LORA_LOAD_DELAY = _env_float("BENCH_LORA_LOAD_DELAY", 0.15)
+LORA_TTFT = _env_float("BENCH_LORA_TTFT", 0.02)
 # Router saturation harness: BENCH_SATURATION=1 steps rungs of
 # closed-loop users (BENCH_SATURATION_STEPS, comma-separated counts)
 # against BENCH_SATURATION_REPLICAS fake replicas through the real
@@ -864,6 +878,27 @@ def _fleet_main() -> None:
     print(json.dumps(result))
 
 
+def _lora_main() -> None:
+    """BENCH_LORA=1: the adapter-affinity noisy-neighbor A/B. Fully
+    hermetic (fake engines), so this branch never imports jax or touches
+    a device. Per-request router INFO logging is squelched — the churn
+    leg logs every eviction and the lines drown the result."""
+    import logging
+
+    from production_stack_tpu.testing.lora_ab import run_lora_ab
+
+    logging.getLogger(
+        "production_stack_tpu.router.request_service"
+    ).setLevel(logging.WARNING)
+    result = asyncio.run(run_lora_ab(
+        adapters=LORA_ADAPTERS, rounds=LORA_ROUNDS,
+        per_adapter=LORA_PER_ADAPTER, load_delay_s=LORA_LOAD_DELAY,
+        engine_ttft=LORA_TTFT))
+    result["backend"] = "fake"
+    _write_artifact(LORA_OUT, result)
+    print(json.dumps(result))
+
+
 def _kv_econ_main() -> None:
     """BENCH_KV_ECON=1: the KV pull-economics crossover sweep. Fully
     hermetic (fake engines), so this branch never imports jax or touches
@@ -1058,6 +1093,9 @@ def main() -> None:
         return
     if KV_ECON:
         _kv_econ_main()
+        return
+    if LORA:
+        _lora_main()
         return
     if STRUCTURED:
         _structured_main()

@@ -923,6 +923,51 @@ def build_dashboard():
              "below the configured count means threads died"))
     y += 7
 
+    # ---- Row 12d: LoRA Adapters (--lora-plane, docs/lora.md) ------------ #
+    panels.append(row("LoRA Adapters", y)); y += 1
+    panels.append(panel(
+        "timeseries", "Adapter request rate",
+        [target("sum by(adapter) (rate(tpu:lora_requests_total[1m]))",
+                legend="{{adapter}} (engine)"),
+         target("sum by(adapter) "
+                "(rate(vllm_router:lora_requests_total[1m]))",
+                legend="{{adapter}} (router)")],
+        grid(7, 8, 0, y), unit="reqps",
+        desc="Per-adapter traffic, metered on both sides: the router "
+             "counts what it routes to each adapter, each engine counts "
+             "what it actually served (tpu:lora_requests_total). A "
+             "router/engine gap for one adapter means requests are "
+             "dying between pick and serve — check the breaker and "
+             "on-demand load panels"))
+    panels.append(panel(
+        "timeseries", "Adapter affinity hit rate",
+        [target("sum(rate(vllm_router:lora_affinity_hits_total[5m])) / "
+                "(sum(rate(vllm_router:lora_affinity_hits_total[5m])) + "
+                "sum(rate(vllm_router:lora_affinity_misses_total[5m])))",
+                legend="hit rate"),
+         target("sum(rate(vllm_router:lora_affinity_misses_total[5m]))",
+                legend="misses/s")],
+        grid(7, 8, 8, y), unit="percentunit",
+        desc="Share of adapter-named requests that landed on a replica "
+             "already holding the adapter (soft pinning). Every miss "
+             "pays an on-demand load on the request path; a sustained "
+             "miss rate means more adapters than fleet slots "
+             "(max_loras) or affinity disabled — the noisy-neighbor "
+             "regime BENCH_LORA quantifies"))
+    panels.append(panel(
+        "timeseries", "Adapter loads & evictions",
+        [target("sum(rate(vllm_router:lora_loads_total[5m]))",
+                legend="loads/s"),
+         target("sum(rate(vllm_router:lora_evictions_total[5m]))",
+                legend="evictions/s")],
+        grid(7, 8, 16, y),
+        desc="Registry-driven residency churn: on-demand + operator "
+             "loads, and LRU evictions made to free slots for them. "
+             "Loads tracking evictions 1:1 is slot thrashing — the "
+             "fleet is oversubscribed and every load steals a slot "
+             "another adapter is about to miss on"))
+    y += 7
+
     # ---- Row 13: Current Resource Usage (ref panels 14-19) -------------- #
     panels.append(row("Current Resource Usage", y)); y += 1
     panels.append(panel(

@@ -206,6 +206,10 @@ class EngineServer:
         # prefill restores it instead of recomputing.
         self.l3_pull_hits = 0
         self.l3_pull_blocks = 0
+        # Per-adapter request metering (tpu:lora_requests_total{adapter}).
+        # Only adapter-addressed requests land here, so the base-model
+        # /metrics exposition is unchanged until an adapter serves.
+        self.lora_request_counts: "dict[str, int]" = {}
         self._device_pipe = None
         self._device_pipe_failed = False
         # Per-request stage tracing (queue/prefill/decode spans recorded
@@ -424,7 +428,10 @@ class EngineServer:
             chunk_hashes,
         )
 
-        chunks = chunk_hashes(text)
+        # Adapter requests salt the controller-side chunk hashes (the
+        # page chains are already adapter-scoped via chain_root), so the
+        # eviction paths reported from here match the salted admissions.
+        chunks = chunk_hashes(text, salt=adapter or None)
         n = len(ids)
         if not chunks or n == 0:
             return
@@ -553,10 +560,13 @@ class EngineServer:
                 return
             try:
                 async with aiohttp.ClientSession(headers=self._auth_headers()) as s:
+                    body = {"instance_id": self.instance_id,
+                            "text": prompt_text}
+                    if adapter:
+                        body["salt"] = adapter
                     await s.post(
                         f"{self.kv_controller_url}/kv/admit",
-                        json={"instance_id": self.instance_id,
-                              "text": prompt_text},
+                        json=body,
                         timeout=aiohttp.ClientTimeout(total=5),
                     )
             except (aiohttp.ClientError, asyncio.TimeoutError) as e:
@@ -875,6 +885,9 @@ class EngineServer:
         t_recv = time.time()
         clock = StageClock(arrival=t_recv)
         clock.prompt_tokens = len(prompt_ids)
+        if adapter:
+            self.lora_request_counts[adapter] = (
+                self.lora_request_counts.get(adapter, 0) + 1)
         try:
             return await self._respond_inner(
                 request, body, prompt_ids, sampling, rid, model, adapter,
@@ -1847,11 +1860,19 @@ class EngineServer:
         return web.json_response({"status": "ok", "lora_name": name})
 
     async def handle_list_lora(self, request: web.Request) -> web.Response:
+        # Residency surface for the router's AdapterRegistry scrape:
+        # adapters plus slot capacity (slot 0 is the base model, so
+        # max_loras-1 slots are loadable) and the base model name.
+        max_loras = int(getattr(self.config, "max_loras", 1))
+        adapters = [
+            {"lora_name": name, "slot": slot}
+            for name, slot in self.core.lora_slots.items()
+        ]
         return web.json_response({
-            "adapters": [
-                {"lora_name": name, "slot": slot}
-                for name, slot in self.core.lora_slots.items()
-            ]
+            "adapters": adapters,
+            "max_loras": max_loras,
+            "capacity": max(max_loras - 1, 0),
+            "base_model": self.config.model,
         })
 
     # ------------------------------------------------------------------ #
@@ -2519,6 +2540,16 @@ class EngineServer:
             f"tpu:structured_violations_total{{{labels}}} "
             f"{s.get('structured_violations_total', 0)}",
         ]
+        # Per-adapter request metering: series appear only once an
+        # adapter-addressed request has been served, so the base-model
+        # exposition stays byte-identical with no adapters configured.
+        if self.lora_request_counts:
+            lines.append("# TYPE tpu:lora_requests counter")
+            lines += [
+                f'tpu:lora_requests_total{{{labels},adapter="{name}"}} '
+                f"{count}"
+                for name, count in sorted(self.lora_request_counts.items())
+            ]
         # Step flight recorder: per-kind step duration sum/count pairs,
         # scheduled tokens, the roofline HBM byte estimate, and the
         # bandwidth-utilization gauge (achieved bytes/s over the recent

@@ -42,7 +42,20 @@ CHUNK_SIZE = 128  # characters per hash chunk; matches router.hashtrie default
 L3_INSTANCE = "__l3__"
 
 
-def chunk_hashes(text: str, chunk_size: int = CHUNK_SIZE) -> List[int]:
+def chunk_hashes(text: str, chunk_size: int = CHUNK_SIZE,
+                 salt: Optional[str] = None) -> List[int]:
+    """Chunk-hash a prompt. ``salt`` partitions the hash space (used for
+    LoRA adapters, whose k/v projections differ from the base model's):
+    a salted chunk never collides with the unsalted one, so prefix reuse
+    and cross-replica pulls cannot cross adapter boundaries. Chunk
+    boundaries are unchanged; ``salt=None``/"" yields today's exact
+    hashes, keeping the base-model path byte-identical."""
+    if salt:
+        prefix = f"{salt}\x00"
+        return [
+            xxhash.xxh64_intdigest(prefix + text[i : i + chunk_size])
+            for i in range(0, len(text), chunk_size)
+        ]
     return [
         xxhash.xxh64_intdigest(text[i : i + chunk_size])
         for i in range(0, len(text), chunk_size)
@@ -484,8 +497,10 @@ class KVController:
                 nxt.instances[instance_id] = now
                 node = nxt
 
-    async def admit_text(self, instance_id: str, text: str) -> None:
-        await self.admit(instance_id, chunk_hashes(text, self.chunk_size))
+    async def admit_text(self, instance_id: str, text: str,
+                         salt: Optional[str] = None) -> None:
+        await self.admit(
+            instance_id, chunk_hashes(text, self.chunk_size, salt=salt))
 
     async def evict(self, instance_id: str, hashes: List[int],
                     spilled: bool = False) -> None:
@@ -514,13 +529,15 @@ class KVController:
                 self._instances[L3_INSTANCE]["last_seen"] = now
 
     # -- lookup (reference LookupMsg) --------------------------------------
-    async def lookup(self, text: str) -> Optional[Tuple[int, str]]:
+    async def lookup(self, text: str,
+                     salt: Optional[str] = None) -> Optional[Tuple[int, str]]:
         """Longest stored prefix of ``text`` → (matched_chars, instance_id).
 
         Live engine holders win over the L3 pseudo-instance at equal match
         depth; a strictly deeper L3 match wins so the fleet pull path can
-        restore the longer prefix from the shared cache."""
-        hashes = chunk_hashes(text, self.chunk_size)
+        restore the longer prefix from the shared cache. ``salt`` scopes
+        the match to one adapter's claims (see ``chunk_hashes``)."""
+        hashes = chunk_hashes(text, self.chunk_size, salt=salt)
         now = time.time()
         async with self._lock:
             node = self._root

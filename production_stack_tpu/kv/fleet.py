@@ -174,18 +174,20 @@ class FleetCache:
         return headers
 
     async def maybe_pull(self, server_url: str, prompt: str,
-                         request_json: dict, request_id: str) -> Optional[dict]:
+                         request_json: dict, request_id: str,
+                         salt: Optional[str] = None) -> Optional[dict]:
         """If a different replica (or the L3) holds a long-enough prefix
         of ``prompt``, ask ``server_url`` to pull it before prefill.
 
         Returns a summary dict (for tracing/tests) or None when no pull
         applied. Never raises: every failure mode means "recompute",
-        which the engine does anyway.
+        which the engine does anyway. ``salt`` scopes the lookup to one
+        LoRA adapter's claims — a pull never crosses adapter boundaries.
         """
         if not prompt or len(prompt) < self.config.min_match_chars:
             return None
         try:
-            match = await self.kv_controller.lookup(prompt)
+            match = await self.kv_controller.lookup(prompt, salt=salt)
         except Exception as e:  # noqa: BLE001 - lookup is best-effort
             logger.warning("fleet lookup failed: %s", e)
             return None
@@ -210,7 +212,7 @@ class FleetCache:
         from production_stack_tpu.router import metrics as router_metrics
 
         holder_key = holder_url.rstrip("/")
-        flight_key = (server_url.rstrip("/"), holder_key,
+        flight_key = (server_url.rstrip("/"), holder_key, salt or "",
                       hash(prompt[:matched_chars]))
         task = self._single_flight.get(flight_key)
         coalesced = task is not None

@@ -1,0 +1,13 @@
+"""The LoRA adapter control plane (router side).
+
+One base model, many adapters: the engine already hot-swaps adapter
+weights in jit-stable slots (engine/core.py); this package makes
+adapters a routed, cached, metered serving dimension above it — the
+S-LoRA / Punica serving pattern applied to the router tier.
+"""
+
+from production_stack_tpu.lora.registry import (  # noqa: F401
+    AdapterRegistry,
+    LoraPlaneConfig,
+    initialize_lora_plane,
+)

@@ -1,0 +1,474 @@
+"""Router-side adapter registry: residency tracking + placement.
+
+The engine tier holds adapter weights in a fixed number of jit-stable
+LoRA slots (``engine/core.py``: slot 0 is the base model, slots
+``1..max_loras-1`` hot-swap). This registry is the router's view of
+that state, scraped from each replica's ``/v1/lora_adapters``:
+
+- **Residency**: which adapter is resident on which replica, with an
+  LRU clock per (replica, adapter) so evictions pick the coldest slot.
+- **Distribution**: ``POST /lora/load`` fans an adapter out to N
+  replicas (fewest-resident-first), LRU-evicting on replicas whose
+  slots are full; ``POST /lora/unload`` retracts it.
+- **Affinity support**: ``ensure_resident`` is the request path's
+  single-flight on-demand load — an adapter-addressed request that
+  lands on a replica without the adapter triggers exactly one load per
+  (replica, adapter) no matter how many requests pile up behind it,
+  with the breaker/timeout semantics of ``router/fault_tolerance.py``
+  (a breaker-open replica is never loaded against).
+- **Discovery refresh**: every scrape pushes the fresh adapter list
+  back into service discovery (``set_lora_adapters``), fixing the
+  set-once staleness of ``EndpointInfo.lora_adapters`` so an unloaded
+  adapter stops attracting requests within one scrape interval.
+
+Created only when ``--lora-plane`` is set; with the flag off the
+request path never reaches this module (flag-off parity convention).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class LoraPlaneConfig:
+    scrape_interval_s: float = 10.0
+    # On-demand load deadline on the request path: past this the
+    # affinity miss degrades (reroute to a resident replica or 503).
+    load_timeout_s: float = 60.0
+    # /lora/load fan-out width when the operator does not pass one.
+    default_replicas: int = 1
+    # Adapter-affinity pinning: when True (default) adapter-addressed
+    # requests restrict routing to replicas where the adapter is already
+    # resident. Off, every replica is a candidate and misses load
+    # on-demand — the A/B baseline leg, not a production setting.
+    affinity: bool = True
+    api_key: Optional[str] = None
+
+
+class _Residency:
+    """One replica's scraped adapter state."""
+
+    __slots__ = ("adapters", "max_loras", "capacity", "base_model",
+                 "scraped_at")
+
+    def __init__(self):
+        # adapter name -> last-used monotonic stamp (the LRU clock;
+        # scrape inserts at 0 so never-routed adapters evict first).
+        self.adapters: Dict[str, float] = {}
+        self.max_loras: int = 0
+        self.capacity: int = 0
+        self.base_model: str = ""
+        self.scraped_at: float = 0.0
+
+
+class AdapterRegistry:
+    """The router's adapter control plane (see module docstring)."""
+
+    def __init__(self, config: LoraPlaneConfig,
+                 service_discovery: Any = None,
+                 fault_tolerance: Any = None):
+        self.config = config
+        self.service_discovery = service_discovery
+        self.fault_tolerance = fault_tolerance
+        self._residency: Dict[str, _Residency] = {}
+        # Single-flight on-demand loads: (url, adapter) -> Task.
+        self._load_flights: Dict[tuple, "asyncio.Task"] = {}
+        # One lock per replica serializes evict+load sequences: two
+        # adapters loading onto the same full replica concurrently would
+        # otherwise race on the LRU victim (double-unload, then one load
+        # still finds the slot table full and fails spuriously).
+        self._replica_locks: Dict[str, "asyncio.Lock"] = {}
+        # Every adapter the plane has seen (scraped or loaded). LRU
+        # eviction is capacity management and must NOT shrink the served
+        # model set — an adapter evicted from its last replica stays
+        # known and reloads on demand at its next request. Only an
+        # explicit operator unload (POST /lora/unload) forgets it.
+        self._known: "set[str]" = set()
+        # Operation counters (mirrored by /debug/lora; the Prometheus
+        # side lives in router/metrics.py).
+        self.loads_total = 0
+        self.load_failures_total = 0
+        self.evictions_total = 0
+        self.affinity_hits_total = 0
+        self.affinity_misses_total = 0
+        self.scrapes_total = 0
+
+    # -- HTTP plumbing ---------------------------------------------------
+
+    def _headers(self) -> Dict[str, str]:
+        if self.config.api_key:
+            return {"Authorization": f"Bearer {self.config.api_key}"}
+        return {}
+
+    def _blocked_urls(self) -> "set[str]":
+        ft = self.fault_tolerance
+        if ft is not None:
+            try:
+                return ft.breaker.blocked_urls()
+            except Exception:  # noqa: BLE001 - breaker view is advisory
+                return set()
+        return set()
+
+    # -- residency queries ------------------------------------------------
+
+    def is_resident(self, url: str, adapter: str) -> bool:
+        res = self._residency.get(url.rstrip("/"))
+        return res is not None and adapter in res.adapters
+
+    def resident_urls(self, adapter: str) -> List[str]:
+        return [url for url, res in self._residency.items()
+                if adapter in res.adapters]
+
+    def base_model_of(self, adapter: str) -> Optional[str]:
+        """Base model of the replicas holding ``adapter`` (None until a
+        scrape has filled in replica base models)."""
+        for res in self._residency.values():
+            if adapter in res.adapters and res.base_model:
+                return res.base_model
+        return None
+
+    def known_adapters(self) -> "set[str]":
+        names: "set[str]" = set(self._known)
+        for res in self._residency.values():
+            names.update(res.adapters)
+        return names
+
+    def touch(self, url: str, adapter: str) -> None:
+        """Bump the LRU clock: this adapter just served on this replica."""
+        res = self._residency.get(url.rstrip("/"))
+        if res is not None and adapter in res.adapters:
+            res.adapters[adapter] = time.monotonic()
+
+    def record_affinity(self, adapter: str, hit: bool) -> None:
+        from production_stack_tpu.router import metrics as router_metrics
+
+        if hit:
+            self.affinity_hits_total += 1
+            router_metrics.lora_affinity_hits.labels(adapter=adapter).inc()
+        else:
+            self.affinity_misses_total += 1
+            router_metrics.lora_affinity_misses.labels(adapter=adapter).inc()
+
+    def snapshot(self) -> dict:
+        """The /debug/lora body."""
+        replicas = {}
+        for url, res in sorted(self._residency.items()):
+            replicas[url] = {
+                "adapters": sorted(res.adapters),
+                "max_loras": res.max_loras,
+                "capacity": res.capacity,
+                "free_slots": max(res.capacity - len(res.adapters), 0),
+                "base_model": res.base_model,
+                "scraped_age_s": (
+                    round(time.monotonic() - res.scraped_at, 3)
+                    if res.scraped_at else None),
+            }
+        return {
+            "replicas": replicas,
+            "adapters": {
+                name: sorted(self.resident_urls(name))
+                for name in sorted(self.known_adapters())
+            },
+            "counters": {
+                "loads": self.loads_total,
+                "load_failures": self.load_failures_total,
+                "evictions": self.evictions_total,
+                "affinity_hits": self.affinity_hits_total,
+                "affinity_misses": self.affinity_misses_total,
+                "scrapes": self.scrapes_total,
+            },
+            "config": {
+                "scrape_interval_s": self.config.scrape_interval_s,
+                "load_timeout_s": self.config.load_timeout_s,
+                "default_replicas": self.config.default_replicas,
+                "affinity": self.config.affinity,
+            },
+        }
+
+    # -- scraping ----------------------------------------------------------
+
+    async def scrape_once(self, urls: List[str]) -> None:
+        """Refresh residency from each replica's /v1/lora_adapters.
+
+        Unreachable replicas keep their last-known residency (routing
+        still filters them through health/breaker state); replicas that
+        left the endpoint list are dropped entirely.
+        """
+        import aiohttp
+
+        keep = {u.rstrip("/") for u in urls}
+        for gone in [u for u in self._residency if u not in keep]:
+            del self._residency[gone]
+        async with aiohttp.ClientSession(headers=self._headers()) as session:
+            results = await asyncio.gather(
+                *(self._scrape_one(session, u) for u in sorted(keep)),
+                return_exceptions=True)
+        for r in results:
+            if isinstance(r, Exception):  # pragma: no cover - gather guard
+                logger.debug("lora scrape error: %s", r)
+        self.scrapes_total += 1
+
+    async def _scrape_one(self, session, url: str) -> None:
+        import aiohttp
+
+        try:
+            async with session.get(
+                f"{url}/v1/lora_adapters",
+                timeout=aiohttp.ClientTimeout(total=5),
+            ) as resp:
+                if resp.status != 200:
+                    return
+                body = await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+            return
+        res = self._residency.get(url)
+        if res is None:
+            res = self._residency[url] = _Residency()
+        scraped = {str(a.get("lora_name")) for a in body.get("adapters", [])
+                   if a.get("lora_name")}
+        # Keep LRU stamps for adapters that stayed; new ones start cold.
+        res.adapters = {name: res.adapters.get(name, 0.0)
+                        for name in scraped}
+        self._known.update(scraped)
+        res.max_loras = int(body.get("max_loras", 0) or 0)
+        res.capacity = int(
+            body.get("capacity", max(res.max_loras - 1, 0)) or 0)
+        res.base_model = str(body.get("base_model", "") or "")
+        res.scraped_at = time.monotonic()
+        self._refresh_discovery(url, sorted(scraped))
+
+    def _refresh_discovery(self, url: str, adapters: List[str]) -> None:
+        """Push fresh residency into service discovery so
+        ``EndpointInfo.lora_adapters`` (and therefore ``serves()`` and
+        adapter salting) tracks loads/unloads instead of staying at its
+        registration-time value."""
+        sd = self.service_discovery
+        fn = getattr(sd, "set_lora_adapters", None)
+        if fn is not None:
+            try:
+                fn(url, adapters)
+            except Exception:  # noqa: BLE001 - discovery mirror is advisory
+                logger.debug("lora discovery refresh failed", exc_info=True)
+
+    async def scrape_loop(self) -> None:
+        """Background task: periodic residency scrape of every
+        discovered endpoint (started from the router's on_startup)."""
+        while True:
+            await asyncio.sleep(self.config.scrape_interval_s)
+            try:
+                sd = self.service_discovery
+                urls = [ep.url for ep in sd.get_endpoint_info()] if sd else []
+                await self.scrape_once(urls)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 - scrape is best-effort
+                logger.debug("lora scrape round failed: %s", e)
+
+    # -- load / unload -----------------------------------------------------
+
+    async def ensure_resident(self, url: str, adapter: str) -> bool:
+        """Request-path on-demand load: make ``adapter`` resident on
+        ``url``, single-flight per (replica, adapter). Returns True when
+        the adapter is (now) resident. Never raises."""
+        url = url.rstrip("/")
+        if self.is_resident(url, adapter):
+            return True
+        if url in self._blocked_urls():
+            # Breaker-open replica: don't spend the load timeout against
+            # a replica that is already failing.
+            return False
+        key = (url, adapter)
+        task = self._load_flights.get(key)
+        if task is None:
+            task = asyncio.ensure_future(self._load_one(url, adapter))
+            self._load_flights[key] = task
+            task.add_done_callback(
+                lambda _t: self._load_flights.pop(key, None))
+        try:
+            # Awaiting the shared Task is cancellation-safe: a cancelled
+            # follower abandons its await without killing the load.
+            return bool(await task)
+        except Exception as e:  # noqa: BLE001 - load is best-effort
+            logger.warning("lora on-demand load %s on %s failed: %s",
+                           adapter, url, e)
+            return False
+
+    async def _load_one(self, url: str, adapter: str) -> bool:
+        """One load RPC against one replica, LRU-evicting on a full
+        reply. Updates residency + metrics on success."""
+        from production_stack_tpu.router import metrics as router_metrics
+
+        import aiohttp
+
+        timeout = aiohttp.ClientTimeout(total=self.config.load_timeout_s)
+        lock = self._replica_locks.setdefault(url, asyncio.Lock())
+        try:
+            async with lock, aiohttp.ClientSession(
+                    headers=self._headers()) as session:
+                status = await self._post_load(session, url, adapter, timeout)
+                if status == 400 and await self._evict_lru(
+                        session, url, timeout):
+                    status = await self._post_load(
+                        session, url, adapter, timeout)
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            logger.warning("lora load %s on %s unreachable: %s",
+                           adapter, url, e)
+            self.load_failures_total += 1
+            return False
+        if status != 200:
+            self.load_failures_total += 1
+            return False
+        self.loads_total += 1
+        router_metrics.lora_loads.labels(adapter=adapter).inc()
+        self._known.add(adapter)
+        res = self._residency.get(url)
+        if res is None:
+            res = self._residency[url] = _Residency()
+        res.adapters[adapter] = time.monotonic()
+        self._refresh_discovery(url, sorted(res.adapters))
+        return True
+
+    async def _post_load(self, session, url: str, adapter: str,
+                         timeout) -> int:
+        async with session.post(
+            f"{url}/v1/load_lora_adapter",
+            json={"lora_name": adapter},
+            timeout=timeout,
+        ) as resp:
+            return resp.status
+
+    async def _evict_lru(self, session, url: str, timeout) -> bool:
+        """Unload the least-recently-used adapter on ``url`` to free a
+        slot (the engine replied 400 "no free slots"). Returns True when
+        an eviction was carried out."""
+        from production_stack_tpu.router import metrics as router_metrics
+
+        res = self._residency.get(url)
+        if res is None or not res.adapters:
+            return False
+        victim = min(res.adapters, key=res.adapters.get)
+        try:
+            async with session.post(
+                f"{url}/v1/unload_lora_adapter",
+                json={"lora_name": victim},
+                timeout=timeout,
+            ) as resp:
+                if resp.status == 404:
+                    # Stale residency: the engine no longer holds the
+                    # victim — dropping our entry IS the reconciliation
+                    # (a slot is free that we thought was taken).
+                    res.adapters.pop(victim, None)
+                    self._refresh_discovery(url, sorted(res.adapters))
+                    return True
+                if resp.status != 200:
+                    return False
+        except Exception:  # noqa: BLE001 - eviction RPC is best-effort
+            return False
+        res.adapters.pop(victim, None)
+        self.evictions_total += 1
+        router_metrics.lora_evictions.labels(adapter=victim).inc()
+        self._refresh_discovery(url, sorted(res.adapters))
+        logger.info("lora: LRU-evicted %s from %s", victim, url)
+        return True
+
+    async def load_adapter(self, adapter: str, urls: List[str],
+                           replicas: Optional[int] = None) -> dict:
+        """Fan-out distribution (POST /lora/load): make ``adapter``
+        resident on ``replicas`` of the given replicas, preferring ones
+        where it already is, then those with the most free slots."""
+        want = max(1, int(replicas or self.config.default_replicas))
+        blocked = self._blocked_urls()
+        candidates = [u.rstrip("/") for u in urls
+                      if u.rstrip("/") not in blocked]
+
+        def free_slots(u: str) -> int:
+            res = self._residency.get(u)
+            if res is None:
+                return 0
+            return res.capacity - len(res.adapters)
+
+        candidates.sort(key=lambda u: (not self.is_resident(u, adapter),
+                                       -free_slots(u), u))
+        loaded: List[str] = []
+        failed: List[str] = []
+        for u in candidates[:want]:
+            if await self.ensure_resident(u, adapter):
+                loaded.append(u)
+            else:
+                failed.append(u)
+        return {"adapter": adapter, "requested_replicas": want,
+                "loaded": loaded, "failed": failed,
+                "skipped_breaker_open": sorted(
+                    blocked & {u.rstrip("/") for u in urls})}
+
+    async def unload_adapter(self, adapter: str, urls: List[str]) -> dict:
+        """Fan-out retraction (POST /lora/unload) from every replica
+        where the adapter is resident."""
+        from production_stack_tpu.router import metrics as router_metrics
+
+        import aiohttp
+
+        timeout = aiohttp.ClientTimeout(total=self.config.load_timeout_s)
+        unloaded: List[str] = []
+        failed: List[str] = []
+        targets = [u.rstrip("/") for u in urls
+                   if self.is_resident(u, adapter)]
+        async with aiohttp.ClientSession(headers=self._headers()) as session:
+            for u in targets:
+                try:
+                    async with session.post(
+                        f"{u}/v1/unload_lora_adapter",
+                        json={"lora_name": adapter},
+                        timeout=timeout,
+                    ) as resp:
+                        ok = resp.status == 200
+                except (aiohttp.ClientError, asyncio.TimeoutError):
+                    ok = False
+                if ok:
+                    unloaded.append(u)
+                    res = self._residency.get(u)
+                    if res is not None:
+                        res.adapters.pop(adapter, None)
+                        self._refresh_discovery(u, sorted(res.adapters))
+                    router_metrics.lora_evictions.labels(
+                        adapter=adapter).inc()
+                    self.evictions_total += 1
+                else:
+                    failed.append(u)
+        if not failed:
+            # Operator retraction: the adapter is gone from the served
+            # model set (requests now 404, no on-demand reload).
+            self._known.discard(adapter)
+        return {"adapter": adapter, "unloaded": unloaded, "failed": failed}
+
+
+def initialize_lora_plane(args, service_discovery: Any = None,
+                          fault_tolerance: Any = None,
+                          ) -> Optional[AdapterRegistry]:
+    """Build the AdapterRegistry from parsed router args — None unless
+    ``--lora-plane`` is set, preserving the flag-off request path byte
+    for byte."""
+    if not getattr(args, "lora_plane", False):
+        return None
+    from production_stack_tpu.utils import auth
+
+    keys = auth.resolve_api_keys(getattr(args, "api_key", None))
+    return AdapterRegistry(
+        LoraPlaneConfig(
+            scrape_interval_s=getattr(args, "lora_scrape_interval", 10.0),
+            load_timeout_s=getattr(args, "lora_load_timeout", 60.0),
+            default_replicas=getattr(args, "lora_default_replicas", 1),
+            affinity=not getattr(args, "lora_no_affinity", False),
+            api_key=keys[0] if keys else None,
+        ),
+        service_discovery=service_discovery,
+        fault_tolerance=fault_tolerance,
+    )

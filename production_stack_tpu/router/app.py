@@ -73,6 +73,7 @@ class RouterState:
     # when --fault-tolerance is set, else None (single-attempt path).
     fault_tolerance: Any = None
     slo: Any = None  # SLOEngine when --slo-config is set, else None
+    lora: Any = None  # AdapterRegistry when --lora-plane is set, else None
     canary: Any = None  # CanaryProber when --canary-interval > 0
     events: Any = None  # EventJournal (always on; bounded ring is cheap)
     loop_monitor: Any = None  # LoopMonitor when --loop-monitor is set
@@ -406,7 +407,10 @@ async def kv_admit(request: web.Request) -> web.Response:
     if "hashes" in body:
         await state.kv_controller.admit(body["instance_id"], body["hashes"])
     else:
-        await state.kv_controller.admit_text(body["instance_id"], body["text"])
+        # "salt": LoRA adapter name for adapter-scoped admissions —
+        # absent/None for base-model reports (byte-identical keys).
+        await state.kv_controller.admit_text(
+            body["instance_id"], body["text"], salt=body.get("salt"))
     return web.json_response({"status": "ok"})
 
 
@@ -449,7 +453,8 @@ async def kv_deregister(request: web.Request) -> web.Response:
 async def kv_lookup(request: web.Request) -> web.Response:
     state = request.app["state"]
     body = await request.json()
-    match = await state.kv_controller.lookup(body.get("text", ""))
+    match = await state.kv_controller.lookup(body.get("text", ""),
+                                             salt=body.get("salt"))
     if match is None:
         return web.json_response({"matched": 0, "instance_id": None})
     return web.json_response({"matched": match[0], "instance_id": match[1]})
@@ -528,6 +533,67 @@ async def autoscale_scale_in(request: web.Request) -> web.Response:
     if state.events is not None:
         state.events.record("scale_in", endpoint=url,
                             drained=result.get("drained"))
+    return web.json_response(result)
+
+
+# -- LoRA adapter plane (production_stack_tpu/lora/registry.py) -------------
+
+
+async def lora_debug(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    if state.lora is None:
+        return web.json_response(
+            {"error": "LoRA adapter plane not enabled "
+                      "(--lora-plane)"}, status=404)
+    return web.json_response(state.lora.snapshot())
+
+
+async def lora_load(request: web.Request) -> web.Response:
+    """Fan-out distribution: make an adapter resident on N replicas."""
+    state = request.app["state"]
+    if state.lora is None:
+        return web.json_response(
+            {"error": "LoRA adapter plane not enabled "
+                      "(--lora-plane)"}, status=404)
+    try:
+        body = await request.json()
+    except Exception:  # noqa: BLE001 - malformed body is a client error
+        body = {}
+    adapter = body.get("lora_name") or body.get("adapter")
+    if not adapter:
+        return web.json_response({"error": "lora_name required"}, status=400)
+    urls = body.get("urls") or [
+        ep.url for ep in state.service_discovery.get_endpoint_info()]
+    result = await state.lora.load_adapter(
+        adapter, urls, replicas=body.get("replicas"))
+    if state.events is not None:
+        state.events.record("lora_load", adapter=adapter,
+                            loaded=len(result.get("loaded", [])),
+                            failed=len(result.get("failed", [])))
+    status = 200 if result.get("loaded") else 502
+    return web.json_response(result, status=status)
+
+
+async def lora_unload(request: web.Request) -> web.Response:
+    """Fan-out retraction: unload an adapter wherever it is resident."""
+    state = request.app["state"]
+    if state.lora is None:
+        return web.json_response(
+            {"error": "LoRA adapter plane not enabled "
+                      "(--lora-plane)"}, status=404)
+    try:
+        body = await request.json()
+    except Exception:  # noqa: BLE001 - malformed body is a client error
+        body = {}
+    adapter = body.get("lora_name") or body.get("adapter")
+    if not adapter:
+        return web.json_response({"error": "lora_name required"}, status=400)
+    urls = body.get("urls") or [
+        ep.url for ep in state.service_discovery.get_endpoint_info()]
+    result = await state.lora.unload_adapter(adapter, urls)
+    if state.events is not None:
+        state.events.record("lora_unload", adapter=adapter,
+                            unloaded=len(result.get("unloaded", [])))
     return web.json_response(result)
 
 
@@ -648,6 +714,10 @@ def build_app(args) -> web.Application:
     # Autoscale recommender (404 unless --autoscale)
     app.router.add_get("/autoscale/recommendation", autoscale_recommendation)
     app.router.add_post("/autoscale/scale_in", autoscale_scale_in)
+    # LoRA adapter plane (404 unless --lora-plane); all privileged.
+    app.router.add_get("/debug/lora", lora_debug)
+    app.router.add_post("/lora/load", lora_load)
+    app.router.add_post("/lora/unload", lora_unload)
     if state.worker_count > 1:
         # Multi-worker: the list-view debug routes fan in over every
         # worker's /debug/snapshot and serve merged, worker=<id>-stamped
@@ -761,11 +831,22 @@ def build_app(args) -> web.Application:
             logger.info(
                 "Fleet auto-min-match enabled: interval=%.1fs damping=%.2f",
                 apply_interval, st.fleet.config.auto_min_match_damping)
+        # Adapter residency scraper: with --lora-plane, refresh each
+        # replica's resident-adapter view (and the service-discovery
+        # mirror) on the configured interval. Flag off = no task.
+        if st.lora is not None:
+            app["_lora_scraper"] = asyncio.get_running_loop().create_task(
+                st.lora.scrape_loop())
+            logger.info(
+                "LoRA adapter plane enabled: scrape_interval=%.1fs "
+                "load_timeout=%.1fs", st.lora.config.scrape_interval_s,
+                st.lora.config.load_timeout_s)
 
     async def on_cleanup(app: web.Application):
         from production_stack_tpu.router.httpclient import AiohttpClientWrapper
 
-        for task_key in ("_lease_sweeper", "_canary", "_auto_min_match"):
+        for task_key in ("_lease_sweeper", "_canary", "_auto_min_match",
+                         "_lora_scraper"):
             task = app.get(task_key)
             if task is not None:
                 task.cancel()
@@ -1100,6 +1181,15 @@ def initialize_all(args) -> RouterState:
             state.autoscaler.config.min_replicas,
             state.autoscaler.config.max_replicas,
             state.autoscaler.config.queue_depth_target)
+
+    # LoRA adapter plane (production_stack_tpu/lora/registry.py): None
+    # unless --lora-plane — adapter-free deployments keep the request
+    # path byte-identical.
+    from production_stack_tpu.lora.registry import initialize_lora_plane
+
+    state.lora = initialize_lora_plane(
+        args, service_discovery=state.service_discovery,
+        fault_tolerance=state.fault_tolerance)
 
     # Dynamic config watcher.
     if getattr(args, "dynamic_config_json", None):

@@ -39,13 +39,23 @@ class HashTrie:
         self.node_count = 0
         self._lock = asyncio.Lock()
 
-    def _chunk_hashes(self, text: str):
+    def _chunk_hashes(self, text: str, salt: Optional[str] = None):
+        # ``salt`` partitions the hash space (LoRA adapter isolation —
+        # salted chunks never collide with base-model ones). Chunk
+        # boundaries are unchanged; None/"" is byte-identical to today.
+        if salt:
+            prefix = f"{salt}\x00"
+            for i in range(0, len(text), self.chunk_size):
+                yield xxhash.xxh64_intdigest(
+                    prefix + text[i : i + self.chunk_size])
+            return
         for i in range(0, len(text), self.chunk_size):
             yield xxhash.xxh64_intdigest(text[i : i + self.chunk_size])
 
-    async def insert(self, text: str, endpoint: str) -> None:
+    async def insert(self, text: str, endpoint: str,
+                     salt: Optional[str] = None) -> None:
         async with self._lock:
-            hashes = list(self._chunk_hashes(text))
+            hashes = list(self._chunk_hashes(text, salt=salt))
             if not hashes:
                 return
             now = time.monotonic()
@@ -89,7 +99,8 @@ class HashTrie:
                 restarted = True
 
     async def longest_prefix_match(
-        self, text: str, available_endpoints: Set[str]
+        self, text: str, available_endpoints: Set[str],
+        salt: Optional[str] = None,
     ) -> Tuple[int, Set[str]]:
         """Return (matched_chunk_count, endpoint set at the deepest match).
 
@@ -102,7 +113,7 @@ class HashTrie:
             matched = 0
             selected: Set[str] = set(available_endpoints)
             now = time.monotonic()
-            for h in self._chunk_hashes(text):
+            for h in self._chunk_hashes(text, salt=salt):
                 nxt = node.children.get(h)
                 if nxt is None:
                     break

@@ -189,6 +189,37 @@ autoscale_current_replicas = Gauge(
     "Replica count the recommender currently observes",
     registry=REGISTRY)
 
+# --- LoRA adapter plane (production_stack_tpu/lora/registry.py) ----------
+# Series appear only once the --lora-plane registry acts (loads, evicts,
+# or routes an adapter-addressed request), so a plane-off deployment's
+# /metrics surface is byte-identical.
+lora_loads = Counter(
+    "vllm_router:lora_loads_total",
+    "Adapter load operations the router drove against engines (fan-out "
+    "distribution plus affinity-miss on-demand loads), by adapter",
+    ["adapter"], registry=REGISTRY)
+lora_evictions = Counter(
+    "vllm_router:lora_evictions_total",
+    "Adapters the router unloaded to make room (LRU eviction when a "
+    "replica's slots are full) or by operator request, by adapter",
+    ["adapter"], registry=REGISTRY)
+lora_affinity_hits = Counter(
+    "vllm_router:lora_affinity_hits_total",
+    "Adapter-addressed requests whose routing pick already had the "
+    "adapter resident (no load stall on the request path)",
+    ["adapter"], registry=REGISTRY)
+lora_affinity_misses = Counter(
+    "vllm_router:lora_affinity_misses_total",
+    "Adapter-addressed requests that picked a replica without the "
+    "adapter resident (single-flight on-demand load before proxying)",
+    ["adapter"], registry=REGISTRY)
+lora_requests = Counter(
+    "vllm_router:lora_requests_total",
+    "Adapter-addressed requests routed, by adapter and SLO outcome "
+    "(additive companion to request_outcomes — the base label set is "
+    "unchanged)",
+    ["adapter", "outcome"], registry=REGISTRY)
+
 # --- Crash-consistent fleet state (leases / resync / stampede control) ---
 kv_controller_instances = Gauge(
     "vllm_router:kv_controller_instances",

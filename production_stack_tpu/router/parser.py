@@ -207,6 +207,26 @@ def build_parser() -> argparse.ArgumentParser:
                         default=120.0,
                         help="seconds /autoscale/scale_in waits for the "
                              "victim's /drain to quiesce")
+    # LoRA adapter plane
+    parser.add_argument("--lora-plane", action="store_true",
+                        help="enable the adapter control plane: residency "
+                             "scraping of each replica's /v1/lora_adapters, "
+                             "adapter-affinity routing with single-flight "
+                             "on-demand loads, /lora/{load,unload} fan-out, "
+                             "GET /debug/lora, and adapter-salted KV keys")
+    parser.add_argument("--lora-scrape-interval", type=float, default=10.0,
+                        help="seconds between adapter residency scrapes")
+    parser.add_argument("--lora-load-timeout", type=float, default=60.0,
+                        help="deadline for one on-demand adapter load on "
+                             "the request path")
+    parser.add_argument("--lora-default-replicas", type=int, default=1,
+                        help="replicas /lora/load targets when the request "
+                             "body names no count")
+    parser.add_argument("--lora-no-affinity", action="store_true",
+                        help="disable adapter-affinity pinning (adapter "
+                             "requests route like base requests and load "
+                             "on-demand wherever they land); A/B baseline, "
+                             "not a production setting")
     # Dynamic config
     parser.add_argument("--kv-admit-ttl", type=float, default=600.0,
                         help="seconds a KV admission claim stays routable "
@@ -378,6 +398,13 @@ def validate_args(args: argparse.Namespace) -> None:
             raise ValueError("--autoscale-queue-depth-target must be > 0")
         if not 0.0 < args.autoscale_hbm_usage_high <= 1.0:
             raise ValueError("--autoscale-hbm-usage-high must be in (0, 1]")
+    if getattr(args, "lora_plane", False):
+        if args.lora_scrape_interval <= 0:
+            raise ValueError("--lora-scrape-interval must be > 0")
+        if args.lora_load_timeout <= 0:
+            raise ValueError("--lora-load-timeout must be > 0")
+        if args.lora_default_replicas < 1:
+            raise ValueError("--lora-default-replicas must be >= 1")
     if not 0.0 <= args.sentry_traces_sample_rate <= 1.0:
         raise ValueError("--sentry-traces-sample-rate must be in [0, 1]")
     if not 0.0 <= args.sentry_profile_session_sample_rate <= 1.0:

@@ -459,7 +459,35 @@ async def route_general_request(
         )
 
     endpoints = state.service_discovery.get_endpoint_info()
-    if requested_model is not None:
+
+    # Adapter identification (--lora-plane) runs against the UNFILTERED
+    # endpoint list: an adapter request may legitimately target a
+    # replica that does not hold the adapter yet (on-demand load), which
+    # the serves() filter below would hide.
+    lora = getattr(state, "lora", None)
+    lora_adapter: Optional[str] = None
+    lora_base: Optional[str] = None
+    if lora is not None and requested_model:
+        base_models = {m for ep in endpoints for m in ep.model_names}
+        is_adapter = requested_model not in base_models and (
+            requested_model in lora.known_adapters()
+            or any(requested_model in (ep.lora_adapters or ())
+                   for ep in endpoints))
+        if is_adapter:
+            lora_adapter = requested_model
+            lora_base = lora.base_model_of(lora_adapter)
+
+    if lora_adapter is not None:
+        # Candidates: replicas already holding the adapter plus every
+        # replica serving its base model (loadable on demand).
+        endpoints = [
+            ep for ep in endpoints
+            if not ep.sleep and (
+                ep.serves(lora_adapter)
+                or lora_base is None
+                or lora_base in ep.model_names)
+        ]
+    elif requested_model is not None:
         endpoints = [
             ep for ep in endpoints
             if ep.serves(requested_model) and not ep.sleep
@@ -467,12 +495,25 @@ async def route_general_request(
     else:
         endpoints = [ep for ep in endpoints if not ep.sleep]
     if not endpoints:
+        # With the adapter plane on, a model nobody serves is most
+        # likely an unknown adapter name: return a clean OpenAI-style
+        # 404 (matching the engine's own unknown-model reply) instead
+        # of the generic 400 — and never fall back to the base model.
+        not_found = (getattr(state, "lora", None) is not None
+                     and requested_model is not None)
         if trace is not None:
-            root.finish(status=400, error="no_endpoints")
+            root.finish(status=404 if not_found else 400,
+                        error="no_endpoints")
             recorder.record(trace)
         if slo is not None:
             slo.observe("failed", tenant.name if tenant else None,
                         requested_model)
+        if not_found:
+            return web.json_response(
+                {"error": {"message": f"model {requested_model!r} not found",
+                           "type": "NotFoundError"}},
+                status=404,
+            )
         return web.json_response(
             {"error": f"Model {requested_model} not found or all engines sleeping."},
             status=400,
@@ -504,6 +545,18 @@ async def route_general_request(
                 )
             endpoints = healthy
 
+    # Adapter-affinity (--lora-plane): a request naming a resident LoRA
+    # adapter pins to the replicas that hold it — soft pinning: when no
+    # replica has it resident, any pick stands and the miss path below
+    # loads it on demand (single-flight, breaker-aware).
+    if lora_adapter is not None and lora.config.affinity:
+        resident = {u.rstrip("/")
+                    for u in lora.resident_urls(lora_adapter)}
+        pinned = [ep for ep in endpoints
+                  if ep.url.rstrip("/") in resident]
+        if pinned:
+            endpoints = pinned
+
     # Weighted-fair dispatch: wait for a slot before picking a backend so
     # the routing decision sees fresh stats.  The lease is held for the
     # whole upstream exchange (streaming included) and released in the
@@ -525,7 +578,8 @@ async def route_general_request(
                 root.finish(status=503, error="qos_shed")
                 recorder.record(trace)
             if slo is not None:
-                slo.observe("shed", tenant.name, requested_model)
+                slo.observe("shed", tenant.name, requested_model,
+                            adapter=lora_adapter)
             events = getattr(state, "events", None)
             if events is not None:
                 events.record(
@@ -580,6 +634,45 @@ async def route_general_request(
             in_router_time, (time.time() - in_router_time) * 1e3,
         )
 
+        # Adapter-affinity outcome: a pick that already has the adapter
+        # resident is a hit; a miss triggers a single-flight on-demand
+        # load on the picked replica (bounded by --lora-load-timeout).
+        # A failed load reroutes to a resident replica when one exists,
+        # else the request fails cleanly — never a silent base-model
+        # fallback.
+        if lora_adapter is not None:
+            if lora.is_resident(server_url, lora_adapter):
+                lora.record_affinity(lora_adapter, hit=True)
+            else:
+                lora.record_affinity(lora_adapter, hit=False)
+                loaded = await _loop_wrap(
+                    state, "lora_load",
+                    lora.ensure_resident(server_url, lora_adapter))
+                if not loaded:
+                    fallback = next(
+                        (ep.url for ep in endpoints
+                         if ep.url != server_url
+                         and lora.is_resident(ep.url, lora_adapter)),
+                        None)
+                    if fallback is None:
+                        slo_outcome = "failed"
+                        if trace is not None:
+                            root.finish(status=503,
+                                        error="lora_load_failed")
+                            recorder.record(trace)
+                        return web.json_response(
+                            {"error": {
+                                "message": (
+                                    f"adapter {lora_adapter!r} could not "
+                                    "be loaded on any replica"),
+                                "type": "ServiceUnavailable"}},
+                            status=503, headers=qos_headers)
+                    logger.info(
+                        "lora: rerouting %s from %s to resident %s",
+                        request_id, server_url, fallback)
+                    server_url = fallback
+            lora.touch(server_url, lora_adapter)
+
         # Global prefix cache (--fleet-cache): if another replica or the
         # L3 holds a long prefix of this prompt, have the picked replica
         # pull it before prefill. Strictly best-effort — any failure
@@ -587,6 +680,7 @@ async def route_general_request(
         fleet = getattr(state, "fleet", None)
         if fleet is not None and request_json is not None:
             from production_stack_tpu.router.routing_logic import (
+                _adapter_salt,
                 _extract_prompt,
             )
 
@@ -596,7 +690,8 @@ async def route_general_request(
                 state, "fleet_pull",
                 fleet.maybe_pull(
                     server_url, _extract_prompt(request_json) or "",
-                    request_json, request_id))
+                    request_json, request_id,
+                    salt=_adapter_salt(request_json, endpoints)))
             if pull_span is not None:
                 if pull is None:
                     pull_span.finish(outcome="skip")
@@ -856,7 +951,7 @@ async def route_general_request(
                     outcome = "failed"
             with _loop_measure(state, "slo_classify"):
                 slo.observe(outcome, tenant.name if tenant else None,
-                            requested_model)
+                            requested_model, adapter=lora_adapter)
         if lease is not None:
             lease.release()
         if qos is not None and tenant is not None:

@@ -167,6 +167,23 @@ def _extract_prompt(request_json: Optional[dict]) -> str:
     return ""
 
 
+def _adapter_salt(request_json: Optional[dict],
+                  endpoints: List[EndpointInfo]) -> Optional[str]:
+    """LoRA adapter salt for prefix/KV keying: the request's model name iff
+    it names an adapter resident on some endpoint (rather than a base
+    model). Base-model requests return None, keeping today's hash keys
+    byte-identical when no adapters are configured."""
+    if not request_json:
+        return None
+    model = request_json.get("model")
+    if not model:
+        return None
+    for ep in endpoints:
+        if model in (ep.lora_adapters or ()):
+            return model
+    return None
+
+
 class PrefixAwareRouter(RoutingInterface):
     """Longest-prefix-match over a hash trie (reference :363-423).
 
@@ -204,17 +221,20 @@ class PrefixAwareRouter(RoutingInterface):
         available = {e.url for e in endpoints}
         if not prompt:
             return random.choice(sorted(available))
-        if self._native is not None:
+        salt = _adapter_salt(request_json, endpoints)
+        if self._native is not None and salt is None:
+            # The native picker has no salt support — adapter-salted
+            # requests fall through to the Python trie.
             self._native.set_endpoints(sorted(available))
             url = self._native.pick_prefix(prompt)
             if url:
                 return url
             return random.choice(sorted(available))
         matched, candidates = await self.trie.longest_prefix_match(
-            prompt, available
+            prompt, available, salt=salt
         )
         url = random.choice(sorted(candidates))
-        await self.trie.insert(prompt, url)
+        await self.trie.insert(prompt, url, salt=salt)
         return url
 
 
@@ -249,7 +269,8 @@ class KvawareRouter(RoutingInterface):
         prompt = _extract_prompt(request_json)
         if prompt and self.kv_controller is not None:
             try:
-                match = await self.kv_controller.lookup(prompt)
+                salt = _adapter_salt(request_json, endpoints)
+                match = await self.kv_controller.lookup(prompt, salt=salt)
                 if match is not None:
                     matched_len, instance_id = match
                     if matched_len >= max(len(prompt) - self.threshold, 1):

@@ -220,6 +220,17 @@ class StaticServiceDiscovery(ServiceDiscovery):
         with self._lock:
             self._lease_unhealthy.discard(url)
 
+    def set_lora_adapters(self, url: str, adapters: List[str]) -> None:
+        """AdapterRegistry scrape mirror: refresh the endpoint's resident
+        adapter list so ``serves()`` tracks loads/unloads instead of
+        keeping the registration-time value forever (an unloaded adapter
+        otherwise keeps attracting requests)."""
+        url = url.rstrip("/")
+        with self._lock:
+            for ep in self._endpoints:
+                if ep.url.rstrip("/") == url:
+                    ep.lora_adapters = list(adapters)
+
     def get_endpoint_info(self) -> List[EndpointInfo]:
         with self._lock:
             return [
@@ -313,6 +324,15 @@ class _K8sWatchDiscoveryBase(ServiceDiscovery):
     def get_endpoint_info(self) -> List[EndpointInfo]:
         with self._lock:
             return list(self._endpoints.values())
+
+    def set_lora_adapters(self, url: str, adapters: List[str]) -> None:
+        """AdapterRegistry scrape mirror (see StaticServiceDiscovery):
+        keyed by URL because the registry does not know object names."""
+        url = url.rstrip("/")
+        with self._lock:
+            for ep in self._endpoints.values():
+                if ep.url.rstrip("/") == url:
+                    ep.lora_adapters = list(adapters)
 
     def get_health(self) -> bool:
         return self._thread.is_alive()

@@ -142,12 +142,19 @@ class SLOEngine:
     # -- accounting -------------------------------------------------------
 
     def observe(self, outcome: str, tenant: Optional[str] = None,
-                model: Optional[str] = None) -> None:
+                model: Optional[str] = None,
+                adapter: Optional[str] = None) -> None:
         if outcome not in self.outcome_counts:
             outcome = "failed"  # never raise on the request path
         router_metrics.request_outcomes.labels(
             outcome=outcome, tenant=tenant or "default", model=model or ""
         ).inc()
+        if adapter:
+            # Additive per-adapter outcome series: the base label set on
+            # request_outcomes is unchanged, so adapter-free deployments
+            # keep today's exposition byte for byte.
+            router_metrics.lora_requests.labels(
+                adapter=adapter, outcome=outcome).inc()
         now = time.monotonic()
         with self._lock:
             self.outcome_counts[outcome] += 1

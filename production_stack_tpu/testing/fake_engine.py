@@ -7,7 +7,10 @@ scraping, sleep mode) be exercised hermetically with no TPU or cluster.
 Serves: /v1/models, /v1/chat/completions, /v1/completions, /v1/embeddings,
 /tokenize, /detokenize, /metrics (vllm:* exposition), /sleep, /wake_up,
 /is_sleeping, /health, /v1/audio/transcriptions, /fault (fault injection),
-/drain (graceful drain, mirroring the real engine server).
+/drain (graceful drain, mirroring the real engine server), and — with
+``max_loras > 0`` — the LoRA residency surface (/v1/lora_adapters,
+/v1/load_lora_adapter, /v1/unload_lora_adapter) with slot limits,
+adapter-salted prefix-cache keys, and unknown-model 404s.
 
 Fault injection (for the router fault-tolerance tests and BENCH_CHAOS):
 POST /fault {"mode": ..., "after_chunks": N, "times": K} arms one of
@@ -61,6 +64,7 @@ class FakeEngine:
         simulate_contention: bool = False,
         enable_chunked_prefill: bool = False,
         prefill_chunks: int = 4,
+        max_loras: int = 0,
     ):
         self.models = models or [model]
         self.ttft = ttft
@@ -157,6 +161,21 @@ class FakeEngine:
         self.prefix_cache_hits = 0
         self.prefix_cache_queries = 0
         self.hbm_headroom_bytes: float = -1.0  # >=0: scraped by autoscaler
+        # LoRA surface (adapter-plane tests / BENCH_LORA), mirroring the
+        # real engine's slot model: slot 0 is the base model, so a
+        # max_loras of N holds N-1 resident adapters. 0 disables the
+        # surface entirely — the historical fake accepts any model name,
+        # and that stays true so timing tests keep their behavior; with
+        # max_loras > 0 an unknown model 404s like the real server.
+        self.max_loras = max_loras
+        self.lora_adapters: Dict[str, float] = {}  # name -> load stamp
+        # Simulated weight fetch: /v1/load_lora_adapter sleeps this long
+        # before the adapter becomes resident (the cost the affinity-on
+        # A/B leg avoids by pinning instead of thrashing slots).
+        self.lora_load_delay_s = 0.0
+        self.lora_loads = 0
+        self.lora_unloads = 0
+        self.lora_request_counts: Dict[str, int] = {}
         # Same trace surface as the real engine server: synthetic
         # queue/prefill/decode spans linked under the router's forwarded
         # traceparent, retrievable at /debug/traces/{request_id}.
@@ -348,6 +367,24 @@ class FakeEngine:
             await self._site.stop()
             self._site = None
 
+    def _lora_check(self, body: dict):
+        """(adapter_or_None, 404_response_or_None) for the request's
+        model. With the LoRA surface on, an unknown model is a clean
+        404 — same contract as the real server's _check_model, never a
+        silent base-model fallback. Resident adapters are counted."""
+        if self.max_loras <= 0:
+            return None, None
+        model = body.get("model")
+        if model is None or model in self.models:
+            return None, None
+        if model not in self.lora_adapters:
+            return None, web.json_response(
+                {"error": {"message": f"model {model!r} not found",
+                           "type": "NotFoundError"}}, status=404)
+        self.lora_request_counts[model] = \
+            self.lora_request_counts.get(model, 0) + 1
+        return model, None
+
     def _prefix_hashes(self, body: dict) -> "List[int]":
         # The simulated prefix cache only exists once the engine is
         # wired to a KV controller (configure_kv) — otherwise repeat
@@ -359,7 +396,14 @@ class FakeEngine:
         from production_stack_tpu.router.routing_logic import _extract_prompt
 
         prompt = _extract_prompt(body)
-        return chunk_hashes(prompt) if prompt else []
+        if not prompt:
+            return []
+        # Adapter-salted keys, mirroring the real engine's admission
+        # report: an adapter-addressed request's chunks can never match
+        # a base-model (or other-adapter) prefix.
+        model = body.get("model")
+        salt = model if (model and model in self.lora_adapters) else None
+        return chunk_hashes(prompt, salt=salt)
 
     def _cached_fraction(self, hashes: "List[int]") -> float:
         """Leading fraction of the prompt's chunks already held — that
@@ -451,6 +495,9 @@ class FakeEngine:
         app.router.add_post("/fault", self.handle_fault)
         app.router.add_post("/drain", self.handle_drain)
         app.router.add_post("/kv/pull", self.handle_kv_pull)
+        app.router.add_get("/v1/lora_adapters", self.handle_list_lora)
+        app.router.add_post("/v1/load_lora_adapter", self.handle_load_lora)
+        app.router.add_post("/v1/unload_lora_adapter", self.handle_unload_lora)
         app.router.add_post("/v1/audio/transcriptions", self.handle_transcription)
         from production_stack_tpu.obs.debug import add_debug_routes
 
@@ -499,6 +546,9 @@ class FakeEngine:
         fault = None if self.fault_mode == "pull_error" else self._take_fault()
         body = await request.json()
         self.requests_seen.append(body)
+        _, not_found = self._lora_check(body)
+        if not_found is not None:
+            return not_found
         structured_text, bad = self._structured_content(body)
         if bad is not None:
             return bad
@@ -604,6 +654,9 @@ class FakeEngine:
                 status=503, headers={"Retry-After": "1"})
         body = await request.json()
         self.requests_seen.append(body)
+        _, not_found = self._lora_check(body)
+        if not_found is not None:
+            return not_found
         structured_text, bad = self._structured_content(body)
         if bad is not None:
             return bad
@@ -707,6 +760,11 @@ class FakeEngine:
                 "# TYPE tpu:hbm_headroom_bytes gauge\n"
                 f"tpu:hbm_headroom_bytes {self.hbm_headroom_bytes}\n"
             )
+        if self.lora_request_counts:
+            text += "# TYPE tpu:lora_requests counter\n"
+            for name in sorted(self.lora_request_counts):
+                text += (f'tpu:lora_requests_total{{adapter="{name}"}} '
+                         f"{self.lora_request_counts[name]}\n")
         return web.Response(text=text, content_type="text/plain")
 
     async def handle_sleep(self, request: web.Request) -> web.Response:
@@ -838,6 +896,54 @@ class FakeEngine:
     async def handle_transcription(self, request: web.Request) -> web.Response:
         await request.post()
         return web.json_response({"text": "fake transcription"})
+
+    # -- LoRA surface ------------------------------------------------------
+    async def handle_list_lora(self, request: web.Request) -> web.Response:
+        """Residency scrape surface, same shape as the real server's
+        enriched /v1/lora_adapters (what AdapterRegistry parses)."""
+        return web.json_response({
+            "adapters": [{"lora_name": name}
+                         for name in sorted(self.lora_adapters)],
+            "max_loras": self.max_loras,
+            "capacity": max(self.max_loras - 1, 0),
+            "base_model": self.models[0],
+        })
+
+    async def handle_load_lora(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        name = body.get("lora_name")
+        if not name:
+            return web.json_response(
+                {"error": {"message": "lora_name required",
+                           "type": "BadRequestError"}}, status=400)
+        if name in self.lora_adapters:
+            return web.json_response(
+                {"status": "ok", "lora_name": name, "already_resident": True})
+        if len(self.lora_adapters) >= max(self.max_loras - 1, 0):
+            # Same 400 the real engine returns on a full slot table —
+            # the registry's cue to LRU-evict and retry.
+            return web.json_response(
+                {"error": {"message": (
+                    f"could not load adapter {name!r} "
+                    "(no free slots or LoRA disabled)"),
+                    "type": "BadRequestError"}}, status=400)
+        if self.lora_load_delay_s > 0:
+            # Simulated weight fetch / swap-in.
+            await asyncio.sleep(self.lora_load_delay_s)
+        self.lora_adapters[name] = time.monotonic()
+        self.lora_loads += 1
+        return web.json_response({"status": "ok", "lora_name": name})
+
+    async def handle_unload_lora(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        name = body.get("lora_name")
+        if name not in self.lora_adapters:
+            return web.json_response(
+                {"error": {"message": f"adapter {name!r} not loaded",
+                           "type": "NotFoundError"}}, status=404)
+        del self.lora_adapters[name]
+        self.lora_unloads += 1
+        return web.json_response({"status": "ok", "lora_name": name})
 
 
 async def run_fake_engine(engine: FakeEngine, host: str, port: int) -> web.AppRunner:

@@ -1,0 +1,248 @@
+"""Hermetic LoRA adapter-plane A/B: affinity pinning ON vs OFF.
+
+The physics, with no TPU and no model: three :class:`FakeEngine`
+replicas each hold ``max_loras - 1 = 2`` adapter slots while the
+workload addresses **four** adapters plus the base model — the fleet
+can hold every adapter somewhere, but no replica can hold them all.
+Adapter loads cost ``lora_load_delay_s`` of wall time (the simulated
+weight fetch), paid on the request path by whichever request triggers
+the on-demand load.
+
+- **affinity_on** leg: the router runs ``--lora-plane`` with affinity
+  pinning (the default). After a one-time ``POST /lora/load`` prime,
+  every adapter request routes to the replica already holding its
+  adapter: the load delay is paid once per adapter, the hit rate is
+  ~1.0, and adapter TTFT stays at the engine's base TTFT.
+- **affinity_off** leg: same plane, ``--lora-no-affinity``. Round-robin
+  scatters each adapter across all three replicas, demanding 4x3 = 12
+  resident slots from a fleet with 6 — every round re-loads adapters
+  through the LRU-evict path, so loads and evictions churn and the
+  load delay lands on p99 TTFT.
+
+Both legs must complete every request (misses degrade to an on-demand
+load, never an error); the A/B quantifies hit rate and p99 TTFT.
+
+Used by ``bench.py`` (BENCH_LORA=1) and ``tests/test_lora_plane.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional
+
+from production_stack_tpu.testing.fleet_ab import _start
+from production_stack_tpu.testing.qos_ab import (
+    _p99,
+    _reset_router_singletons,
+)
+
+BASE_MODEL = "lora-base"
+
+
+def _adapter_name(i: int) -> str:
+    return f"sql-expert-{i}"
+
+
+def _adapter_prompt(i: int, chars: int = 600) -> str:
+    """Per-adapter repeat prompt (each adapter's tenant re-sends its own
+    context, the usual multi-tenant shape)."""
+    return (f"adapter-{i:02d} tenant corpus, schema table_{i} columns. "
+            * 32)[:chars]
+
+
+async def _ttft_request(session, router_url: str, model: str, prompt: str,
+                        timeout_s: float = 30.0) -> Optional[float]:
+    """One streamed chat completion; returns TTFT (first content chunk)
+    on a complete stream, None on any failure."""
+    import aiohttp
+
+    t0 = time.perf_counter()
+    try:
+        async with session.post(
+            router_url + "/v1/chat/completions",
+            json={"model": model, "max_tokens": 2, "stream": True,
+                  "messages": [{"role": "user", "content": prompt}]},
+            timeout=aiohttp.ClientTimeout(total=timeout_s),
+        ) as resp:
+            if resp.status != 200:
+                return None
+            ttft = None
+            done = False
+            async for line in resp.content:
+                stripped = line.strip()
+                if stripped == b"data: [DONE]":
+                    done = True
+                elif ttft is None and stripped.startswith(b"data:"):
+                    ttft = time.perf_counter() - t0
+            return ttft if done else None
+    except (aiohttp.ClientError, asyncio.TimeoutError):
+        return None
+
+
+async def _run_leg(*, affinity: bool, adapters: int, rounds: int,
+                   per_adapter: int, concurrency: int, engine_ttft: float,
+                   load_delay_s: float, replicas: int,
+                   max_loras: int) -> dict:
+    import aiohttp
+
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.parser import build_parser
+    from production_stack_tpu.testing.fake_engine import (
+        FakeEngine,
+        run_fake_engine,
+    )
+
+    _reset_router_singletons()
+    engines = [FakeEngine(model=BASE_MODEL, ttft=engine_ttft,
+                          max_tokens_default=2, max_loras=max_loras)
+               for _ in range(replicas)]
+    for e in engines:
+        e.lora_load_delay_s = load_delay_s
+    runners = [await run_fake_engine(e, "127.0.0.1", 0) for e in engines]
+    urls = [e.self_url for e in engines]
+
+    args = build_parser().parse_args([])
+    args.static_backends = ",".join(urls)
+    args.static_models = ",".join([BASE_MODEL] * replicas)
+    # Round-robin on purpose: it maximizes adapter requests landing off
+    # the resident replica, which is exactly what affinity pinning fixes.
+    args.routing_logic = "roundrobin"
+    args.engine_stats_interval = 60
+    args.lora_plane = True
+    args.lora_no_affinity = not affinity
+    router_app = build_app(args)
+    router_runner, router_url = await _start(router_app)
+
+    names = [_adapter_name(i) for i in range(adapters)]
+    prompts = {name: _adapter_prompt(i) for i, name in enumerate(names)}
+    adapter_ttfts: List[float] = []
+    base_ttfts: List[float] = []
+    failed = 0
+    sem = asyncio.Semaphore(concurrency)
+
+    async def one(session, model: str, prompt: str, bucket: List[float]):
+        nonlocal failed
+        async with sem:
+            ttft = await _ttft_request(session, router_url, model, prompt)
+            if ttft is None:
+                failed += 1
+            else:
+                bucket.append(ttft)
+
+    debug: dict = {}
+    try:
+        async with aiohttp.ClientSession() as session:
+            # Prime: distribute every adapter to one replica through the
+            # router's fan-out (the helm post-install hook does the same
+            # against the engines directly). Barrier before traffic so
+            # both legs start from identical residency.
+            for name in names:
+                async with session.post(
+                    router_url + "/lora/load",
+                    json={"lora_name": name, "replicas": 1},
+                    timeout=aiohttp.ClientTimeout(total=30),
+                ) as resp:
+                    body = await resp.json()
+                    if resp.status != 200 or not body.get("loaded"):
+                        raise RuntimeError(
+                            f"prime load of {name!r} failed: {body}")
+            for _ in range(rounds):
+                tasks = []
+                for name in names:
+                    tasks.extend(
+                        one(session, name, prompts[name], adapter_ttfts)
+                        for _ in range(per_adapter))
+                tasks.extend(
+                    one(session, BASE_MODEL,
+                        "base workload prompt, shared by every tenant.",
+                        base_ttfts)
+                    for _ in range(per_adapter))
+                await asyncio.gather(*tasks)
+            async with session.get(
+                router_url + "/debug/lora",
+                timeout=aiohttp.ClientTimeout(total=10),
+            ) as resp:
+                debug = await resp.json() if resp.status == 200 else {}
+    finally:
+        await router_runner.cleanup()
+        for runner in runners:
+            await runner.cleanup()
+        _reset_router_singletons()
+
+    counters = debug.get("counters", {})
+    hits = counters.get("affinity_hits", 0)
+    misses = counters.get("affinity_misses", 0)
+    adapter_sorted = sorted(adapter_ttfts)
+    per_engine: Dict[str, int] = {}
+    for e in engines:
+        for name, n in e.lora_request_counts.items():
+            per_engine[name] = per_engine.get(name, 0) + n
+    return {
+        "affinity": affinity,
+        "adapters": adapters,
+        "rounds": rounds,
+        "per_adapter": per_adapter,
+        "completed": len(adapter_ttfts) + len(base_ttfts),
+        "failed": failed,
+        "adapter_ttft_p50_s": round(
+            adapter_sorted[len(adapter_sorted) // 2], 4)
+        if adapter_sorted else None,
+        "adapter_ttft_p99_s": round(_p99(adapter_ttfts), 4)
+        if adapter_ttfts else None,
+        "base_ttft_p99_s": round(_p99(base_ttfts), 4)
+        if base_ttfts else None,
+        "affinity_hits": hits,
+        "affinity_misses": misses,
+        "affinity_hit_rate": round(hits / (hits + misses), 4)
+        if (hits + misses) else None,
+        "router_loads": counters.get("loads", 0),
+        "router_evictions": counters.get("evictions", 0),
+        "engine_loads": sum(e.lora_loads for e in engines),
+        "engine_unloads": sum(e.lora_unloads for e in engines),
+        "adapter_requests_by_engine": per_engine,
+    }
+
+
+async def run_lora_ab(*, adapters: int = 4, rounds: int = 3,
+                      per_adapter: int = 3, concurrency: int = 8,
+                      engine_ttft: float = 0.02,
+                      load_delay_s: float = 0.15,
+                      replicas: int = 3, max_loras: int = 3,
+                      skip_off: bool = False) -> dict:
+    """Run the affinity-on leg then the affinity-off baseline; A/B dict.
+
+    ``skip_off`` runs only the ON leg (tier-1 test uses it — the OFF
+    leg exists to quantify the pinning win, not to gate correctness)."""
+    on = await _run_leg(
+        affinity=True, adapters=adapters, rounds=rounds,
+        per_adapter=per_adapter, concurrency=concurrency,
+        engine_ttft=engine_ttft, load_delay_s=load_delay_s,
+        replicas=replicas, max_loras=max_loras)
+    off = None
+    if not skip_off:
+        off = await _run_leg(
+            affinity=False, adapters=adapters, rounds=rounds,
+            per_adapter=per_adapter, concurrency=concurrency,
+            engine_ttft=engine_ttft, load_delay_s=load_delay_s,
+            replicas=replicas, max_loras=max_loras)
+    speedup = None
+    if (off and on["adapter_ttft_p99_s"] and off["adapter_ttft_p99_s"]
+            and on["adapter_ttft_p99_s"] > 0):
+        speedup = round(
+            off["adapter_ttft_p99_s"] / on["adapter_ttft_p99_s"], 2)
+    return {
+        "metric": "lora_affinity_ab",
+        "unit": "adapter_p99_ttft_speedup",
+        "value": speedup,
+        "adapters": adapters,
+        "rounds": rounds,
+        "per_adapter": per_adapter,
+        "concurrency": concurrency,
+        "engine_ttft_s": engine_ttft,
+        "load_delay_s": load_delay_s,
+        "replicas": replicas,
+        "max_loras": max_loras,
+        "affinity_on": on,
+        "affinity_off": off,
+    }

@@ -48,7 +48,8 @@ def is_gated(path: str) -> bool:
 # deployment key when one is set.
 _PRIVILEGED_EXACT = frozenset({"/kv/deregister", "/debug/profile",
                                "/debug/events", "/debug/traces",
-                               "/debug/steps", "/debug/loop"})
+                               "/debug/steps", "/debug/loop",
+                               "/debug/lora"})
 # /debug/kv/* (pull economics, trie introspection) leaks cache topology,
 # holder URLs, and workload prefix structure — privileged as a prefix so
 # future additions under it are born gated. /debug/snapshot is the
@@ -56,9 +57,12 @@ _PRIVILEGED_EXACT = frozenset({"/kv/deregister", "/debug/profile",
 # one body) and /debug/workers carries pids and shared-state divergence
 # views — both prefixes so ?query variants and future sub-paths stay
 # gated.
+# /lora/* is the adapter distribution fan-out (load/unload across the
+# fleet) — control-plane writes, privileged as a prefix.
 _PRIVILEGED_PREFIXES = ("/autoscale/", "/debug/profile/",
                         "/debug/traces/", "/debug/kv/",
-                        "/debug/snapshot", "/debug/workers")
+                        "/debug/snapshot", "/debug/workers",
+                        "/lora/")
 
 
 def is_privileged(path: str) -> bool:

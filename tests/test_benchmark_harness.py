@@ -305,6 +305,47 @@ def test_committed_prefill_kernel_ab_artifact_schema():
         < fd["dispatches_per_pair"]["alternating"]
 
 
+def test_committed_lora_ab_artifact_schema():
+    """The committed LoRA affinity A/B (r19) is real and carries the
+    tentpole's acceptance numbers: both legs completed every request
+    (misses degrade to on-demand loads, never errors), the affinity-on
+    leg's hit rate is strictly higher and its adapter p99 TTFT strictly
+    lower than the affinity-off baseline's at equal load, and the off
+    leg actually churned (the slot pressure the pinning is for)."""
+    data = json.load(open(os.path.join(REPO, "BENCH_LORA_r19.json")))
+    assert data["metric"] == "lora_affinity_ab"
+    assert data["unit"] == "adapter_p99_ttft_speedup"
+    assert data["meta"]["schema"] == 1
+    assert data["backend"] == "fake"
+    # The workload oversubscribes slots: adapters * replicas demanded
+    # vs (max_loras - 1) * replicas held.
+    assert data["adapters"] > data["max_loras"] - 1
+    on, off = data["affinity_on"], data["affinity_off"]
+    expected = data["adapters"] * data["rounds"] * data["per_adapter"] \
+        + data["rounds"] * data["per_adapter"]
+    for leg in (on, off):
+        assert leg["failed"] == 0
+        assert leg["completed"] == expected
+        # Every adapter saw traffic on some engine.
+        assert len(leg["adapter_requests_by_engine"]) == data["adapters"]
+    # Acceptance: affinity-on wins on both hit rate and tail latency.
+    assert on["affinity_hit_rate"] > off["affinity_hit_rate"]
+    assert on["adapter_ttft_p99_s"] < off["adapter_ttft_p99_s"]
+    assert data["value"] == round(
+        off["adapter_ttft_p99_s"] / on["adapter_ttft_p99_s"], 2)
+    assert data["value"] > 1.0
+    # The on leg pinned: one load per adapter, no evictions. The off
+    # leg churned through the LRU-evict path.
+    assert on["router_loads"] == data["adapters"]
+    assert on["router_evictions"] == 0
+    assert off["router_evictions"] > 0
+    assert off["router_loads"] > on["router_loads"]
+    # Router counters and engine ground truth agree.
+    for leg in (on, off):
+        assert leg["engine_loads"] == leg["router_loads"]
+        assert leg["engine_unloads"] == leg["router_evictions"]
+
+
 def test_plot_table(tmp_path, monkeypatch):
     spec = importlib.util.spec_from_file_location(
         "bench_plot", os.path.join(REPO, "benchmarks", "plot.py"))

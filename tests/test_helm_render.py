@@ -64,6 +64,48 @@ def test_engine_flags_render_into_command():
         assert cmd[cmd.index(flag) + 1] not in ("", None)
 
 
+def test_lora_adapters_render_hook_job_and_router_plane():
+    """modelSpec.loraAdapters renders a post-install/post-upgrade hook
+    Job that POSTs /v1/load_lora_adapter for every declared adapter to
+    that entry's engine Service, and routerSpec.lora.enabled turns on
+    the router's --lora-plane."""
+    rendered = _render(os.path.join(
+        CHART, "examples", "values-05-multi-model-lora.yaml"))
+    jobs = list(_docs(rendered, "Job"))
+    assert len(jobs) == 1  # only the mixtral entry declares loraAdapters
+    job = jobs[0]
+    assert job["metadata"]["name"].endswith("-mixtral-lora-load")
+    ann = job["metadata"]["annotations"]
+    assert ann["helm.sh/hook"] == "post-install,post-upgrade"
+    assert "before-hook-creation" in ann["helm.sh/hook-delete-policy"]
+    spec = job["spec"]["template"]["spec"]
+    assert spec["restartPolicy"] == "OnFailure"
+    cmd = spec["containers"][0]["command"]
+    script = cmd[cmd.index("-c") + 1]
+    assert "/v1/load_lora_adapter" in script
+    # Target: the entry's engine Service, on the engine port.
+    url = cmd[cmd.index("-c") + 2]
+    assert "-mixtral-engine-service" in url and url.endswith(":8000")
+    # Every declared adapter rides as a name=path argv entry.
+    assert "sql-expert=/models/loras/sql-expert" in cmd
+    assert "support-bot=" in cmd
+    # No hook Job for the adapter-less opt125m entry.
+    assert not [d for d in jobs
+                if "opt125m" in d["metadata"]["name"]]
+    routers = [d for d in _docs(rendered, "Deployment")
+               if d["metadata"]["name"].endswith("-router")]
+    assert routers, "router deployment missing"
+    rcmd = routers[0]["spec"]["template"]["spec"]["containers"][0][
+        "command"]
+    assert "--lora-plane" in rcmd
+    assert rcmd[rcmd.index("--lora-default-replicas") + 1] == "1"
+    # The plane stays off the command line when the block is disabled.
+    base = _render()
+    for d in _docs(base, "Deployment"):
+        c = d["spec"]["template"]["spec"]["containers"][0]["command"]
+        assert "--lora-plane" not in c
+
+
 def test_multihost_renders_statefulset_and_pins_service():
     example = os.path.join(
         CHART, "examples", "values-07-multihost-llama70b.yaml")

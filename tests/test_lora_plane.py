@@ -1,0 +1,425 @@
+"""LoRA adapter plane: adapter-salted KV keys, the router-side
+AdapterRegistry (scrape / LRU-evict / single-flight on-demand loads /
+discovery refresh), and the affinity-routed request path.
+
+Controller/trie/registry units run in-process; scenarios run real
+FakeEngine replicas behind the real router (hermetic, no TPU). Two
+conventions are pinned here:
+
+- **Adapter-salted keying**: prefix reuse, KV-aware scoring, and
+  cross-replica pulls never cross an adapter boundary — and the base
+  model's keys are byte-identical with the salt absent (flag-off
+  parity).
+- **Plane-off parity**: without ``--lora-plane``, ``state.lora`` is
+  None, /debug/lora 404s, and the request path is the pre-plane one.
+"""
+
+import asyncio
+from types import SimpleNamespace
+
+from production_stack_tpu.kv.controller import KVController, chunk_hashes
+from production_stack_tpu.lora.registry import (
+    AdapterRegistry,
+    LoraPlaneConfig,
+)
+from production_stack_tpu.router.hashtrie import HashTrie
+from production_stack_tpu.router.service_discovery import (
+    StaticServiceDiscovery,
+)
+
+BASE = "lora-base"
+
+
+async def _start(app):
+    from aiohttp import web
+
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+# --------------------------------------------------------------------- #
+# Adapter-salted chunk hashing (the KV-correctness core)
+# --------------------------------------------------------------------- #
+
+def test_chunk_hashes_adapter_salt_disjoint():
+    """The same text keyed under two adapters (or an adapter and the
+    base model) shares NO chunk hashes — so trie matches, controller
+    lookups, and fleet pulls can never cross an adapter boundary.
+    (Red on pre-plane code: chunk_hashes had no salt parameter and every
+    adapter shared the base model's key space.)"""
+    text = "x" * 400  # several chunks
+    base = chunk_hashes(text)
+    a = chunk_hashes(text, salt="adapter-a")
+    b = chunk_hashes(text, salt="adapter-b")
+    assert len(base) == len(a) == len(b)  # salting never moves boundaries
+    assert not set(base) & set(a)
+    assert not set(base) & set(b)
+    assert not set(a) & set(b)
+    # Deterministic per salt.
+    assert a == chunk_hashes(text, salt="adapter-a")
+
+
+def test_chunk_hashes_no_salt_is_byte_identical():
+    """salt=None and salt='' take the exact pre-plane code path: the
+    base model's keys don't change when the plane ships (flag-off
+    parity, and no fleet-wide cache invalidation on upgrade)."""
+    text = "y" * 300
+    assert chunk_hashes(text, salt=None) == chunk_hashes(text)
+    assert chunk_hashes(text, salt="") == chunk_hashes(text)
+
+
+def test_controller_lookup_respects_salt():
+    async def run():
+        ctl = KVController(chunk_size=128)
+        text = "z" * 384
+        await ctl.register_instance("A", "http://a")
+        await ctl.admit_text("A", text, salt="adapter-a")
+        assert await ctl.lookup(text, salt="adapter-a") == (384, "A")
+        # Another adapter, or the base model, sees nothing.
+        assert await ctl.lookup(text, salt="adapter-b") is None
+        assert await ctl.lookup(text) is None
+
+        # And the base model's claims are invisible to adapters.
+        await ctl.admit_text("A", text)
+        assert await ctl.lookup(text) == (384, "A")
+        assert await ctl.lookup(text, salt="adapter-b") is None
+
+    asyncio.run(run())
+
+
+def test_hashtrie_respects_salt():
+    async def run():
+        trie = HashTrie(chunk_size=128)
+        text = "w" * 512
+        await trie.insert(text, "http://a", salt="adapter-a")
+        ep = {"http://a", "http://b"}
+        matched, urls = await trie.longest_prefix_match(
+            text, ep, salt="adapter-a")
+        assert matched > 0 and urls == {"http://a"}
+        assert (await trie.longest_prefix_match(
+            text, ep, salt="adapter-b"))[0] == 0
+        assert (await trie.longest_prefix_match(text, ep))[0] == 0
+
+    asyncio.run(run())
+
+
+def test_routing_adapter_salt_helper():
+    from production_stack_tpu.router.routing_logic import _adapter_salt
+
+    eps = [SimpleNamespace(lora_adapters=["sql-expert"])]
+    assert _adapter_salt({"model": "sql-expert"}, eps) == "sql-expert"
+    assert _adapter_salt({"model": BASE}, eps) is None
+    assert _adapter_salt({}, eps) is None
+    assert _adapter_salt(None, eps) is None
+
+
+# --------------------------------------------------------------------- #
+# AdapterRegistry units against real fake engines
+# --------------------------------------------------------------------- #
+
+def _registry(sd=None, **cfg):
+    return AdapterRegistry(LoraPlaneConfig(**cfg), service_discovery=sd)
+
+
+def test_scrape_refreshes_residency_and_service_discovery():
+    """Regression (set-once staleness): EndpointInfo.lora_adapters used
+    to be populated at registration and never refreshed, so an unloaded
+    adapter kept attracting requests forever. Every scrape must push the
+    fresh list back into discovery."""
+    from production_stack_tpu.testing.fake_engine import (
+        FakeEngine,
+        run_fake_engine,
+    )
+
+    async def run():
+        eng = FakeEngine(model=BASE, max_loras=3)
+        runner = await run_fake_engine(eng, "127.0.0.1", 0)
+        url = eng.self_url
+        sd = StaticServiceDiscovery(urls=[url], models=[BASE])
+        reg = _registry(sd=sd)
+        try:
+            eng.lora_adapters["sql-expert"] = 1.0
+            await reg.scrape_once([url])
+            assert reg.is_resident(url, "sql-expert")
+            assert reg.base_model_of("sql-expert") == BASE
+            ep = sd.get_endpoint_info()[0]
+            assert ep.lora_adapters == ["sql-expert"]
+            assert ep.serves("sql-expert")
+
+            # The unload must propagate on the next scrape — this is
+            # the staleness bug the plane fixes.
+            del eng.lora_adapters["sql-expert"]
+            await reg.scrape_once([url])
+            assert not reg.is_resident(url, "sql-expert")
+            ep = sd.get_endpoint_info()[0]
+            assert ep.lora_adapters == []
+            assert not ep.serves("sql-expert")
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_ensure_resident_single_flight():
+    """N concurrent misses for the same (replica, adapter) collapse to
+    exactly one engine load RPC."""
+    from production_stack_tpu.testing.fake_engine import (
+        FakeEngine,
+        run_fake_engine,
+    )
+
+    async def run():
+        eng = FakeEngine(model=BASE, max_loras=3)
+        eng.lora_load_delay_s = 0.1
+        runner = await run_fake_engine(eng, "127.0.0.1", 0)
+        reg = _registry()
+        try:
+            results = await asyncio.gather(*[
+                reg.ensure_resident(eng.self_url, "sql-expert")
+                for _ in range(8)])
+            assert all(results)
+            assert eng.lora_loads == 1
+            assert reg.loads_total == 1
+            # Already-resident short-circuits without an RPC.
+            assert await reg.ensure_resident(eng.self_url, "sql-expert")
+            assert eng.lora_loads == 1
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_full_replica_lru_evicts_coldest():
+    """A load against a full slot table (engine 400) evicts the
+    least-recently-used adapter and retries — and touch() protects the
+    hot one."""
+    from production_stack_tpu.testing.fake_engine import (
+        FakeEngine,
+        run_fake_engine,
+    )
+
+    async def run():
+        eng = FakeEngine(model=BASE, max_loras=3)  # capacity 2
+        runner = await run_fake_engine(eng, "127.0.0.1", 0)
+        url = eng.self_url
+        reg = _registry()
+        try:
+            assert await reg.ensure_resident(url, "cold")
+            assert await reg.ensure_resident(url, "hot")
+            reg.touch(url, "cold")
+            reg.touch(url, "hot")
+            reg.touch(url, "cold")  # leaves "hot" as the LRU victim
+            reg.touch(url, "cold")
+            # Make cold genuinely newer than hot.
+            reg._residency[url].adapters["cold"] = \
+                reg._residency[url].adapters["hot"] + 1.0
+            assert await reg.ensure_resident(url, "third")
+            assert reg.evictions_total == 1
+            assert sorted(eng.lora_adapters) == ["cold", "third"]
+            assert not reg.is_resident(url, "hot")
+            # Eviction is capacity management, not retraction: the
+            # victim stays a known (reloadable) adapter.
+            assert "hot" in reg.known_adapters()
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_engine_slot_limit_and_unknown_model_404():
+    """Fake engine honors max_loras (400 on a full table, like the real
+    server) and 404s unknown models instead of silently serving base."""
+    from production_stack_tpu.testing.fake_engine import (
+        FakeEngine,
+        run_fake_engine,
+    )
+
+    async def run():
+        import aiohttp
+
+        eng = FakeEngine(model=BASE, max_loras=2)  # capacity 1
+        runner = await run_fake_engine(eng, "127.0.0.1", 0)
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(f"{eng.self_url}/v1/load_lora_adapter",
+                                 json={"lora_name": "a"})
+                assert r.status == 200
+                r = await s.post(f"{eng.self_url}/v1/load_lora_adapter",
+                                 json={"lora_name": "b"})
+                assert r.status == 400
+                body = await r.json()
+                assert "no free slots" in body["error"]["message"]
+                r = await s.post(
+                    f"{eng.self_url}/v1/chat/completions",
+                    json={"model": "never-loaded", "max_tokens": 2,
+                          "messages": [{"role": "user", "content": "hi"}]})
+                assert r.status == 404
+                body = await r.json()
+                assert body["error"]["type"] == "NotFoundError"
+                # The resident adapter serves.
+                r = await s.post(
+                    f"{eng.self_url}/v1/chat/completions",
+                    json={"model": "a", "max_tokens": 2,
+                          "messages": [{"role": "user", "content": "hi"}]})
+                assert r.status == 200
+                assert eng.lora_request_counts == {"a": 1}
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_fake_engine_prefix_cache_is_adapter_salted():
+    """A resident adapter's simulated prefix cache shares nothing with
+    the base model's for the same prompt text."""
+    from production_stack_tpu.testing.fake_engine import FakeEngine
+
+    eng = FakeEngine(model=BASE, max_loras=3)
+    eng.kv_controller_url = "http://unused"  # enables the prefix cache
+    eng.lora_adapters["sql-expert"] = 1.0
+    prompt = "p" * 400
+    body_base = {"model": BASE, "prompt": prompt}
+    body_lora = {"model": "sql-expert", "prompt": prompt}
+    assert not set(eng._prefix_hashes(body_base)) & \
+        set(eng._prefix_hashes(body_lora))
+    assert eng._prefix_hashes(body_base) == chunk_hashes(prompt)
+
+
+# --------------------------------------------------------------------- #
+# Router scenarios (real router, fake engines)
+# --------------------------------------------------------------------- #
+
+def _router_args(urls, lora_plane=True):
+    from production_stack_tpu.router.parser import build_parser
+
+    args = build_parser().parse_args([])
+    args.static_backends = ",".join(urls)
+    args.static_models = ",".join([BASE] * len(urls))
+    args.routing_logic = "roundrobin"
+    args.engine_stats_interval = 60
+    args.lora_plane = lora_plane
+    return args
+
+
+def test_router_unknown_adapter_404_and_debug_surface():
+    """Unknown adapter through the router: clean 404, no base-model
+    fallback. /debug/lora reports the plane state; /lora/load fans out
+    and the adapter then serves."""
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.testing.fake_engine import (
+        FakeEngine,
+        run_fake_engine,
+    )
+    from production_stack_tpu.testing.qos_ab import _reset_router_singletons
+
+    async def run():
+        import aiohttp
+
+        _reset_router_singletons()
+        engines = [FakeEngine(model=BASE, max_loras=3) for _ in range(2)]
+        runners = [await run_fake_engine(e, "127.0.0.1", 0)
+                   for e in engines]
+        router_runner, router_url = await _start(
+            build_app(_router_args([e.self_url for e in engines])))
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(
+                    f"{router_url}/v1/chat/completions",
+                    json={"model": "no-such-adapter", "max_tokens": 2,
+                          "messages": [{"role": "user", "content": "q"}]})
+                assert r.status == 404
+                body = await r.json()
+                assert body["error"]["type"] == "NotFoundError"
+                assert all(not e.requests_seen for e in engines)
+
+                r = await s.post(f"{router_url}/lora/load",
+                                 json={"lora_name": "sql-expert"})
+                assert r.status == 200
+                body = await r.json()
+                assert len(body["loaded"]) == 1
+
+                r = await s.get(f"{router_url}/debug/lora")
+                assert r.status == 200
+                snap = await r.json()
+                assert snap["adapters"]["sql-expert"] == body["loaded"]
+                assert snap["counters"]["loads"] == 1
+
+                r = await s.post(
+                    f"{router_url}/v1/chat/completions",
+                    json={"model": "sql-expert", "max_tokens": 2,
+                          "messages": [{"role": "user", "content": "q"}]})
+                assert r.status == 200
+                snap = await (await s.get(
+                    f"{router_url}/debug/lora")).json()
+                assert snap["counters"]["affinity_hits"] == 1
+
+                r = await s.post(f"{router_url}/lora/unload",
+                                 json={"lora_name": "sql-expert"})
+                assert r.status == 200
+                # Operator retraction: the adapter 404s again.
+                r = await s.post(
+                    f"{router_url}/v1/chat/completions",
+                    json={"model": "sql-expert", "max_tokens": 2,
+                          "messages": [{"role": "user", "content": "q"}]})
+                assert r.status == 404
+        finally:
+            await router_runner.cleanup()
+            for runner in runners:
+                await runner.cleanup()
+            _reset_router_singletons()
+
+    asyncio.run(run())
+
+
+def test_plane_off_parity():
+    """Without --lora-plane: state.lora is None, /debug/lora 404s, and
+    an unmatched model keeps the historical 400 reply."""
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.testing.fake_engine import (
+        FakeEngine,
+        run_fake_engine,
+    )
+    from production_stack_tpu.testing.qos_ab import _reset_router_singletons
+
+    async def run():
+        import aiohttp
+
+        _reset_router_singletons()
+        eng = FakeEngine(model=BASE)
+        runner = await run_fake_engine(eng, "127.0.0.1", 0)
+        app = build_app(_router_args([eng.self_url], lora_plane=False))
+        assert app["state"].lora is None
+        router_runner, router_url = await _start(app)
+        try:
+            async with aiohttp.ClientSession() as s:
+                assert (await s.get(f"{router_url}/debug/lora")).status == 404
+                r = await s.post(
+                    f"{router_url}/v1/chat/completions",
+                    json={"model": "nope", "max_tokens": 2,
+                          "messages": [{"role": "user", "content": "q"}]})
+                assert r.status == 400
+        finally:
+            await router_runner.cleanup()
+            await runner.cleanup()
+            _reset_router_singletons()
+
+    asyncio.run(run())
+
+
+def test_lora_ab_affinity_leg():
+    """The A/B harness's affinity-on leg: every request completes, the
+    hit rate is perfect after the prime, and nothing is evicted."""
+    from production_stack_tpu.testing.lora_ab import run_lora_ab
+
+    result = asyncio.run(run_lora_ab(
+        adapters=3, rounds=2, per_adapter=2, load_delay_s=0.05,
+        engine_ttft=0.0, skip_off=True))
+    on = result["affinity_on"]
+    assert on["failed"] == 0
+    assert on["affinity_hit_rate"] == 1.0
+    assert on["router_evictions"] == 0
+    assert result["affinity_off"] is None
