@@ -238,6 +238,21 @@ STRUCTURED_OUT = os.environ.get("BENCH_STRUCTURED_OUT",
 STRUCTURED_REQS = _env_int("BENCH_STRUCTURED_REQS", 8)
 STRUCTURED_MAX_TOKENS = _env_int("BENCH_STRUCTURED_MAX_TOKENS", 32)
 STRUCTURED_REPEATS = _env_int("BENCH_STRUCTURED_REPEATS", 3)
+# Draft-model speculation A/B: BENCH_SPEC_DRAFT=1 runs the
+# testing/spec_draft_ab.py harness on the real CPU engine — prompt
+# lookup vs a draft model on non-repetitive text (where lookup drafts
+# nothing), then the structured composition: the same
+# grammar-constrained JSON traffic with no speculation, with the
+# drafter FSM-ablated, and with the token FSM threaded into the
+# drafter. Writes BENCH_SPEC_DRAFT_OUT (default BENCH_SPEC_DRAFT_r20.json).
+# Acceptance: draft-model tokens-per-forward >= 1.3x prompt lookup on
+# the non-repetitive leg, structured+drafter beats structured-alone AND
+# drafter-alone, 0 failed requests every leg.
+SPEC_DRAFT = _env_int("BENCH_SPEC_DRAFT", 0)
+SPEC_DRAFT_OUT = os.environ.get("BENCH_SPEC_DRAFT_OUT",
+                                "BENCH_SPEC_DRAFT_r20.json")
+SPEC_DRAFT_MAX_TOKENS = _env_int("BENCH_SPEC_DRAFT_MAX_TOKENS", 32)
+SPEC_DRAFT_K = _env_int("BENCH_SPEC_DRAFT_K", 4)
 # LoRA adapter-plane A/B: BENCH_LORA=1 runs the hermetic noisy-neighbor
 # harness (testing/lora_ab.py) — 4 adapters + base across 3 fake
 # replicas with 2 adapter slots each, adapter-affinity pinning ON then
@@ -933,6 +948,19 @@ def _structured_main() -> None:
     print(json.dumps(result))
 
 
+def _spec_draft_main() -> None:
+    """BENCH_SPEC_DRAFT=1: draft-model speculation A/B on the real CPU
+    engine (tiny zoo models, one device)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from production_stack_tpu.testing.spec_draft_ab import run_spec_draft_ab
+
+    result = run_spec_draft_ab(max_tokens=SPEC_DRAFT_MAX_TOKENS,
+                               spec_tokens=SPEC_DRAFT_K)
+    result["backend"] = "cpu-engine"
+    _write_artifact(SPEC_DRAFT_OUT, result)
+    print(json.dumps(result))
+
+
 def _saturation_main() -> None:
     """BENCH_SATURATION=1: the router saturation harness. Fully hermetic
     (fake engines), so this branch never imports jax or touches a
@@ -1099,6 +1127,9 @@ def main() -> None:
         return
     if STRUCTURED:
         _structured_main()
+        return
+    if SPEC_DRAFT:
+        _spec_draft_main()
         return
     if SATURATION:
         _saturation_main()

@@ -81,6 +81,10 @@
 - --speculative-num-tokens
 - {{ .model.speculativeNumTokens | quote }}
 {{- end }}
+{{- if .model.speculativeDraftModel }}
+- --speculative-draft-model
+- {{ .model.speculativeDraftModel | quote }}
+{{- end }}
 {{- if .model.structuredCacheSize }}
 - --structured-cache-size
 - {{ .model.structuredCacheSize | quote }}

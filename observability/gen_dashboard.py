@@ -304,22 +304,26 @@ def build_dashboard():
              "pool, HTTP 503 + Retry-After)"))
     y += 7
 
-    # ---- Row 6: Speculative decoding (prompt-lookup drafts) ------------- #
+    # ---- Row 6: Speculative decoding (ngram / draft-model proposers) ---- #
     panels.append(row("Speculative Decoding", y)); y += 1
     panels.append(panel(
         "timeseries", "Draft tokens proposed (rate)",
-        [target("rate(tpu:spec_proposed_tokens_total[5m])",
-                legend="{{instance}}")],
+        [target("sum by(instance, source) "
+                "(rate(tpu:spec_proposed_tokens_total[5m]))",
+                legend="{{instance}}/{{source}}")],
         grid(7, 6, 0, y),
-        desc="Prompt-lookup draft tokens sent to verification per second "
-             "(--speculative-num-tokens)"))
+        desc="Draft tokens sent to verification per second, by proposer "
+             "(--speculative-num-tokens): source=\"ngram\" is host-side "
+             "prompt lookup, source=\"draft_model\" is the small-model "
+             "drafter (--speculative-draft-model)"))
     panels.append(panel(
         "timeseries", "Draft tokens accepted (rate)",
-        [target("rate(tpu:spec_accepted_tokens_total[5m])",
-                legend="{{instance}}")],
+        [target("sum by(instance, source) "
+                "(rate(tpu:spec_accepted_tokens_total[5m]))",
+                legend="{{instance}}/{{source}}")],
         grid(7, 6, 6, y),
         desc="Draft tokens that matched what plain decode would have "
-             "sampled — each one saved a forward pass"))
+             "sampled — each one saved a target forward pass"))
     panels.append(panel(
         "timeseries", "Draft acceptance rate",
         [target("tpu:spec_acceptance_rate", legend="{{instance}}")],
@@ -341,7 +345,23 @@ def build_dashboard():
                 legend="{{instance}}")],
         grid(7, 16, 0, y),
         desc="The speculation win: >1 means verify bursts are emitting "
-             "multiple tokens per forward pass (1.0 = plain decode)"))
+             "multiple tokens per TARGET forward pass (1.0 = plain "
+             "decode); draft-model forwards are excluded — the next "
+             "panel prices them"))
+    panels.append(panel(
+        "timeseries", "Draft-model forwards (rate)",
+        [target("rate(tpu:spec_draft_forward_steps_total[5m])",
+                legend="{{instance}} draft forwards"),
+         target('sum by(instance) (rate(tpu:spec_accepted_tokens_total'
+                '{source="draft_model"}[5m])) / '
+                "rate(tpu:spec_draft_forward_steps_total[5m])",
+                legend="{{instance}} accepted/draft-forward")],
+        grid(7, 8, 16, y),
+        desc="Small-model forwards spent producing proposals (catch-up "
+             "chunks + extension steps). The overlay divides accepted "
+             "target tokens by drafter forwards: scale it by the "
+             "target/draft per-forward cost ratio — above 1 the drafter "
+             "pays for itself"))
     y += 7
 
     # ---- Row 6b: Structured output (grammar-constrained decoding) ------- #

@@ -88,16 +88,44 @@ class EngineConfig:
     # so the default is OFF; the knob remains for decode-dominated
     # workloads with sparse arrivals.
     decode_steps_pressure: int = 0
-    # Prompt-lookup speculative decoding: each decode burst may verify a
-    # host-proposed draft (n-gram matched against the request's own
-    # prompt + generated tokens) in ONE batched forward pass instead of
-    # K sequential scan steps. The value is the verify width K: one
-    # burst consumes the last emitted token plus up to K-1 draft tokens
-    # and emits between 1 and K tokens. 0 disables (default).
+    # Speculative decoding: each decode burst may verify a proposed
+    # draft in ONE batched forward pass instead of K sequential scan
+    # steps. The value is the verify width K: one burst consumes the
+    # last emitted token plus up to K-1 draft tokens and emits between
+    # 1 and K tokens. 0 disables (default). Proposer selection: a draft
+    # MODEL when ``speculative_draft_model`` is set, host-side
+    # prompt-lookup (n-gram matched against the request's own prompt +
+    # generated tokens) otherwise. The verify program, acceptance rule,
+    # and rollback are proposer-agnostic — streams stay byte-identical
+    # to plain decode either way.
     speculative_num_tokens: int = 0
     # n-gram length matched against the request context to find a draft
-    # continuation (Saxena, "Prompt Lookup Decoding").
+    # continuation (Saxena, "Prompt Lookup Decoding"). Used only when no
+    # draft model is configured.
     speculative_ngram_size: int = 3
+    # Draft-model speculation: name of a zoo model (same vocab as the
+    # target; typically a much smaller family member, e.g. tpu-llama-1b
+    # drafting for Llama-3-8B) loaded alongside the target on the same
+    # mesh. It runs a compiled greedy K-step draft program against its
+    # own bf16 KV pages (a small pool sized for max_num_seqs worst-case
+    # sequences, carved out up front so it never competes with the
+    # target's auto-sized pool). Structured requests draft under the
+    # token-FSM mask — the drafter proposes only DFA-legal tokens,
+    # exactly the mask the verify pass applies.
+    speculative_draft_model: Optional[str] = None
+    # Ablation knob: thread each structured request's token FSM into
+    # the drafter (mask drafter logits exactly as verify masks the
+    # target's). Leave ON in production — off, the drafter proposes
+    # unconstrained tokens that verify rejects at the first
+    # out-of-grammar position, which is precisely the baseline the
+    # BENCH_SPEC_DRAFT composition leg measures.
+    speculative_draft_constrain: bool = True
+    # Per-request probation for a latched-off draft-model proposer:
+    # after the adaptive fallback disables drafting for a request, retry
+    # after this many plain bursts (draft quality varies by region of
+    # text, unlike prompt lookup whose miss is a property of the prompt
+    # — n-gram latches stay permanent). 0 = latch is permanent.
+    speculative_draft_probation: int = 64
     # Adaptive fallback: once at least ``speculative_accept_window``
     # draft tokens have been judged for a request, stop proposing for it
     # when the rolling acceptance rate is below this threshold — so
@@ -172,6 +200,13 @@ class EngineConfig:
                 "speculative_num_tokens must be 0 (off) or >= 2")
         if self.speculative_ngram_size < 1:
             raise ValueError("speculative_ngram_size must be >= 1")
+        if self.speculative_draft_model and self.speculative_num_tokens == 0:
+            raise ValueError(
+                "speculative_draft_model requires speculative_num_tokens "
+                ">= 2 (the drafter only proposes; the verify width must "
+                "be on)")
+        if self.speculative_draft_probation < 0:
+            raise ValueError("speculative_draft_probation must be >= 0")
         if self.structured_cache_size < 1:
             raise ValueError("structured_cache_size must be >= 1")
         if self.hbm_headroom_reserve < 0:

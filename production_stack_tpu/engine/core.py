@@ -319,6 +319,20 @@ class EngineCore:
         self.params = jax.jit(_init, out_shardings=self._param_shardings)()
         self._maybe_load_checkpoint()
 
+        # -- draft model (speculative decoding proposer) -------------------
+        # Built BEFORE the target's KV pool is sized: the drafter's
+        # params + fixed worst-case page pool come out of free HBM (the
+        # headroom reserve in sized deployments), so _auto_num_blocks
+        # naturally excludes them and the target pool never shrinks to
+        # accommodate drafts mid-flight. Every process constructs it
+        # (followers replay draft ops against their local shards).
+        self._draft = None
+        if config.speculative_draft_model:
+            from production_stack_tpu.engine.draft import DraftModel
+
+            self._draft = DraftModel(
+                config, self.mesh, self._repl, self.model_config)
+
         # -- KV pages ------------------------------------------------------
         if self._mh is not None and not self._mh.is_leader:
             # The pool size is a host-side decision that must agree across
@@ -384,6 +398,11 @@ class EngineCore:
             self.num_blocks, config.block_size, config.enable_prefix_caching,
             namespace=config.model,
         )
+        if self._draft is not None:
+            # Every teardown path (finish / preempt / abort / drain)
+            # frees target KV through kv_mgr.free — piggyback the
+            # drafter's page + frontier cleanup on it.
+            self.kv_mgr.on_free = self._draft.release
         self.scheduler = Scheduler(
             self.kv_mgr, config.max_num_seqs, config.max_model_len,
             chunked_prefill=config.chunked_prefill_enabled,
@@ -510,6 +529,14 @@ class EngineCore:
         self.spec_disabled_requests_total = 0
         self.spec_verify_bursts_total = 0
         self.decode_forward_steps_total = 0
+        # Per-proposer split of the proposed/accepted totals (exported
+        # as the source label on tpu:spec_{proposed,accepted}_tokens_total)
+        # and the drafter's own forward count — draft forwards are small-
+        # model steps, so they are NOT in decode_forward_steps_total (the
+        # tokens-per-TARGET-forward speedup metric).
+        self.spec_proposed_by_source = {"ngram": 0, "draft_model": 0}
+        self.spec_accepted_by_source = {"ngram": 0, "draft_model": 0}
+        self.spec_draft_forward_steps_total = 0
         # Structured output: compiled token-FSM cache (LRU, knob-sized)
         # and the tpu:structured_* counters. The packed mask row width is
         # fixed by the padded vocab so every program shares one shape.
@@ -685,7 +712,14 @@ class EngineCore:
                 16 << 30,
             )
         param_bytes = 0
-        for leaf in jax.tree_util.tree_leaves(self.params):
+        trees = [self.params]
+        draft = getattr(self, "_draft", None)
+        if draft is not None:
+            # The drafter's params AND its already-allocated page pool
+            # are resident before the target pool is sized.
+            trees.append(draft.params)
+            trees.append(draft.kv)
+        for leaf in jax.tree_util.tree_leaves(trees):
             try:
                 param_bytes += sum(
                     s.data.nbytes for s in leaf.addressable_shards
@@ -1259,6 +1293,17 @@ class EngineCore:
             fn = self._spec_verify_fn(static["K"])
             outs, self.kv = fn(self.params, self.kv, *arrays)
             return outs
+        if name == "draft_forward":
+            # Draft-model catch-up / FSM-constrained draft step: runs
+            # against the DRAFTER's params and pages — never compiles or
+            # touches a target-model program.
+            d = self._draft
+            out, d.kv = d.forward_fn(d.params, d.kv, *arrays)
+            return out
+        if name == "draft_scan":
+            d = self._draft
+            out, d.kv = d.scan_fn(d.params, d.kv, *arrays)
+            return out
         if name == "set_counts_row":
             self._token_counts = self._set_counts_row_fn(
                 self._token_counts, *arrays)
@@ -1928,12 +1973,20 @@ class EngineCore:
                     if maxb_w >= cfg.max_blocks_per_seq:
                         break
                     maxb_w *= 2
+            # Draft-model programs: the drafter's own bounded set (one
+            # catch-up forward per bucket + one greedy scan), compiled
+            # against the DRAFTER's params — zero new target variants.
+            n_draft = 0
+            if self._draft is not None:
+                gc.collect()  # phase boundary (see above)
+                n_draft = self._draft.warmup(self._mask_row_bytes)
         self.warmup_variants = {
             "prefill": n_prefill, "decode": n_decode, "spec": n_spec,
+            "draft": n_draft,
         }
         logger.info("Warmup compiled %d prefill + %d decode + %d spec-verify "
-                    "variants in %.1f s", n_prefill, n_decode, n_spec,
-                    time.time() - t0)
+                    "+ %d draft variants in %.1f s", n_prefill, n_decode,
+                    n_spec, n_draft, time.time() - t0)
 
     def add_request(
         self,
@@ -2337,6 +2390,10 @@ class EngineCore:
             "decode_forward_steps_total": self.decode_forward_steps_total,
             "spec_proposed_tokens_total": self.spec_proposed_tokens_total,
             "spec_accepted_tokens_total": self.spec_accepted_tokens_total,
+            "spec_proposed_by_source": dict(self.spec_proposed_by_source),
+            "spec_accepted_by_source": dict(self.spec_accepted_by_source),
+            "spec_draft_forward_steps_total":
+                self.spec_draft_forward_steps_total,
             "spec_disabled_requests_total": self.spec_disabled_requests_total,
             "spec_verify_bursts_total": self.spec_verify_bursts_total,
             "structured_requests_total": self.structured_requests_total,
@@ -3498,8 +3555,10 @@ class EngineCore:
         }
 
     def _propose_spec_drafts(self):
-        """Prompt-lookup drafting for the next burst. Returns a list of
-        ``(seq, draft)`` covering EVERY running row, or None.
+        """Drafting for the next burst. Returns a list of ``(seq, draft)``
+        covering EVERY running row, or None. Drafts come from the draft
+        model when one is configured, from host prompt lookup otherwise;
+        either way the verify burst that consumes the plan is identical.
 
         All-or-nothing: a verify burst replaces the whole batched decode
         step, so it only pays when every live row brings at least one
@@ -3511,30 +3570,233 @@ class EngineCore:
         text."""
         cfg = self.config
         K = cfg.speculative_num_tokens
+        use_draft = self._draft is not None
         with self._lock:
             active = [s for s in self.scheduler.running()
                       if self.scheduler.slots[s.slot] is s]
         if not active:
             return None
-        plan = []
+        rows = []
         for seq in active:
             r = seq.req
             if r.sampling.presence_penalty or r.sampling.frequency_penalty:
                 return None
             if r.spec is None:
-                r.spec = SpecState(cfg.speculative_ngram_size)
+                r.spec = SpecState(
+                    cfg.speculative_ngram_size,
+                    source="draft_model" if use_draft else "ngram",
+                    probation=(cfg.speculative_draft_probation
+                               if use_draft else 0),
+                )
             if r.spec.disabled:
-                return None
+                # Each plain burst the request sits out counts against a
+                # drafter's probation; an n-gram latch (probation 0)
+                # stays permanent.
+                r.spec.tick_probation()
+                if r.spec.disabled:
+                    return None
             allow = max(1, min(
                 K,
                 r.sampling.max_tokens - len(r.output_token_ids),
                 cfg.max_model_len - len(r.all_token_ids) + 1,
             ))
-            draft = (r.spec.propose(r.all_token_ids, allow - 1)
-                     if allow >= 2 else [])
+            if allow < 2:
+                return None
+            rows.append((seq, allow))
+        if use_draft:
+            return self._propose_draft_model(rows)
+        plan = []
+        for seq, allow in rows:
+            draft = seq.req.spec.propose(seq.req.all_token_ids, allow - 1)
             if not draft:
                 return None
             plan.append((seq, list(draft)))
+        return plan
+
+    def _propose_draft_model(self, rows):
+        """Batched draft-model proposal. Phase A catches the drafter's KV
+        up with every token it has not seen (the whole prompt right after
+        prefill, one verified suffix in steady state), chunked through
+        the warmed buckets, and takes the greedy next token at each row's
+        frontier as the first draft. Phase B extends to the full draft
+        width: one fused greedy scan when no row is FSM-masked, else
+        token-by-token forwards with each row's token-FSM mask applied to
+        the DRAFTER's logits — the same mask walk (local cursor, dead
+        state unmasks) the verify program applies, so constrained rows
+        draft only DFA-legal tokens. Returns a plan for _do_decode_spec,
+        or None to fall back to a plain burst."""
+        cfg = self.config
+        d = self._draft
+        B = cfg.max_num_seqs
+        bs = cfg.block_size
+        maxb = cfg.max_blocks_per_seq
+        info = []
+        with self._lock:
+            for seq, allow in rows:
+                r = seq.req
+                rid = r.request_id
+                n = len(r.all_token_ids)
+                # Worst-case feeds this burst: catch-up to n, then
+                # allow-2 draft-extension steps.
+                if not d.ensure_capacity(rid, n + allow - 2):
+                    return None  # drafter pool exhausted: plain burst
+                start = min(d.computed.get(rid, 0), n - 1)
+                st = (r.structured
+                      if self.config.speculative_draft_constrain else None)
+                info.append({
+                    "seq": seq, "rid": rid, "allow": allow, "n": n,
+                    "start": start,
+                    "feed": list(r.all_token_ids[start:]),
+                    "table": np.asarray(d.block_table(rid), np.int64),
+                    "st": st if (st is not None and st.masking) else None,
+                })
+        buckets = d.buckets()
+        maxW = buckets[-1]
+
+        def page_slots(table, positions):
+            return table[positions // bs] * bs + positions % bs
+
+        # -- phase A: chunked KV catch-up + first draft token ----------
+        drafts: list = [None] * len(info)
+        fed = [0] * len(info)
+        pending = set(range(len(info)))
+        while pending:
+            take = {i: min(len(info[i]["feed"]) - fed[i], maxW)
+                    for i in pending}
+            W = cfg.bucket_for(max(take.values()))
+            tokens_a = np.zeros((B, W), np.int32)
+            positions = np.zeros((B, W), np.int32)
+            slot_map = np.full((B, W), -1, np.int64)
+            tables = np.zeros((B, maxb), np.int32)
+            ctx = np.ones((B,), np.int32)
+            sl = np.ones((B,), np.int32)
+            mask_bits = np.zeros((B, self._mask_row_bytes), np.uint8)
+            mask_on = np.zeros((B,), bool)
+            done_now = []
+            for i in sorted(pending):
+                e = info[i]
+                b = e["seq"].slot
+                t = take[i]
+                lo = e["start"] + fed[i]
+                span = np.arange(lo, lo + t, dtype=np.int64)
+                tokens_a[b, :t] = e["feed"][fed[i]:fed[i] + t]
+                positions[b, :t] = span
+                slot_map[b, :t] = page_slots(e["table"], span)
+                use = min(len(e["table"]), maxb)
+                tables[b, :use] = e["table"][:use]
+                ctx[b] = lo + t
+                sl[b] = t
+                fed[i] += t
+                if lo + t == e["n"]:
+                    # This round produces the row's first draft; mask it
+                    # with the request's CURRENT automaton state — the
+                    # same mask the verify program applies at position 0.
+                    done_now.append(i)
+                    if e["st"] is not None and e["st"].state >= 0:
+                        mask_bits[b] = e["st"].mask_row()
+                        mask_on[b] = True
+            out = self._dispatch("draft_forward", {"bucket": W}, [
+                tokens_a, positions, slot_map, tables, ctx, sl,
+                mask_bits, mask_on])
+            self.spec_draft_forward_steps_total += 1
+            toks = np.asarray(jax.device_get(_unwrap_fused(out)))
+            for i in done_now:
+                drafts[i] = [int(toks[info[i]["seq"].slot])]
+                pending.discard(i)
+
+        # -- phase B: extend to the full draft width -------------------
+        steps_max = max(e["allow"] for e in info) - 2
+        any_masked = any(e["st"] is not None for e in info)
+        if steps_max >= 1 and not any_masked:
+            S = cfg.speculative_num_tokens - 2
+            token0 = np.zeros((B,), np.int32)
+            positions0 = np.zeros((B,), np.int32)
+            slot_mat = np.full((B, S), -1, np.int64)
+            tables = np.zeros((B, maxb), np.int32)
+            ctx0 = np.ones((B,), np.int32)
+            for i, e in enumerate(info):
+                b = e["seq"].slot
+                token0[b] = drafts[i][0]
+                positions0[b] = e["n"]
+                ctx0[b] = e["n"] + 1
+                t = e["allow"] - 2
+                if t > 0:
+                    span = np.arange(e["n"], e["n"] + t, dtype=np.int64)
+                    slot_mat[b, :t] = page_slots(e["table"], span)
+                use = min(len(e["table"]), maxb)
+                tables[b, :use] = e["table"][:use]
+            out = self._dispatch("draft_scan", {}, [
+                token0, positions0, slot_mat, tables, ctx0])
+            self.spec_draft_forward_steps_total += S
+            toks = np.asarray(jax.device_get(_unwrap_fused(out)))
+            for i, e in enumerate(info):
+                b = e["seq"].slot
+                drafts[i].extend(
+                    int(x) for x in toks[b, :e["allow"] - 2])
+        elif steps_max >= 1:
+            # FSM-constrained drafting: step token by token so each
+            # masked row's mask reflects the tokens drafted so far. A
+            # LOCAL cursor walks the automaton exactly like the
+            # verify-side mask walk (the request's real state advances
+            # only at emission); once the cursor leaves the language the
+            # remaining positions draft unmasked, mirroring the verify
+            # walk's break.
+            W0 = buckets[0]
+            cur = []
+            for i, e in enumerate(info):
+                c = e["st"].state if e["st"] is not None else -1
+                if c >= 0:
+                    c = e["st"].fsm.advance(c, drafts[i][0])
+                cur.append(c)
+            for s in range(1, steps_max + 1):
+                live = [i for i, e in enumerate(info)
+                        if e["allow"] - 1 > s]
+                if not live:
+                    break
+                tokens_a = np.zeros((B, W0), np.int32)
+                positions = np.zeros((B, W0), np.int32)
+                slot_map = np.full((B, W0), -1, np.int64)
+                tables = np.zeros((B, maxb), np.int32)
+                ctx = np.ones((B,), np.int32)
+                sl = np.ones((B,), np.int32)
+                mask_bits = np.zeros((B, self._mask_row_bytes), np.uint8)
+                mask_on = np.zeros((B,), bool)
+                for i in live:
+                    e = info[i]
+                    b = e["seq"].slot
+                    p = e["n"] + s - 1
+                    tokens_a[b, 0] = drafts[i][s - 1]
+                    positions[b, 0] = p
+                    slot_map[b, 0] = (
+                        int(e["table"][p // bs]) * bs + p % bs)
+                    ctx[b] = p + 1
+                    sl[b] = 1
+                    use = min(len(e["table"]), maxb)
+                    tables[b, :use] = e["table"][:use]
+                    if e["st"] is not None and cur[i] >= 0:
+                        mask_bits[b] = e["st"].fsm.mask_row(cur[i])
+                        mask_on[b] = True
+                out = self._dispatch("draft_forward", {"bucket": W0}, [
+                    tokens_a, positions, slot_map, tables, ctx, sl,
+                    mask_bits, mask_on])
+                self.spec_draft_forward_steps_total += 1
+                toks = np.asarray(jax.device_get(_unwrap_fused(out)))
+                for i in live:
+                    e = info[i]
+                    tok = int(toks[e["seq"].slot])
+                    drafts[i].append(tok)
+                    if e["st"] is not None and cur[i] >= 0:
+                        cur[i] = e["st"].fsm.advance(cur[i], tok)
+
+        # -- bookkeeping + plan ----------------------------------------
+        plan = []
+        with self._lock:
+            for i, e in enumerate(info):
+                dr = drafts[i][:e["allow"] - 1]
+                # Drafter KV now covers the request's n tokens plus the
+                # drafts it fed back (all but the last drafted token).
+                d.computed[e["rid"]] = e["n"] + len(dr) - 1
+                plan.append((e["seq"], dr))
         return plan
 
     def _do_decode_spec(self, plan) -> None:
@@ -3742,6 +4004,7 @@ class EngineCore:
         cfg = self.config
         emitted_seqs = []
         rollbacks = []
+        draft_rollbacks = []
         for seq in pending["active"]:
             r = seq.req
             allow = pending["allows"].get(r.request_id, 1)
@@ -3769,11 +4032,25 @@ class EngineCore:
             self.generation_tokens_total += emitted
             self.spec_proposed_tokens_total += len(draft)
             self.spec_accepted_tokens_total += j
+            source = r.spec.source if r.spec is not None else "ngram"
+            self.spec_proposed_by_source[source] = (
+                self.spec_proposed_by_source.get(source, 0) + len(draft))
+            self.spec_accepted_by_source[source] = (
+                self.spec_accepted_by_source.get(source, 0) + j)
             if r.spec is not None and r.spec.judge(
                     len(draft), j, cfg.speculative_accept_window,
                     cfg.speculative_accept_threshold):
                 self.spec_disabled_requests_total += 1
             rollbacks.append((r.request_id, allow - emitted))
+            if self._draft is not None:
+                # The drafter fed len(draft)-1 draft tokens past the
+                # request's pre-burst length n; keep the accepted ones
+                # (all fed drafts when the whole draft landed) and roll
+                # the rejected positions' pages back.
+                n_before = len(r.all_token_ids) - emitted
+                draft_rollbacks.append(
+                    (r.request_id,
+                     n_before + min(j, max(len(draft) - 1, 0))))
             if emitted and self.scheduler.slots[seq.slot] is seq:
                 emitted_seqs.append(seq)
         with self._lock:
@@ -3782,6 +4059,8 @@ class EngineCore:
                 # each decode/verify step writes its own position before
                 # any attention can read it.
                 self.kv_mgr.rollback_tokens(rid, n)
+            for rid, keep in draft_rollbacks:
+                self._draft.truncate(rid, keep)
             for seq in emitted_seqs:
                 self.kv_mgr.register_decode_blocks(
                     seq.req.request_id, seq.req.all_token_ids

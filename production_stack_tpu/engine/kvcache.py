@@ -177,6 +177,11 @@ class KVCacheManager:
         # can be restored into HBM by the engine (see allocate_prompt's
         # ``restores`` return).
         self.external_lookup = None
+        # Called as on_free(seq_id) after a sequence's blocks are released
+        # — every teardown path (finish, preempt, abort, drain) funnels
+        # through free(), so a companion allocator (the speculative
+        # drafter's KV pool) hooks here to drop its mirror state.
+        self.on_free = None
 
     def chain_root(self, adapter: str = "") -> "str | None":
         """Root value for the prefix hash chain. Adapter names (stable
@@ -394,6 +399,8 @@ class KVCacheManager:
             return
         for bid in seq.block_ids:
             self.allocator.release(bid)
+        if self.on_free is not None:
+            self.on_free(seq_id)
 
     def block_table(self, seq_id: str) -> List[int]:
         return self.seqs[seq_id].block_ids

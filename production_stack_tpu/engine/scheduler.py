@@ -59,28 +59,39 @@ def priority_label(priority: int) -> str:
 
 
 class SpecState:
-    """Per-request prompt-lookup speculative-decode state.
+    """Per-request speculative-decode proposer state.
 
-    Holds the host-side n-gram index over prompt + generated tokens
+    ``source`` names the proposer ("ngram" for host prompt lookup,
+    "draft_model" for the small-model drafter). For prompt lookup it
+    holds the host-side n-gram index over prompt + generated tokens
     (n-gram tuple -> its latest start position, grown incrementally as
-    tokens arrive) and the acceptance stats behind the adaptive
-    fallback: once ``proposed`` reaches the configured window with an
-    acceptance rate below the threshold, the request latches
-    ``disabled`` and reverts to plain decode bursts for its remaining
-    lifetime. The index survives preemption untouched — positions are
-    absolute in ``all_token_ids``, which re-prefill reproduces exactly.
+    tokens arrive); either way it carries the acceptance stats behind
+    the adaptive fallback: once ``proposed`` reaches the configured
+    window with an acceptance rate below the threshold, the request
+    latches ``disabled`` and reverts to plain decode bursts. For prompt
+    lookup the latch is permanent (a miss is a property of the prompt);
+    a draft model gets ``probation`` — after that many plain bursts the
+    latch lifts and the acceptance window restarts, since draft quality
+    varies by region of text. The index survives preemption untouched —
+    positions are absolute in ``all_token_ids``, which re-prefill
+    reproduces exactly.
     """
 
     __slots__ = ("ngram", "index", "indexed_upto",
-                 "proposed", "accepted", "disabled")
+                 "proposed", "accepted", "disabled",
+                 "source", "probation", "disabled_bursts")
 
-    def __init__(self, ngram: int):
+    def __init__(self, ngram: int, source: str = "ngram",
+                 probation: int = 0):
         self.ngram = ngram
         self.index: Dict[tuple, int] = {}
         self.indexed_upto = 0
         self.proposed = 0
         self.accepted = 0
         self.disabled = False
+        self.source = source
+        self.probation = probation
+        self.disabled_bursts = 0
 
     def propose(self, tokens: List[int], max_draft: int) -> List[int]:
         """Draft up to ``max_draft`` tokens: index any new n-grams, then
@@ -108,8 +119,25 @@ class SpecState:
         if (not self.disabled and self.proposed >= window
                 and self.accepted < threshold * self.proposed):
             self.disabled = True
+            self.disabled_bursts = 0
             return True
         return False
+
+    def tick_probation(self) -> bool:
+        """Count one plain (non-speculative) burst against a latched
+        proposer's probation. Returns True when the latch lifts — the
+        acceptance stats reset so the proposer gets a fresh window
+        instead of being re-judged on the history that latched it."""
+        if not self.disabled or self.probation <= 0:
+            return False
+        self.disabled_bursts += 1
+        if self.disabled_bursts < self.probation:
+            return False
+        self.disabled = False
+        self.disabled_bursts = 0
+        self.proposed = 0
+        self.accepted = 0
+        return True
 
 
 class RequestStatus(enum.Enum):

@@ -2489,14 +2489,24 @@ class EngineServer:
             "# TYPE tpu:batched_token_utilization gauge",
             f"tpu:batched_token_utilization{{{labels}}} "
             f"{s.get('batched_token_utilization', 0.0):.6f}",
-            # Speculative decoding (--speculative-num-tokens): prompt-lookup
-            # drafts verified in single-pass batched bursts.
+            # Speculative decoding (--speculative-num-tokens): drafts
+            # (prompt-lookup n-grams, or a draft model when
+            # --speculative-draft-model is set) verified in single-pass
+            # batched bursts. proposed/accepted split by proposer via
+            # the source label; both label values always emitted so
+            # rate() never sees a vanishing series.
             "# TYPE tpu:spec_proposed_tokens counter",
-            f"tpu:spec_proposed_tokens_total{{{labels}}} "
-            f"{s.get('spec_proposed_tokens_total', 0)}",
+            f'tpu:spec_proposed_tokens_total{{{labels},source="ngram"}} '
+            f"{s.get('spec_proposed_by_source', {}).get('ngram', 0)}",
+            f'tpu:spec_proposed_tokens_total{{{labels},'
+            f'source="draft_model"}} '
+            f"{s.get('spec_proposed_by_source', {}).get('draft_model', 0)}",
             "# TYPE tpu:spec_accepted_tokens counter",
-            f"tpu:spec_accepted_tokens_total{{{labels}}} "
-            f"{s.get('spec_accepted_tokens_total', 0)}",
+            f'tpu:spec_accepted_tokens_total{{{labels},source="ngram"}} '
+            f"{s.get('spec_accepted_by_source', {}).get('ngram', 0)}",
+            f'tpu:spec_accepted_tokens_total{{{labels},'
+            f'source="draft_model"}} '
+            f"{s.get('spec_accepted_by_source', {}).get('draft_model', 0)}",
             "# TYPE tpu:spec_acceptance_rate gauge",
             f"tpu:spec_acceptance_rate{{{labels}}} {spec_rate:.6f}",
             "# TYPE tpu:spec_disabled_requests counter",
@@ -2505,6 +2515,12 @@ class EngineServer:
             "# TYPE tpu:spec_verify_bursts counter",
             f"tpu:spec_verify_bursts_total{{{labels}}} "
             f"{s.get('spec_verify_bursts_total', 0)}",
+            # Draft-model forwards behind the proposals (small-model
+            # steps; NOT in decode_forward_steps_total, which counts
+            # target-model forwards only).
+            "# TYPE tpu:spec_draft_forward_steps counter",
+            f"tpu:spec_draft_forward_steps_total{{{labels}}} "
+            f"{s.get('spec_draft_forward_steps_total', 0)}",
             "# TYPE tpu:decode_forward_steps counter",
             f"tpu:decode_forward_steps_total{{{labels}}} "
             f"{s.get('decode_forward_steps_total', 0)}",
@@ -2772,13 +2788,27 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "(requires --enable-chunked-prefill; compiles "
                         "zero new variants)")
     p.add_argument("--speculative-num-tokens", type=int, default=0,
-                   help="prompt-lookup speculative decoding: verify up to "
-                        "this many tokens per forward pass (the drafts come "
-                        "from an n-gram index over each request's own "
-                        "prompt+output; 0 disables)")
+                   help="speculative decoding: verify up to this many "
+                        "tokens per forward pass; 0 disables. Drafts come "
+                        "from the draft model when "
+                        "--speculative-draft-model is set, otherwise from "
+                        "prompt lookup (an n-gram index over each "
+                        "request's own prompt+output)")
     p.add_argument("--speculative-ngram-size", type=int, default=3,
                    help="n-gram length matched by the prompt-lookup "
-                        "draft index")
+                        "draft index (ignored when a draft model is "
+                        "configured)")
+    p.add_argument("--speculative-draft-model", default=None,
+                   help="zoo model that drafts for the target (same "
+                        "vocab; e.g. tpu-llama-1b drafting for "
+                        "Llama-3-8B). Shares the mesh, runs its own "
+                        "greedy draft programs against its own bf16 KV "
+                        "pages; replaces the prompt-lookup proposer")
+    p.add_argument("--speculative-draft-probation", type=int, default=64,
+                   help="plain bursts after which a request whose "
+                        "draft-model speculation was adaptively latched "
+                        "off retries drafting (0 = latch is permanent, "
+                        "as prompt-lookup latches always are)")
     p.add_argument("--structured-cache-size", type=int, default=32,
                    help="LRU capacity of the compiled structured-output "
                         "token-FSM cache (one entry per distinct "
@@ -2901,6 +2931,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         seed=args.seed,
         speculative_num_tokens=args.speculative_num_tokens,
         speculative_ngram_size=args.speculative_ngram_size,
+        speculative_draft_model=args.speculative_draft_model,
+        speculative_draft_probation=args.speculative_draft_probation,
         structured_cache_size=args.structured_cache_size,
         kv_offload_bytes=int(args.kv_offload_gb * (1 << 30)),
         kv_remote_url=args.kv_remote_url,

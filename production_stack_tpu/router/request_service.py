@@ -903,7 +903,8 @@ async def route_general_request(
                         slo_outcome = slo.latency_outcome(
                             tenant.name if tenant else None,
                             requested_model,
-                            ttft_s=ttft_s, inter_token_s=inter_s)
+                            ttft_s=ttft_s, inter_token_s=inter_s,
+                            base_model=lora_base)
 
             # Post-request hooks: semantic cache store + callbacks (reference :129-137).
             if state.semantic_cache is not None and endpoint.endswith("chat/completions"):

@@ -114,8 +114,22 @@ class SLOEngine:
     # -- objectives -------------------------------------------------------
 
     def objectives(self, tenant: Optional[str] = None,
-                   model: Optional[str] = None) -> dict:
+                   model: Optional[str] = None,
+                   base_model: Optional[str] = None) -> dict:
+        """Resolve objectives: default < models[base_model] <
+        models[model] < tenants[tenant].
+
+        ``model`` is what the request named — for LoRA traffic that is
+        the ADAPTER name, and ``base_model`` is the model it decorates.
+        An adapter entry under ``models:`` therefore overrides its base
+        model's entry (an adapter serving a latency-tolerant fine-tune
+        can relax the base's bound, or tighten it), while adapters
+        without their own entry inherit the base model's objectives
+        instead of falling back to the default.
+        """
         out = dict(self.default)
+        if base_model and base_model != model and base_model in self.models:
+            out.update(self.models[base_model])
         if model and model in self.models:
             out.update(self.models[model])
         if tenant and tenant in self.tenants:
@@ -128,9 +142,10 @@ class SLOEngine:
         model: Optional[str],
         ttft_s: Optional[float] = None,
         inter_token_s: Optional[float] = None,
+        base_model: Optional[str] = None,
     ) -> str:
         """``ok`` or ``slow`` for a request that completed successfully."""
-        obj = self.objectives(tenant, model)
+        obj = self.objectives(tenant, model, base_model=base_model)
         bound = obj.get("ttft_p99_s", 0.0)
         if ttft_s is not None and bound > 0 and ttft_s > bound:
             return "slow"

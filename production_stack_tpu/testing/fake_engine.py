@@ -84,10 +84,15 @@ class FakeEngine:
         self.prefill_chunks = max(prefill_chunks, 1)
         self.prefill_chunks_total = 0
         # Speculative-decoding counters (static here: the fake engine does
-        # no real drafting, it just exposes the tpu:spec_* scrape surface).
+        # no real drafting, it just exposes the tpu:spec_* scrape surface,
+        # including the per-proposer source split and the draft-model
+        # forward counter).
         self.spec_proposed_tokens_total = 0
         self.spec_accepted_tokens_total = 0
         self.spec_disabled_requests_total = 0
+        self.spec_proposed_by_source = {"ngram": 0, "draft_model": 0}
+        self.spec_accepted_by_source = {"ngram": 0, "draft_model": 0}
+        self.spec_draft_forward_steps_total = 0
         # Structured output: compiled like the real engine (same
         # parse/compile path) but "generation" is the DFA's example
         # string, so router e2e conformance runs hermetically on CPU.
@@ -742,14 +747,23 @@ class FakeEngine:
             "# TYPE tpu:prefill_chunks counter\n"
             f"tpu:prefill_chunks_total {self.prefill_chunks_total}\n"
             "# TYPE tpu:spec_proposed_tokens counter\n"
-            f"tpu:spec_proposed_tokens_total {self.spec_proposed_tokens_total}\n"
+            f'tpu:spec_proposed_tokens_total{{source="ngram"}} '
+            f"{self.spec_proposed_by_source['ngram']}\n"
+            f'tpu:spec_proposed_tokens_total{{source="draft_model"}} '
+            f"{self.spec_proposed_by_source['draft_model']}\n"
             "# TYPE tpu:spec_accepted_tokens counter\n"
-            f"tpu:spec_accepted_tokens_total {self.spec_accepted_tokens_total}\n"
+            f'tpu:spec_accepted_tokens_total{{source="ngram"}} '
+            f"{self.spec_accepted_by_source['ngram']}\n"
+            f'tpu:spec_accepted_tokens_total{{source="draft_model"}} '
+            f"{self.spec_accepted_by_source['draft_model']}\n"
             "# TYPE tpu:spec_acceptance_rate gauge\n"
             f"tpu:spec_acceptance_rate "
             f"{(self.spec_accepted_tokens_total / self.spec_proposed_tokens_total) if self.spec_proposed_tokens_total else 0.0}\n"
             "# TYPE tpu:spec_disabled_requests counter\n"
             f"tpu:spec_disabled_requests_total {self.spec_disabled_requests_total}\n"
+            "# TYPE tpu:spec_draft_forward_steps counter\n"
+            f"tpu:spec_draft_forward_steps_total "
+            f"{self.spec_draft_forward_steps_total}\n"
             "# TYPE tpu:structured_requests counter\n"
             f"tpu:structured_requests_total {self.structured_requests_total}\n"
             "# TYPE tpu:structured_violations counter\n"

@@ -346,6 +346,56 @@ def test_committed_lora_ab_artifact_schema():
         assert leg["engine_unloads"] == leg["router_evictions"]
 
 
+def test_committed_spec_draft_ab_artifact_schema():
+    """The committed draft-model speculation A/B (r20) carries the
+    tentpole's acceptance numbers: on non-repetitive text (where prompt
+    lookup drafts nothing) the draft model delivers >= 1.3x
+    tokens-per-forward; on the same grammar-constrained JSON traffic
+    the FSM-threaded drafter beats both structured-alone (no
+    speculation) and drafter-alone (FSM-threading ablated, so verify
+    rejects out-of-grammar drafts); zero failed requests in every
+    leg."""
+    data = json.load(open(os.path.join(REPO, "BENCH_SPEC_DRAFT_r20.json")))
+    assert data["metric"] == "spec_draft_ab"
+    assert data["unit"] == "tokens_per_forward_ratio"
+    assert data["meta"]["schema"] == 1
+    assert data["backend"] == "cpu-engine"
+    assert data["failed_requests"] == 0
+
+    nonrep = data["nonrepetitive"]
+    ng, dm = nonrep["prompt_lookup"], nonrep["draft_model"]
+    for leg in (ng, dm):
+        assert leg["failed_requests"] == 0
+        assert leg["generated_tokens"] > 0
+    # Prompt lookup found nothing to propose on text with no repeats;
+    # the drafter proposed (and proposed from the right source).
+    assert dm["spec_proposed_by_source"]["draft_model"] > 0
+    assert dm["spec_proposed_by_source"]["ngram"] == 0
+    assert dm["spec_draft_forward_steps"] > 0
+    # Acceptance bar: >= 1.3x tokens per TARGET forward.
+    assert data["value"] == nonrep["tokens_per_forward_ratio"]
+    assert data["value"] >= 1.3
+    assert dm["tokens_per_forward"] \
+        >= 1.3 * ng["tokens_per_forward"]
+
+    st = data["structured_json"]
+    legs = (st["structured_alone"], st["drafter_alone"],
+            st["structured_drafter"])
+    for leg in legs:
+        assert leg["failed_requests"] == 0
+    # Composition bar: the FSM-threaded drafter beats structured-alone
+    # (speculation re-widens one-step-per-burst rows) AND the ablated
+    # drafter (whose unconstrained drafts die at the first
+    # out-of-grammar position).
+    assert st["beats_structured_alone"] is True
+    assert st["beats_drafter_alone"] is True
+    assert st["structured_drafter"]["tokens_per_forward"] \
+        > st["structured_alone"]["tokens_per_forward"]
+    assert st["structured_drafter"]["tokens_per_forward"] \
+        > st["drafter_alone"]["tokens_per_forward"]
+    assert st["structured_violations"] == 0
+
+
 def test_plot_table(tmp_path, monkeypatch):
     spec = importlib.util.spec_from_file_location(
         "bench_plot", os.path.join(REPO, "benchmarks", "plot.py"))

@@ -243,3 +243,36 @@ def test_spec_verify_compile_budget():
         assert not off._spec_verify_fns
     finally:
         off.stop()
+
+
+def test_draft_model_compile_budget():
+    """A draft model must not widen the TARGET's compiled surface: the
+    prefill/decode/spec variant counts and the verify-program family
+    are byte-identical to a drafter-free engine — everything the
+    drafter compiles lands in its own bounded ``draft`` bucket (one
+    forward per catch-up span bucket, plus one scan when K > 2)."""
+    base = make_engine(speculative_num_tokens=4, max_loras=0)
+    try:
+        base.warmup()
+        wv_base = dict(base.warmup_variants)
+        n_verify_base = len(base._spec_verify_fns)
+    finally:
+        base.stop()
+
+    eng = make_engine(speculative_num_tokens=4, max_loras=0,
+                      speculative_draft_model="tiny-llama")
+    try:
+        eng.warmup()
+        wv = eng.warmup_variants
+        # Drafter programs exist and are bounded: one forward variant
+        # per warmed span bucket + exactly one scan (K=4 > 2).
+        assert wv["draft"] == len(eng._draft.buckets()) + 1, wv
+        # Zero new target variants.
+        for kind in ("prefill", "decode", "spec"):
+            assert wv[kind] == wv_base[kind], (kind, wv, wv_base)
+        assert len(eng._spec_verify_fns) == n_verify_base
+    finally:
+        eng.stop()
+
+    # Drafter off → no draft bucket entries at all.
+    assert wv_base["draft"] == 0

@@ -474,6 +474,39 @@ def test_speculative_num_tokens_plumbs_into_engine_command():
     assert "--speculative-num-tokens" not in bcmd
 
 
+def test_speculative_draft_model_plumbs_into_engine_command():
+    """speculativeDraftModel renders as --speculative-draft-model next to
+    the num-tokens knob (and stays absent when unset), and the schema
+    accepts the string."""
+    import copy
+    import json
+
+    import jsonschema
+
+    values = copy.deepcopy(load_values(CHART, os.path.join(
+        CHART, "examples", "values-01-minimal.yaml")))
+    spec = values["servingEngineSpec"]["modelSpec"][0]
+    spec["speculativeNumTokens"] = 4
+    spec["speculativeDraftModel"] = "tpu-llama-1b"
+    with open(os.path.join(CHART, "values.schema.json")) as f:
+        jsonschema.validate(values, json.load(f))
+
+    rendered = MiniHelm(CHART).render(values)
+    deps = [d for d in _docs(rendered, "Deployment")
+            if d["metadata"]["name"].endswith("-engine")]
+    assert deps, "engine deployment missing"
+    cmd = deps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--speculative-draft-model" in cmd
+    assert cmd[cmd.index("--speculative-draft-model") + 1] == "tpu-llama-1b"
+
+    base = _render(os.path.join(CHART, "examples",
+                                "values-01-minimal.yaml"))
+    bdeps = [d for d in _docs(base, "Deployment")
+             if d["metadata"]["name"].endswith("-engine")]
+    bcmd = bdeps[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--speculative-draft-model" not in bcmd
+
+
 def test_qos_tenants_render_configmap_and_router_flags():
     """routerSpec.qos.enabled renders the tenants ConfigMap, mounts it
     at /etc/qos, and passes --qos-* flags to the router; disabled (the

@@ -52,6 +52,47 @@ def test_objectives_precedence_tenant_beats_model_beats_default():
     assert eng.objectives(model="big")["inter_token_p99_s"] == 0.5
 
 
+def test_objectives_adapter_entry_beats_base_model_entry():
+    eng = SLOEngine({
+        "default": {"ttft_p99_s": 2.0, "inter_token_p99_s": 0.5},
+        "models": {"base-8b": {"ttft_p99_s": 5.0},
+                   "sql-adapter": {"ttft_p99_s": 1.5}},
+        "tenants": {"premium": {"ttft_p99_s": 1.0}},
+    })
+    # Adapter traffic names the adapter as ``model``; its own entry
+    # wins over the base model's.
+    obj = eng.objectives(model="sql-adapter", base_model="base-8b")
+    assert obj["ttft_p99_s"] == 1.5
+    # An adapter WITHOUT its own entry inherits the base model's
+    # objectives instead of the default.
+    obj = eng.objectives(model="other-adapter", base_model="base-8b")
+    assert obj["ttft_p99_s"] == 5.0
+    # Non-overridden keys still fall through to the default.
+    assert obj["inter_token_p99_s"] == 0.5
+    # Tenant override beats both.
+    obj = eng.objectives(tenant="premium", model="sql-adapter",
+                         base_model="base-8b")
+    assert obj["ttft_p99_s"] == 1.0
+    # Non-LoRA traffic: base_model is None (or equals model) — exactly
+    # the old resolution.
+    assert eng.objectives(model="base-8b")["ttft_p99_s"] == 5.0
+    assert eng.objectives(
+        model="base-8b", base_model="base-8b")["ttft_p99_s"] == 5.0
+
+
+def test_latency_outcome_uses_adapter_resolution():
+    eng = SLOEngine({
+        "default": {"ttft_p99_s": 2.0},
+        "models": {"base-8b": {"ttft_p99_s": 5.0},
+                   "sql-adapter": {"ttft_p99_s": 0.5}},
+    })
+    # 1s TTFT: fine for the base model, a violation for the adapter.
+    assert eng.latency_outcome(
+        None, "other-adapter", ttft_s=1.0, base_model="base-8b") == "ok"
+    assert eng.latency_outcome(
+        None, "sql-adapter", ttft_s=1.0, base_model="base-8b") == "slow"
+
+
 def test_objectives_config_junk_is_ignored_not_fatal():
     eng = SLOEngine({
         "default": {"ttft_p99_s": "fast", "unknown_knob": 3,

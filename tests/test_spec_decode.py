@@ -241,6 +241,172 @@ def test_spec_adversarial_latch_never_below_plain():
 
 
 # ---------------------------------------------------------------------------
+# Draft-model proposer (--speculative-draft-model)
+# ---------------------------------------------------------------------------
+
+
+def test_draft_model_streams_equal_greedy():
+    """tiny-llama drafting for tiny-llama (same seed -> identical
+    weights): greedy drafts are always right, every burst accepts in
+    full, and the streams are byte-identical to plain decode. A
+    non-repetitive prompt is included so the drafts demonstrably come
+    from the model, not from prompt lookup."""
+    reqs = [
+        ([5, 6, 7, 8] * 6, greedy(24)),
+        ([31, 7, 2, 19, 44, 3, 28, 11], greedy(24)),  # no repeated n-grams
+    ]
+    ref = make_engine(**SPEC_CFG)
+    try:
+        expected = run(ref, reqs)
+        ref_tpf = tokens_per_forward(ref)
+    finally:
+        ref.stop()
+    eng = make_engine(speculative_num_tokens=4,
+                      speculative_draft_model="tiny-llama", **SPEC_CFG)
+    try:
+        got = run(eng, reqs)
+        assert eng.spec_proposed_by_source["draft_model"] > 0
+        assert eng.spec_proposed_by_source["ngram"] == 0, (
+            "a configured draft model must replace prompt lookup")
+        assert (eng.spec_accepted_by_source["draft_model"]
+                == eng.spec_proposed_by_source["draft_model"]), (
+            "an identical drafter must have every draft accepted")
+        assert eng.spec_draft_forward_steps_total > 0
+        # Drafter forwards are small-model steps and must NOT count as
+        # decode forwards — the target-side win stays visible.
+        assert tokens_per_forward(eng) >= 1.3 * ref_tpf
+    finally:
+        eng.stop()
+    assert got == expected
+
+
+def test_draft_model_mispredicting_latch_and_probation():
+    """tiny-mixtral drafting for tiny-llama (different arch and
+    weights) at temperature 1.0: drafts rarely match, the adaptive
+    fallback latches drafting off, probation re-enables it after the
+    configured plain-burst count, and it latches again — while the
+    stream stays byte-identical to plain decode (verify replays the
+    decode RNG schedule)."""
+    alphabet = [21, 22, 23, 24]
+    prompt = de_bruijn(alphabet, 3)
+    sampling = SamplingParams(
+        max_tokens=32, temperature=1.0, seed=7, ignore_eos=True,
+        logit_bias={t: 100.0 for t in alphabet})
+    reqs = [(prompt, sampling)]
+    off = make_engine(**SPEC_CFG)
+    try:
+        expected = run(off, reqs)
+        off_tpf = tokens_per_forward(off)
+    finally:
+        off.stop()
+    on = make_engine(speculative_num_tokens=4, speculative_accept_window=6,
+                     speculative_draft_probation=3,
+                     speculative_draft_model="tiny-mixtral", **SPEC_CFG)
+    try:
+        got = run(on, reqs)
+        on_tpf = tokens_per_forward(on)
+        assert on.spec_proposed_by_source["draft_model"] > 0
+        assert on.spec_disabled_requests_total >= 2, (
+            "probation must retry after the latch and latch again on a "
+            "persistently wrong drafter")
+    finally:
+        on.stop()
+    assert got == expected
+    assert on_tpf >= off_tpf - 1e-9, (on_tpf, off_tpf)
+
+
+def test_draft_model_structured_composes_streams_equal():
+    """FSM-constrained drafting: the drafter samples under the same
+    token mask verify applies, so structured requests keep drafting
+    instead of wasting proposals on out-of-grammar tokens — streams
+    match the plain engine and the grammar is never violated."""
+    body = {"temperature": 0, "max_tokens": 16,
+            "guided_regex": "[ab]{6,12}"}
+    ref = make_engine(**SPEC_CFG)
+    try:
+        prompt = ref.tokenizer.encode("value:")
+        expected = _collect_structured(ref, prompt, body, "s1")
+    finally:
+        ref.stop()
+    eng = make_engine(speculative_num_tokens=4,
+                      speculative_draft_model="tiny-llama", **SPEC_CFG)
+    try:
+        got = _collect_structured(eng, prompt, body, "s1")
+        assert eng.stats()["structured_violations_total"] == 0
+        assert eng.spec_accepted_by_source["draft_model"] > 0, (
+            "masked greedy drafts from an identical drafter must be "
+            "accepted under the grammar")
+    finally:
+        eng.stop()
+    assert got == expected
+
+
+def _collect_structured(engine, prompt_ids, body, rid, timeout=300):
+    q = queue.Queue()
+    engine.add_request(rid, list(prompt_ids),
+                       SamplingParams.from_request(body),
+                       lambda t, f: q.put((t, f)))
+    tokens = []
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            token, finish = q.get(timeout=10)
+        except queue.Empty:
+            continue
+        if token is not None:
+            tokens.append(token)
+        if finish is not None:
+            return tokens, finish
+    raise TimeoutError(rid)
+
+
+def test_draft_model_preempt_resume_streams_equal():
+    """Preemption frees the drafter's pages through the target-KV free
+    hook; resume re-runs prefill and the drafter catch-up re-feeds the
+    whole context — streams still match plain decode with ample KV."""
+    reqs = [
+        ([5, 6, 7, 8] * 2, greedy(60)),
+        ([9, 10, 11, 12] * 12, greedy(60)),
+    ]
+    ref = make_engine(**SPEC_CFG)
+    try:
+        expected = run(ref, reqs)
+    finally:
+        ref.stop()
+    tight = dict(SPEC_CFG, num_blocks=16)
+    eng = make_engine(speculative_num_tokens=4,
+                      speculative_draft_model="tiny-llama", **tight)
+    try:
+        got = run(eng, reqs)
+        assert eng.scheduler.num_preempted_total >= 1
+    finally:
+        eng.stop()
+    assert got == expected
+
+
+def test_draft_model_chunked_prefill_streams_equal():
+    reqs = [
+        ([5, 6, 7, 8] * 15, greedy(16)),
+        ([9, 10, 11] * 4, greedy(16)),
+    ]
+    ref = make_engine(**SPEC_CFG)
+    try:
+        expected = run(ref, reqs)
+    finally:
+        ref.stop()
+    eng = make_engine(speculative_num_tokens=4, enable_chunked_prefill=True,
+                      max_num_batched_tokens=32,
+                      speculative_draft_model="tiny-llama", **SPEC_CFG)
+    try:
+        got = run(eng, reqs)
+        assert eng.prefill_chunks_total >= 2
+        assert eng.spec_verify_bursts_total >= 1
+    finally:
+        eng.stop()
+    assert got == expected
+
+
+# ---------------------------------------------------------------------------
 # /metrics surface
 # ---------------------------------------------------------------------------
 
@@ -292,18 +458,28 @@ def test_spec_metrics_exported_over_http():
                 async with s.get(url + "/metrics") as r:
                     text = await r.text()
             metrics = {}
+            lines = []
             for ln in text.splitlines():
                 if ln.startswith(("tpu:spec_", "tpu:decode_forward_steps")):
                     metrics[ln.split("{")[0]] = float(ln.rsplit(" ", 1)[1])
+                    lines.append(ln)
             for name in ("tpu:spec_proposed_tokens_total",
                          "tpu:spec_accepted_tokens_total",
                          "tpu:spec_acceptance_rate",
                          "tpu:spec_disabled_requests_total",
                          "tpu:spec_verify_bursts_total",
+                         "tpu:spec_draft_forward_steps_total",
                          "tpu:decode_forward_steps_total"):
                 assert name in metrics, (name, sorted(metrics))
             assert metrics["tpu:decode_forward_steps_total"] > 0
             assert 0.0 <= metrics["tpu:spec_acceptance_rate"] <= 1.0
+            # Proposed/accepted export per-source: both label values
+            # always present (a vanished series is indistinguishable
+            # from a zero rate).
+            for src in ("ngram", "draft_model"):
+                assert any(
+                    ln.startswith("tpu:spec_proposed_tokens_total")
+                    and f'source="{src}"' in ln for ln in lines), (src, lines)
         asyncio.run(go())
     finally:
         loop.call_soon_threadsafe(loop.stop)
